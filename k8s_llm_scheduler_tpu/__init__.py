@@ -1,0 +1,34 @@
+"""k8s_llm_scheduler_tpu — a TPU-native LLM-driven Kubernetes scheduler framework.
+
+A from-scratch rebuild of the capabilities of AshishGautamX/K8s-LLM-Scheduler
+(reference: /root/reference/scheduler.py), designed TPU-first:
+
+- The reference calls Llama-3.3-70B through the HuggingFace Inference API
+  (reference scheduler.py:425-433). Here the decision LLM is an in-tree
+  JAX/XLA inference engine: jit'd prefill + autoregressive decode, weights
+  GSPMD-sharded over a `jax.sharding.Mesh`, paged KV cache, continuous
+  batching of pending-pod prompts, and constrained JSON decoding.
+- The control plane (watch -> metrics -> prompt -> decide -> validate -> bind,
+  with decision cache / retries / circuit breaker / heuristic fallbacks,
+  reference scheduler.py:625-770) is kept as the behavioral contract and
+  rebuilt as a genuinely async loop over a pluggable cluster interface.
+
+Package layout:
+    core/          pure decision logic: cache, breaker, fallback, prompt
+    cluster/       ClusterState + Binder protocols; fake + kubernetes impls
+    models/        Llama family in functional JAX (RMSNorm, RoPE, GQA, SwiGLU)
+    ops/           attention ops incl. Pallas TPU kernels
+    parallel/      mesh construction, partition specs, ring attention
+    engine/        paged KV cache, prefill/decode, sampling, batching, backends
+    sched/         the scheduling control loop and stats
+    observability/ metrics endpoint, phase tracing
+    utils/         unit parsers, JSON extraction, tokenizers
+"""
+
+__version__ = "0.1.0"
+
+from k8s_llm_scheduler_tpu.types import (  # noqa: F401
+    NodeMetrics,
+    PodSpec,
+    SchedulingDecision,
+)

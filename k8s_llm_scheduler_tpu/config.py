@@ -1,0 +1,199 @@
+"""Layered configuration: env var > config.yaml > hardcoded default.
+
+Behavioral parity with the reference's config system (reference
+scheduler.py:46-66): YAML loaded once, env vars override YAML, hardcoded
+defaults under both (scheduler.py:55-60). The reference's env names
+(SCHEDULER_NAME, LLM_MODEL, LLM_TIMEOUT, MAX_RETRIES — scheduler.py:56-60)
+keep working.
+
+Differences, on purpose:
+- No hard process exit on a missing API token (the reference sys.exit(1)s
+  without HUGGINGFACE_TOKEN, scheduler.py:62-66) — the TPU build needs no
+  token because the model is in-tree; zero external API calls is the point.
+- The reference's dead keys (SURVEY §5: scheduler.watch_interval,
+  llm.retry_delay, logging.*, metrics.*, circuit_breaker.half_open_max_calls)
+  are all LIVE here: the watch loop honors watch_interval, retry_delay seeds
+  the backoff, the metrics block drives the real :9090 endpoint.
+- The llm block gains the north-star TPU fields: mesh, sharding, max_batch,
+  plus engine geometry (page_size, max_prefill_tokens, buckets).
+"""
+
+from __future__ import annotations
+
+import copy
+import dataclasses
+import os
+from pathlib import Path
+from typing import Any
+
+import yaml
+
+_MISSING = object()
+
+
+DEFAULTS: dict[str, Any] = {
+    "scheduler": {
+        "name": "ai-llama-scheduler",
+        "namespace": "kube-system",
+        "watch_interval": 60,  # watch re-list timeout seconds (live, unlike ref)
+        "error_backoff_seconds": 5.0,  # scheduler.py:685
+    },
+    "llm": {
+        "model": "llama-3.2-1b-instruct",
+        "backend": "local",  # local | stub
+        "timeout": 60,
+        "max_retries": 3,
+        "retry_delay": 1.0,  # base of exponential backoff (live, unlike ref)
+        "temperature": 0.3,  # config.yaml:13
+        "max_tokens": 200,  # config.yaml:14
+        "constrained_json": True,
+        # --- TPU engine geometry (north star: mesh/sharding/max_batch) ---
+        "mesh": {"dp": 1, "tp": 1},
+        "sharding": "tensor_parallel",
+        "max_batch": 8,
+        "page_size": 128,
+        "max_pages_per_seq": 64,
+        "prefill_buckets": [256, 512, 1024, 2048, 4096, 8192],
+        "checkpoint_path": None,
+    },
+    "cache": {
+        "enabled": True,
+        "ttl_seconds": 300,  # config.yaml:19
+        "max_size": 100,  # config.yaml:20
+    },
+    "logging": {
+        "level": "INFO",
+        "format": "text",  # text | json
+        "file": None,
+    },
+    "metrics": {
+        "enabled": False,
+        "port": 9090,  # config.yaml:31 — made real by observability/metrics.py
+    },
+    "fallback": {
+        "enabled": True,
+        "strategy": "resource_balanced",  # config.yaml:36
+    },
+    "circuit_breaker": {
+        "enabled": True,
+        "failure_threshold": 5,  # config.yaml:41
+        "timeout": 60,  # config.yaml:42
+        "half_open_max_calls": 1,
+    },
+}
+
+# Env var name -> dotted config path (reference scheduler.py:56-60 names kept).
+ENV_OVERRIDES: dict[str, str] = {
+    "SCHEDULER_NAME": "scheduler.name",
+    "SCHEDULER_NAMESPACE": "scheduler.namespace",
+    "LLM_MODEL": "llm.model",
+    "LLM_BACKEND": "llm.backend",
+    "LLM_TIMEOUT": "llm.timeout",
+    "LLM_MAX_BATCH": "llm.max_batch",
+    "LLM_CHECKPOINT_PATH": "llm.checkpoint_path",
+    "MAX_RETRIES": "llm.max_retries",
+    "CACHE_ENABLED": "cache.enabled",
+    "CACHE_TTL": "cache.ttl_seconds",
+    "CACHE_MAX_SIZE": "cache.max_size",
+    "LOG_LEVEL": "logging.level",
+    "LOG_FORMAT": "logging.format",
+    "METRICS_ENABLED": "metrics.enabled",
+    "METRICS_PORT": "metrics.port",
+    "FALLBACK_STRATEGY": "fallback.strategy",
+}
+
+
+def _coerce(value: str, template: Any) -> Any:
+    """Coerce an env string to the type of the default it overrides."""
+    if isinstance(template, bool):
+        return value.strip().lower() in ("1", "true", "yes", "on")
+    if isinstance(template, int):
+        return int(value)
+    if isinstance(template, float):
+        return float(value)
+    return value
+
+
+def _deep_merge(base: dict[str, Any], override: dict[str, Any]) -> dict[str, Any]:
+    merged = dict(base)
+    for key, val in override.items():
+        if isinstance(val, dict) and isinstance(merged.get(key), dict):
+            merged[key] = _deep_merge(merged[key], val)
+        else:
+            merged[key] = val
+    return merged
+
+
+@dataclasses.dataclass
+class Config:
+    """Resolved configuration tree with dotted-path access."""
+
+    data: dict[str, Any]
+
+    def get(self, path: str, default: Any = _MISSING) -> Any:
+        node: Any = self.data
+        for part in path.split("."):
+            if not isinstance(node, dict) or part not in node:
+                if default is _MISSING:
+                    raise KeyError(path)
+                return default
+            node = node[part]
+        return node
+
+    def section(self, name: str) -> dict[str, Any]:
+        value = self.data.get(name, {})
+        return value if isinstance(value, dict) else {}
+
+    def __getitem__(self, path: str) -> Any:
+        return self.get(path)
+
+
+def load_config(
+    yaml_path: str | os.PathLike[str] | None = None,
+    env: dict[str, str] | None = None,
+) -> Config:
+    """Resolve config with precedence env > yaml > defaults
+    (reference scheduler.py:55-60).
+
+    `yaml_path` defaults to ./config.yaml next to the caller's CWD if present
+    (the reference loads from its own directory, scheduler.py:46-52).
+    `env` defaults to os.environ; injectable for tests.
+    """
+    data = copy.deepcopy(DEFAULTS)
+
+    if yaml_path is None:
+        candidate = Path("config.yaml")
+        yaml_path = candidate if candidate.exists() else None
+    if yaml_path is not None:
+        raw = Path(yaml_path).read_text()
+        loaded = yaml.safe_load(raw) or {}
+        if not isinstance(loaded, dict):
+            raise ValueError(f"config file {yaml_path} must contain a mapping")
+        for key, val in loaded.items():
+            if key in DEFAULTS and isinstance(DEFAULTS[key], dict) and not isinstance(val, dict):
+                raise ValueError(
+                    f"config file {yaml_path}: section {key!r} must be a mapping, got {type(val).__name__}"
+                )
+        data = _deep_merge(data, loaded)
+
+    env_map = os.environ if env is None else env
+    for env_name, dotted in ENV_OVERRIDES.items():
+        if env_name in env_map:
+            parts = dotted.split(".")
+            node = data
+            for part in parts[:-1]:
+                node = node.setdefault(part, {})
+                if not isinstance(node, dict):
+                    raise ValueError(
+                        f"cannot apply env var {env_name}: config section "
+                        f"{'.'.join(parts[:-1])!r} is not a mapping"
+                    )
+            template = node.get(parts[-1])
+            try:
+                node[parts[-1]] = _coerce(env_map[env_name], template)
+            except ValueError as exc:
+                raise ValueError(
+                    f"invalid value for env var {env_name}={env_map[env_name]!r}: {exc}"
+                ) from exc
+
+    return Config(data)

@@ -1,0 +1,1 @@
+"""Pure decision-plane logic: cache, circuit breaker, fallback, prompt."""

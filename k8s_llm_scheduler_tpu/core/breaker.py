@@ -1,0 +1,132 @@
+"""Circuit breaker for the decision backend.
+
+Behavioral parity with the reference's CircuitBreaker (reference
+scheduler.py:299-332): CLOSED / OPEN / HALF_OPEN states (scheduler.py:307);
+opens after `failure_threshold` consecutive failures (scheduler.py:329-331);
+OPEN transitions to HALF_OPEN after `timeout_seconds` (scheduler.py:311-314);
+a success in HALF_OPEN closes the breaker and resets the failure count
+(scheduler.py:320-323). Defaults threshold=5, timeout=60s (config.yaml:41-42).
+
+Improvements over the reference:
+- A typed `CircuitOpenError` instead of matching the string
+  "Circuit breaker is OPEN" upstream (the reference matches by substring at
+  scheduler.py:404 — fragile).
+- Thread-safe: the continuous-batching engine calls through the breaker from
+  multiple tasks.
+- In the TPU build the breaker guards *device health* (engine failures, XLA
+  errors, TPU-VM liveness probes) rather than a remote HTTP API — same state
+  machine, repointed per the north star (SURVEY §2.3).
+"""
+
+from __future__ import annotations
+
+import enum
+import threading
+import time
+from typing import Any, Callable, TypeVar
+
+T = TypeVar("T")
+
+
+class CircuitState(enum.Enum):
+    CLOSED = "closed"
+    OPEN = "open"
+    HALF_OPEN = "half_open"
+
+
+class CircuitOpenError(RuntimeError):
+    """Raised when a call is rejected because the breaker is OPEN."""
+
+
+class CircuitBreaker:
+    def __init__(
+        self,
+        failure_threshold: int = 5,
+        timeout_seconds: float = 60.0,
+        half_open_max_calls: int = 1,
+    ) -> None:
+        self.failure_threshold = int(failure_threshold)
+        self.timeout_seconds = float(timeout_seconds)
+        self.half_open_max_calls = int(half_open_max_calls)
+        self._state = CircuitState.CLOSED
+        self._failure_count = 0
+        self._opened_at = 0.0
+        self._half_open_inflight = 0
+        self._lock = threading.Lock()
+        self.trip_count = 0
+
+    @property
+    def state(self) -> CircuitState:
+        with self._lock:
+            return self._effective_state()
+
+    def _effective_state(self) -> CircuitState:
+        """OPEN decays to HALF_OPEN after the cooldown (scheduler.py:311-314)."""
+        if (
+            self._state is CircuitState.OPEN
+            and time.monotonic() - self._opened_at >= self.timeout_seconds
+        ):
+            self._state = CircuitState.HALF_OPEN
+        return self._state
+
+    def call(self, func: Callable[..., T], *args: Any, **kwargs: Any) -> T:
+        """Run `func` through the breaker (reference scheduler.py:309-332).
+
+        In HALF_OPEN at most `half_open_max_calls` probes run concurrently
+        (the reference declares this knob at config.yaml:43 but never reads
+        it); excess callers get CircuitOpenError rather than hammering a
+        backend that is still being probed.
+        """
+        half_open_probe = False
+        with self._lock:
+            state = self._effective_state()
+            if state is CircuitState.OPEN:
+                raise CircuitOpenError(
+                    f"circuit open for {self.timeout_seconds - (time.monotonic() - self._opened_at):.1f}s more"
+                )
+            if state is CircuitState.HALF_OPEN:
+                if self._half_open_inflight >= self.half_open_max_calls:
+                    raise CircuitOpenError("circuit half-open, probe already in flight")
+                self._half_open_inflight += 1
+                half_open_probe = True
+        try:
+            result = func(*args, **kwargs)
+        except Exception:
+            self.record_failure()
+            raise
+        else:
+            self.record_success()
+            return result
+        finally:
+            if half_open_probe:
+                with self._lock:
+                    self._half_open_inflight -= 1
+
+    def record_success(self) -> None:
+        with self._lock:
+            if self._effective_state() is CircuitState.HALF_OPEN:
+                self._state = CircuitState.CLOSED
+            self._failure_count = 0
+
+    def record_failure(self) -> None:
+        with self._lock:
+            self._failure_count += 1
+            state = self._effective_state()
+            if state is CircuitState.HALF_OPEN or self._failure_count >= self.failure_threshold:
+                if self._state is not CircuitState.OPEN:
+                    self.trip_count += 1
+                self._state = CircuitState.OPEN
+                self._opened_at = time.monotonic()
+
+    def reset(self) -> None:
+        with self._lock:
+            self._state = CircuitState.CLOSED
+            self._failure_count = 0
+
+    def stats(self) -> dict[str, Any]:
+        with self._lock:
+            return {
+                "state": self._effective_state().value,
+                "failure_count": self._failure_count,
+                "trips": self.trip_count,
+            }

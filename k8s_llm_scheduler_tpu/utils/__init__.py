@@ -1,0 +1,1 @@
+"""Dependency-free utilities: unit parsing, JSON extraction, tokenization."""

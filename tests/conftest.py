@@ -1,0 +1,79 @@
+"""Test harness config: run JAX on a virtual 8-device CPU mesh.
+
+Must run before any `import jax` (pytest imports conftest first), so the
+multi-chip sharding paths are exercised hermetically without TPU hardware.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import pytest  # noqa: E402
+
+from k8s_llm_scheduler_tpu.types import NodeMetrics, PodSpec  # noqa: E402
+
+
+def make_node(
+    name: str = "node-1",
+    cpu_pct: float = 30.0,
+    mem_pct: float = 40.0,
+    cpu_cores: float = 8.0,
+    mem_gb: float = 32.0,
+    pods: int = 10,
+    max_pods: int = 110,
+    ready: bool = True,
+    labels: dict | None = None,
+    taints: tuple = (),
+) -> NodeMetrics:
+    return NodeMetrics(
+        name=name,
+        cpu_usage_percent=cpu_pct,
+        memory_usage_percent=mem_pct,
+        available_cpu_cores=cpu_cores,
+        available_memory_gb=mem_gb,
+        pod_count=pods,
+        max_pods=max_pods,
+        labels=labels or {},
+        taints=taints,
+        conditions={"Ready": "True" if ready else "False"},
+    )
+
+
+def make_pod(
+    name: str = "pod-1",
+    namespace: str = "default",
+    cpu: float = 0.1,
+    mem_gb: float = 0.125,
+    priority: int = 0,
+    node_selector: dict | None = None,
+    tolerations: tuple = (),
+) -> PodSpec:
+    return PodSpec(
+        name=name,
+        namespace=namespace,
+        cpu_request=cpu,
+        memory_request=mem_gb,
+        node_selector=node_selector or {},
+        tolerations=tolerations,
+        priority=priority,
+    )
+
+
+@pytest.fixture
+def three_nodes():
+    """A 3-node cluster like the reference's Minikube setup (README.md:70)."""
+    return [
+        make_node("node-a", cpu_pct=20.0, mem_pct=30.0, pods=5),
+        make_node("node-b", cpu_pct=60.0, mem_pct=50.0, pods=20),
+        make_node("node-c", cpu_pct=90.0, mem_pct=85.0, pods=60),
+    ]

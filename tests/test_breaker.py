@@ -1,0 +1,108 @@
+"""Circuit breaker state machine (parity: reference scheduler.py:299-332)."""
+
+import pytest
+
+from k8s_llm_scheduler_tpu.core.breaker import (
+    CircuitBreaker,
+    CircuitOpenError,
+    CircuitState,
+)
+
+
+def boom():
+    raise ValueError("backend failure")
+
+
+class TestCircuitBreaker:
+    def test_starts_closed(self):
+        assert CircuitBreaker().state is CircuitState.CLOSED
+
+    def test_opens_after_threshold_failures(self):
+        cb = CircuitBreaker(failure_threshold=3, timeout_seconds=60)
+        for _ in range(3):
+            with pytest.raises(ValueError):
+                cb.call(boom)
+        assert cb.state is CircuitState.OPEN
+        assert cb.trip_count == 1
+
+    def test_open_rejects_calls(self):
+        cb = CircuitBreaker(failure_threshold=1, timeout_seconds=60)
+        with pytest.raises(ValueError):
+            cb.call(boom)
+        with pytest.raises(CircuitOpenError):
+            cb.call(lambda: "never runs")
+
+    def test_success_resets_failure_count(self):
+        cb = CircuitBreaker(failure_threshold=3)
+        for _ in range(2):
+            with pytest.raises(ValueError):
+                cb.call(boom)
+        assert cb.call(lambda: "ok") == "ok"
+        for _ in range(2):
+            with pytest.raises(ValueError):
+                cb.call(boom)
+        assert cb.state is CircuitState.CLOSED  # count was reset
+
+    def test_open_decays_to_half_open_after_timeout(self):
+        cb = CircuitBreaker(failure_threshold=1, timeout_seconds=0.0)
+        with pytest.raises(ValueError):
+            cb.call(boom)
+        # timeout 0 -> immediately HALF_OPEN (scheduler.py:311-314)
+        assert cb.state is CircuitState.HALF_OPEN
+
+    def test_half_open_success_closes(self):
+        cb = CircuitBreaker(failure_threshold=1, timeout_seconds=0.0)
+        with pytest.raises(ValueError):
+            cb.call(boom)
+        assert cb.call(lambda: 42) == 42  # probe succeeds (scheduler.py:320-323)
+        assert cb.state is CircuitState.CLOSED
+
+    def test_half_open_failure_reopens(self):
+        cb = CircuitBreaker(failure_threshold=5, timeout_seconds=0.0)
+        for _ in range(5):
+            with pytest.raises(ValueError):
+                cb.call(boom)
+        assert cb.state is CircuitState.HALF_OPEN
+        with pytest.raises(ValueError):
+            cb.call(boom)  # single failure in HALF_OPEN reopens immediately
+        # timeout=0 means it decays right back to HALF_OPEN; trip_count shows
+        # the reopen happened.
+        assert cb.trip_count == 2
+
+    def test_reset(self):
+        cb = CircuitBreaker(failure_threshold=1, timeout_seconds=60)
+        with pytest.raises(ValueError):
+            cb.call(boom)
+        cb.reset()
+        assert cb.state is CircuitState.CLOSED
+        assert cb.call(lambda: "ok") == "ok"
+
+
+class TestHalfOpenProbeLimit:
+    def test_half_open_limits_concurrent_probes(self):
+        import threading
+
+        cb = CircuitBreaker(failure_threshold=1, timeout_seconds=0.0, half_open_max_calls=1)
+        with pytest.raises(ValueError):
+            cb.call(boom)
+        assert cb.state is CircuitState.HALF_OPEN
+
+        release = threading.Event()
+        started = threading.Event()
+        results = {}
+
+        def slow_probe():
+            started.set()
+            release.wait(timeout=5)
+            return "probe-ok"
+
+        t = threading.Thread(target=lambda: results.update(a=cb.call(slow_probe)))
+        t.start()
+        started.wait(timeout=5)
+        # Second caller while the probe is in flight is rejected.
+        with pytest.raises(CircuitOpenError):
+            cb.call(lambda: "excess")
+        release.set()
+        t.join(timeout=5)
+        assert results["a"] == "probe-ok"
+        assert cb.state is CircuitState.CLOSED

@@ -1,0 +1,106 @@
+"""Decision cache (parity: reference scheduler.py:257-294)."""
+
+from k8s_llm_scheduler_tpu.core.cache import DecisionCache, decision_cache_key
+from k8s_llm_scheduler_tpu.types import DecisionSource, SchedulingDecision
+
+from conftest import make_node, make_pod
+
+
+def make_decision(node="node-a", fallback=False):
+    return SchedulingDecision(
+        selected_node=node,
+        confidence=0.9,
+        reasoning="test",
+        fallback_needed=fallback,
+        source=DecisionSource.FALLBACK if fallback else DecisionSource.LLM,
+    )
+
+
+class TestCacheKey:
+    def test_same_state_same_key(self):
+        nodes = [make_node("a"), make_node("b")]
+        k1 = decision_cache_key(make_pod("p1", cpu=0.1), nodes)
+        k2 = decision_cache_key(make_pod("p2", cpu=0.1), nodes)
+        # Pod name is excluded — same resource shape means same key
+        # (reference scheduler.py:265-271).
+        assert k1 == k2
+
+    def test_different_resources_different_key(self):
+        nodes = [make_node("a")]
+        k1 = decision_cache_key(make_pod(cpu=0.1), nodes)
+        k2 = decision_cache_key(make_pod(cpu=0.2), nodes)
+        assert k1 != k2
+
+    def test_node_order_irrelevant(self):
+        a, b = make_node("a"), make_node("b", cpu_pct=70)
+        pod = make_pod()
+        assert decision_cache_key(pod, [a, b]) == decision_cache_key(pod, [b, a])
+
+    def test_node_load_change_changes_key(self):
+        pod = make_pod()
+        k1 = decision_cache_key(pod, [make_node("a", cpu_pct=10)])
+        k2 = decision_cache_key(pod, [make_node("a", cpu_pct=90)])
+        assert k1 != k2
+
+    def test_priority_in_key(self):
+        nodes = [make_node("a")]
+        assert decision_cache_key(make_pod(priority=0), nodes) != decision_cache_key(
+            make_pod(priority=10), nodes
+        )
+
+
+class TestDecisionCache:
+    def test_miss_then_hit(self):
+        cache = DecisionCache()
+        pod, nodes = make_pod(), [make_node()]
+        assert cache.get(pod, nodes) is None
+        cache.set(pod, nodes, make_decision())
+        hit = cache.get(pod, nodes)
+        assert hit is not None and hit.selected_node == "node-a"
+        assert cache.stats() == {"size": 1, "hits": 1, "misses": 1}
+
+    def test_ttl_expiry_on_read(self):
+        cache = DecisionCache(ttl_seconds=0.0)
+        pod, nodes = make_pod(), [make_node()]
+        cache.set(pod, nodes, make_decision())
+        import time
+
+        time.sleep(0.01)
+        assert cache.get(pod, nodes) is None  # expired (scheduler.py:278-282)
+        assert len(cache) == 0
+
+    def test_size_cap_evicts_oldest(self):
+        cache = DecisionCache(max_size=2)
+        n1, n2, n3 = [make_node("x")], [make_node("y")], [make_node("z")]
+        pod = make_pod()
+        cache.set(pod, n1, make_decision("x"))
+        cache.set(pod, n2, make_decision("y"))
+        cache.set(pod, n3, make_decision("z"))
+        assert len(cache) == 2
+        assert cache.get(pod, n1) is None  # oldest evicted (scheduler.py:287-290)
+        assert cache.get(pod, n3).selected_node == "z"
+
+    def test_fallback_decisions_never_cached(self):
+        cache = DecisionCache()
+        pod, nodes = make_pod(), [make_node()]
+        cache.set(pod, nodes, make_decision(fallback=True))
+        assert len(cache) == 0  # scheduler.py:398-399
+
+
+class TestConstraintsInKey:
+    def test_node_selector_in_key(self):
+        """Unlike the reference (scheduler.py:265-271), placement constraints
+        are part of the key so a constrained pod never reuses an unconstrained
+        pod's cached node."""
+        nodes = [make_node("a")]
+        k1 = decision_cache_key(make_pod(), nodes)
+        k2 = decision_cache_key(make_pod(node_selector={"gpu": "true"}), nodes)
+        assert k1 != k2
+
+    def test_tolerations_in_key(self):
+        nodes = [make_node("a")]
+        k1 = decision_cache_key(make_pod(), nodes)
+        k2 = decision_cache_key(
+            make_pod(tolerations=({"key": "gpu", "effect": "NoSchedule"},)), nodes
+        )
+        assert k1 != k2

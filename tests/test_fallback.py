@@ -1,0 +1,60 @@
+"""Fallback scoring (parity: reference scheduler.py:521-559; round_robin fixed)."""
+
+from k8s_llm_scheduler_tpu.core.fallback import (
+    FALLBACK_CONFIDENCE,
+    fallback_decision,
+    score_resource_balanced,
+)
+from k8s_llm_scheduler_tpu.types import DecisionSource
+
+from conftest import make_node
+
+
+class TestFallbackDecision:
+    def test_resource_balanced_picks_least_loaded(self, three_nodes):
+        d = fallback_decision(three_nodes, strategy="resource_balanced")
+        assert d.selected_node == "node-a"
+        assert d.fallback_needed is True
+        assert d.confidence == FALLBACK_CONFIDENCE
+        assert d.source is DecisionSource.FALLBACK
+
+    def test_resource_balanced_weights(self):
+        node = make_node("n", cpu_pct=40, mem_pct=60, pods=55, max_pods=110)
+        # 0.35*60 + 0.35*40 + 0.30*50 = 21 + 14 + 15 = 50 (scheduler.py:537-541)
+        assert abs(score_resource_balanced(node) - 50.0) < 1e-9
+
+    def test_least_loaded(self, three_nodes):
+        d = fallback_decision(three_nodes, strategy="least_loaded")
+        assert d.selected_node == "node-a"
+
+    def test_round_robin_prefers_fewest_pods(self):
+        nodes = [
+            make_node("busy", pods=50),
+            make_node("idle", pods=2),
+            make_node("mid", pods=20),
+        ]
+        d = fallback_decision(nodes, strategy="round_robin")
+        # The reference's round_robin argmaxes pod_count, picking the MOST
+        # loaded node despite its "prefer fewer pods" comment
+        # (scheduler.py:544-545). We implement the documented intent.
+        assert d.selected_node == "idle"
+
+    def test_not_ready_nodes_excluded(self):
+        nodes = [
+            make_node("down", cpu_pct=0, ready=False),
+            make_node("up", cpu_pct=99),
+        ]
+        d = fallback_decision(nodes)
+        assert d.selected_node == "up"  # scheduler.py:532-535
+
+    def test_no_ready_nodes_returns_none(self):
+        assert fallback_decision([make_node("down", ready=False)]) is None
+        assert fallback_decision([]) is None
+
+    def test_unknown_strategy_defaults_to_resource_balanced(self, three_nodes):
+        d = fallback_decision(three_nodes, strategy="nonsense")
+        assert d.selected_node == "node-a"
+
+    def test_reason_recorded(self, three_nodes):
+        d = fallback_decision(three_nodes, reason="circuit_open")
+        assert "circuit_open" in d.reasoning

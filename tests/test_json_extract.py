@@ -1,0 +1,78 @@
+"""JSON extraction (parity: reference scheduler.py:474-519, 3 strategies)."""
+
+from k8s_llm_scheduler_tpu.utils.json_extract import (
+    extract_json,
+    parse_decision_json,
+)
+
+DECISION = '{"selected_node": "node-a", "confidence": 0.9, "reasoning": "low load"}'
+
+
+class TestExtractJson:
+    def test_bare_json(self):
+        assert extract_json(DECISION)["selected_node"] == "node-a"
+
+    def test_fenced_block(self):
+        text = f"Here is my answer:\n```json\n{DECISION}\n```\nDone."
+        assert extract_json(text)["selected_node"] == "node-a"
+
+    def test_fence_without_language_tag(self):
+        text = f"```\n{DECISION}\n```"
+        assert extract_json(text)["selected_node"] == "node-a"
+
+    def test_last_balanced_object_wins(self):
+        text = '{"selected_node": "old"} some chatter {"selected_node": "new", "confidence": 1.0}'
+        assert extract_json(text)["selected_node"] == "new"
+
+    def test_falls_back_to_earlier_object_when_last_is_broken(self):
+        text = f'{DECISION} trailing {{"broken": '
+        assert extract_json(text)["selected_node"] == "node-a"
+
+    def test_braces_inside_strings(self):
+        text = '{"selected_node": "node-a", "reasoning": "has {braces} inside"}'
+        obj = extract_json(text)
+        assert obj["reasoning"] == "has {braces} inside"
+
+    def test_escaped_quotes(self):
+        text = '{"selected_node": "node-a", "reasoning": "said \\"ok\\" {x}"}'
+        assert extract_json(text)["selected_node"] == "node-a"
+
+    def test_surrounding_prose(self):
+        text = f"I think the best choice is:\n\n{DECISION}\n\nbecause it has low load."
+        assert extract_json(text)["selected_node"] == "node-a"
+
+    def test_no_json(self):
+        assert extract_json("no json here at all") is None
+        assert extract_json("") is None
+        assert extract_json("{unclosed") is None
+
+    def test_non_object_json_rejected(self):
+        assert extract_json("[1, 2, 3]") is None
+
+
+class TestParseDecisionJson:
+    def test_full_decision(self):
+        d = parse_decision_json(DECISION)
+        assert d == {
+            "selected_node": "node-a",
+            "confidence": 0.9,
+            "reasoning": "low load",
+        }
+
+    def test_missing_node_rejected(self):
+        assert parse_decision_json('{"confidence": 0.9}') is None
+
+    def test_confidence_clamped(self):
+        d = parse_decision_json('{"selected_node": "n", "confidence": 7}')
+        assert d["confidence"] == 1.0
+        d = parse_decision_json('{"selected_node": "n", "confidence": -1}')
+        assert d["confidence"] == 0.0
+
+    def test_confidence_defaulted(self):
+        d = parse_decision_json('{"selected_node": "n"}')
+        assert d["confidence"] == 0.5
+        assert d["reasoning"] == ""
+
+    def test_bad_confidence_type(self):
+        d = parse_decision_json('{"selected_node": "n", "confidence": "high"}')
+        assert d["confidence"] == 0.5

@@ -1,0 +1,78 @@
+"""Prompt engine (parity: reference scheduler.py:192-252, prefix-cacheable)."""
+
+from k8s_llm_scheduler_tpu.core.prompt import (
+    PromptEngine,
+    SYSTEM_PROMPT,
+    cluster_prefix,
+    pod_suffix,
+)
+
+from conftest import make_node, make_pod
+
+
+class TestPrompt:
+    def test_system_prompt_demands_json_schema(self):
+        assert "selected_node" in SYSTEM_PROMPT
+        assert "confidence" in SYSTEM_PROMPT
+        assert "reasoning" in SYSTEM_PROMPT
+        assert "JSON" in SYSTEM_PROMPT
+
+    def test_prompt_contains_all_nodes_and_pod(self, three_nodes):
+        engine = PromptEngine()
+        pod = make_pod("web-1", cpu=0.5, mem_gb=0.5)
+        prompt = engine.construct_scheduling_prompt(pod, three_nodes)
+        for node in three_nodes:
+            assert node.name in prompt
+        assert "web-1" in prompt
+        assert "0.500 cores" in prompt
+
+    def test_valid_node_names_line(self, three_nodes):
+        prompt = PromptEngine().construct_scheduling_prompt(make_pod(), three_nodes)
+        assert "VALID NODE NAMES: [node-a, node-b, node-c]" in prompt
+
+    def test_cluster_prefix_is_shared_across_pods(self, three_nodes):
+        """The burst-equivalence property the prefix cache exploits: different
+        pods against the same snapshot share the whole cluster prefix."""
+        engine = PromptEngine()
+        prefix1, tail1 = engine.split_prompt(make_pod("p1", cpu=0.1), three_nodes)
+        prefix2, tail2 = engine.split_prompt(make_pod("p2", cpu=2.0), three_nodes)
+        assert prefix1 == prefix2
+        assert tail1 != tail2
+        assert prefix1 + tail1 == engine.construct_scheduling_prompt(
+            make_pod("p1", cpu=0.1), three_nodes
+        )
+
+    def test_prefix_precedes_pod_block(self, three_nodes):
+        prompt = PromptEngine().construct_scheduling_prompt(make_pod(), three_nodes)
+        assert prompt.index("CLUSTER STATE") < prompt.index("POD TO SCHEDULE")
+
+    def test_node_selector_and_tolerations_rendered(self, three_nodes):
+        pod = make_pod(
+            node_selector={"disktype": "ssd"},
+            tolerations=({"key": "gpu", "effect": "NoSchedule"},),
+        )
+        tail = pod_suffix(pod)
+        assert "disktype=ssd" in tail
+        assert "gpu:NoSchedule" in tail
+
+    def test_taints_rendered(self):
+        node = make_node(
+            "tainted", taints=({"key": "gpu", "value": "true", "effect": "NoSchedule"},)
+        )
+        block = cluster_prefix([node])
+        assert "gpu=true:NoSchedule" in block
+
+    def test_boring_labels_filtered(self):
+        node = make_node(
+            "n",
+            labels={"kubernetes.io/hostname": "n", "disktype": "ssd"},
+        )
+        block = cluster_prefix([node])
+        assert "disktype=ssd" in block
+        assert "kubernetes.io/hostname" not in block
+
+    def test_prompt_linear_in_node_count(self):
+        """The long-context axis: prompt grows with node count (SURVEY §5)."""
+        small = cluster_prefix([make_node(f"n{i}") for i in range(4)])
+        large = cluster_prefix([make_node(f"n{i}") for i in range(64)])
+        assert len(large) > 10 * len(small)

@@ -1,0 +1,72 @@
+"""Unit parsers (parity: reference scheduler.py:172-187, 737-753)."""
+
+import math
+
+import pytest
+
+from k8s_llm_scheduler_tpu.utils.units import (
+    format_cpu,
+    format_memory_gb,
+    parse_cpu,
+    parse_memory_bytes,
+    parse_memory_gb,
+)
+
+
+class TestParseCpu:
+    @pytest.mark.parametrize(
+        "raw,expected",
+        [
+            ("100m", 0.1),
+            ("500m", 0.5),
+            ("1", 1.0),
+            ("2.5", 2.5),
+            ("1500m", 1.5),
+            ("0", 0.0),
+            ("", 0.0),
+            (None, 0.0),
+            (2, 2.0),
+            (0.25, 0.25),
+        ],
+    )
+    def test_values(self, raw, expected):
+        assert math.isclose(parse_cpu(raw), expected)
+
+    def test_whitespace(self):
+        assert parse_cpu(" 250m ") == 0.25
+
+
+class TestParseMemory:
+    @pytest.mark.parametrize(
+        "raw,expected_bytes",
+        [
+            ("128Mi", 128 * 1024**2),
+            ("1Gi", 1024**3),
+            ("512Ki", 512 * 1024),
+            ("2Ti", 2 * 1024**4),
+            ("1G", 1e9),
+            ("500M", 5e8),
+            ("1k", 1e3),
+            ("1024", 1024.0),
+            ("", 0.0),
+            (None, 0.0),
+        ],
+    )
+    def test_bytes(self, raw, expected_bytes):
+        assert math.isclose(parse_memory_bytes(raw), expected_bytes)
+
+    def test_gb(self):
+        assert math.isclose(parse_memory_gb("1Gi"), 1.0)
+        assert math.isclose(parse_memory_gb("512Mi"), 0.5)
+        assert math.isclose(parse_memory_gb("2048Mi"), 2.0)
+
+
+class TestFormat:
+    def test_cpu_roundtrip(self):
+        assert format_cpu(0.1) == "100m"
+        assert format_cpu(2.0) == "2"
+        assert parse_cpu(format_cpu(0.25)) == 0.25
+
+    def test_memory(self):
+        assert format_memory_gb(1.0) == "1Gi"
+        assert format_memory_gb(0.5) == "512Mi"

@@ -1,0 +1,62 @@
+"""Decision validation + feasibility (parity: reference scheduler.py:453-465)."""
+
+from k8s_llm_scheduler_tpu.core.validation import (
+    feasible_nodes,
+    resources_fit,
+    selector_matches,
+    tolerates_taints,
+    validate_decision,
+)
+from k8s_llm_scheduler_tpu.types import SchedulingDecision
+
+from conftest import make_node, make_pod
+
+
+def decision(node):
+    return SchedulingDecision(selected_node=node, confidence=0.9, reasoning="")
+
+
+class TestValidateDecision:
+    def test_known_node_accepted(self, three_nodes):
+        assert validate_decision(decision("node-b"), three_nodes)
+
+    def test_hallucinated_node_rejected(self, three_nodes):
+        assert not validate_decision(decision("node-x"), three_nodes)
+        assert not validate_decision(decision(""), three_nodes)
+
+
+class TestFeasibility:
+    def test_selector(self):
+        node = make_node("n", labels={"disktype": "ssd"})
+        assert selector_matches(make_pod(node_selector={"disktype": "ssd"}), node)
+        assert not selector_matches(make_pod(node_selector={"disktype": "hdd"}), node)
+        assert selector_matches(make_pod(), node)  # empty selector matches all
+
+    def test_taints(self):
+        tainted = make_node("n", taints=({"key": "gpu", "effect": "NoSchedule"},))
+        assert not tolerates_taints(make_pod(), tainted)
+        assert tolerates_taints(
+            make_pod(tolerations=({"key": "gpu", "effect": "NoSchedule"},)), tainted
+        )
+        assert tolerates_taints(
+            make_pod(tolerations=({"key": "gpu"},)), tainted
+        )  # effect-less toleration matches any effect
+        # PreferNoSchedule is soft — never blocks
+        soft = make_node("n", taints=({"key": "x", "effect": "PreferNoSchedule"},))
+        assert tolerates_taints(make_pod(), soft)
+
+    def test_resources(self):
+        node = make_node("n", cpu_cores=1.0, mem_gb=1.0, pods=109, max_pods=110)
+        assert resources_fit(make_pod(cpu=0.5, mem_gb=0.5), node)
+        assert not resources_fit(make_pod(cpu=2.0, mem_gb=0.5), node)
+        assert not resources_fit(make_pod(cpu=0.5, mem_gb=2.0), node)
+        full = make_node("n", pods=110, max_pods=110)
+        assert not resources_fit(make_pod(), full)
+
+    def test_feasible_nodes_composition(self, three_nodes):
+        nodes = three_nodes + [
+            make_node("down", ready=False),
+            make_node("tainted", taints=({"key": "x", "effect": "NoSchedule"},)),
+        ]
+        names = {n.name for n in feasible_nodes(make_pod(), nodes)}
+        assert names == {"node-a", "node-b", "node-c"}
