@@ -1,0 +1,8 @@
+"""Cluster interfaces: read (ClusterState), write (Binder), fake + kube impls."""
+
+from k8s_llm_scheduler_tpu.cluster.interface import (  # noqa: F401
+    Binder,
+    ClusterState,
+    RawPod,
+    raw_pod_to_spec,
+)

@@ -1,0 +1,205 @@
+"""Real Kubernetes cluster interface via the official client.
+
+Import-gated: the kubernetes package may be absent in hermetic environments;
+`KubeCluster.available()` reports whether the driver can be used. All
+behavior parity points:
+
+- node metrics: list nodes, extract labels/taints/conditions and allocatable
+  cpu/mem/pods (reference scheduler.py:121-170). The reference issues one
+  list-pods API call *per node* to count pods (scheduler.py:144-147 — the N+1
+  pattern SURVEY §7 flags); here a single list_pod_for_all_namespaces call is
+  bucketed by spec.nodeName, so a 256-node snapshot costs 2 API calls, not 257.
+- usage synthesis: (pods/max_pods)*50 when metrics-server is absent, exactly
+  the reference's stand-in (scheduler.py:149-151).
+- watch: list_pod_for_all_namespaces watch stream with timeout, filter
+  phase==Pending ∧ schedulerName==ours ∧ nodeName unset
+  (scheduler.py:657-676), bridged into asyncio via a reader thread so the
+  event loop never blocks (the reference's "async" loop blocks on the watch
+  generator, SURVEY §2 component 12).
+- binding: V1Binding with target kind=Node, _preload_content=False to dodge
+  the k8s-client Binding deserialization bug (scheduler.py:598-602).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import queue as queue_mod
+import threading
+from collections.abc import AsyncIterator, Sequence
+
+from k8s_llm_scheduler_tpu.cluster.interface import RawPod
+from k8s_llm_scheduler_tpu.types import NodeMetrics
+from k8s_llm_scheduler_tpu.utils.units import parse_cpu, parse_memory_gb
+
+logger = logging.getLogger(__name__)
+
+try:  # pragma: no cover - exercised only with a real cluster
+    from kubernetes import client as k8s_client
+    from kubernetes import config as k8s_config
+    from kubernetes import watch as k8s_watch
+    from kubernetes.client.rest import ApiException
+
+    _KUBERNETES_AVAILABLE = True
+except ImportError:  # pragma: no cover
+    k8s_client = k8s_config = k8s_watch = None
+    ApiException = Exception
+    _KUBERNETES_AVAILABLE = False
+
+
+def _pod_to_raw(pod) -> RawPod:
+    """V1Pod -> RawPod (field extraction parity: reference scheduler.py:731-764)."""
+    spec = pod.spec
+    requests = []
+    for container in spec.containers or []:
+        res = getattr(container, "resources", None)
+        req = getattr(res, "requests", None) or {}
+        requests.append({"cpu": req.get("cpu", ""), "memory": req.get("memory", "")})
+    tolerations = tuple(
+        {
+            "key": t.key or "",
+            "operator": t.operator or "",
+            "value": t.value or "",
+            "effect": t.effect or "",
+        }
+        for t in (spec.tolerations or [])
+    )
+    return RawPod(
+        name=pod.metadata.name,
+        namespace=pod.metadata.namespace,
+        phase=pod.status.phase or "Unknown",
+        scheduler_name=spec.scheduler_name or "",
+        node_name=spec.node_name,
+        container_requests=tuple(requests),
+        node_selector=dict(spec.node_selector or {}),
+        tolerations=tolerations,
+        priority=spec.priority or 0,
+        uid=pod.metadata.uid or "",
+    )
+
+
+class KubeCluster:  # pragma: no cover - requires a live cluster
+    """ClusterState + Binder against a real K8s API server."""
+
+    def __init__(self, watch_timeout_seconds: int = 60) -> None:
+        if not _KUBERNETES_AVAILABLE:
+            raise RuntimeError(
+                "kubernetes package not installed; use cluster.fake.FakeCluster"
+            )
+        try:
+            k8s_config.load_incluster_config()
+        except Exception:
+            k8s_config.load_kube_config()
+        self._v1 = k8s_client.CoreV1Api()
+        self._watch_timeout = watch_timeout_seconds
+        self._stop = threading.Event()
+
+    @staticmethod
+    def available() -> bool:
+        return _KUBERNETES_AVAILABLE
+
+    # ----------------------------------------------------------- ClusterState
+    def get_node_metrics(self) -> Sequence[NodeMetrics]:
+        nodes = self._v1.list_node().items
+        # ONE call for all pods, bucketed by node — not one call per node.
+        pods = self._v1.list_pod_for_all_namespaces().items
+        counts: dict[str, int] = {}
+        for pod in pods:
+            node_name = pod.spec.node_name
+            if node_name:
+                counts[node_name] = counts.get(node_name, 0) + 1
+
+        out = []
+        for node in nodes:
+            name = node.metadata.name
+            allocatable = node.status.allocatable or {}
+            cpu_cores = parse_cpu(allocatable.get("cpu", "0"))
+            mem_gb = parse_memory_gb(allocatable.get("memory", "0"))
+            max_pods = int(parse_cpu(allocatable.get("pods", "110")))
+            pod_count = counts.get(name, 0)
+            synthesized = (pod_count / max_pods) * 50.0 if max_pods else 0.0
+            conditions = {
+                c.type: c.status for c in (node.status.conditions or [])
+            }
+            taints = tuple(
+                {
+                    "key": t.key or "",
+                    "value": t.value or "",
+                    "effect": t.effect or "",
+                }
+                for t in (node.spec.taints or [])
+            )
+            out.append(
+                NodeMetrics(
+                    name=name,
+                    cpu_usage_percent=synthesized,
+                    memory_usage_percent=synthesized,
+                    available_cpu_cores=cpu_cores,
+                    available_memory_gb=mem_gb,
+                    pod_count=pod_count,
+                    max_pods=max_pods,
+                    labels=dict(node.metadata.labels or {}),
+                    taints=taints,
+                    conditions=conditions,
+                )
+            )
+        return out
+
+    async def watch_pending_pods(self, scheduler_name: str) -> AsyncIterator[RawPod]:
+        """Watch stream bridged thread->asyncio so the loop stays responsive."""
+        sync_queue: queue_mod.Queue[RawPod | None] = queue_mod.Queue()
+
+        def reader() -> None:
+            while not self._stop.is_set():
+                try:
+                    w = k8s_watch.Watch()
+                    for event in w.stream(
+                        self._v1.list_pod_for_all_namespaces,
+                        timeout_seconds=self._watch_timeout,
+                    ):
+                        if self._stop.is_set():
+                            break
+                        raw = _pod_to_raw(event["object"])
+                        if raw.needs_scheduling and raw.scheduler_name == scheduler_name:
+                            sync_queue.put(raw)
+                except Exception as exc:
+                    # Self-heal: log + brief sleep + re-watch (scheduler.py:683-685)
+                    logger.warning("watch stream error, re-watching: %s", exc)
+                    self._stop.wait(5.0)
+            sync_queue.put(None)
+
+        thread = threading.Thread(target=reader, daemon=True, name="k8s-watch")
+        thread.start()
+        loop = asyncio.get_running_loop()
+        while True:
+            raw = await loop.run_in_executor(None, sync_queue.get)
+            if raw is None:
+                return
+            yield raw
+
+    def close(self) -> None:
+        self._stop.set()
+
+    # ---------------------------------------------------------------- Binder
+    def bind_pod_to_node(self, pod_name: str, namespace: str, node_name: str) -> bool:
+        binding = k8s_client.V1Binding(
+            metadata=k8s_client.V1ObjectMeta(name=pod_name, namespace=namespace),
+            target=k8s_client.V1ObjectReference(
+                api_version="v1", kind="Node", name=node_name
+            ),
+        )
+        try:
+            self._v1.create_namespaced_binding(
+                namespace=namespace, body=binding, _preload_content=False
+            )
+            return True
+        except ApiException as exc:
+            logger.error(
+                "binding failed pod=%s/%s node=%s status=%s reason=%s",
+                namespace,
+                pod_name,
+                node_name,
+                getattr(exc, "status", "?"),
+                getattr(exc, "reason", "?"),
+            )
+            return False

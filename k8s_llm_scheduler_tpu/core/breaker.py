@@ -44,10 +44,14 @@ class CircuitBreaker:
         failure_threshold: int = 5,
         timeout_seconds: float = 60.0,
         half_open_max_calls: int = 1,
+        non_failure_exceptions: tuple[type[BaseException], ...] = (),
     ) -> None:
         self.failure_threshold = int(failure_threshold)
         self.timeout_seconds = float(timeout_seconds)
         self.half_open_max_calls = int(half_open_max_calls)
+        # Exceptions that propagate without counting as backend failures
+        # (e.g. "this pod is unschedulable" — a pod property, not ill health).
+        self.non_failure_exceptions = non_failure_exceptions
         self._state = CircuitState.CLOSED
         self._failure_count = 0
         self._opened_at = 0.0
@@ -91,6 +95,8 @@ class CircuitBreaker:
                 half_open_probe = True
         try:
             result = func(*args, **kwargs)
+        except self.non_failure_exceptions:
+            raise
         except Exception:
             self.record_failure()
             raise

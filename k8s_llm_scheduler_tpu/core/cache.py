@@ -50,7 +50,8 @@ def decision_cache_key(pod: PodSpec, nodes: Sequence[NodeMetrics]) -> str:
         h.update(f"|aff:{sorted(pod.affinity_rules.items())!r}".encode())
     for node in sorted(nodes, key=lambda n: n.name):
         h.update(
-            f"|{node.name}|{node.cpu_usage_percent:.2f}|{node.memory_usage_percent:.2f}".encode()
+            f"|{node.name}|{node.cpu_usage_percent:.2f}|{node.memory_usage_percent:.2f}"
+            f"|{int(node.is_ready)}".encode()
         )
     return h.hexdigest()
 

@@ -20,9 +20,11 @@ from __future__ import annotations
 
 from collections.abc import Sequence
 
+from k8s_llm_scheduler_tpu.core.validation import feasible_nodes
 from k8s_llm_scheduler_tpu.types import (
     DecisionSource,
     NodeMetrics,
+    PodSpec,
     SchedulingDecision,
 )
 
@@ -62,14 +64,26 @@ def fallback_decision(
     nodes: Sequence[NodeMetrics],
     reason: str = "llm_unavailable",
     strategy: str = "resource_balanced",
+    pod: PodSpec | None = None,
 ) -> SchedulingDecision | None:
-    """Pick a node heuristically. Returns None when no Ready node exists
-    (the caller then leaves the pod Pending for the next watch cycle)."""
+    """Pick a node heuristically. Returns None when no candidate node exists
+    (the caller then leaves the pod Pending for the next watch cycle).
+
+    When `pod` is provided, candidates are filtered to nodes the pod can
+    legally run on (selector, taints, resource fit) — the reference's
+    fallback ignores placement constraints entirely (scheduler.py:532-535
+    filters only on readiness), which can bind a pod onto a node that
+    violates its nodeSelector; K8s honors bindings unconditionally, so that
+    is a real mis-placement, not a transient.
+    """
     scorer = _SCORERS.get(strategy, score_resource_balanced)
-    ready = [n for n in nodes if n.is_ready]
-    if not ready:
+    if pod is not None:
+        candidates = feasible_nodes(pod, nodes)
+    else:
+        candidates = [n for n in nodes if n.is_ready]
+    if not candidates:
         return None
-    best = max(ready, key=scorer)
+    best = max(candidates, key=scorer)
     return SchedulingDecision(
         selected_node=best.name,
         confidence=FALLBACK_CONFIDENCE,

@@ -1,0 +1,1 @@
+"""TPU inference engine: backends, KV cache, batching, sampling, tokenizers."""

@@ -1,0 +1,1 @@
+"""The scheduling control plane: decision client, watch loop, stats."""

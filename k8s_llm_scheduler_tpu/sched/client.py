@@ -1,0 +1,176 @@
+"""DecisionClient — resilience wrapper around any DecisionBackend.
+
+Control-flow parity with the reference's HuggingFaceClient.get_scheduling_decision
+(reference scheduler.py:377-416): cache check first (scheduler.py:380-385);
+up to max_retries attempts through the circuit breaker (scheduler.py:390-395)
+with exponential backoff retry_delay * 2**attempt (scheduler.py:409-412 —
+the reference hardcodes base 1s and never reads its retry_delay config key;
+here the key is live); breaker-open or retry exhaustion falls back to the
+heuristic scorer (scheduler.py:404-416); successful non-fallback decisions
+are cached (scheduler.py:398-399); decisions are validated against the live
+node list before acceptance (scheduler.py:453-465).
+
+Differences, on purpose:
+- genuinely async: backoff is `await asyncio.sleep`, the backend call runs in
+  a worker thread — the reference's `time.sleep` blocks its event loop
+  (SURVEY §2 component 12);
+- the breaker guards the in-tree TPU engine (BackendError, XLA failures)
+  instead of a remote HTTP API;
+- stats parity: total/successful/failed/cached requests, avg response time,
+  breaker trips (scheduler.py:344-351).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import logging
+import time
+from collections.abc import Sequence
+
+from k8s_llm_scheduler_tpu.core.breaker import CircuitBreaker, CircuitOpenError
+from k8s_llm_scheduler_tpu.core.cache import DecisionCache
+from k8s_llm_scheduler_tpu.core.fallback import fallback_decision
+from k8s_llm_scheduler_tpu.core.validation import validate_decision
+from k8s_llm_scheduler_tpu.engine.backend import DecisionBackend, NoFeasibleNodeError
+from k8s_llm_scheduler_tpu.types import (
+    DecisionSource,
+    NodeMetrics,
+    PodSpec,
+    SchedulingDecision,
+)
+
+logger = logging.getLogger(__name__)
+
+
+class DecisionClient:
+    def __init__(
+        self,
+        backend: DecisionBackend,
+        cache: DecisionCache | None = None,
+        breaker: CircuitBreaker | None = None,
+        max_retries: int = 3,
+        retry_delay: float = 1.0,
+        fallback_strategy: str = "resource_balanced",
+        fallback_enabled: bool = True,
+    ) -> None:
+        self.backend = backend
+        self.cache = cache
+        self.breaker = breaker
+        if breaker is not None and NoFeasibleNodeError not in breaker.non_failure_exceptions:
+            # Unschedulable pods must never open the circuit (pod property,
+            # not device health).
+            breaker.non_failure_exceptions = (
+                *breaker.non_failure_exceptions,
+                NoFeasibleNodeError,
+            )
+        self.max_retries = max(1, int(max_retries))
+        self.retry_delay = float(retry_delay)
+        self.fallback_strategy = fallback_strategy
+        self.fallback_enabled = fallback_enabled
+        self.stats = {
+            "total_requests": 0,
+            "successful_requests": 0,
+            "failed_requests": 0,
+            "cached_requests": 0,
+            "fallback_decisions": 0,
+            "invalid_decisions": 0,
+            "avg_response_time_ms": 0.0,
+        }
+
+    def _note_response_time(self, ms: float) -> None:
+        """Running average (reference scheduler.py:435-441)."""
+        n = self.stats["successful_requests"]
+        prev = self.stats["avg_response_time_ms"]
+        self.stats["avg_response_time_ms"] = prev + (ms - prev) / max(1, n)
+
+    def _call_backend(
+        self, pod: PodSpec, nodes: Sequence[NodeMetrics]
+    ) -> SchedulingDecision:
+        if self.breaker is not None:
+            return self.breaker.call(self.backend.get_scheduling_decision, pod, nodes)
+        return self.backend.get_scheduling_decision(pod, nodes)
+
+    def _fallback(
+        self, nodes: Sequence[NodeMetrics], reason: str, pod: PodSpec | None = None
+    ) -> SchedulingDecision | None:
+        if not self.fallback_enabled:
+            return None
+        decision = fallback_decision(
+            nodes, reason=reason, strategy=self.fallback_strategy, pod=pod
+        )
+        if decision is not None:
+            self.stats["fallback_decisions"] += 1
+        return decision
+
+    async def get_scheduling_decision(
+        self, pod: PodSpec, nodes: Sequence[NodeMetrics]
+    ) -> SchedulingDecision | None:
+        """Decide a node for `pod`, or None when nothing can decide (the pod
+        stays Pending and will be re-observed — correctness rests on the
+        cluster as source of truth, SURVEY §5 checkpoint note)."""
+        self.stats["total_requests"] += 1
+
+        if self.cache is not None:
+            cached = self.cache.get(pod, nodes)
+            # Staleness guard beyond TTL: the cached node must still exist AND
+            # be Ready in the *current* snapshot — a node can go NotReady
+            # within the TTL without changing the load figures in the key.
+            if cached is not None and validate_decision(cached, nodes):
+                node = next(n for n in nodes if n.name == cached.selected_node)
+                if node.is_ready:
+                    self.stats["cached_requests"] += 1
+                    return dataclasses.replace(cached, source=DecisionSource.CACHE)
+
+        last_error: Exception | None = None
+        for attempt in range(self.max_retries):
+            start = time.perf_counter()  # per attempt: excludes backoff sleeps
+            try:
+                decision = await asyncio.to_thread(self._call_backend, pod, nodes)
+            except CircuitOpenError as exc:
+                logger.warning("circuit open, using fallback: %s", exc)
+                return self._fallback(nodes, "circuit_open", pod)
+            except NoFeasibleNodeError as exc:
+                # Pod property, not backend health: no retries, no breaker
+                # failure, no constraint-ignoring fallback. Pod stays Pending.
+                logger.warning("unschedulable: %s", exc)
+                return self._fallback(nodes, "no_feasible_node", pod)
+            except Exception as exc:
+                last_error = exc
+                logger.warning(
+                    "backend attempt %d/%d failed: %s", attempt + 1, self.max_retries, exc
+                )
+                if attempt + 1 < self.max_retries:
+                    await asyncio.sleep(self.retry_delay * (2**attempt))
+                continue
+
+            if not validate_decision(decision, nodes):
+                # Hallucinated node name — defense in depth behind the
+                # constrained decoder (reference scheduler.py:453-465).
+                self.stats["invalid_decisions"] += 1
+                logger.warning(
+                    "backend selected unknown node %r, using fallback",
+                    decision.selected_node,
+                )
+                return self._fallback(nodes, "invalid_node", pod)
+
+            self.stats["successful_requests"] += 1
+            elapsed_ms = (time.perf_counter() - start) * 1000.0
+            if decision.latency_ms == 0.0:
+                decision.latency_ms = elapsed_ms
+            self._note_response_time(elapsed_ms)
+            if self.cache is not None:
+                self.cache.set(pod, nodes, decision)
+            return decision
+
+        self.stats["failed_requests"] += 1
+        logger.warning("all %d attempts failed (%s), using fallback", self.max_retries, last_error)
+        return self._fallback(nodes, f"retries_exhausted:{last_error}", pod)
+
+    def get_stats(self) -> dict:
+        out = dict(self.stats)
+        if self.cache is not None:
+            out["cache"] = self.cache.stats()
+        if self.breaker is not None:
+            out["circuit_breaker"] = self.breaker.stats()
+        return out

@@ -1,0 +1,87 @@
+"""Fixture generators shared by tests and bench.py.
+
+The reference ships 3 nginx fixture pods with graduated requests
+(reference ai-test-pods.yaml:1-44: 100m/128Mi, 250m/256Mi, 500m/512Mi)
+targeting schedulerName ai-llama-scheduler. `fixture_pods()` reproduces that
+workload; `synthetic_cluster`/`pod_burst` generate the BASELINE stress shapes
+(64/256-node clusters, 1000-pod bursts).
+"""
+
+from __future__ import annotations
+
+from k8s_llm_scheduler_tpu.cluster.fake import FakeCluster, FakeNode
+from k8s_llm_scheduler_tpu.cluster.interface import RawPod
+
+SCHEDULER_NAME = "ai-llama-scheduler"
+
+
+def fixture_pods(scheduler_name: str = SCHEDULER_NAME) -> list[RawPod]:
+    """The reference's 3 graduated nginx test pods (ai-test-pods.yaml)."""
+    shapes = [
+        ("ai-test-pod-1", "100m", "128Mi"),
+        ("ai-test-pod-2", "250m", "256Mi"),
+        ("ai-test-pod-3", "500m", "512Mi"),
+    ]
+    return [
+        RawPod(
+            name=name,
+            namespace="default",
+            scheduler_name=scheduler_name,
+            container_requests=({"cpu": cpu, "memory": mem},),
+        )
+        for name, cpu, mem in shapes
+    ]
+
+
+def synthetic_cluster(
+    n_nodes: int = 3,
+    cpu_cores: float = 16.0,
+    memory_gb: float = 64.0,
+    max_pods: int = 110,
+    load_spread: bool = True,
+) -> FakeCluster:
+    """A FakeCluster with n nodes at varied synthetic load levels."""
+    cluster = FakeCluster()
+    for i in range(n_nodes):
+        load = (i * 37 % 90) if load_spread else None
+        cluster.add_node(
+            FakeNode(
+                name=f"node-{i}",
+                cpu_capacity_cores=cpu_cores,
+                memory_capacity_gb=memory_gb,
+                max_pods=max_pods,
+                cpu_usage_percent=float(load) if load is not None else None,
+                memory_usage_percent=float(load) if load is not None else None,
+                labels={"zone": f"z{i % 4}"},
+            )
+        )
+    return cluster
+
+
+def pod_burst(
+    n_pods: int,
+    scheduler_name: str = SCHEDULER_NAME,
+    distinct_shapes: int = 8,
+) -> list[RawPod]:
+    """A burst of pending pods with `distinct_shapes` resource shapes.
+
+    distinct_shapes controls the decision-cache hit rate: a 1000-pod burst
+    with 8 shapes means ~992 decisions are cache-servable, which mirrors real
+    bursts (replicas of few deployments) and the reference's cache-key
+    equivalence design (scheduler.py:265-271).
+    """
+    pods = []
+    for i in range(n_pods):
+        shape = i % distinct_shapes
+        cpu_m = 100 + 50 * shape
+        mem_mi = 128 * (1 + shape % 4)
+        pods.append(
+            RawPod(
+                name=f"burst-pod-{i}",
+                namespace="default",
+                scheduler_name=scheduler_name,
+                container_requests=({"cpu": f"{cpu_m}m", "memory": f"{mem_mi}Mi"},),
+                priority=shape % 3,
+            )
+        )
+    return pods

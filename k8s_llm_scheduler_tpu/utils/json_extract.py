@@ -26,37 +26,31 @@ from typing import Any
 _FENCE_RE = re.compile(r"```(?:json)?\s*(\{.*?\})\s*```", re.DOTALL)
 
 
-def _balanced_objects(text: str) -> list[str]:
-    """All top-level balanced {...} spans, string/escape-aware."""
-    spans: list[str] = []
-    depth = 0
-    start = -1
-    in_string = False
-    escape = False
-    for i, ch in enumerate(text):
-        if in_string:
-            if escape:
-                escape = False
-            elif ch == "\\":
-                escape = True
-            elif ch == '"':
-                in_string = False
+_DECODER = json.JSONDecoder()
+
+
+def _decodable_objects(text: str) -> list[dict[str, Any]]:
+    """All JSON objects decodable starting at some '{' in the text.
+
+    Tries `raw_decode` at each '{' position; on success skips past the
+    decoded span (so nested objects aren't re-reported), on failure moves to
+    the next '{'. Unlike a brace-depth counter, a stray unmatched '{' in the
+    model's prose before the real object cannot swallow it.
+    """
+    objects: list[dict[str, Any]] = []
+    pos = 0
+    while True:
+        start = text.find("{", pos)
+        if start == -1:
+            return objects
+        try:
+            obj, end = _DECODER.raw_decode(text, start)
+        except (json.JSONDecodeError, ValueError):
+            pos = start + 1
             continue
-        if ch == '"':
-            if depth > 0:
-                in_string = True
-            continue
-        if ch == "{":
-            if depth == 0:
-                start = i
-            depth += 1
-        elif ch == "}":
-            if depth > 0:
-                depth -= 1
-                if depth == 0 and start >= 0:
-                    spans.append(text[start : i + 1])
-                    start = -1
-    return spans
+        if isinstance(obj, dict):
+            objects.append(obj)
+        pos = end
 
 
 def _try_load(candidate: str) -> dict[str, Any] | None:
@@ -82,11 +76,9 @@ def extract_json(text: str) -> dict[str, Any] | None:
         if obj is not None:
             return obj
 
-    spans = _balanced_objects(text)
-    for candidate in reversed(spans):  # last object first (scheduler.py:487-501)
-        obj = _try_load(candidate)
-        if obj is not None:
-            return obj
+    objects = _decodable_objects(text)
+    if objects:
+        return objects[-1]  # last object first (scheduler.py:487-501)
     return None
 
 

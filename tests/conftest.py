@@ -18,9 +18,30 @@ from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
+import asyncio  # noqa: E402
+import inspect  # noqa: E402
+
 import pytest  # noqa: E402
 
 from k8s_llm_scheduler_tpu.types import NodeMetrics, PodSpec  # noqa: E402
+
+
+def pytest_configure(config):
+    config.addinivalue_line("markers", "asyncio: run test as a coroutine")
+
+
+@pytest.hookimpl(tryfirst=True)
+def pytest_pyfunc_call(pyfuncitem):
+    """Minimal async test support (pytest-asyncio is not in the image)."""
+    func = pyfuncitem.obj
+    if inspect.iscoroutinefunction(func):
+        kwargs = {
+            name: pyfuncitem.funcargs[name]
+            for name in pyfuncitem._fixtureinfo.argnames
+        }
+        asyncio.run(func(**kwargs))
+        return True
+    return None
 
 
 def make_node(

@@ -1,0 +1,182 @@
+"""DecisionClient resilience flow (parity: reference scheduler.py:377-416)."""
+
+import pytest
+
+from k8s_llm_scheduler_tpu.core.breaker import CircuitBreaker
+from k8s_llm_scheduler_tpu.core.cache import DecisionCache
+from k8s_llm_scheduler_tpu.engine.backend import BackendError, StubBackend
+from k8s_llm_scheduler_tpu.sched.client import DecisionClient
+from k8s_llm_scheduler_tpu.types import (
+    DecisionSource,
+    NodeMetrics,
+    PodSpec,
+    SchedulingDecision,
+)
+
+from conftest import make_node, make_pod
+
+
+class HallucinatingBackend:
+    def get_scheduling_decision(self, pod, nodes):
+        return SchedulingDecision(
+            selected_node="node-that-does-not-exist", confidence=0.99, reasoning="trust me"
+        )
+
+
+def client(backend=None, **kw):
+    return DecisionClient(
+        backend=backend or StubBackend(),
+        cache=kw.pop("cache", DecisionCache()),
+        breaker=kw.pop("breaker", CircuitBreaker()),
+        retry_delay=kw.pop("retry_delay", 0.0),
+        **kw,
+    )
+
+
+class TestDecide:
+    @pytest.mark.asyncio
+    async def test_llm_decision(self, three_nodes):
+        c = client()
+        d = await c.get_scheduling_decision(make_pod(), three_nodes)
+        assert d.selected_node == "node-a"
+        assert d.source is DecisionSource.LLM
+        assert d.latency_ms >= 0
+        assert c.stats["successful_requests"] == 1
+
+    @pytest.mark.asyncio
+    async def test_cache_hit_on_second_call(self, three_nodes):
+        c = client()
+        d1 = await c.get_scheduling_decision(make_pod("p1"), three_nodes)
+        d2 = await c.get_scheduling_decision(make_pod("p2"), three_nodes)
+        assert d1.source is DecisionSource.LLM
+        assert d2.source is DecisionSource.CACHE
+        assert d2.selected_node == d1.selected_node
+        assert c.stats["cached_requests"] == 1
+        # Backend called exactly once.
+        assert c.backend.calls == 1
+
+    @pytest.mark.asyncio
+    async def test_retry_then_success(self, three_nodes):
+        backend = StubBackend()
+        backend.fail_next = 2
+        c = client(backend, max_retries=3)
+        d = await c.get_scheduling_decision(make_pod(), three_nodes)
+        assert d.source is DecisionSource.LLM
+        assert backend.calls == 3
+
+    @pytest.mark.asyncio
+    async def test_retries_exhausted_falls_back(self, three_nodes):
+        backend = StubBackend()
+        backend.fail_next = 99
+        c = client(backend, max_retries=3)
+        d = await c.get_scheduling_decision(make_pod(), three_nodes)
+        assert d.fallback_needed is True
+        assert d.source is DecisionSource.FALLBACK
+        assert c.stats["failed_requests"] == 1
+        assert c.stats["fallback_decisions"] == 1
+
+    @pytest.mark.asyncio
+    async def test_breaker_open_falls_back_without_backend_call(self, three_nodes):
+        backend = StubBackend()
+        breaker = CircuitBreaker(failure_threshold=1, timeout_seconds=60)
+        try:
+            breaker.call(lambda: (_ for _ in ()).throw(BackendError("dead")))
+        except BackendError:
+            pass
+        c = client(backend, breaker=breaker)
+        d = await c.get_scheduling_decision(make_pod(), three_nodes)
+        assert d.source is DecisionSource.FALLBACK
+        assert "circuit_open" in d.reasoning
+        assert backend.calls == 0
+
+    @pytest.mark.asyncio
+    async def test_hallucinated_node_rejected(self, three_nodes):
+        c = client(HallucinatingBackend())
+        d = await c.get_scheduling_decision(make_pod(), three_nodes)
+        assert d.source is DecisionSource.FALLBACK
+        assert d.selected_node in {n.name for n in three_nodes}
+        assert c.stats["invalid_decisions"] == 1
+
+    @pytest.mark.asyncio
+    async def test_fallback_decisions_not_cached(self, three_nodes):
+        backend = StubBackend()
+        backend.fail_next = 99
+        cache = DecisionCache()
+        c = client(backend, max_retries=1, cache=cache)
+        await c.get_scheduling_decision(make_pod(), three_nodes)
+        assert len(cache) == 0
+
+    @pytest.mark.asyncio
+    async def test_fallback_disabled_returns_none(self, three_nodes):
+        backend = StubBackend()
+        backend.fail_next = 99
+        c = client(backend, max_retries=1, fallback_enabled=False)
+        assert await c.get_scheduling_decision(make_pod(), three_nodes) is None
+
+    @pytest.mark.asyncio
+    async def test_no_feasible_node_leaves_pod_pending(self):
+        """An infeasible pod gets None (stays Pending) — the pod-aware
+        fallback refuses to bind onto a node that violates constraints
+        (unlike the reference, whose fallback ignores fit,
+        scheduler.py:521-559)."""
+        tiny_node = [make_node("tiny", cpu_cores=0.01, mem_gb=0.01)]
+        c = client(StubBackend(), max_retries=1)
+        d = await c.get_scheduling_decision(make_pod(cpu=4.0), tiny_node)
+        assert d is None
+
+    @pytest.mark.asyncio
+    async def test_unschedulable_pod_does_not_trip_breaker(self, three_nodes):
+        """One chronically unschedulable pod must not open the circuit and
+        poison scheduling for healthy pods."""
+        breaker = CircuitBreaker(failure_threshold=2, timeout_seconds=60)
+        c = client(StubBackend(), breaker=breaker)
+        bad_pod = make_pod("bad", node_selector={"no-such-label": "x"})
+        for _ in range(5):
+            assert await c.get_scheduling_decision(bad_pod, three_nodes) is None
+        # Breaker untouched: a healthy pod still gets an LLM decision.
+        d = await c.get_scheduling_decision(make_pod("good"), three_nodes)
+        assert d.source is DecisionSource.LLM
+        assert breaker.stats()["trips"] == 0
+
+    @pytest.mark.asyncio
+    async def test_cached_decision_for_now_unready_node_not_served(self, three_nodes):
+        """A node going NotReady within the TTL invalidates its cached
+        decisions even though load figures are unchanged."""
+        c = client()
+        d1 = await c.get_scheduling_decision(make_pod(), three_nodes)
+        assert d1.selected_node == "node-a"
+        # Same snapshot, but node-a now NotReady.
+        stale = [
+            make_node("node-a", cpu_pct=20.0, mem_pct=30.0, pods=5, ready=False),
+            three_nodes[1],
+            three_nodes[2],
+        ]
+        d2 = await c.get_scheduling_decision(make_pod(), stale)
+        assert d2.selected_node != "node-a"
+        assert d2.source is not DecisionSource.CACHE
+
+    @pytest.mark.asyncio
+    async def test_constrained_pod_fallback_respects_selector(self):
+        """Fallback honors nodeSelector (the reference's does not,
+        scheduler.py:532-535)."""
+        nodes = [
+            make_node("plain", cpu_pct=5.0),
+            make_node("gpu-node", cpu_pct=95.0, labels={"gpu": "true"}),
+        ]
+        backend = StubBackend()
+        backend.fail_next = 99  # force the fallback path
+        c = client(backend, max_retries=1)
+        d = await c.get_scheduling_decision(
+            make_pod(node_selector={"gpu": "true"}), nodes
+        )
+        assert d.selected_node == "gpu-node"
+        assert d.source is DecisionSource.FALLBACK
+
+    @pytest.mark.asyncio
+    async def test_stats_shape(self, three_nodes):
+        c = client()
+        await c.get_scheduling_decision(make_pod(), three_nodes)
+        stats = c.get_stats()
+        assert stats["total_requests"] == 1
+        assert "cache" in stats and "circuit_breaker" in stats
+        assert stats["avg_response_time_ms"] > 0

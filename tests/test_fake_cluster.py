@@ -1,0 +1,170 @@
+"""FakeCluster semantics: watch, metrics synthesis, binding."""
+
+import asyncio
+
+import pytest
+
+from k8s_llm_scheduler_tpu.cluster.fake import FakeCluster, FakeNode
+from k8s_llm_scheduler_tpu.cluster.interface import RawPod, raw_pod_to_spec
+from k8s_llm_scheduler_tpu.testing import fixture_pods
+
+
+def pending(name, sched="s1"):
+    return RawPod(
+        name=name,
+        namespace="default",
+        scheduler_name=sched,
+        container_requests=({"cpu": "100m", "memory": "128Mi"},),
+    )
+
+
+class TestRawPodToSpec:
+    def test_sums_container_requests(self):
+        raw = RawPod(
+            name="p",
+            namespace="ns",
+            container_requests=(
+                {"cpu": "100m", "memory": "128Mi"},
+                {"cpu": "1", "memory": "1Gi"},
+            ),
+        )
+        spec = raw_pod_to_spec(raw)
+        assert abs(spec.cpu_request - 1.1) < 1e-9
+        assert abs(spec.memory_request - 1.125) < 1e-9
+
+    def test_malformed_quantities_count_zero(self):
+        raw = RawPod(
+            name="p",
+            namespace="ns",
+            container_requests=({"cpu": "garbage", "memory": "5X"},),
+        )
+        spec = raw_pod_to_spec(raw)
+        assert spec.cpu_request == 0.0
+        assert spec.memory_request == 0.0
+
+    def test_fixture_pods_match_reference_shapes(self):
+        """ai-test-pods.yaml parity: 100m/128Mi, 250m/256Mi, 500m/512Mi."""
+        specs = [raw_pod_to_spec(p) for p in fixture_pods()]
+        assert [round(s.cpu_request, 3) for s in specs] == [0.1, 0.25, 0.5]
+        assert [round(s.memory_request, 3) for s in specs] == [0.125, 0.25, 0.5]
+
+
+class TestMetrics:
+    def test_usage_synthesized_from_pod_count(self):
+        """(pods/max_pods)*50, the reference's metrics-server stand-in
+        (scheduler.py:149-151)."""
+        cluster = FakeCluster()
+        cluster.add_node(FakeNode(name="n1", max_pods=100))
+        for i in range(10):
+            pod = pending(f"p{i}")
+            cluster.add_pod(pod)
+            cluster.bind_pod_to_node(f"p{i}", "default", "n1")
+        [m] = cluster.get_node_metrics()
+        assert m.pod_count == 10
+        assert m.cpu_usage_percent == 5.0  # 10/100 * 50
+
+    def test_explicit_usage_overrides(self):
+        cluster = FakeCluster()
+        cluster.add_node(FakeNode(name="n1", cpu_usage_percent=77.0))
+        [m] = cluster.get_node_metrics()
+        assert m.cpu_usage_percent == 77.0
+
+    def test_frozen_node_not_ready(self):
+        cluster = FakeCluster()
+        cluster.add_nodes(2)
+        cluster.freeze_nodes("node-0")
+        metrics = {m.name: m for m in cluster.get_node_metrics()}
+        assert metrics["node-0"].is_ready is False
+        assert metrics["node-1"].is_ready is True
+
+
+class TestBinding:
+    def test_bind_flips_to_running(self):
+        cluster = FakeCluster()
+        cluster.add_nodes(1)
+        cluster.add_pod(pending("p1"))
+        assert cluster.bind_pod_to_node("p1", "default", "node-0")
+        pod = cluster.get_pod("default", "p1")
+        assert pod.node_name == "node-0"
+        assert pod.phase == "Running"
+        assert cluster.bindings == [("default", "p1", "node-0")]
+
+    def test_double_bind_rejected(self):
+        cluster = FakeCluster()
+        cluster.add_nodes(2)
+        cluster.add_pod(pending("p1"))
+        assert cluster.bind_pod_to_node("p1", "default", "node-0")
+        assert not cluster.bind_pod_to_node("p1", "default", "node-1")
+
+    def test_bind_unknown_pod_or_node_fails(self):
+        cluster = FakeCluster()
+        cluster.add_nodes(1)
+        assert not cluster.bind_pod_to_node("ghost", "default", "node-0")
+        cluster.add_pod(pending("p1"))
+        assert not cluster.bind_pod_to_node("p1", "default", "ghost-node")
+
+    def test_failure_injection(self):
+        cluster = FakeCluster()
+        cluster.add_nodes(1)
+        cluster.add_pod(pending("p1"))
+        cluster.fail_next_bindings = 1
+        assert not cluster.bind_pod_to_node("p1", "default", "node-0")
+        assert cluster.bind_pod_to_node("p1", "default", "node-0")
+
+
+class TestWatch:
+    @pytest.mark.asyncio
+    async def test_backlog_then_live(self):
+        cluster = FakeCluster()
+        cluster.add_nodes(1)
+        cluster.add_pod(pending("backlog-pod"))
+
+        seen = []
+
+        async def consume():
+            async for pod in cluster.watch_pending_pods("s1"):
+                seen.append(pod.name)
+
+        task = asyncio.create_task(consume())
+        await asyncio.sleep(0.05)
+        cluster.add_pod(pending("live-pod"))
+        await asyncio.sleep(0.05)
+        cluster.close()
+        await asyncio.wait_for(task, timeout=2)
+        assert seen == ["backlog-pod", "live-pod"]
+
+    @pytest.mark.asyncio
+    async def test_filters_by_scheduler_name(self):
+        cluster = FakeCluster()
+        cluster.add_pod(pending("ours", sched="s1"))
+        cluster.add_pod(pending("theirs", sched="default-scheduler"))
+
+        seen = []
+
+        async def consume():
+            async for pod in cluster.watch_pending_pods("s1"):
+                seen.append(pod.name)
+
+        task = asyncio.create_task(consume())
+        await asyncio.sleep(0.05)
+        cluster.close()
+        await asyncio.wait_for(task, timeout=2)
+        assert seen == ["ours"]
+
+    @pytest.mark.asyncio
+    async def test_bound_pods_not_delivered(self):
+        cluster = FakeCluster()
+        cluster.add_nodes(1)
+        cluster.add_pod(pending("p1"))
+        cluster.bind_pod_to_node("p1", "default", "node-0")
+        seen = []
+
+        async def consume():
+            async for pod in cluster.watch_pending_pods("s1"):
+                seen.append(pod.name)
+
+        task = asyncio.create_task(consume())
+        await asyncio.sleep(0.05)
+        cluster.close()
+        await asyncio.wait_for(task, timeout=2)
+        assert seen == []

@@ -76,3 +76,14 @@ class TestParseDecisionJson:
     def test_bad_confidence_type(self):
         d = parse_decision_json('{"selected_node": "n", "confidence": "high"}')
         assert d["confidence"] == 0.5
+
+
+class TestStrayBraces:
+    def test_stray_open_brace_before_object(self):
+        """A stray '{' in prose must not swallow the real object."""
+        text = 'I weighed cpu{mem tradeoffs. {"selected_node": "n1", "confidence": 0.9}'
+        assert extract_json(text)["selected_node"] == "n1"
+
+    def test_stray_brace_between_objects(self):
+        text = '{"selected_node": "old"} junk { more junk {"selected_node": "new"}'
+        assert extract_json(text)["selected_node"] == "new"
