@@ -54,7 +54,9 @@ class FakeCluster:
         self._nodes: dict[str, FakeNode] = {}
         self._pods: dict[tuple[str, str], RawPod] = {}
         self._lock = threading.Lock()
-        self._watchers: list[asyncio.Queue[RawPod | None]] = []
+        # (queue, owning event loop) — pushes from foreign threads must go
+        # through call_soon_threadsafe (asyncio.Queue is not thread-safe).
+        self._watchers: list[tuple[asyncio.Queue[RawPod | None], asyncio.AbstractEventLoop]] = []
         self._uid_counter = itertools.count(1)
         self.fail_next_bindings = 0
         self.bind_count = 0
@@ -85,8 +87,8 @@ class FakeCluster:
             self._pods[(pod.namespace, pod.name)] = pod
             watchers = list(self._watchers)
         if pod.needs_scheduling:
-            for queue in watchers:
-                queue.put_nowait(pod)
+            for queue, loop in watchers:
+                self._deliver(queue, loop, pod)
 
     def get_pod(self, namespace: str, name: str) -> RawPod | None:
         with self._lock:
@@ -149,8 +151,9 @@ class FakeCluster:
         """Initial list of pending pods, then live additions (K8s watch shape,
         reference scheduler.py:657-676). Ends on close()."""
         queue: asyncio.Queue[RawPod | None] = asyncio.Queue()
+        entry = (queue, asyncio.get_running_loop())
         with self._lock:
-            self._watchers.append(queue)
+            self._watchers.append(entry)
             backlog = [p for p in self._pods.values() if p.needs_scheduling]
         try:
             for pod in backlog:
@@ -164,15 +167,29 @@ class FakeCluster:
                     yield pod
         finally:
             with self._lock:
-                if queue in self._watchers:
-                    self._watchers.remove(queue)
+                if entry in self._watchers:
+                    self._watchers.remove(entry)
+
+    @staticmethod
+    def _deliver(
+        queue: asyncio.Queue, loop: asyncio.AbstractEventLoop, item: RawPod | None
+    ) -> None:
+        """Thread-safe push to a watcher queue."""
+        try:
+            running = asyncio.get_running_loop()
+        except RuntimeError:
+            running = None
+        if running is loop:
+            queue.put_nowait(item)
+        else:
+            loop.call_soon_threadsafe(queue.put_nowait, item)
 
     def close(self) -> None:
         """End all watch streams."""
         with self._lock:
             watchers = list(self._watchers)
-        for queue in watchers:
-            queue.put_nowait(None)
+        for queue, loop in watchers:
+            self._deliver(queue, loop, None)
 
     # ---------------------------------------------------------------- Binder
     def bind_pod_to_node(self, pod_name: str, namespace: str, node_name: str) -> bool:

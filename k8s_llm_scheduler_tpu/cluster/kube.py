@@ -146,36 +146,64 @@ class KubeCluster:  # pragma: no cover - requires a live cluster
         return out
 
     async def watch_pending_pods(self, scheduler_name: str) -> AsyncIterator[RawPod]:
-        """Watch stream bridged thread->asyncio so the loop stays responsive."""
-        sync_queue: queue_mod.Queue[RawPod | None] = queue_mod.Queue()
+        """Watch stream bridged thread->asyncio so the loop stays responsive.
+
+        Cleanup contract: abandoning/aclosing the generator stops the reader
+        thread (its stop event is per-watch, so the cluster object can be
+        watched again), and the bounded queue + timeout-polling get mean no
+        thread is ever parked forever on an abandoned watch.
+        """
+        sync_queue: queue_mod.Queue[RawPod | None] = queue_mod.Queue(maxsize=1024)
+        stop = threading.Event()
 
         def reader() -> None:
-            while not self._stop.is_set():
+            while not (stop.is_set() or self._stop.is_set()):
                 try:
                     w = k8s_watch.Watch()
                     for event in w.stream(
                         self._v1.list_pod_for_all_namespaces,
                         timeout_seconds=self._watch_timeout,
                     ):
-                        if self._stop.is_set():
+                        if stop.is_set() or self._stop.is_set():
                             break
                         raw = _pod_to_raw(event["object"])
                         if raw.needs_scheduling and raw.scheduler_name == scheduler_name:
-                            sync_queue.put(raw)
+                            while not (stop.is_set() or self._stop.is_set()):
+                                try:
+                                    sync_queue.put(raw, timeout=0.5)
+                                    break
+                                except queue_mod.Full:
+                                    continue
                 except Exception as exc:
                     # Self-heal: log + brief sleep + re-watch (scheduler.py:683-685)
                     logger.warning("watch stream error, re-watching: %s", exc)
-                    self._stop.wait(5.0)
-            sync_queue.put(None)
+                    stop.wait(5.0)
+            try:
+                sync_queue.put_nowait(None)
+            except queue_mod.Full:
+                pass
+
+        def poll_get() -> RawPod | None:
+            """Blocking get with a timeout loop so the executor thread can
+            notice a stopped watch instead of parking forever."""
+            while True:
+                try:
+                    return sync_queue.get(timeout=0.5)
+                except queue_mod.Empty:
+                    if stop.is_set() or self._stop.is_set():
+                        return None
 
         thread = threading.Thread(target=reader, daemon=True, name="k8s-watch")
         thread.start()
         loop = asyncio.get_running_loop()
-        while True:
-            raw = await loop.run_in_executor(None, sync_queue.get)
-            if raw is None:
-                return
-            yield raw
+        try:
+            while True:
+                raw = await loop.run_in_executor(None, poll_get)
+                if raw is None:
+                    return
+                yield raw
+        finally:
+            stop.set()
 
     def close(self) -> None:
         self._stop.set()

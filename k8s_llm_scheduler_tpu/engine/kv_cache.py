@@ -1,0 +1,219 @@
+"""Paged KV cache with static shapes — the on-device replacement for the
+reference's client-side RequestCache.
+
+The reference caches whole *decisions* in host RAM (reference
+scheduler.py:257-294); the TPU build additionally needs token-level KV state
+for in-flight generations. vLLM-style paging under JAX's static-shape
+regime (SURVEY §7 hard part #2):
+
+- K/V arrays are [n_layers, num_pages, page_size, n_kv_heads, head_dim],
+  allocated once; page 0 is reserved scratch (inactive decode slots write
+  there; padded prefill pages point there).
+- A fixed pool of `max_slots` sequence slots; per-slot page tables
+  [max_slots, max_pages_per_seq] map logical token blocks to pages.
+- Page allocation/free is HOST-side bookkeeping (a free list) between jit
+  calls; all device-side mutation happens inside jit'd scatters with
+  donated buffers, so shapes never change and nothing recompiles.
+
+Prefix reuse: `fork_slot` lets a new sequence share the pages of a common
+prompt prefix (the burst-shared cluster-state block, core/prompt.py) with
+copy-on-write granularity of one page — sharing is at whole-page level, the
+partial tail page is copied.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from k8s_llm_scheduler_tpu.models.configs import LlamaConfig
+
+
+class OutOfPagesError(RuntimeError):
+    """The page pool is exhausted — caller should backpressure admissions."""
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _scatter_pages(cache: jax.Array, page_ids: jax.Array, blocks: jax.Array) -> jax.Array:
+    """cache[:, page_ids[i]] = blocks[:, i] for all i (donated, in-place)."""
+    return cache.at[:, page_ids].set(blocks)
+
+
+@dataclasses.dataclass
+class SlotInfo:
+    slot: int
+    length: int  # tokens currently stored
+    pages: list[int]  # owned pages (refcounted globally)
+
+
+class PagedKVCache:
+    def __init__(
+        self,
+        cfg: LlamaConfig,
+        num_pages: int = 256,
+        page_size: int = 128,
+        max_slots: int = 8,
+        max_pages_per_seq: int = 64,
+        dtype=None,
+    ) -> None:
+        self.cfg = cfg
+        self.num_pages = int(num_pages)
+        self.page_size = int(page_size)
+        self.max_slots = int(max_slots)
+        self.max_pages_per_seq = int(max_pages_per_seq)
+        dtype = dtype or cfg.dtype
+        shape = (cfg.n_layers, num_pages, page_size, cfg.n_kv_heads, cfg.head_dim)
+        self.k = jnp.zeros(shape, dtype=dtype)
+        self.v = jnp.zeros(shape, dtype=dtype)
+        # Host-side state. Page 0 is scratch — never allocated.
+        self._free = list(range(num_pages - 1, 0, -1))
+        self._refcount = np.zeros(num_pages, dtype=np.int32)
+        self._slots: dict[int, SlotInfo] = {}
+        self._free_slots = list(range(max_slots - 1, -1, -1))
+        # Device mirrors (rebuilt on change; [max_slots, max_pages_per_seq]).
+        self._tables_np = np.zeros((max_slots, max_pages_per_seq), dtype=np.int32)
+        self._tables_dirty = True
+        self._tables_dev: jax.Array | None = None
+
+    # ------------------------------------------------------------- plumbing
+    @property
+    def pages_free(self) -> int:
+        return len(self._free)
+
+    def page_tables(self) -> jax.Array:
+        if self._tables_dirty or self._tables_dev is None:
+            self._tables_dev = jnp.asarray(self._tables_np)
+            self._tables_dirty = False
+        return self._tables_dev
+
+    def _alloc_pages(self, n: int) -> list[int]:
+        if n > len(self._free):
+            raise OutOfPagesError(f"need {n} pages, {len(self._free)} free")
+        pages = [self._free.pop() for _ in range(n)]
+        for p in pages:
+            self._refcount[p] += 1
+        return pages
+
+    def _release_pages(self, pages: list[int]) -> None:
+        for p in pages:
+            self._refcount[p] -= 1
+            if self._refcount[p] == 0:
+                self._free.append(p)
+
+    def pages_needed(self, n_tokens: int) -> int:
+        return max(1, -(-n_tokens // self.page_size))
+
+    # ----------------------------------------------------------------- slots
+    def allocate_slot(self, n_tokens: int, reserve_decode: int = 0) -> int:
+        """Claim a slot with pages covering n_tokens (+reserve_decode more)."""
+        if not self._free_slots:
+            raise OutOfPagesError("no free sequence slots")
+        need = self.pages_needed(n_tokens + reserve_decode)
+        if need > self.max_pages_per_seq:
+            raise OutOfPagesError(
+                f"sequence needs {need} pages > max_pages_per_seq={self.max_pages_per_seq}"
+            )
+        pages = self._alloc_pages(need)
+        slot = self._free_slots.pop()
+        self._slots[slot] = SlotInfo(slot=slot, length=0, pages=pages)
+        row = np.zeros(self.max_pages_per_seq, dtype=np.int32)
+        row[: len(pages)] = pages
+        self._tables_np[slot] = row
+        self._tables_dirty = True
+        return slot
+
+    def free_slot(self, slot: int) -> None:
+        info = self._slots.pop(slot)
+        self._release_pages(info.pages)
+        self._free_slots.append(slot)
+        self._tables_np[slot] = 0
+        self._tables_dirty = True
+
+    def slot_length(self, slot: int) -> int:
+        return self._slots[slot].length
+
+    def ensure_decode_capacity(self, slot: int) -> None:
+        """Grow the slot by one page if the next token would overflow."""
+        info = self._slots[slot]
+        if info.length + 1 > len(info.pages) * self.page_size:
+            if len(info.pages) + 1 > self.max_pages_per_seq:
+                raise OutOfPagesError("sequence exceeded max_pages_per_seq")
+            (page,) = self._alloc_pages(1)
+            self._tables_np[info.slot, len(info.pages)] = page
+            info.pages.append(page)
+            self._tables_dirty = True
+
+    def note_token_appended(self, slot: int) -> None:
+        self._slots[slot].length += 1
+
+    # --------------------------------------------------------------- prefill
+    def write_prefill(
+        self,
+        slot: int,
+        k_all: jax.Array,  # [L, S, n_kv, hd] — one sequence's prefill KV
+        v_all: jax.Array,
+        seq_len: int,
+    ) -> None:
+        """Scatter a sequence's prefill K/V into its pages.
+
+        S (the padded bucket length) may exceed seq_len; whole pages beyond
+        the needed count are routed to scratch page 0.
+        """
+        info = self._slots[slot]
+        L, S, n_kv, hd = k_all.shape
+        assert S % self.page_size == 0, "bucket sizes must be multiples of page_size"
+        n_blocks = S // self.page_size
+        used = self.pages_needed(seq_len)
+        # Destination for each block: real page while within the sequence,
+        # scratch page 0 for pure-padding blocks.
+        dest = np.zeros(n_blocks, dtype=np.int32)
+        for i in range(min(used, n_blocks)):
+            dest[i] = info.pages[i]
+        page_ids = jnp.asarray(dest)
+        blocks_k = k_all.reshape(L, n_blocks, self.page_size, n_kv, hd)
+        blocks_v = v_all.reshape(L, n_blocks, self.page_size, n_kv, hd)
+        self.k = _scatter_pages(self.k, page_ids, blocks_k)
+        self.v = _scatter_pages(self.v, page_ids, blocks_v)
+        info.length = seq_len
+
+    # ----------------------------------------------------- prefix sharing
+    def fork_slot(self, src_slot: int, shared_tokens: int, extra_tokens: int) -> int:
+        """New slot sharing the source's full pages covering `shared_tokens`;
+        the partial tail page (and room for extra_tokens) is freshly owned.
+
+        Page-granular copy-on-write: shared pages are refcounted, never
+        written by the fork (decode appends land in the fork's own pages).
+        Returns the new slot id; caller must write the non-shared suffix KV.
+        """
+        if not self._free_slots:
+            raise OutOfPagesError("no free sequence slots")
+        src = self._slots[src_slot]
+        full_shared = min(shared_tokens // self.page_size, len(src.pages))
+        shared_pages = src.pages[:full_shared]
+        total_tokens = shared_tokens + extra_tokens
+        need = self.pages_needed(total_tokens)
+        if need > self.max_pages_per_seq:
+            raise OutOfPagesError(
+                f"forked sequence needs {need} pages > max_pages_per_seq={self.max_pages_per_seq}"
+            )
+        # Allocate own pages FIRST — if the pool is exhausted this raises
+        # before any refcount is touched, so nothing leaks.
+        own_pages = self._alloc_pages(max(0, need - full_shared))
+        for p in shared_pages:
+            self._refcount[p] += 1
+        slot = self._free_slots.pop()
+        pages = shared_pages + own_pages
+        self._slots[slot] = SlotInfo(slot=slot, length=0, pages=pages)
+        row = np.zeros(self.max_pages_per_seq, dtype=np.int32)
+        row[: len(pages)] = pages
+        self._tables_np[slot] = row
+        self._tables_dirty = True
+        return slot
+
+    def shared_page_tokens(self, shared_tokens: int) -> int:
+        """How many tokens of a prefix are reusable at page granularity."""
+        return (shared_tokens // self.page_size) * self.page_size

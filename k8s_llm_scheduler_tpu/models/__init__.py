@@ -1,0 +1,10 @@
+"""Decision-model families in functional JAX (Llama 3.x dense)."""
+
+from k8s_llm_scheduler_tpu.models.configs import (  # noqa: F401
+    LLAMA_3_1_8B,
+    LLAMA_3_2_1B,
+    LLAMA_3_3_70B,
+    TINY,
+    LlamaConfig,
+    get_config,
+)

@@ -1,0 +1,1 @@
+"""Attention and norm ops: XLA reference paths + Pallas TPU kernels."""

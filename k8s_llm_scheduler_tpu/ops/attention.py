@@ -1,0 +1,97 @@
+"""Attention ops for prefill and paged decode.
+
+These are the XLA-compiled reference paths; ops/pallas_paged_attention.py
+provides the hand-tiled TPU decode kernel behind the same signature. Both
+paths are jit-compatible: static shapes, no Python control flow on traced
+values (everything masks instead of branching).
+
+Replaces the remote attention the reference rents from the HF-hosted 70B
+(reference scheduler.py:425-433) with in-tree compute.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30  # large-negative mask value; avoids NaN from -inf * 0
+
+
+def causal_prefill_attention(
+    q: jax.Array,  # [B, S, n_heads, head_dim]
+    k: jax.Array,  # [B, S, n_kv_heads, head_dim]
+    v: jax.Array,  # [B, S, n_kv_heads, head_dim]
+    seq_lens: jax.Array,  # [B] valid lengths (padding beyond)
+) -> jax.Array:
+    """Causal self-attention over a (padded) prompt chunk, GQA-aware.
+
+    One fused einsum chain — XLA tiles this well onto the MXU; bf16 inputs,
+    f32 softmax accumulation.
+    """
+    B, S, n_heads, head_dim = q.shape
+    n_kv = k.shape[2]
+    q_per_kv = n_heads // n_kv
+
+    # Group heads: [B, S, n_kv, q_per_kv, hd]
+    qg = q.reshape(B, S, n_kv, q_per_kv, head_dim)
+    scale = head_dim**-0.5
+    logits = jnp.einsum(
+        "bqkgh,bskh->bkgqs", qg.astype(jnp.float32) * scale, k.astype(jnp.float32)
+    )  # [B, n_kv, q_per_kv, S_q, S_kv]
+
+    pos = jnp.arange(S)
+    causal = pos[:, None] >= pos[None, :]  # [S_q, S_kv]
+    valid = pos[None, :] < seq_lens[:, None]  # [B, S_kv]
+    mask = causal[None, None, None, :, :] & valid[:, None, None, None, :]
+    logits = jnp.where(mask, logits, NEG_INF)
+
+    weights = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bkgqs,bskh->bqkgh", weights, v.astype(jnp.float32))
+    return out.reshape(B, S, n_heads, head_dim).astype(q.dtype)
+
+
+def gather_pages(
+    cache: jax.Array,  # [num_pages, page_size, n_kv, head_dim]
+    page_table: jax.Array,  # [B, max_pages]
+) -> jax.Array:
+    """Gather each sequence's pages into a contiguous view
+    [B, max_pages*page_size, n_kv, head_dim]."""
+    gathered = cache[page_table]  # [B, max_pages, page_size, n_kv, hd]
+    B, P, psize, n_kv, hd = gathered.shape
+    return gathered.reshape(B, P * psize, n_kv, hd)
+
+
+def paged_decode_attention(
+    q: jax.Array,  # [B, n_heads, head_dim] — one new token per sequence
+    k_cache: jax.Array,  # [num_pages, page_size, n_kv, head_dim]
+    v_cache: jax.Array,  # [num_pages, page_size, n_kv, head_dim]
+    page_table: jax.Array,  # [B, max_pages] page ids per sequence
+    seq_lens: jax.Array,  # [B] length INCLUDING the new token
+) -> jax.Array:
+    """One decode step of attention against the paged KV cache.
+
+    The new token's K/V must already be scattered into the cache (the model
+    layer does that before calling). XLA path: gather pages then masked
+    attention. The Pallas kernel version streams pages without
+    materializing the gather.
+    """
+    B, n_heads, head_dim = q.shape
+    n_kv = k_cache.shape[2]
+    q_per_kv = n_heads // n_kv
+
+    k = gather_pages(k_cache, page_table)  # [B, L, n_kv, hd]
+    v = gather_pages(v_cache, page_table)
+    L = k.shape[1]
+
+    qg = q.reshape(B, n_kv, q_per_kv, head_dim)
+    scale = head_dim**-0.5
+    logits = jnp.einsum(
+        "bkgh,blkh->bkgl", qg.astype(jnp.float32) * scale, k.astype(jnp.float32)
+    )  # [B, n_kv, q_per_kv, L]
+
+    valid = jnp.arange(L)[None, :] < seq_lens[:, None]  # [B, L]
+    logits = jnp.where(valid[:, None, None, :], logits, NEG_INF)
+
+    weights = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bkgl,blkh->bkgh", weights, v.astype(jnp.float32))
+    return out.reshape(B, n_heads, head_dim).astype(q.dtype)

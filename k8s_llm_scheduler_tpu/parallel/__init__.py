@@ -1,0 +1,1 @@
+"""Device mesh, GSPMD partition specs, and sequence-parallel attention."""

@@ -1,0 +1,68 @@
+"""Device mesh construction from config.
+
+The reference's only "distributed backend" is HTTPS to the HF router
+(reference scheduler.py:343,425; SURVEY §2.3). Here distribution is a
+`jax.sharding.Mesh` over TPU chips: the `llm.mesh` config block (the north
+star's new field) names axis sizes, e.g. {dp: 1, tp: 8} for a v5p-16
+tensor-parallel slice. XLA lowers all collectives (psum/all-gather/
+reduce-scatter) over ICI from the shardings alone — no hand-written
+NCCL/MPI analog exists or is needed.
+
+Axis conventions used across the framework:
+    dp    data/batch parallel (continuous-batching slots)
+    fsdp  optional param sharding for training (weights scattered, gathered per layer)
+    tp    tensor parallel (attention heads, MLP hidden dim)
+    sp    sequence/context parallel (ring attention over long prompts)
+"""
+
+from __future__ import annotations
+
+import math
+from collections.abc import Mapping, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+AXIS_ORDER = ("dp", "fsdp", "sp", "tp")
+
+
+def make_mesh(
+    axes: Mapping[str, int] | None = None,
+    devices: Sequence[jax.Device] | None = None,
+) -> Mesh:
+    """Build a Mesh with named axes from an {axis: size} mapping.
+
+    Axes of size 1 are kept (harmless, makes specs uniform). Axis order
+    follows AXIS_ORDER so tp (highest-traffic collectives) maps to the
+    innermost/fastest device dimension — on TPU that keeps TP traffic on
+    ICI neighbors.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    axes = dict(axes or {})
+    for name in axes:
+        if name not in AXIS_ORDER:
+            raise ValueError(f"unknown mesh axis {name!r}; known: {AXIS_ORDER}")
+    ordered = [(name, int(axes.get(name, 1))) for name in AXIS_ORDER if axes.get(name, 1) > 1]
+    if not ordered:
+        ordered = [("dp", 1)]
+    total = math.prod(size for _, size in ordered)
+    if total > len(devices):
+        raise ValueError(
+            f"mesh {dict(ordered)} needs {total} devices, have {len(devices)}"
+        )
+    names = tuple(name for name, _ in ordered)
+    shape = tuple(size for _, size in ordered)
+    grid = np.array(devices[:total]).reshape(shape)
+    return Mesh(grid, names)
+
+
+def mesh_from_config(mesh_cfg: Mapping[str, int] | None) -> Mesh:
+    """Mesh from the `llm.mesh` config block; defaults to all of one axis."""
+    if not mesh_cfg:
+        return make_mesh({"dp": 1})
+    return make_mesh(mesh_cfg)
+
+
+def axis_size(mesh: Mesh, name: str) -> int:
+    return mesh.shape.get(name, 1)

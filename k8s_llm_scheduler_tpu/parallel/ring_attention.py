@@ -1,0 +1,118 @@
+"""Ring attention: causal self-attention with the sequence sharded over a
+mesh axis.
+
+Long-context prefill support (SURVEY §5 long-context axis; BASELINE config 5
+stresses an ~8k-token 256-node prompt — this module is what lets the same
+design scale far beyond that). Each device holds one sequence chunk of
+Q/K/V; K/V chunks rotate around the ring via `ppermute` while attention
+accumulates blockwise with the streaming-softmax (log-sum-exp) correction,
+so no device ever materializes the full [S, S] score matrix and the
+communication pattern rides ICI neighbor links.
+
+Pure-JAX implementation (einsum + fori_loop under shard_map) — XLA overlaps
+the ppermute with the block computation. GQA-aware like ops/attention.py.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from k8s_llm_scheduler_tpu.ops.attention import NEG_INF
+
+
+def _block_attn(q, k, v, q_pos, k_pos, scale):
+    """One (q-chunk x k-chunk) block: masked logits, local max/sum stats.
+
+    q: [B, Sq, n_kv, g, hd]; k/v: [B, Sk, n_kv, hd].
+    Returns (num [B,Sq,n_kv,g,hd], den [B,Sq,n_kv,g], mx [B,Sq,n_kv,g]).
+    """
+    logits = jnp.einsum(
+        "bqkgh,bskh->bqkgs", q.astype(jnp.float32) * scale, k.astype(jnp.float32)
+    )
+    mask = q_pos[:, None] >= k_pos[None, :]  # causal by absolute position
+    logits = jnp.where(mask[None, :, None, None, :], logits, NEG_INF)
+    mx = jnp.max(logits, axis=-1)
+    p = jnp.exp(logits - mx[..., None])
+    den = jnp.sum(p, axis=-1)
+    num = jnp.einsum("bqkgs,bskh->bqkgh", p, v.astype(jnp.float32))
+    return num, den, mx
+
+
+def ring_self_attention(
+    q: jax.Array,  # [B, S_local, n_heads, head_dim] — local sequence chunk
+    k: jax.Array,  # [B, S_local, n_kv, head_dim]
+    v: jax.Array,
+    axis_name: str,
+) -> jax.Array:
+    """Causal ring attention over `axis_name`. Call inside shard_map with the
+    sequence dim sharded over that axis. Chunks are assumed layed out in
+    order: device i holds positions [i*S_local, (i+1)*S_local)."""
+    B, S, n_heads, hd = q.shape
+    n_kv = k.shape[2]
+    g = n_heads // n_kv
+    scale = hd**-0.5
+
+    n = jax.lax.psum(1, axis_name)
+    me = jax.lax.axis_index(axis_name)
+    local_pos = jnp.arange(S)
+    q_pos = me * S + local_pos
+
+    qg = q.reshape(B, S, n_kv, g, hd)
+
+    # Initial accumulators must be marked device-varying over the ring axis
+    # or the fori_loop carry types mismatch (shard_map VMA tracking).
+    def _varying(x):
+        return jax.lax.pcast(x, (axis_name,), to="varying")
+
+    num0 = _varying(jnp.zeros((B, S, n_kv, g, hd), jnp.float32))
+    den0 = _varying(jnp.zeros((B, S, n_kv, g), jnp.float32))
+    mx0 = _varying(jnp.full((B, S, n_kv, g), NEG_INF, jnp.float32))
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(r, carry):
+        k_cur, v_cur, num, den, mx = carry
+        src = (me - r) % n  # whose chunk we hold after r rotations
+        k_pos = src * S + local_pos
+        b_num, b_den, b_mx = _block_attn(qg, k_cur, v_cur, q_pos, k_pos, scale)
+        new_mx = jnp.maximum(mx, b_mx)
+        corr_old = jnp.exp(mx - new_mx)
+        corr_new = jnp.exp(b_mx - new_mx)
+        num = num * corr_old[..., None] + b_num * corr_new[..., None]
+        den = den * corr_old + b_den * corr_new
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return (k_nxt, v_nxt, num, den, new_mx)
+
+    k_f, v_f, num, den, mx = jax.lax.fori_loop(
+        0, n, step, (k, v, num0, den0, mx0)
+    )
+    # Fully-masked rows (den==0 can't happen causally: position attends to
+    # itself) — still guard the division.
+    out = num / jnp.maximum(den, 1e-30)[..., None]
+    return out.reshape(B, S, n_heads, hd).astype(q.dtype)
+
+
+def make_ring_prefill_attention(mesh: Mesh, sp_axis: str = "sp"):
+    """shard_map-wrapped ring attention: takes full [B, S, H, hd] arrays with
+    S sharded over `sp_axis`, returns the attention output with the same
+    sharding. Drop-in replacement for causal_prefill_attention on a mesh
+    with an sp axis (full sequences, no padding)."""
+
+    @functools.partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(
+            P(None, sp_axis, None, None),
+            P(None, sp_axis, None, None),
+            P(None, sp_axis, None, None),
+        ),
+        out_specs=P(None, sp_axis, None, None),
+    )
+    def wrapped(q, k, v):
+        return ring_self_attention(q, k, v, sp_axis)
+
+    return wrapped

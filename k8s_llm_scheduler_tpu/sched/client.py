@@ -112,15 +112,13 @@ class DecisionClient:
         self.stats["total_requests"] += 1
 
         if self.cache is not None:
+            # Staleness is handled by the cache key itself: node names and
+            # readiness are part of the digest (core/cache.py), so a node
+            # going NotReady or disappearing changes the key and misses.
             cached = self.cache.get(pod, nodes)
-            # Staleness guard beyond TTL: the cached node must still exist AND
-            # be Ready in the *current* snapshot — a node can go NotReady
-            # within the TTL without changing the load figures in the key.
-            if cached is not None and validate_decision(cached, nodes):
-                node = next(n for n in nodes if n.name == cached.selected_node)
-                if node.is_ready:
-                    self.stats["cached_requests"] += 1
-                    return dataclasses.replace(cached, source=DecisionSource.CACHE)
+            if cached is not None:
+                self.stats["cached_requests"] += 1
+                return dataclasses.replace(cached, source=DecisionSource.CACHE)
 
         last_error: Exception | None = None
         for attempt in range(self.max_retries):

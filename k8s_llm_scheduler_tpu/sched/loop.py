@@ -58,7 +58,7 @@ class Scheduler:
         self._snapshot: tuple[float, Sequence[NodeMetrics]] | None = None
         self._snapshot_lock = asyncio.Lock()
         self._tasks: set[asyncio.Task] = set()
-        self._stop_event: asyncio.Event | None = None
+        self._stop_event = asyncio.Event()
         self.running = False
         self.stats = {
             "total_scheduled": 0,
@@ -78,9 +78,6 @@ class Scheduler:
             metrics = await asyncio.to_thread(self.cluster.get_node_metrics)
             self._snapshot = (time.monotonic(), metrics)
             return metrics
-
-    def invalidate_snapshot(self) -> None:
-        self._snapshot = None
 
     async def schedule_pod(self, raw: RawPod) -> bool:
         """One pod through the full pipeline (reference scheduler.py:690-729).
@@ -138,9 +135,11 @@ class Scheduler:
         Self-heals on stream errors (reference scheduler.py:683-685).
         stop() terminates the loop even while the watch stream is idle —
         each stream read is raced against the stop event."""
+        if self._stop_event.is_set():
+            return  # stop() was called before run() got scheduled
         self.running = True
-        self._stop_event = asyncio.Event()
         while self.running:
+            stream = None
             try:
                 stream = self.cluster.watch_pending_pods(self.scheduler_name).__aiter__()
                 while self.running:
@@ -151,6 +150,10 @@ class Scheduler:
                     )
                     if stop_task in done and next_task not in done:
                         next_task.cancel()
+                        try:
+                            await next_task  # let the generator settle
+                        except (asyncio.CancelledError, StopAsyncIteration):
+                            pass
                         break
                     stop_task.cancel()
                     try:
@@ -166,6 +169,10 @@ class Scheduler:
             except Exception:
                 logger.exception("watch stream error, re-watching in %.1fs", self.error_backoff_s)
                 await asyncio.sleep(self.error_backoff_s)
+            finally:
+                if stream is not None and hasattr(stream, "aclose"):
+                    # Run the generator's cleanup (stops kube watch threads).
+                    await stream.aclose()
         await self.drain()
 
     async def drain(self) -> None:
@@ -174,9 +181,9 @@ class Scheduler:
             await asyncio.gather(*list(self._tasks), return_exceptions=True)
 
     def stop(self) -> None:
+        """Request loop termination; safe to call before or during run()."""
         self.running = False
-        if self._stop_event is not None:
-            self._stop_event.set()
+        self._stop_event.set()
 
     def get_stats(self) -> dict:
         return {**self.stats, "client": self.client.get_stats()}

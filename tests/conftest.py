@@ -6,12 +6,19 @@ multi-chip sharding paths are exercised hermetically without TPU hardware.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"  # force: the env presets axon (real TPU)
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# jax is pre-imported at interpreter startup in this image, so it captured
+# JAX_PLATFORMS=axon before this file ran — override via the config API
+# (must happen before the first backend use).
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import sys
 from pathlib import Path
