@@ -67,8 +67,14 @@ class DecisionCache:
         self.hits = 0
         self.misses = 0
 
-    def get(self, pod: PodSpec, nodes: Sequence[NodeMetrics]) -> SchedulingDecision | None:
-        key = decision_cache_key(pod, nodes)
+    def get(
+        self,
+        pod: PodSpec,
+        nodes: Sequence[NodeMetrics],
+        key: str | None = None,
+    ) -> SchedulingDecision | None:
+        if key is None:
+            key = decision_cache_key(pod, nodes)
         now = time.monotonic()
         with self._lock:
             entry = self._entries.get(key)
@@ -84,13 +90,18 @@ class DecisionCache:
             return decision
 
     def set(
-        self, pod: PodSpec, nodes: Sequence[NodeMetrics], decision: SchedulingDecision
+        self,
+        pod: PodSpec,
+        nodes: Sequence[NodeMetrics],
+        decision: SchedulingDecision,
+        key: str | None = None,
     ) -> None:
         """Store a decision. Fallback decisions are never cached
         (reference scheduler.py:398-399)."""
         if decision.fallback_needed:
             return
-        key = decision_cache_key(pod, nodes)
+        if key is None:
+            key = decision_cache_key(pod, nodes)
         with self._lock:
             if key in self._entries:
                 del self._entries[key]

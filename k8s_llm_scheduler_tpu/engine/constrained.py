@@ -1,0 +1,188 @@
+"""Grammar-constrained JSON decoding — hallucination-proof by construction.
+
+The reference validates the LLM's selected node *after* decoding and falls
+back when the model hallucinates (reference scheduler.py:453-465), and needs
+a 3-strategy JSON extractor because the model may wrap the object in prose
+(scheduler.py:474-519). Here the token stream itself is constrained by a
+DFA over the decision grammar, so the model *cannot* emit anything but
+
+    {"selected_node": "<one of the allowed names>",
+     "confidence": <0.0-1.0 literal>,
+     "reasoning": "<free text, bounded length>"}
+
+- Fixed skeleton spans are forced (exactly one allowed token per state).
+- The node name is a trie over the FEASIBLE node names (core/validation
+  computes the candidate set), so selection degrees of freedom exist only
+  where names diverge.
+- `confidence` allows the literal grammar 0.d{1,2} | 1.0.
+- `reasoning` is any non-quote printable text up to a length cap, then a
+  forced closing quote+brace+EOS.
+
+The DFA compiles to two dense tables — allowed[state, vocab] (bool) and
+next_state[state, vocab] (int32) — applied INSIDE the fused decode loop on
+device (engine/engine.py): masking is a where(), transition is a gather.
+Nothing about decoding leaves the jit step, which also kills the per-token
+host round trips the axon tunnel punishes.
+
+Validation downstream (sched/client.py) stays as defense in depth.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from k8s_llm_scheduler_tpu.engine.tokenizer import Tokenizer
+
+
+@dataclasses.dataclass
+class DecisionDFA:
+    """Dense DFA tables for constrained decoding (numpy; engine ships them
+    to device once per cluster snapshot)."""
+
+    allowed: np.ndarray  # [n_states, vocab] bool
+    next_state: np.ndarray  # [n_states, vocab] int32
+    start_state: int
+    done_state: int
+
+    @property
+    def n_states(self) -> int:
+        return self.allowed.shape[0]
+
+
+class _Builder:
+    def __init__(self, vocab_size: int) -> None:
+        self.vocab = vocab_size
+        self.allowed: list[np.ndarray] = []
+        self.next_state: list[np.ndarray] = []
+
+    def new_state(self) -> int:
+        self.allowed.append(np.zeros(self.vocab, dtype=bool))
+        self.next_state.append(np.zeros(self.vocab, dtype=np.int32))
+        return len(self.allowed) - 1
+
+    def edge(self, src: int, token: int, dst: int) -> None:
+        self.allowed[src][token] = True
+        self.next_state[src][token] = dst
+
+    def chain(self, src: int, tokens: list[int]) -> int:
+        """Forced token sequence; returns the state after the last token."""
+        cur = src
+        for tok in tokens:
+            nxt = self.new_state()
+            self.edge(cur, tok, nxt)
+            cur = nxt
+        return cur
+
+    def finish(self, start: int, done: int) -> DecisionDFA:
+        return DecisionDFA(
+            allowed=np.stack(self.allowed),
+            next_state=np.stack(self.next_state),
+            start_state=start,
+            done_state=done,
+        )
+
+
+def build_decision_dfa(
+    tokenizer: Tokenizer,
+    node_names: list[str],
+    max_reason_tokens: int = 120,
+) -> DecisionDFA:
+    """Compile the decision grammar for this set of allowed node names.
+
+    Token-level trie — works for any tokenizer whose encode() is prefix-
+    consistent over the name strings (byte-level trivially is; BPE names are
+    encoded whole so each name is one fixed token path).
+    """
+    if not node_names:
+        raise ValueError("constrained decoding needs at least one allowed node name")
+    b = _Builder(tokenizer.vocab_size)
+
+    start = b.new_state()
+    done = b.new_state()
+
+    # {"selected_node": "
+    s = b.chain(start, tokenizer.encode('{"selected_node": "'))
+
+    # trie over node names; all leaves converge on the post-name state
+    post_name = b.new_state()
+    trie: dict[tuple[int, ...], int] = {(): s}
+    for name in node_names:
+        toks = tokenizer.encode(name)
+        prefix: tuple[int, ...] = ()
+        for i, tok in enumerate(toks):
+            nxt_prefix = prefix + (tok,)
+            if nxt_prefix not in trie:
+                trie[nxt_prefix] = b.new_state()
+                b.edge(trie[prefix], tok, trie[nxt_prefix])
+            elif not b.allowed[trie[prefix]][tok]:
+                b.edge(trie[prefix], tok, trie[nxt_prefix])
+            prefix = nxt_prefix
+        # closing quote after a complete name
+        quote = tokenizer.encode('"')[0]
+        b.edge(trie[prefix], quote, post_name)
+
+    # , "confidence":<space>
+    s = b.chain(post_name, tokenizer.encode(', "confidence": '))
+
+    digits = {d: tokenizer.encode(str(d))[0] for d in range(10)}
+    dot = tokenizer.encode(".")[0]
+    # 0.d or 0.dd  |  1.0
+    zero_state = b.new_state()
+    b.edge(s, digits[0], zero_state)
+    zero_dot = b.new_state()
+    b.edge(zero_state, dot, zero_dot)
+    first_dec = b.new_state()
+    for d in range(10):
+        b.edge(zero_dot, digits[d], first_dec)
+    comma = tokenizer.encode(",")[0]
+    # first decimal can end (comma handled below) or take a second decimal
+    second_dec = b.new_state()
+    for d in range(10):
+        b.edge(first_dec, digits[d], second_dec)
+    one_state = b.new_state()
+    b.edge(s, digits[1], one_state)
+    one_dot = b.new_state()
+    b.edge(one_state, dot, one_dot)
+    one_zero = b.new_state()
+    b.edge(one_dot, digits[0], one_zero)
+
+    # after the number: , "reasoning": "
+    reason_open = tokenizer.encode(' "reasoning": "')
+    after_num_chain_src = b.new_state()
+    reason_start = b.chain(after_num_chain_src, reason_open)
+    for st in (first_dec, second_dec, one_zero):
+        b.edge(st, comma, after_num_chain_src)
+
+    # reasoning: printable non-quote bytes, bounded length, then "}<EOS>
+    quote = tokenizer.encode('"')[0]
+    close_tokens = tokenizer.encode('}')
+    printable = [
+        tokenizer.encode(chr(c))[0]
+        for c in range(32, 127)
+        if chr(c) not in ('"', "\\")
+    ]
+    reason_states = [reason_start] + [b.new_state() for _ in range(max_reason_tokens)]
+    # closing path: " -> } -> EOS -> done
+    close_q = b.new_state()
+    close_b = b.chain(close_q, close_tokens)
+    b.edge(close_b, tokenizer.eos_id, done)
+    for i, st in enumerate(reason_states):
+        b.edge(st, quote, close_q)
+        if i < max_reason_tokens:
+            for tok in printable:
+                b.edge(st, tok, reason_states[i + 1])
+    # at the cap, only the quote is allowed (handled: last state has only quote)
+
+    # done state: self-loop on pad so finished slots stay well-defined
+    b.edge(done, tokenizer.pad_id, done)
+
+    return b.finish(start, done)
+
+
+def first_token_of(dfa: DecisionDFA) -> int:
+    """The single allowed first token (the opening brace)."""
+    (candidates,) = np.nonzero(dfa.allowed[dfa.start_state])
+    assert len(candidates) == 1
+    return int(candidates[0])

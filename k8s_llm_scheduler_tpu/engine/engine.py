@@ -1,0 +1,381 @@
+"""The TPU inference engine: bucketed prefill + fused multi-token decode.
+
+This is the component that replaces the reference's entire
+HuggingFaceClient network path (reference scheduler.py:418-433): where the
+reference ships a prompt over HTTPS and waits for a remote 70B, this engine
+runs the model in-process on the TPU mesh.
+
+Design, driven by XLA semantics and the measured dispatch economics
+(~20 ms/dispatch over the axon tunnel):
+
+- **Bucketed prefill**: prompts pad to the nearest bucket from
+  `prefill_buckets` (multiples of the KV page size), so there is exactly one
+  compiled prefill program per bucket. Static shapes, no recompiles in
+  steady state.
+- **Fused decode chunks**: decode runs `chunk_steps` tokens per device
+  dispatch inside one jit'd lax.scan — sampling, grammar masking, DFA state
+  transitions, KV scatters all stay on device. A ~40-token constrained JSON
+  decision completes in 2-3 dispatches instead of ~300 host round trips.
+- **Slot-based continuous batching**: a fixed decode batch of `max_slots`
+  sequence slots over the paged KV cache; requests join/leave between
+  chunks. Shapes never depend on how many requests are in flight.
+- **Grammar-constrained sampling** (engine/constrained.py): the DFA tables
+  ride along as device arrays padded to a fixed state capacity, so changing
+  the allowed node-name set never recompiles.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from k8s_llm_scheduler_tpu.engine.constrained import DecisionDFA
+from k8s_llm_scheduler_tpu.engine.kv_cache import PagedKVCache
+from k8s_llm_scheduler_tpu.engine.tokenizer import ByteTokenizer, Tokenizer
+from k8s_llm_scheduler_tpu.models.configs import LlamaConfig
+from k8s_llm_scheduler_tpu.models.llama import (
+    Params,
+    forward_decode,
+    forward_prefill,
+)
+from k8s_llm_scheduler_tpu.ops.attention import NEG_INF
+
+logger = logging.getLogger(__name__)
+
+
+def _sample(logits, mask, rng, temperature):
+    """Masked sampling: temperature>0 -> categorical, else argmax. f32."""
+    masked = jnp.where(mask, logits, NEG_INF)
+    greedy = jnp.argmax(masked, axis=-1)
+    scaled = masked / jnp.maximum(temperature, 1e-6)
+    sampled = jax.random.categorical(rng, scaled, axis=-1)
+    return jnp.where(temperature > 0, sampled, greedy).astype(jnp.int32)
+
+
+def _first_token_impl(logits_last, allowed, state, rng, temperature):
+    """Sample each slot's first generated token from prefill logits."""
+    mask = allowed[state]  # [B, V]
+    return _sample(logits_last, mask, rng, temperature)
+
+
+def _decode_chunk_impl(
+    params: Params,
+    cfg: LlamaConfig,
+    k_cache, v_cache,
+    page_tables,
+    tokens,      # [B] current input token per slot (sampled, not yet processed)
+    positions,   # [B] position of that token
+    active,      # [B] bool
+    dfa_state,   # [B] int32
+    allowed,     # [S, V] bool (padded to fixed S)
+    next_state,  # [S, V] int32
+    done_state,  # scalar int32
+    eos_id,      # scalar int32
+    pad_id,      # scalar int32 — emission sentinel for finished slots
+    rng,
+    temperature,  # scalar f32
+    n_steps: int,
+):
+    """`n_steps` decode iterations fused into one program. Emits the sampled
+    token per step; finished/inactive slots emit pad_id and idle in place."""
+
+    def step(carry, _):
+        kc, vc, tok, pos, act, st, key = carry
+        logits, kc, vc = forward_decode(
+            params, cfg, tok, pos, kc, vc, page_tables, act
+        )
+        key, sub = jax.random.split(key)
+        mask = allowed[st]
+        nxt = _sample(logits, mask, sub, temperature)
+        new_st = next_state[st, nxt]
+        emitted = jnp.where(act, nxt, pad_id)
+        new_st = jnp.where(act, new_st, st)
+        finished = (new_st == done_state) | (nxt == eos_id)
+        new_act = act & ~finished
+        new_pos = jnp.where(act, pos + 1, pos)
+        return (kc, vc, emitted, new_pos, new_act, new_st, key), emitted
+
+    (k_cache, v_cache, tokens, positions, active, dfa_state, rng), toks = (
+        jax.lax.scan(
+            step,
+            (k_cache, v_cache, tokens, positions, active, dfa_state, rng),
+            None,
+            length=n_steps,
+        )
+    )
+    return k_cache, v_cache, tokens, positions, active, dfa_state, rng, toks.T  # [B, n]
+
+
+@dataclasses.dataclass
+class _Request:
+    req_id: int
+    slot: int
+    prompt_len: int
+    max_new_tokens: int
+    generated: list[int] = dataclasses.field(default_factory=list)
+    done: bool = False
+    submitted_at: float = dataclasses.field(default_factory=time.perf_counter)
+
+
+@dataclasses.dataclass
+class Finished:
+    req_id: int
+    token_ids: list[int]
+    text: str
+    latency_ms: float
+
+
+class InferenceEngine:
+    """Single-owner (one thread/task) engine over one model + one KV cache."""
+
+    DFA_STATE_CAPACITY = 4096
+
+    def __init__(
+        self,
+        params: Params,
+        cfg: LlamaConfig,
+        tokenizer: Tokenizer | None = None,
+        *,
+        num_pages: int = 512,
+        page_size: int = 64,
+        max_slots: int = 8,
+        max_pages_per_seq: int = 64,
+        prefill_buckets: tuple[int, ...] = (128, 256, 512, 1024, 2048, 4096, 8192),
+        chunk_steps: int = 16,
+        temperature: float = 0.3,
+        rng_seed: int = 0,
+    ) -> None:
+        self.cfg = cfg
+        self.params = params
+        self.tokenizer = tokenizer or ByteTokenizer()
+        self.kv = PagedKVCache(
+            cfg,
+            num_pages=num_pages,
+            page_size=page_size,
+            max_slots=max_slots,
+            max_pages_per_seq=max_pages_per_seq,
+        )
+        bad = [bkt for bkt in prefill_buckets if bkt % page_size]
+        if bad:
+            raise ValueError(f"prefill buckets {bad} not multiples of page_size={page_size}")
+        self.prefill_buckets = tuple(sorted(prefill_buckets))
+        self.chunk_steps = int(chunk_steps)
+        self.temperature = float(temperature)
+        self.max_slots = max_slots
+
+        self._prefill = jax.jit(forward_prefill, static_argnums=(1,))
+        self._first = jax.jit(_first_token_impl)
+        self._chunk = jax.jit(
+            _decode_chunk_impl, static_argnums=(1, 16), donate_argnums=(2, 3)
+        )
+
+        # Grammar tables (fixed shapes; content swaps without recompiling).
+        V = self.tokenizer.vocab_size
+        self._allowed = jnp.ones((self.DFA_STATE_CAPACITY, V), dtype=bool)
+        self._next_state = jnp.zeros((self.DFA_STATE_CAPACITY, V), dtype=jnp.int32)
+        self._done_state = jnp.int32(-1)  # unconstrained: nothing reaches done
+        self._dfa_start = 0
+
+        self._rng = jax.random.PRNGKey(rng_seed)
+        self._req_counter = 0
+        self._by_slot: dict[int, _Request] = {}
+        # Host mirrors of per-slot decode state.
+        B = max_slots
+        self._tok_np = np.zeros(B, dtype=np.int32)
+        self._pos_np = np.zeros(B, dtype=np.int32)
+        self._act_np = np.zeros(B, dtype=bool)
+        self._st_np = np.zeros(B, dtype=np.int32)
+        self.stats = {
+            "requests": 0,
+            "completed": 0,
+            "prefill_tokens": 0,
+            "decode_tokens": 0,
+            "chunks": 0,
+            "prefills": 0,
+        }
+
+    # ------------------------------------------------------------- grammar
+    def set_grammar(self, dfa: DecisionDFA | None) -> None:
+        """Install (or clear) the decision grammar. Padded to fixed capacity
+        so this never changes compiled shapes."""
+        V = self.tokenizer.vocab_size
+        cap = self.DFA_STATE_CAPACITY
+        if dfa is None:
+            self._allowed = jnp.ones((cap, V), dtype=bool)
+            self._next_state = jnp.zeros((cap, V), dtype=jnp.int32)
+            self._done_state = jnp.int32(-1)
+            self._dfa_start = 0
+            return
+        if dfa.n_states > cap:
+            raise ValueError(
+                f"DFA has {dfa.n_states} states > capacity {cap} "
+                "(raise DFA_STATE_CAPACITY or shrink max_reason_tokens)"
+            )
+        allowed = np.zeros((cap, V), dtype=bool)
+        nxt = np.zeros((cap, V), dtype=np.int32)
+        allowed[: dfa.n_states] = dfa.allowed
+        nxt[: dfa.n_states] = dfa.next_state
+        self._allowed = jnp.asarray(allowed)
+        self._next_state = jnp.asarray(nxt)
+        self._done_state = jnp.int32(dfa.done_state)
+        self._dfa_start = dfa.start_state
+
+    # ------------------------------------------------------------ requests
+    def _bucket_for(self, n: int) -> int:
+        for bkt in self.prefill_buckets:
+            if n <= bkt:
+                return bkt
+        raise ValueError(
+            f"prompt of {n} tokens exceeds largest prefill bucket "
+            f"{self.prefill_buckets[-1]}"
+        )
+
+    @property
+    def free_slots(self) -> int:
+        return self.max_slots - len(self._by_slot)
+
+    @property
+    def has_active(self) -> bool:
+        return bool(self._by_slot)
+
+    def add_request(
+        self,
+        prompt_ids: list[int],
+        max_new_tokens: int = 200,
+    ) -> int:
+        """Prefill a prompt into a free slot; returns req_id. The request
+        starts decoding at the next `step()` call.
+
+        max_new_tokens defaults to the reference's sampling cap
+        (config.yaml:14)."""
+        if not prompt_ids:
+            raise ValueError("empty prompt")
+        if self.free_slots == 0:
+            raise RuntimeError("no free slots — backpressure the caller")
+        n = len(prompt_ids)
+        bucket = self._bucket_for(n)
+        pad = self.tokenizer.pad_id
+        tokens = np.full((1, bucket), pad, dtype=np.int32)
+        tokens[0, :n] = prompt_ids
+        reserve = max_new_tokens + self.chunk_steps
+        slot = self.kv.allocate_slot(n, reserve_decode=reserve)
+
+        logits, k_all, v_all = self._prefill(
+            self.params, self.cfg, jnp.asarray(tokens), jnp.asarray([n])
+        )
+        self.kv.write_prefill(slot, k_all[:, 0], v_all[:, 0], n)
+
+        # First generated token from the prefill's last valid logits.
+        self._rng, sub = jax.random.split(self._rng)
+        state0 = jnp.asarray([self._dfa_start], dtype=jnp.int32)
+        first = self._first(
+            logits[:, n - 1], self._allowed, state0, sub,
+            jnp.float32(self.temperature),
+        )
+        first_tok = int(first[0])
+        next_st = int(self._next_state[self._dfa_start, first_tok])
+
+        req = _Request(
+            req_id=self._req_counter,
+            slot=slot,
+            prompt_len=n,
+            max_new_tokens=max_new_tokens,
+        )
+        self._req_counter += 1
+        self._by_slot[slot] = req
+        req.generated.append(first_tok)
+
+        self._tok_np[slot] = first_tok
+        self._pos_np[slot] = n  # the first generated token sits at index n
+        self._act_np[slot] = True
+        self._st_np[slot] = next_st
+        self.stats["requests"] += 1
+        self.stats["prefills"] += 1
+        self.stats["prefill_tokens"] += n
+        return req.req_id
+
+    # ---------------------------------------------------------------- step
+    def step(self) -> list[Finished]:
+        """One fused decode chunk for all active slots; returns requests that
+        finished during this chunk."""
+        if not self._by_slot:
+            return []
+        n = self.chunk_steps
+        for slot, req in self._by_slot.items():
+            if self._act_np[slot]:
+                self.kv.ensure_capacity(slot, int(self._pos_np[slot]) + n + 1)
+
+        self._rng, sub = jax.random.split(self._rng)
+        (
+            self.kv.k, self.kv.v,
+            tok_d, pos_d, act_d, st_d, _, toks_d,
+        ) = self._chunk(
+            self.params, self.cfg, self.kv.k, self.kv.v,
+            self.kv.page_tables(),
+            jnp.asarray(self._tok_np), jnp.asarray(self._pos_np),
+            jnp.asarray(self._act_np), jnp.asarray(self._st_np),
+            self._allowed, self._next_state, self._done_state,
+            jnp.int32(self.tokenizer.eos_id), jnp.int32(self.tokenizer.pad_id),
+            sub, jnp.float32(self.temperature), n,
+        )
+        # One host sync for the whole chunk (np.array copies: the mirrors
+        # are mutated host-side, and views of jax buffers are read-only).
+        toks, self._tok_np, self._pos_np, self._act_np, self._st_np = (
+            np.asarray(toks_d), np.array(tok_d), np.array(pos_d),
+            np.array(act_d), np.array(st_d),
+        )
+        self.stats["chunks"] += 1
+
+        finished: list[Finished] = []
+        for slot, req in list(self._by_slot.items()):
+            emitted = [int(t) for t in toks[slot] if t != self.tokenizer.pad_id]
+            # Tokens after the finishing token are pad, so emitted is exact.
+            req.generated.extend(emitted)
+            self.stats["decode_tokens"] += len(emitted)
+            hit_cap = len(req.generated) >= req.max_new_tokens
+            if not self._act_np[slot] or hit_cap:
+                if hit_cap:
+                    self._act_np[slot] = False
+                req.done = True
+                self.kv.free_slot(slot)
+                del self._by_slot[slot]
+                ids = req.generated[: req.max_new_tokens]
+                finished.append(
+                    Finished(
+                        req_id=req.req_id,
+                        token_ids=ids,
+                        text=self.tokenizer.decode(ids),
+                        latency_ms=(time.perf_counter() - req.submitted_at) * 1000.0,
+                    )
+                )
+                self.stats["completed"] += 1
+        return finished
+
+    def abort_all(self) -> None:
+        """Free every in-flight slot and its KV pages — recovery path after a
+        failed decode chunk so the engine never leaks capacity."""
+        for slot in list(self._by_slot):
+            self.kv.free_slot(slot)
+            del self._by_slot[slot]
+        self._act_np[:] = False
+
+    # ------------------------------------------------------------ convenience
+    def generate(
+        self, prompt_ids: list[int], max_new_tokens: int = 200
+    ) -> Finished:
+        """Synchronous single-request generation (tests, simple callers)."""
+        req_id = self.add_request(prompt_ids, max_new_tokens)
+        while True:
+            for fin in self.step():
+                if fin.req_id == req_id:
+                    return fin
+
+    def get_stats(self) -> dict[str, Any]:
+        return {**self.stats, "pages_free": self.kv.pages_free,
+                "slots_free": self.free_slots}

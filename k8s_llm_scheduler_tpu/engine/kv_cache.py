@@ -138,8 +138,13 @@ class PagedKVCache:
 
     def ensure_decode_capacity(self, slot: int) -> None:
         """Grow the slot by one page if the next token would overflow."""
+        self.ensure_capacity(slot, self._slots[slot].length + 1)
+
+    def ensure_capacity(self, slot: int, upto_len: int) -> None:
+        """Grow the slot's page list to cover `upto_len` tokens (used to
+        reserve a whole fused-decode chunk ahead of time)."""
         info = self._slots[slot]
-        if info.length + 1 > len(info.pages) * self.page_size:
+        while len(info.pages) * self.page_size < upto_len:
             if len(info.pages) + 1 > self.max_pages_per_seq:
                 raise OutOfPagesError("sequence exceeded max_pages_per_seq")
             (page,) = self._alloc_pages(1)

@@ -1,0 +1,270 @@
+"""LocalLLMBackend — the in-tree TPU decision backend with continuous
+batching.
+
+This implements the DecisionBackend seam (engine/backend.py) with a real
+model: prompts built by core/prompt.py, decoded by engine/engine.py under a
+node-name grammar (engine/constrained.py). It replaces the reference's
+HuggingFaceClient._make_api_call (reference scheduler.py:418-433) — same
+inputs (pod, cluster state), same output (a SchedulingDecision), zero
+network.
+
+Concurrency model: DecisionClient calls get_scheduling_decision from worker
+threads (one per in-flight pod, via asyncio.to_thread). Those calls enqueue
+a request and block on a Future. A single engine-owner thread drains the
+queue and drives the InferenceEngine: admit -> fused decode chunk -> admit
+more -> ... — so concurrent pod decisions share decode batches
+(continuous batching at chunk granularity), and a burst of N pods costs
+~N/max_slots decode streams instead of N serial ones.
+
+Grammar grouping: the engine holds ONE grammar at a time, keyed by the
+cluster snapshot's ready-node-name set. Requests are grouped by that key;
+a new group installs its DFA only when the engine drains. Within a burst
+(shared snapshot — the reference's own cache-key equivalence,
+scheduler.py:265-271) everything lands in one group.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+import time
+from collections.abc import Sequence
+from concurrent.futures import Future
+from typing import Any
+
+import jax
+
+from k8s_llm_scheduler_tpu.core.prompt import PromptEngine
+from k8s_llm_scheduler_tpu.core.validation import feasible_nodes
+from k8s_llm_scheduler_tpu.engine.backend import BackendError, NoFeasibleNodeError
+from k8s_llm_scheduler_tpu.engine.constrained import build_decision_dfa
+from k8s_llm_scheduler_tpu.engine.engine import InferenceEngine
+from k8s_llm_scheduler_tpu.engine.tokenizer import ByteTokenizer, Tokenizer
+from k8s_llm_scheduler_tpu.models.configs import LlamaConfig, get_config
+from k8s_llm_scheduler_tpu.models.llama import init_params
+from k8s_llm_scheduler_tpu.parallel.mesh import mesh_from_config
+from k8s_llm_scheduler_tpu.parallel.sharding import (
+    param_specs,
+    shard_params,
+    validate_specs_divisibility,
+)
+from k8s_llm_scheduler_tpu.types import (
+    DecisionSource,
+    NodeMetrics,
+    PodSpec,
+    SchedulingDecision,
+)
+from k8s_llm_scheduler_tpu.utils.json_extract import parse_decision_json
+
+logger = logging.getLogger(__name__)
+
+
+class _WorkItem:
+    __slots__ = ("prompt_ids", "grammar_key", "node_names", "future", "enqueued_at")
+
+    def __init__(self, prompt_ids, grammar_key, node_names):
+        self.prompt_ids = prompt_ids
+        self.grammar_key = grammar_key
+        self.node_names = node_names
+        self.future: Future = Future()
+        self.enqueued_at = time.perf_counter()
+
+
+class LocalLLMBackend:
+    """DecisionBackend over an in-process InferenceEngine."""
+
+    def __init__(
+        self,
+        engine: InferenceEngine,
+        tokenizer: Tokenizer | None = None,
+        max_new_tokens: int = 200,
+        constrained: bool = True,
+        request_timeout_s: float = 60.0,
+        admit_wait_s: float = 0.002,
+    ) -> None:
+        self.engine = engine
+        self.tokenizer = tokenizer or engine.tokenizer
+        self.prompt_engine = PromptEngine()
+        self.max_new_tokens = max_new_tokens
+        self.constrained = constrained and self.tokenizer.vocab_size <= 2048
+        if constrained and not self.constrained:
+            logger.warning(
+                "constrained decoding disabled: vocab %d too large for dense DFA tables",
+                self.tokenizer.vocab_size,
+            )
+        self.request_timeout_s = request_timeout_s
+        self.admit_wait_s = admit_wait_s
+        self._queue: queue.Queue[_WorkItem | None] = queue.Queue()
+        self._dfa_cache: dict[tuple[str, ...], Any] = {}
+        self._current_group: tuple[str, ...] | None = None
+        self._worker = threading.Thread(
+            target=self._run_worker, daemon=True, name="llm-engine"
+        )
+        self._stopped = threading.Event()
+        self._worker.start()
+
+    # ------------------------------------------------------------- backend
+    def get_scheduling_decision(
+        self, pod: PodSpec, nodes: Sequence[NodeMetrics]
+    ) -> SchedulingDecision:
+        candidates = feasible_nodes(pod, nodes)
+        if not candidates:
+            raise NoFeasibleNodeError(
+                f"no feasible node for {pod.namespace}/{pod.name}"
+            )
+        prompt_text = self.prompt_engine.construct_scheduling_prompt(pod, nodes)
+        prompt_ids = self.tokenizer.chat_prompt(
+            self.prompt_engine.system_prompt, prompt_text
+        )
+        # Grammar over READY nodes of this snapshot (stable across the pods
+        # of a burst); per-pod feasibility is enforced by validation upstream.
+        ready_names = tuple(sorted(n.name for n in nodes if n.is_ready))
+        item = _WorkItem(prompt_ids, ready_names if self.constrained else None,
+                         ready_names)
+        self._queue.put(item)
+        try:
+            text = item.future.result(timeout=self.request_timeout_s)
+        except TimeoutError as exc:
+            raise BackendError(f"decision timed out after {self.request_timeout_s}s") from exc
+        return self._parse(text, pod)
+
+    def _parse(self, text: str, pod: PodSpec) -> SchedulingDecision:
+        parsed = parse_decision_json(text)
+        if parsed is None:
+            raise BackendError(f"model produced unparseable decision: {text[:200]!r}")
+        return SchedulingDecision(
+            selected_node=parsed["selected_node"],
+            confidence=parsed["confidence"],
+            reasoning=parsed["reasoning"],
+            source=DecisionSource.LLM,
+        )
+
+    # -------------------------------------------------------------- worker
+    def _grammar_for(self, key: tuple[str, ...]):
+        if key not in self._dfa_cache:
+            if len(self._dfa_cache) > 16:
+                self._dfa_cache.clear()
+            # The whole emission must fit in max_new_tokens or the decode
+            # truncates mid-JSON: skeleton (~60 tokens byte-level) + longest
+            # name + reasoning + closing.
+            overhead = 60 + max(len(self.tokenizer.encode(n)) for n in key)
+            max_reason = max(8, self.max_new_tokens - overhead - 4)
+            self._dfa_cache[key] = build_decision_dfa(
+                self.tokenizer, list(key), max_reason_tokens=max_reason
+            )
+        return self._dfa_cache[key]
+
+    def _admit(self, pending: list[_WorkItem], inflight: dict[int, _WorkItem]) -> list[_WorkItem]:
+        """Admit queued items whose grammar matches the current group."""
+        rest: list[_WorkItem] = []
+        for item in pending:
+            if self.engine.free_slots == 0:
+                rest.append(item)
+                continue
+            if not inflight and item.grammar_key != self._current_group:
+                # Engine drained: switch grammar groups.
+                self._current_group = item.grammar_key
+                self.engine.set_grammar(
+                    self._grammar_for(item.grammar_key)
+                    if item.grammar_key is not None
+                    else None
+                )
+            if item.grammar_key != self._current_group:
+                rest.append(item)
+                continue
+            try:
+                req_id = self.engine.add_request(item.prompt_ids, self.max_new_tokens)
+            except Exception as exc:  # slot/page pressure or bad prompt
+                item.future.set_exception(BackendError(str(exc)))
+                continue
+            inflight[req_id] = item
+        return rest
+
+    def _run_worker(self) -> None:
+        pending: list[_WorkItem] = []
+        inflight: dict[int, _WorkItem] = {}
+        while not self._stopped.is_set():
+            # Drain the queue (block briefly when totally idle).
+            try:
+                timeout = None if (not pending and not inflight) else 0.0
+                while True:
+                    item = self._queue.get(timeout=timeout) if timeout is None else self._queue.get_nowait()
+                    if item is None:
+                        return
+                    pending.append(item)
+                    timeout = 0.0
+            except queue.Empty:
+                pass
+            if not pending and not inflight:
+                continue
+            if pending and self.admit_wait_s and not inflight:
+                # tiny window to let a burst coalesce into one batch
+                time.sleep(self.admit_wait_s)
+                try:
+                    while True:
+                        extra = self._queue.get_nowait()
+                        if extra is None:
+                            return
+                        pending.append(extra)
+                except queue.Empty:
+                    pass
+            pending = self._admit(pending, inflight)
+            if not inflight:
+                continue
+            try:
+                for fin in self.engine.step():
+                    item = inflight.pop(fin.req_id, None)
+                    if item is not None:
+                        item.future.set_result(fin.text)
+            except Exception as exc:
+                logger.exception("engine chunk failed")
+                for item in inflight.values():
+                    item.future.set_exception(BackendError(str(exc)))
+                inflight.clear()
+                # Free wedged slots/pages or the engine's capacity leaks and
+                # every later request queues until timeout.
+                self.engine.abort_all()
+
+    def close(self) -> None:
+        self._stopped.set()
+        self._queue.put(None)
+        self._worker.join(timeout=5)
+
+    def get_stats(self) -> dict[str, Any]:
+        return self.engine.get_stats()
+
+
+def build_local_backend(
+    model: str = "tiny",
+    mesh_axes: dict[str, int] | None = None,
+    *,
+    cfg: LlamaConfig | None = None,
+    temperature: float = 0.3,
+    max_slots: int = 8,
+    num_pages: int = 512,
+    page_size: int = 64,
+    prefill_buckets: tuple[int, ...] = (128, 256, 512, 1024, 2048, 4096, 8192),
+    chunk_steps: int = 16,
+    max_new_tokens: int = 200,
+    constrained: bool = True,
+    rng_seed: int = 0,
+) -> LocalLLMBackend:
+    """Construct the full local stack: params (random-init until a checkpoint
+    is loaded — models/loader.py), mesh sharding, engine, backend."""
+    cfg = cfg or get_config(model)
+    mesh = mesh_from_config(mesh_axes)
+    params = init_params(jax.random.PRNGKey(rng_seed), cfg)
+    if mesh.devices.size > 1:
+        validate_specs_divisibility(cfg, mesh)
+        params = shard_params(params, mesh, param_specs(cfg), cfg)
+    tokenizer = ByteTokenizer()
+    engine = InferenceEngine(
+        params, cfg, tokenizer,
+        num_pages=num_pages, page_size=page_size, max_slots=max_slots,
+        prefill_buckets=prefill_buckets, chunk_steps=chunk_steps,
+        temperature=temperature,
+    )
+    return LocalLLMBackend(
+        engine, tokenizer, max_new_tokens=max_new_tokens, constrained=constrained
+    )

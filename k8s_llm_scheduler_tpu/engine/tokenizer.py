@@ -1,0 +1,94 @@
+"""Tokenizers for the decision model.
+
+Two implementations behind one tiny interface:
+
+- `ByteTokenizer`: deterministic byte-level vocab (256 bytes + specials,
+  padded to 512 for MXU-friendly embedding shapes). Zero files, zero
+  network — used by tests, benches, and any run without a real checkpoint.
+  This is what lets the framework exercise the full TPU path hermetically
+  (the reference can't test its LLM path without the live HF API,
+  SURVEY §4).
+- `HFTokenizerAdapter`: wraps a local HuggingFace tokenizer directory for
+  real Llama checkpoints (transformers is in-image; loading is from local
+  files only — zero external API calls is the north star).
+
+The chat template mirrors the reference's two-message structure
+(system + user, reference scheduler.py:425-430) with explicit role tokens.
+"""
+
+from __future__ import annotations
+
+from typing import Protocol, Sequence
+
+
+class Tokenizer(Protocol):
+    vocab_size: int
+    pad_id: int
+    eos_id: int
+
+    def encode(self, text: str) -> list[int]: ...
+    def decode(self, ids: Sequence[int]) -> str: ...
+    def chat_prompt(self, system: str, user: str) -> list[int]: ...
+
+
+class ByteTokenizer:
+    """Bytes 0-255 map to ids 1-256; specials above; vocab padded to 512."""
+
+    PAD = 0
+    BOS = 257
+    EOS = 258
+    SYSTEM = 259
+    USER = 260
+    ASSISTANT = 261
+    END_ROLE = 262
+
+    vocab_size = 512
+    pad_id = PAD
+    eos_id = EOS
+
+    def encode(self, text: str) -> list[int]:
+        return [b + 1 for b in text.encode("utf-8")]
+
+    def decode(self, ids: Sequence[int]) -> str:
+        data = bytes(i - 1 for i in ids if 1 <= i <= 256)
+        return data.decode("utf-8", errors="replace")
+
+    def chat_prompt(self, system: str, user: str) -> list[int]:
+        """[BOS][SYSTEM]...[END_ROLE][USER]...[END_ROLE][ASSISTANT]"""
+        return (
+            [self.BOS, self.SYSTEM]
+            + self.encode(system)
+            + [self.END_ROLE, self.USER]
+            + self.encode(user)
+            + [self.END_ROLE, self.ASSISTANT]
+        )
+
+
+class HFTokenizerAdapter:
+    """Local-files-only wrapper over a HuggingFace fast tokenizer.
+
+    `path` must contain tokenizer.json etc. (e.g. an exported Llama 3
+    tokenizer dir). Import is deferred so hermetic environments never touch
+    transformers.
+    """
+
+    def __init__(self, path: str) -> None:
+        from transformers import AutoTokenizer  # local import by design
+
+        self._tok = AutoTokenizer.from_pretrained(path, local_files_only=True)
+        self.vocab_size = len(self._tok)
+        self.pad_id = self._tok.pad_token_id or 0
+        self.eos_id = self._tok.eos_token_id
+
+    def encode(self, text: str) -> list[int]:
+        return self._tok.encode(text, add_special_tokens=False)
+
+    def decode(self, ids: Sequence[int]) -> str:
+        return self._tok.decode(ids, skip_special_tokens=True)
+
+    def chat_prompt(self, system: str, user: str) -> list[int]:
+        messages = [
+            {"role": "system", "content": system},
+            {"role": "user", "content": user},
+        ]
+        return self._tok.apply_chat_template(messages, add_generation_prompt=True)

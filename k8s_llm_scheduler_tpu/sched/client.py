@@ -29,7 +29,7 @@ import time
 from collections.abc import Sequence
 
 from k8s_llm_scheduler_tpu.core.breaker import CircuitBreaker, CircuitOpenError
-from k8s_llm_scheduler_tpu.core.cache import DecisionCache
+from k8s_llm_scheduler_tpu.core.cache import DecisionCache, decision_cache_key
 from k8s_llm_scheduler_tpu.core.fallback import fallback_decision
 from k8s_llm_scheduler_tpu.core.validation import validate_decision
 from k8s_llm_scheduler_tpu.engine.backend import DecisionBackend, NoFeasibleNodeError
@@ -73,10 +73,16 @@ class DecisionClient:
             "successful_requests": 0,
             "failed_requests": 0,
             "cached_requests": 0,
+            "coalesced_requests": 0,
             "fallback_decisions": 0,
             "invalid_decisions": 0,
             "avg_response_time_ms": 0.0,
         }
+        # Single-flight: identical (pod shape, cluster state) decisions share
+        # one in-flight backend call — without this, a 1000-pod burst of 8
+        # shapes fires 1000 LLM requests before the first one can populate
+        # the cache.
+        self._inflight: dict[str, asyncio.Future] = {}
 
     def _note_response_time(self, ms: float) -> None:
         """Running average (reference scheduler.py:435-441)."""
@@ -111,15 +117,57 @@ class DecisionClient:
         cluster as source of truth, SURVEY §5 checkpoint note)."""
         self.stats["total_requests"] += 1
 
+        key: str | None = None
+        my_future: asyncio.Future | None = None
         if self.cache is not None:
             # Staleness is handled by the cache key itself: node names and
             # readiness are part of the digest (core/cache.py), so a node
             # going NotReady or disappearing changes the key and misses.
-            cached = self.cache.get(pod, nodes)
+            key = decision_cache_key(pod, nodes)
+            cached = self.cache.get(pod, nodes, key=key)
             if cached is not None:
                 self.stats["cached_requests"] += 1
                 return dataclasses.replace(cached, source=DecisionSource.CACHE)
+            existing = self._inflight.get(key)
+            if existing is not None:
+                try:
+                    leader = await asyncio.shield(existing)
+                except Exception:
+                    leader = None
+                if leader is not None:
+                    self.stats["coalesced_requests"] += 1
+                    self.stats["cached_requests"] += 1
+                    return dataclasses.replace(leader, source=DecisionSource.CACHE)
+                # Leader failed or fell back — compute independently below.
+            fut = asyncio.get_running_loop().create_future()
+            # Register only if nobody else re-registered first (two followers
+            # waking from a failed leader must not overwrite each other).
+            if self._inflight.setdefault(key, fut) is fut:
+                my_future = fut
 
+        try:
+            decision = await self._decide_uncached(pod, nodes, cache_key=key)
+        except BaseException:
+            if my_future is not None:
+                if self._inflight.get(key) is my_future:
+                    del self._inflight[key]
+                my_future.set_result(None)
+            raise
+        if my_future is not None:
+            if self._inflight.get(key) is my_future:
+                del self._inflight[key]
+            # Followers reuse only clean LLM decisions.
+            my_future.set_result(
+                decision if decision is not None and not decision.fallback_needed else None
+            )
+        return decision
+
+    async def _decide_uncached(
+        self,
+        pod: PodSpec,
+        nodes: Sequence[NodeMetrics],
+        cache_key: str | None = None,
+    ) -> SchedulingDecision | None:
         last_error: Exception | None = None
         for attempt in range(self.max_retries):
             start = time.perf_counter()  # per attempt: excludes backoff sleeps
@@ -158,7 +206,7 @@ class DecisionClient:
                 decision.latency_ms = elapsed_ms
             self._note_response_time(elapsed_ms)
             if self.cache is not None:
-                self.cache.set(pod, nodes, decision)
+                self.cache.set(pod, nodes, decision, key=cache_key)
             return decision
 
         self.stats["failed_requests"] += 1

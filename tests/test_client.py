@@ -180,3 +180,65 @@ class TestDecide:
         assert stats["total_requests"] == 1
         assert "cache" in stats and "circuit_breaker" in stats
         assert stats["avg_response_time_ms"] > 0
+
+
+class SlowBackend:
+    def __init__(self, latency=0.1):
+        self.latency = latency
+        self.calls = 0
+
+    def get_scheduling_decision(self, pod, nodes):
+        self.calls += 1
+        import time as _t
+
+        _t.sleep(self.latency)
+        return SchedulingDecision(
+            selected_node=nodes[0].name, confidence=0.9, reasoning="slow"
+        )
+
+
+class TestSingleFlight:
+    @pytest.mark.asyncio
+    async def test_identical_inflight_decisions_coalesce(self, three_nodes):
+        """N identical concurrent requests -> 1 backend call; followers get
+        CACHE-sourced copies."""
+        import asyncio
+
+        backend = SlowBackend(latency=0.1)
+        c = client(backend)
+        results = await asyncio.gather(
+            *(c.get_scheduling_decision(make_pod(f"p{i}"), three_nodes) for i in range(8))
+        )
+        assert backend.calls == 1
+        assert sum(1 for d in results if d.source is DecisionSource.LLM) == 1
+        assert sum(1 for d in results if d.source is DecisionSource.CACHE) == 7
+        assert c.stats["coalesced_requests"] == 7
+
+    @pytest.mark.asyncio
+    async def test_different_shapes_not_coalesced(self, three_nodes):
+        import asyncio
+
+        backend = SlowBackend(latency=0.05)
+        c = client(backend)
+        await asyncio.gather(
+            c.get_scheduling_decision(make_pod("a", cpu=0.1), three_nodes),
+            c.get_scheduling_decision(make_pod("b", cpu=2.0), three_nodes),
+        )
+        assert backend.calls == 2
+
+    @pytest.mark.asyncio
+    async def test_leader_failure_not_propagated_to_followers(self, three_nodes):
+        """If the leader's backend call fails, followers compute their own
+        decision instead of inheriting the failure."""
+        import asyncio
+
+        backend = StubBackend()
+        backend.fail_next = 3  # leader exhausts its retries; follower succeeds
+        c = client(backend, max_retries=3)
+        r = await asyncio.gather(
+            c.get_scheduling_decision(make_pod("p1"), three_nodes),
+            c.get_scheduling_decision(make_pod("p2"), three_nodes),
+        )
+        sources = sorted(d.source.value for d in r)
+        # One fell back (leader), the other got a real LLM decision.
+        assert "fallback" in sources and "llm" in sources

@@ -1,0 +1,189 @@
+"""Inference engine: tokenizer, constrained DFA, fused decode, local backend."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from k8s_llm_scheduler_tpu.engine.constrained import (
+    build_decision_dfa,
+    first_token_of,
+)
+from k8s_llm_scheduler_tpu.engine.engine import InferenceEngine
+from k8s_llm_scheduler_tpu.engine.tokenizer import ByteTokenizer
+from k8s_llm_scheduler_tpu.models.configs import LlamaConfig
+from k8s_llm_scheduler_tpu.models.llama import init_params
+from k8s_llm_scheduler_tpu.utils.json_extract import parse_decision_json
+
+TOK = ByteTokenizer()
+
+ENGINE_CFG = LlamaConfig(
+    name="engine-test", vocab_size=512, d_model=64, n_layers=2, n_heads=4,
+    n_kv_heads=2, d_ff=128, max_seq_len=2048, rope_theta=10000.0,
+    dtype=jnp.float32, tie_embeddings=True,
+)
+
+
+@pytest.fixture(scope="module")
+def engine():
+    params = init_params(jax.random.PRNGKey(0), ENGINE_CFG)
+    return InferenceEngine(
+        params, ENGINE_CFG, TOK,
+        num_pages=128, page_size=64, max_slots=4, max_pages_per_seq=32,
+        prefill_buckets=(128, 256, 512, 1024),
+        chunk_steps=8, temperature=0.0,
+    )
+
+
+class TestTokenizer:
+    def test_roundtrip(self):
+        text = 'node-1 {"x": 0.5}'
+        assert TOK.decode(TOK.encode(text)) == text
+
+    def test_specials_not_in_byte_range(self):
+        ids = TOK.chat_prompt("sys", "user")
+        assert ids[0] == TOK.BOS
+        assert TOK.SYSTEM in ids and TOK.USER in ids and TOK.ASSISTANT in ids
+        assert TOK.decode(ids) == "sysuser"  # specials skipped
+
+    def test_vocab_bounds(self):
+        ids = TOK.encode("".join(chr(c) for c in range(32, 127)))
+        assert all(1 <= i <= 256 for i in ids)
+        assert TOK.vocab_size == 512
+
+
+class TestDecisionDFA:
+    NAMES = ["node-a", "node-b", "node-abc"]
+
+    def test_every_state_has_an_out_edge(self):
+        dfa = build_decision_dfa(TOK, self.NAMES, max_reason_tokens=10)
+        assert dfa.allowed[: dfa.n_states].any(axis=1).all()
+
+    def test_first_token_is_open_brace(self):
+        dfa = build_decision_dfa(TOK, self.NAMES)
+        assert first_token_of(dfa) == TOK.encode("{")[0]
+
+    def _random_walk(self, dfa, rng, max_len=400):
+        state = dfa.start_state
+        out = []
+        for _ in range(max_len):
+            if state == dfa.done_state:
+                break
+            (opts,) = np.nonzero(dfa.allowed[state])
+            tok = int(rng.choice(opts))
+            out.append(tok)
+            state = int(dfa.next_state[state, tok])
+        assert state == dfa.done_state, "walk must reach done"
+        return out
+
+    def test_random_walks_always_parse(self):
+        """ANY path through the DFA is valid JSON with a valid node name —
+        the can't-fail-by-construction property replacing the reference's
+        validate-then-fallback (scheduler.py:453-465)."""
+        dfa = build_decision_dfa(TOK, self.NAMES, max_reason_tokens=20)
+        rng = np.random.default_rng(0)
+        for _ in range(50):
+            toks = self._random_walk(dfa, rng)
+            text = TOK.decode([t for t in toks if t != TOK.EOS])
+            obj = json.loads(text)  # strict parse, no extractor needed
+            assert obj["selected_node"] in self.NAMES
+            assert 0.0 <= obj["confidence"] <= 1.0
+            assert isinstance(obj["reasoning"], str)
+
+    def test_prefix_names_both_reachable(self):
+        """node-a is a prefix of node-abc; both must be emittable."""
+        dfa = build_decision_dfa(TOK, ["node-a", "node-abc"], max_reason_tokens=5)
+        rng = np.random.default_rng(1)
+        seen = set()
+        for _ in range(200):
+            toks = self._random_walk(dfa, rng)
+            text = TOK.decode([t for t in toks if t != TOK.EOS])
+            seen.add(json.loads(text)["selected_node"])
+        assert seen == {"node-a", "node-abc"}
+
+    def test_reason_length_cap(self):
+        dfa = build_decision_dfa(TOK, ["n1"], max_reason_tokens=5)
+        rng = np.random.default_rng(2)
+        for _ in range(20):
+            toks = self._random_walk(dfa, rng, max_len=200)
+            obj = json.loads(TOK.decode([t for t in toks if t != TOK.EOS]))
+            assert len(obj["reasoning"]) <= 5
+
+    def test_empty_names_rejected(self):
+        with pytest.raises(ValueError):
+            build_decision_dfa(TOK, [])
+
+
+class TestEngine:
+    def test_unconstrained_generate_caps_at_max_tokens(self, engine):
+        prompt = TOK.chat_prompt("system", "hello world")
+        fin = engine.generate(prompt, max_new_tokens=12)
+        assert 1 <= len(fin.token_ids) <= 12
+        assert fin.latency_ms > 0
+        assert engine.free_slots == engine.max_slots  # slot released
+
+    def test_greedy_is_deterministic(self, engine):
+        prompt = TOK.chat_prompt("system", "determinism")
+        a = engine.generate(prompt, max_new_tokens=10)
+        b = engine.generate(prompt, max_new_tokens=10)
+        assert a.token_ids == b.token_ids
+
+    def test_constrained_generate_emits_valid_decision(self, engine):
+        names = ["node-0", "node-1", "node-2"]
+        engine.set_grammar(build_decision_dfa(TOK, names, max_reason_tokens=30))
+        try:
+            prompt = TOK.chat_prompt("pick a node", "cluster state here")
+            fin = engine.generate(prompt, max_new_tokens=150)
+            obj = json.loads(fin.text.replace("\x00", ""))
+            assert obj["selected_node"] in names
+            assert 0.0 <= obj["confidence"] <= 1.0
+            parsed = parse_decision_json(fin.text)
+            assert parsed is not None
+        finally:
+            engine.set_grammar(None)
+
+    def test_concurrent_requests_complete(self, engine):
+        names = ["node-0", "node-1"]
+        engine.set_grammar(build_decision_dfa(TOK, names, max_reason_tokens=20))
+        try:
+            ids = [
+                engine.add_request(
+                    TOK.chat_prompt("sys", f"pod-{i} needs a node"), 150
+                )
+                for i in range(3)
+            ]
+            done = {}
+            for _ in range(80):
+                for fin in engine.step():
+                    done[fin.req_id] = fin
+                if len(done) == 3:
+                    break
+            assert set(done) == set(ids)
+            for fin in done.values():
+                assert json.loads(fin.text)["selected_node"] in names
+        finally:
+            engine.set_grammar(None)
+
+    def test_backpressure_when_slots_full(self, engine):
+        prompt = TOK.chat_prompt("s", "u")
+        held = [engine.add_request(prompt, 200) for _ in range(engine.max_slots)]
+        with pytest.raises(RuntimeError, match="no free slots"):
+            engine.add_request(prompt, 10)
+        # drain
+        while engine.has_active:
+            engine.step()
+        assert engine.free_slots == engine.max_slots
+        assert len(held) == engine.max_slots
+
+    def test_oversized_prompt_rejected(self, engine):
+        with pytest.raises(ValueError, match="exceeds largest prefill bucket"):
+            engine.add_request([1] * 5000, 10)
+
+    def test_stats_accumulate(self, engine):
+        stats = engine.get_stats()
+        assert stats["requests"] > 0
+        assert stats["completed"] > 0
+        assert stats["decode_tokens"] > 0
+        assert stats["pages_free"] > 0
