@@ -1,0 +1,168 @@
+"""Benchmark: p50 scheduling-decision latency on a pod burst (BASELINE metric).
+
+Drives the COMPLETE stack — FakeCluster snapshot -> prompt -> in-tree JAX
+Llama with grammar-constrained fused decode -> validation -> bind — on the
+real TPU chip, and reports the p50 per-pod decision latency for a burst.
+
+The reference publishes no numbers (BASELINE.md: "not published"); its
+operating point is a remote HF chat_completion per pod with a 60s timeout
+(reference config.yaml:10) and seconds-scale round trips. The BASELINE
+north-star target is p50 < 200 ms on a burst, zero external API calls —
+vs_baseline here is target_ms / measured_p50 (>1.0 beats the target).
+
+Usage: python bench.py [--pods N] [--nodes N] [--shapes N] [--model NAME]
+Prints exactly one JSON line on stdout.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import statistics
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+
+import jax.numpy as jnp
+
+TARGET_P50_MS = 200.0
+
+
+def build_cfg(name: str):
+    from k8s_llm_scheduler_tpu.models.configs import LlamaConfig, get_config
+
+    if name == "bench":
+        # Big enough that the MXU does real work, small enough to compile in
+        # seconds — the architecture is identical to the 1B/8B/70B ladder.
+        return LlamaConfig(
+            name="bench", vocab_size=512, d_model=512, n_layers=6, n_heads=8,
+            n_kv_heads=4, d_ff=1408, max_seq_len=16384, rope_theta=500000.0,
+            tie_embeddings=True,
+        )
+    return get_config(name)
+
+
+async def run_burst(scheduler, cluster, pods, timeout_s: float) -> dict[str, float]:
+    """Add all pods at t0, wait until all bound; per-pod latency = bind - t0."""
+    bind_times: dict[str, float] = {}
+    orig_bind = cluster.bind_pod_to_node
+
+    def timed_bind(pod_name, namespace, node_name):
+        ok = orig_bind(pod_name, namespace, node_name)
+        if ok:
+            bind_times[pod_name] = time.perf_counter()
+        return ok
+
+    cluster.bind_pod_to_node = timed_bind
+    try:
+        t0 = time.perf_counter()
+        for pod in pods:
+            cluster.add_pod(pod)
+        async with asyncio.timeout(timeout_s):
+            while cluster.bind_count < len(pods):
+                await asyncio.sleep(0.005)
+        return {name: (t - t0) * 1000.0 for name, t in bind_times.items()}
+    finally:
+        cluster.bind_pod_to_node = orig_bind
+
+
+async def bench(args) -> dict:
+    from k8s_llm_scheduler_tpu.core.breaker import CircuitBreaker
+    from k8s_llm_scheduler_tpu.core.cache import DecisionCache
+    from k8s_llm_scheduler_tpu.engine.local import build_local_backend
+    from k8s_llm_scheduler_tpu.sched.client import DecisionClient
+    from k8s_llm_scheduler_tpu.sched.loop import Scheduler
+    from k8s_llm_scheduler_tpu.testing import (
+        SCHEDULER_NAME,
+        pod_burst,
+        synthetic_cluster,
+    )
+
+    backend = build_local_backend(
+        cfg=build_cfg(args.model),
+        max_slots=args.slots,
+        num_pages=1024,
+        page_size=128,
+        prefill_buckets=(2048, 4096, 8192, 16384),
+        chunk_steps=args.chunk_steps,
+        temperature=args.temperature,
+        max_new_tokens=args.max_new_tokens,
+    )
+
+    async def one_round(n_pods: int, round_id: int, timeout_s: float):
+        cluster = synthetic_cluster(args.nodes)
+        client = DecisionClient(
+            backend,
+            cache=DecisionCache(),
+            breaker=CircuitBreaker(),
+            retry_delay=0.1,
+        )
+        scheduler = Scheduler(
+            cluster, cluster, client,
+            scheduler_name=SCHEDULER_NAME, snapshot_ttl_s=300.0,
+            max_concurrency=256,
+        )
+        task = asyncio.create_task(scheduler.run())
+        pods = pod_burst(n_pods, distinct_shapes=args.shapes)
+        # distinct names per round so bind bookkeeping stays unambiguous
+        import dataclasses as _dc
+
+        pods = [_dc.replace(p, name=f"r{round_id}-{p.name}") for p in pods]
+        try:
+            latencies = await run_burst(scheduler, cluster, pods, timeout_s)
+        finally:
+            scheduler.stop()
+            cluster.close()
+            await asyncio.wait_for(task, timeout=30)
+        return latencies, scheduler.get_stats()
+
+    # Warmup: compiles prefill bucket, first-token fn, and the decode chunk.
+    await one_round(max(args.shapes, 2), round_id=0, timeout_s=600.0)
+
+    latencies, stats = await one_round(args.pods, round_id=1, timeout_s=600.0)
+    backend.close()
+
+    values = sorted(latencies.values())
+    p50 = statistics.median(values)
+    p99 = values[min(len(values) - 1, int(len(values) * 0.99))]
+    total_s = max(values) / 1000.0
+    return {
+        "metric": "p50_decision_latency_ms",
+        "value": round(p50, 2),
+        "unit": "ms",
+        "vs_baseline": round(TARGET_P50_MS / p50, 3),
+        "extra": {
+            "p99_ms": round(p99, 2),
+            "pods": args.pods,
+            "nodes": args.nodes,
+            "shapes": args.shapes,
+            "pods_per_sec": round(args.pods / total_s, 2),
+            "llm_decisions": stats["llm_decisions"],
+            "cache_decisions": stats["cache_decisions"],
+            "fallback_decisions": stats["fallback_decisions"],
+            "model": args.model,
+            "baseline_note": "reference publishes no numbers; target p50<200ms (BASELINE.md)",
+        },
+    }
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--pods", type=int, default=64)
+    parser.add_argument("--nodes", type=int, default=32)
+    parser.add_argument("--shapes", type=int, default=8)
+    parser.add_argument("--slots", type=int, default=8)
+    parser.add_argument("--model", default="bench")
+    parser.add_argument("--chunk-steps", type=int, default=24)
+    parser.add_argument("--max-new-tokens", type=int, default=72)
+    parser.add_argument("--temperature", type=float, default=0.3)
+    args = parser.parse_args()
+    result = asyncio.run(bench(args))
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,265 @@
+"""CLI entry point: run / verify / bench / demo.
+
+Parity surface (reference -> here):
+- `python scheduler.py`            -> `python -m k8s_llm_scheduler_tpu.cli run`
+  (banner, start, Ctrl-C handling, final stats dump — reference
+  scheduler.py:775-823)
+- `python verify_setup.py`         -> `... cli verify` (files/env/imports/
+  cluster preflight — reference verify_setup.py:28-114; extended with JAX
+  device + engine smoke checks, minus any API-token requirement)
+- bench harness (reference: none)  -> `... cli bench` (wraps bench.py)
+- `... cli demo` runs the full stack against the in-memory fake cluster —
+  the zero-dependency path the reference never had.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import importlib
+import json
+import logging
+import sys
+from typing import Any
+
+from k8s_llm_scheduler_tpu.config import Config, load_config
+from k8s_llm_scheduler_tpu.logging_setup import setup_logging
+
+logger = logging.getLogger(__name__)
+
+BANNER = r"""
+  TPU-native LLM Kubernetes Scheduler
+  watch -> snapshot -> prompt -> decide(on-TPU) -> validate -> bind
+"""
+
+
+def _build_stack(cfg: Config, cluster) -> Any:
+    from k8s_llm_scheduler_tpu.core.breaker import CircuitBreaker
+    from k8s_llm_scheduler_tpu.core.cache import DecisionCache
+    from k8s_llm_scheduler_tpu.sched.client import DecisionClient
+    from k8s_llm_scheduler_tpu.sched.loop import Scheduler
+
+    backend_kind = cfg.get("llm.backend")
+    if backend_kind == "stub":
+        from k8s_llm_scheduler_tpu.engine.backend import StubBackend
+
+        backend = StubBackend()
+    else:
+        from k8s_llm_scheduler_tpu.engine.local import build_local_backend
+
+        backend = build_local_backend(
+            model=cfg.get("llm.model", "tiny"),
+            mesh_axes=cfg.get("llm.mesh", None),
+            temperature=cfg.get("llm.temperature"),
+            max_slots=cfg.get("llm.max_batch"),
+            page_size=cfg.get("llm.page_size"),
+            prefill_buckets=tuple(cfg.get("llm.prefill_buckets")),
+            max_new_tokens=cfg.get("llm.max_tokens"),
+            constrained=cfg.get("llm.constrained_json"),
+        )
+
+    cache = (
+        DecisionCache(
+            ttl_seconds=cfg.get("cache.ttl_seconds"),
+            max_size=cfg.get("cache.max_size"),
+        )
+        if cfg.get("cache.enabled")
+        else None
+    )
+    breaker = (
+        CircuitBreaker(
+            failure_threshold=cfg.get("circuit_breaker.failure_threshold"),
+            timeout_seconds=cfg.get("circuit_breaker.timeout"),
+            half_open_max_calls=cfg.get("circuit_breaker.half_open_max_calls"),
+        )
+        if cfg.get("circuit_breaker.enabled")
+        else None
+    )
+    client = DecisionClient(
+        backend,
+        cache=cache,
+        breaker=breaker,
+        max_retries=cfg.get("llm.max_retries"),
+        retry_delay=cfg.get("llm.retry_delay"),
+        fallback_strategy=cfg.get("fallback.strategy"),
+        fallback_enabled=cfg.get("fallback.enabled"),
+    )
+    scheduler = Scheduler(
+        cluster, cluster, client,
+        scheduler_name=cfg.get("scheduler.name"),
+        error_backoff_s=cfg.get("scheduler.error_backoff_seconds"),
+    )
+    return scheduler, backend
+
+
+async def _run_scheduler(cfg: Config, cluster, demo_pods: bool = False) -> int:
+    scheduler, backend = _build_stack(cfg, cluster)
+
+    metrics_server = None
+    if cfg.get("metrics.enabled"):
+        from k8s_llm_scheduler_tpu.observability.metrics import MetricsServer
+
+        metrics_server = MetricsServer(
+            scheduler.get_stats,
+            port=cfg.get("metrics.port"),
+            is_alive=lambda: scheduler.running,
+        )
+        metrics_server.start()
+
+    if demo_pods:
+        from k8s_llm_scheduler_tpu.testing import fixture_pods
+
+        for pod in fixture_pods(cfg.get("scheduler.name")):
+            cluster.add_pod(pod)
+
+    print(BANNER)
+    logger.info("scheduler %r starting", cfg.get("scheduler.name"))
+    task = asyncio.create_task(scheduler.run())
+    try:
+        if demo_pods:
+            while cluster.bind_count < 3:
+                await asyncio.sleep(0.05)
+            logger.info("demo: all fixture pods scheduled")
+            scheduler.stop()
+            cluster.close()
+        await task
+    except (KeyboardInterrupt, asyncio.CancelledError):
+        logger.info("shutting down")
+        scheduler.stop()
+        close = getattr(cluster, "close", None)
+        if close:
+            close()
+        await asyncio.wait_for(task, timeout=30)
+    finally:
+        if metrics_server:
+            metrics_server.stop()
+        close_backend = getattr(backend, "close", None)
+        if close_backend:
+            close_backend()
+        # Final stats dump (reference scheduler.py:803-819).
+        print(json.dumps(scheduler.get_stats(), indent=2, default=str))
+    return 0
+
+
+def cmd_run(args: argparse.Namespace, cfg: Config) -> int:
+    if args.fake_cluster:
+        from k8s_llm_scheduler_tpu.testing import synthetic_cluster
+
+        cluster = synthetic_cluster(args.fake_nodes)
+    else:
+        from k8s_llm_scheduler_tpu.cluster.kube import KubeCluster
+
+        if not KubeCluster.available():
+            print(
+                "kubernetes client not installed; use --fake-cluster for the "
+                "in-memory cluster",
+                file=sys.stderr,
+            )
+            return 2
+        cluster = KubeCluster(
+            watch_timeout_seconds=cfg.get("scheduler.watch_interval")
+        )
+    return asyncio.run(_run_scheduler(cfg, cluster, demo_pods=False))
+
+
+def cmd_demo(args: argparse.Namespace, cfg: Config) -> int:
+    from k8s_llm_scheduler_tpu.testing import synthetic_cluster
+
+    cluster = synthetic_cluster(args.fake_nodes)
+    return asyncio.run(_run_scheduler(cfg, cluster, demo_pods=True))
+
+
+def cmd_verify(args: argparse.Namespace, cfg: Config) -> int:
+    """Preflight (reference verify_setup.py:28-114, TPU edition)."""
+    failures = []
+
+    def check(name: str, fn) -> None:
+        try:
+            detail = fn()
+            print(f"  [ok] {name}" + (f" — {detail}" if detail else ""))
+        except Exception as exc:
+            failures.append((name, exc))
+            print(f"  [FAIL] {name}: {exc}")
+
+    print("Preflight checks:")
+    for mod in ("jax", "numpy", "yaml", "optax"):
+        check(f"import {mod}", lambda m=mod: importlib.import_module(m).__name__)
+    check("jax devices", lambda: str(__import__("jax").devices()))
+    check("config resolves", lambda: f"scheduler={cfg.get('scheduler.name')}")
+
+    def engine_smoke():
+        import jax as _jax
+        import jax.numpy as _jnp
+
+        from k8s_llm_scheduler_tpu.models.configs import TINY
+        from k8s_llm_scheduler_tpu.models.llama import forward_prefill, init_params
+
+        params = init_params(_jax.random.PRNGKey(0), TINY)
+        logits, _, _ = _jax.jit(forward_prefill, static_argnums=(1,))(
+            params, TINY, _jnp.zeros((1, 16), _jnp.int32), _jnp.array([16])
+        )
+        return f"forward ok {logits.shape}"
+
+    if not args.fast:
+        check("model forward (TINY)", engine_smoke)
+
+    def kube_check():
+        from k8s_llm_scheduler_tpu.cluster.kube import KubeCluster
+
+        if not KubeCluster.available():
+            return "kubernetes client not installed (fake cluster available)"
+        nodes = KubeCluster().get_node_metrics()
+        return f"{len(nodes)} nodes visible"
+
+    check("cluster access", kube_check)
+
+    if failures:
+        print(f"\n{len(failures)} check(s) failed")
+        return 1
+    print("\nall checks passed")
+    return 0
+
+
+def cmd_bench(args: argparse.Namespace, cfg: Config) -> int:
+    import subprocess
+
+    cmd = [sys.executable, "bench.py"] + args.bench_args
+    return subprocess.call(cmd)
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(prog="k8s_llm_scheduler_tpu")
+    parser.add_argument("--config", default=None, help="path to config.yaml")
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    p_run = sub.add_parser("run", help="run the scheduler against a cluster")
+    p_run.add_argument("--fake-cluster", action="store_true")
+    p_run.add_argument("--fake-nodes", type=int, default=3)
+
+    p_demo = sub.add_parser("demo", help="schedule fixture pods on a fake cluster")
+    p_demo.add_argument("--fake-nodes", type=int, default=3)
+
+    p_verify = sub.add_parser("verify", help="preflight environment checks")
+    p_verify.add_argument("--fast", action="store_true", help="skip model smoke test")
+
+    p_bench = sub.add_parser("bench", help="run the benchmark")
+    p_bench.add_argument("bench_args", nargs="*")
+
+    args = parser.parse_args(argv)
+    cfg = load_config(yaml_path=args.config)
+    setup_logging(
+        level=cfg.get("logging.level"),
+        fmt=cfg.get("logging.format"),
+        file=cfg.get("logging.file"),
+    )
+    handlers = {
+        "run": cmd_run,
+        "demo": cmd_demo,
+        "verify": cmd_verify,
+        "bench": cmd_bench,
+    }
+    return handlers[args.command](args, cfg)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -181,6 +181,7 @@ class InferenceEngine:
         self._next_state = jnp.zeros((self.DFA_STATE_CAPACITY, V), dtype=jnp.int32)
         self._done_state = jnp.int32(-1)  # unconstrained: nothing reaches done
         self._dfa_start = 0
+        self.set_grammar(None)  # applies the pad-exclusion mask
 
         self._rng = jax.random.PRNGKey(rng_seed)
         self._req_counter = 0
@@ -207,7 +208,13 @@ class InferenceEngine:
         V = self.tokenizer.vocab_size
         cap = self.DFA_STATE_CAPACITY
         if dfa is None:
-            self._allowed = jnp.ones((cap, V), dtype=bool)
+            allowed = np.ones((cap, V), dtype=bool)
+            # pad is the idle-slot emission sentinel — never sampleable, or
+            # emitted pads would be dropped from output and max_new_tokens
+            # accounting (generate() could spin forever on a pad-argmaxing
+            # model).
+            allowed[:, self.tokenizer.pad_id] = False
+            self._allowed = jnp.asarray(allowed)
             self._next_state = jnp.zeros((cap, V), dtype=jnp.int32)
             self._done_state = jnp.int32(-1)
             self._dfa_start = 0
@@ -293,7 +300,12 @@ class InferenceEngine:
 
         self._tok_np[slot] = first_tok
         self._pos_np[slot] = n  # the first generated token sits at index n
-        self._act_np[slot] = True
+        # A first token that is already terminal (EOS, or a one-token
+        # grammar) must not burn decode chunks.
+        already_done = first_tok == self.tokenizer.eos_id or next_st == int(
+            self._done_state
+        )
+        self._act_np[slot] = not already_done
         self._st_np[slot] = next_st
         self.stats["requests"] += 1
         self.stats["prefills"] += 1
@@ -307,35 +319,40 @@ class InferenceEngine:
         if not self._by_slot:
             return []
         n = self.chunk_steps
-        for slot, req in self._by_slot.items():
-            if self._act_np[slot]:
-                self.kv.ensure_capacity(slot, int(self._pos_np[slot]) + n + 1)
+        any_active = any(self._act_np[slot] for slot in self._by_slot)
+        if any_active:
+            for slot in self._by_slot:
+                if self._act_np[slot]:
+                    self.kv.ensure_capacity(slot, int(self._pos_np[slot]) + n + 1)
 
-        self._rng, sub = jax.random.split(self._rng)
-        (
-            self.kv.k, self.kv.v,
-            tok_d, pos_d, act_d, st_d, _, toks_d,
-        ) = self._chunk(
-            self.params, self.cfg, self.kv.k, self.kv.v,
-            self.kv.page_tables(),
-            jnp.asarray(self._tok_np), jnp.asarray(self._pos_np),
-            jnp.asarray(self._act_np), jnp.asarray(self._st_np),
-            self._allowed, self._next_state, self._done_state,
-            jnp.int32(self.tokenizer.eos_id), jnp.int32(self.tokenizer.pad_id),
-            sub, jnp.float32(self.temperature), n,
-        )
-        # One host sync for the whole chunk (np.array copies: the mirrors
-        # are mutated host-side, and views of jax buffers are read-only).
-        toks, self._tok_np, self._pos_np, self._act_np, self._st_np = (
-            np.asarray(toks_d), np.array(tok_d), np.array(pos_d),
-            np.array(act_d), np.array(st_d),
-        )
-        self.stats["chunks"] += 1
+            self._rng, sub = jax.random.split(self._rng)
+            (
+                self.kv.k, self.kv.v,
+                tok_d, pos_d, act_d, st_d, _, toks_d,
+            ) = self._chunk(
+                self.params, self.cfg, self.kv.k, self.kv.v,
+                self.kv.page_tables(),
+                jnp.asarray(self._tok_np), jnp.asarray(self._pos_np),
+                jnp.asarray(self._act_np), jnp.asarray(self._st_np),
+                self._allowed, self._next_state, self._done_state,
+                jnp.int32(self.tokenizer.eos_id), jnp.int32(self.tokenizer.pad_id),
+                sub, jnp.float32(self.temperature), n,
+            )
+            # One host sync for the whole chunk (np.array copies: the mirrors
+            # are mutated host-side, and views of jax buffers are read-only).
+            toks, self._tok_np, self._pos_np, self._act_np, self._st_np = (
+                np.asarray(toks_d), np.array(tok_d), np.array(pos_d),
+                np.array(act_d), np.array(st_d),
+            )
+            self.stats["chunks"] += 1
+        else:
+            toks = np.full((self.max_slots, n), self.tokenizer.pad_id, np.int32)
 
         finished: list[Finished] = []
         for slot, req in list(self._by_slot.items()):
             emitted = [int(t) for t in toks[slot] if t != self.tokenizer.pad_id]
-            # Tokens after the finishing token are pad, so emitted is exact.
+            # Tokens after the finishing token are pad, so emitted is exact
+            # (pad is never sampleable for active slots — see set_grammar).
             req.generated.extend(emitted)
             self.stats["decode_tokens"] += len(emitted)
             hit_cap = len(req.generated) >= req.max_new_tokens

@@ -31,6 +31,7 @@ import threading
 import time
 from collections.abc import Sequence
 from concurrent.futures import Future
+from concurrent.futures import TimeoutError as FuturesTimeout
 from typing import Any
 
 import jax
@@ -61,12 +62,11 @@ logger = logging.getLogger(__name__)
 
 
 class _WorkItem:
-    __slots__ = ("prompt_ids", "grammar_key", "node_names", "future", "enqueued_at")
+    __slots__ = ("prompt_ids", "grammar_key", "future", "enqueued_at")
 
-    def __init__(self, prompt_ids, grammar_key, node_names):
+    def __init__(self, prompt_ids, grammar_key):
         self.prompt_ids = prompt_ids
         self.grammar_key = grammar_key
-        self.node_names = node_names
         self.future: Future = Future()
         self.enqueued_at = time.perf_counter()
 
@@ -120,12 +120,13 @@ class LocalLLMBackend:
         # Grammar over READY nodes of this snapshot (stable across the pods
         # of a burst); per-pod feasibility is enforced by validation upstream.
         ready_names = tuple(sorted(n.name for n in nodes if n.is_ready))
-        item = _WorkItem(prompt_ids, ready_names if self.constrained else None,
-                         ready_names)
+        item = _WorkItem(prompt_ids, ready_names if self.constrained else None)
         self._queue.put(item)
         try:
             text = item.future.result(timeout=self.request_timeout_s)
-        except TimeoutError as exc:
+        except FuturesTimeout as exc:
+            # (concurrent.futures.TimeoutError only aliases the builtin from
+            # Python 3.11 — catch the futures one for 3.10.)
             raise BackendError(f"decision timed out after {self.request_timeout_s}s") from exc
         return self._parse(text, pod)
 
@@ -146,12 +147,21 @@ class LocalLLMBackend:
             if len(self._dfa_cache) > 16:
                 self._dfa_cache.clear()
             # The whole emission must fit in max_new_tokens or the decode
-            # truncates mid-JSON: skeleton (~60 tokens byte-level) + longest
-            # name + reasoning + closing.
-            overhead = 60 + max(len(self.tokenizer.encode(n)) for n in key)
-            max_reason = max(8, self.max_new_tokens - overhead - 4)
+            # truncates mid-JSON. Worst case emission =
+            #   len('{"selected_node": ""') + name + len(', "confidence": 0.00')
+            #   + len(', "reasoning": ""}') + EOS + reasoning
+            # = 59 + name_tokens + 1 + reasoning. No floor: an empty
+            # reasoning is grammatical; a floor here broke the guarantee.
+            longest_name = max(len(self.tokenizer.encode(n)) for n in key)
+            budget = self.max_new_tokens - (60 + longest_name) - 2  # margin
+            if budget < 0:
+                raise ValueError(
+                    f"max_new_tokens={self.max_new_tokens} cannot fit even an "
+                    f"empty decision for node names up to {longest_name} tokens; "
+                    f"need >= {62 + longest_name}"
+                )
             self._dfa_cache[key] = build_decision_dfa(
-                self.tokenizer, list(key), max_reason_tokens=max_reason
+                self.tokenizer, list(key), max_reason_tokens=min(budget, 120)
             )
         return self._dfa_cache[key]
 
@@ -162,56 +172,76 @@ class LocalLLMBackend:
             if self.engine.free_slots == 0:
                 rest.append(item)
                 continue
-            if not inflight and item.grammar_key != self._current_group:
-                # Engine drained: switch grammar groups.
-                self._current_group = item.grammar_key
-                self.engine.set_grammar(
-                    self._grammar_for(item.grammar_key)
-                    if item.grammar_key is not None
-                    else None
-                )
-            if item.grammar_key != self._current_group:
-                rest.append(item)
-                continue
             try:
+                if not inflight and item.grammar_key != self._current_group:
+                    # Engine drained: switch grammar groups.
+                    self.engine.set_grammar(
+                        self._grammar_for(item.grammar_key)
+                        if item.grammar_key is not None
+                        else None
+                    )
+                    self._current_group = item.grammar_key
+                if item.grammar_key != self._current_group:
+                    rest.append(item)
+                    continue
                 req_id = self.engine.add_request(item.prompt_ids, self.max_new_tokens)
-            except Exception as exc:  # slot/page pressure or bad prompt
+            except Exception as exc:  # grammar build/install, slot/page pressure
                 item.future.set_exception(BackendError(str(exc)))
                 continue
             inflight[req_id] = item
         return rest
 
+    def _drain_queue(self, pending: list[_WorkItem], block: bool) -> None:
+        """Move queued items into `pending`; a None sentinel sets _stopped."""
+        try:
+            timeout = None if block else 0.0
+            while True:
+                item = (
+                    self._queue.get(timeout=timeout) if block else self._queue.get_nowait()
+                )
+                if item is None:
+                    self._stopped.set()
+                    return
+                pending.append(item)
+                block = False
+        except queue.Empty:
+            pass
+
     def _run_worker(self) -> None:
         pending: list[_WorkItem] = []
         inflight: dict[int, _WorkItem] = {}
         while not self._stopped.is_set():
-            # Drain the queue (block briefly when totally idle).
+            self._drain_queue(pending, block=not pending and not inflight)
+            if self._stopped.is_set() or (not pending and not inflight):
+                continue
+            # Nothing below may kill the engine-owner thread — a dead worker
+            # bricks every future request.
             try:
-                timeout = None if (not pending and not inflight) else 0.0
-                while True:
-                    item = self._queue.get(timeout=timeout) if timeout is None else self._queue.get_nowait()
-                    if item is None:
-                        return
-                    pending.append(item)
-                    timeout = 0.0
-            except queue.Empty:
-                pass
-            if not pending and not inflight:
-                continue
-            if pending and self.admit_wait_s and not inflight:
-                # tiny window to let a burst coalesce into one batch
-                time.sleep(self.admit_wait_s)
-                try:
-                    while True:
-                        extra = self._queue.get_nowait()
-                        if extra is None:
-                            return
-                        pending.append(extra)
-                except queue.Empty:
-                    pass
-            pending = self._admit(pending, inflight)
-            if not inflight:
-                continue
+                pending = self._worker_tick(pending, inflight)
+            except Exception as exc:  # pragma: no cover - last-resort guard
+                logger.exception("engine worker tick failed")
+                for item in pending + list(inflight.values()):
+                    if not item.future.done():
+                        item.future.set_exception(BackendError(str(exc)))
+                pending = []
+                inflight.clear()
+                self.engine.abort_all()
+        # Shutdown: fail anything still queued or in flight.
+        self._drain_queue(pending, block=False)
+        for item in pending + list(inflight.values()):
+            if not item.future.done():
+                item.future.set_exception(BackendError("backend closed"))
+
+    def _worker_tick(
+        self, pending: list[_WorkItem], inflight: dict[int, _WorkItem]
+    ) -> list[_WorkItem]:
+        """One admit+decode cycle; returns the still-unadmitted items."""
+        if pending and self.admit_wait_s and not inflight:
+            # tiny window to let a burst coalesce into one batch
+            time.sleep(self.admit_wait_s)
+            self._drain_queue(pending, block=False)
+        pending = self._admit(pending, inflight)
+        if inflight:
             try:
                 for fin in self.engine.step():
                     item = inflight.pop(fin.req_id, None)
@@ -225,6 +255,7 @@ class LocalLLMBackend:
                 # Free wedged slots/pages or the engine's capacity leaks and
                 # every later request queues until timeout.
                 self.engine.abort_all()
+        return pending
 
     def close(self) -> None:
         self._stopped.set()

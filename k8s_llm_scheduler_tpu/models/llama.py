@@ -149,16 +149,23 @@ def forward_prefill(
     cfg: LlamaConfig,
     tokens: jax.Array,  # [B, S] int32, left-aligned, padded
     seq_lens: jax.Array,  # [B]
+    attn_impl: Any = None,  # (q,k,v,seq_lens)->out; default causal full attn
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Full-prompt forward pass.
 
     Returns (logits [B,S,V] f32, k_all [L,B,S,n_kv,hd], v_all [...]) — the
     engine scatters k_all/v_all into KV cache pages (engine/kv_cache.py).
+
+    `attn_impl` swaps the attention kernel: the training path passes a
+    ring-attention wrapper (parallel/ring_attention.py) when the mesh has a
+    sequence-parallel axis. Must be static under jit (pass via closure or
+    static_argnums).
     """
     B, S = tokens.shape
     hd = cfg.head_dim
     inv_freq = rope_inv_freq(cfg)
     positions = jnp.broadcast_to(jnp.arange(S), (B, S))
+    attn_fn = attn_impl if attn_impl is not None else causal_prefill_attention
 
     x = params["embed"][tokens]  # [B, S, D]
 
@@ -169,7 +176,7 @@ def forward_prefill(
         v = jnp.einsum("bsd,dh->bsh", h, lp["wv"]).reshape(B, S, cfg.n_kv_heads, hd)
         q = apply_rope(q, positions, inv_freq)
         k = apply_rope(k, positions, inv_freq)
-        attn = causal_prefill_attention(q, k, v, seq_lens)
+        attn = attn_fn(q, k, v, seq_lens)
         attn = jnp.einsum("bsh,hd->bsd", attn.reshape(B, S, cfg.n_heads * hd), lp["wo"])
         x = x + attn
         x = x + _mlp(lp, cfg, x)

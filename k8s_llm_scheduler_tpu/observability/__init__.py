@@ -1,0 +1,1 @@
+"""Metrics endpoint and per-phase tracing."""

@@ -1,0 +1,114 @@
+"""Prometheus-style metrics endpoint.
+
+The reference *declares* `metrics: {enabled, port: 9090}` in its config but
+no server exists — the keys are read by nothing (reference config.yaml:29-31,
+SURVEY §5 "dead config"; README.md:184 defers it to future work). This module
+makes the endpoint real: a stdlib ThreadingHTTPServer serving
+
+    /metrics   Prometheus text exposition of the scheduler + engine stats
+    /healthz   liveness (200 when the loop is running)
+    /stats     the full merged stats dict as JSON
+
+Stats are pulled from a provider callable at scrape time — no push path,
+no extra locks on the hot path.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable
+
+logger = logging.getLogger(__name__)
+
+_PREFIX = "llm_scheduler"
+
+
+def _flatten(stats: dict[str, Any], prefix: str = "") -> dict[str, float]:
+    out: dict[str, float] = {}
+    for key, value in stats.items():
+        name = f"{prefix}_{key}" if prefix else key
+        if isinstance(value, dict):
+            out.update(_flatten(value, name))
+        elif isinstance(value, bool):
+            out[name] = 1.0 if value else 0.0
+        elif isinstance(value, (int, float)):
+            out[name] = float(value)
+        # strings (e.g. breaker state) become labeled gauges below
+        elif isinstance(value, str):
+            out[f"{name}{{value=\"{value}\"}}"] = 1.0
+    return out
+
+
+def render_prometheus(stats: dict[str, Any]) -> str:
+    lines = []
+    for name, value in sorted(_flatten(stats).items()):
+        metric = f"{_PREFIX}_{name}"
+        # metric names cannot contain '{' — split label part back out
+        if "{" in name:
+            base, label = name.split("{", 1)
+            metric = f"{_PREFIX}_{base}{{{label}"
+        lines.append(f"{metric} {value}")
+    return "\n".join(lines) + "\n"
+
+
+class MetricsServer:
+    """Serve scheduler stats on the (formerly dead) metrics port."""
+
+    def __init__(
+        self,
+        stats_provider: Callable[[], dict[str, Any]],
+        port: int = 9090,
+        host: str = "0.0.0.0",
+        is_alive: Callable[[], bool] = lambda: True,
+    ) -> None:
+        self.stats_provider = stats_provider
+        self.is_alive = is_alive
+
+        provider = self.stats_provider
+        alive = self.is_alive
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self) -> None:  # noqa: N802
+                try:
+                    if self.path.startswith("/metrics"):
+                        body = render_prometheus(provider()).encode()
+                        ctype = "text/plain; version=0.0.4"
+                        code = 200
+                    elif self.path.startswith("/healthz"):
+                        ok = alive()
+                        body = (b"ok" if ok else b"not running")
+                        ctype = "text/plain"
+                        code = 200 if ok else 503
+                    elif self.path.startswith("/stats"):
+                        body = json.dumps(provider()).encode()
+                        ctype = "application/json"
+                        code = 200
+                    else:
+                        body, ctype, code = b"not found", "text/plain", 404
+                except Exception as exc:  # pragma: no cover
+                    body, ctype, code = str(exc).encode(), "text/plain", 500
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, fmt: str, *args: Any) -> None:
+                logger.debug("metrics: " + fmt, *args)
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._server.server_address[1]  # resolved (port=0 ok)
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True, name="metrics"
+        )
+
+    def start(self) -> None:
+        self._thread.start()
+        logger.info("metrics endpoint on :%d (/metrics /healthz /stats)", self.port)
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()

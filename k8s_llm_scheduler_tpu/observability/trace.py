@@ -1,0 +1,78 @@
+"""Per-phase timing and optional JAX profiler capture.
+
+The reference's only instrumentation is a running average of remote-API wall
+time (reference scheduler.py:435-441; SURVEY §5 tracing: "none"). Here every
+scheduling decision can be broken into phases —
+watch -> snapshot -> prompt -> prefill -> decode -> bind — with a low-overhead
+recorder, plus a context manager around `jax.profiler` for device traces.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from collections import defaultdict
+from typing import Iterator
+
+
+class PhaseRecorder:
+    """Thread-safe accumulator of per-phase durations (count/total/max)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._count: dict[str, int] = defaultdict(int)
+        self._total: dict[str, float] = defaultdict(float)
+        self._max: dict[str, float] = defaultdict(float)
+
+    @contextlib.contextmanager
+    def phase(self, name: str) -> Iterator[None]:
+        start = time.perf_counter()
+        try:
+            yield
+        finally:
+            elapsed = time.perf_counter() - start
+            with self._lock:
+                self._count[name] += 1
+                self._total[name] += elapsed
+                self._max[name] = max(self._max[name], elapsed)
+
+    def record(self, name: str, seconds: float) -> None:
+        with self._lock:
+            self._count[name] += 1
+            self._total[name] += seconds
+            self._max[name] = max(self._max[name], seconds)
+
+    def snapshot(self) -> dict[str, dict[str, float]]:
+        with self._lock:
+            return {
+                name: {
+                    "count": self._count[name],
+                    "total_ms": self._total[name] * 1000.0,
+                    "avg_ms": (self._total[name] / self._count[name]) * 1000.0,
+                    "max_ms": self._max[name] * 1000.0,
+                }
+                for name in self._count
+            }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._count.clear()
+            self._total.clear()
+            self._max.clear()
+
+
+# Global default recorder — components grab phases without plumbing.
+recorder = PhaseRecorder()
+
+
+@contextlib.contextmanager
+def device_trace(log_dir: str) -> Iterator[None]:
+    """Capture a jax.profiler trace (TensorBoard format) around a block."""
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

@@ -47,6 +47,7 @@ def ring_self_attention(
     k: jax.Array,  # [B, S_local, n_kv, head_dim]
     v: jax.Array,
     axis_name: str,
+    varying_axes: tuple[str, ...] | None = None,
 ) -> jax.Array:
     """Causal ring attention over `axis_name`. Call inside shard_map with the
     sequence dim sharded over that axis. Chunks are assumed layed out in
@@ -63,10 +64,13 @@ def ring_self_attention(
 
     qg = q.reshape(B, S, n_kv, g, hd)
 
-    # Initial accumulators must be marked device-varying over the ring axis
-    # or the fori_loop carry types mismatch (shard_map VMA tracking).
+    # Initial accumulators must be marked device-varying over every manual
+    # axis of the enclosing shard_map (ring axis + optional batch axis) or
+    # the fori_loop carry types mismatch (shard_map VMA tracking).
+    axes = varying_axes if varying_axes is not None else (axis_name,)
+
     def _varying(x):
-        return jax.lax.pcast(x, (axis_name,), to="varying")
+        return jax.lax.pcast(x, axes, to="varying")
 
     num0 = _varying(jnp.zeros((B, S, n_kv, g, hd), jnp.float32))
     den0 = _varying(jnp.zeros((B, S, n_kv, g), jnp.float32))
@@ -96,23 +100,27 @@ def ring_self_attention(
     return out.reshape(B, S, n_heads, hd).astype(q.dtype)
 
 
-def make_ring_prefill_attention(mesh: Mesh, sp_axis: str = "sp"):
+def make_ring_prefill_attention(
+    mesh: Mesh, sp_axis: str = "sp", batch_axis: str | None = None
+):
     """shard_map-wrapped ring attention: takes full [B, S, H, hd] arrays with
-    S sharded over `sp_axis`, returns the attention output with the same
-    sharding. Drop-in replacement for causal_prefill_attention on a mesh
-    with an sp axis (full sequences, no padding)."""
+    S sharded over `sp_axis` (and optionally B over `batch_axis`), returns
+    the attention output with the same sharding. Signature-compatible with
+    ops.attention.causal_prefill_attention so it can be passed as
+    `attn_impl` to the model forward; `seq_lens` is accepted but sequences
+    must be full/unpadded (ring chunks have no per-chunk padding support)."""
 
-    @functools.partial(
-        jax.shard_map,
-        mesh=mesh,
-        in_specs=(
-            P(None, sp_axis, None, None),
-            P(None, sp_axis, None, None),
-            P(None, sp_axis, None, None),
-        ),
-        out_specs=P(None, sp_axis, None, None),
-    )
+    spec = P(batch_axis, sp_axis, None, None)
+    varying = tuple(a for a in (sp_axis, batch_axis) if a)
+
     def wrapped(q, k, v):
-        return ring_self_attention(q, k, v, sp_axis)
+        return ring_self_attention(q, k, v, sp_axis, varying_axes=varying)
 
-    return wrapped
+    wrapped = functools.partial(
+        jax.shard_map, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
+    )(wrapped)
+
+    def attn(q, k, v, seq_lens=None):
+        return wrapped(q, k, v)
+
+    return attn

@@ -1,0 +1,1 @@
+"""Training/fine-tuning support: sharded causal-LM train step."""

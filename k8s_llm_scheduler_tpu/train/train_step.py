@@ -1,0 +1,113 @@
+"""Sharded causal-LM training step for the decision model.
+
+The reference consumes a frozen hosted model and has no training surface at
+all; this module exists so the framework can fine-tune its decision LLM
+(e.g. on logged (cluster state, good placement) pairs) with the same
+parallelism vocabulary as inference, and it is what `dryrun_multichip`
+exercises over a virtual mesh.
+
+Parallelism mapping (axes from parallel/mesh.py):
+    dp    batch dimension of the token batch
+    fsdp  weight-dim sharding of every parameter (ZeRO-3 style; XLA
+          all-gathers per layer inside the scan and reduce-scatters grads)
+    tp    Megatron column/row sharding from parallel/sharding.py
+    sp    sequence dimension via ring attention (parallel/ring_attention.py)
+
+pp/ep deliberately absent: layers run under one lax.scan (pipelining would
+fight the scan fusion for no win at decision-model scale) and Llama 3.x is
+dense, so there are no experts to place. Cited capability gap in the
+reference: SURVEY §2.3 — all parallelism happened server-side at HF.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from k8s_llm_scheduler_tpu.models.configs import LlamaConfig
+from k8s_llm_scheduler_tpu.models.llama import Params, forward_prefill, init_params
+from k8s_llm_scheduler_tpu.parallel.ring_attention import make_ring_prefill_attention
+from k8s_llm_scheduler_tpu.parallel.sharding import param_specs, shard_params
+
+
+class TrainState(NamedTuple):
+    params: Params
+    opt_state: Any
+    step: jax.Array
+
+
+def causal_lm_loss(logits: jax.Array, tokens: jax.Array, seq_lens: jax.Array) -> jax.Array:
+    """Mean next-token cross entropy over valid (non-pad) positions."""
+    targets = tokens[:, 1:]
+    logits = logits[:, :-1]
+    S = targets.shape[1]
+    mask = (jnp.arange(S)[None, :] < (seq_lens[:, None] - 1)).astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+
+def make_train_step(
+    cfg: LlamaConfig,
+    mesh: Mesh,
+    optimizer: optax.GradientTransformation | None = None,
+    use_ring_attention: bool | None = None,
+) -> tuple[Callable, Callable]:
+    """Build (init_fn, step_fn) jitted over `mesh`.
+
+    init_fn(rng, tokens_shape) -> TrainState with params sharded per
+    param_specs (tp + fsdp when those axes exist) and optimizer moments
+    inheriting the same shardings via GSPMD propagation.
+
+    step_fn(state, tokens, seq_lens) -> (state, loss). Batch rides dp,
+    sequence rides sp via ring attention when the mesh has an sp axis.
+    """
+    optimizer = optimizer or optax.adamw(1e-5)
+    axes = mesh.shape
+    tp = "tp" if axes.get("tp", 1) > 1 else None
+    fsdp = "fsdp" if axes.get("fsdp", 1) > 1 else None
+    dp = "dp" if axes.get("dp", 1) > 1 else None
+    sp = "sp" if axes.get("sp", 1) > 1 else None
+    if use_ring_attention is None:
+        use_ring_attention = sp is not None
+
+    specs = param_specs(cfg, tp=tp, fsdp=fsdp)
+    attn_impl = (
+        make_ring_prefill_attention(mesh, "sp", batch_axis=dp)
+        if use_ring_attention
+        else None
+    )
+    data_sharding = NamedSharding(mesh, P(dp, sp))
+    lens_sharding = NamedSharding(mesh, P(dp))
+
+    def loss_fn(params, tokens, seq_lens):
+        logits, _, _ = forward_prefill(params, cfg, tokens, seq_lens, attn_impl)
+        return causal_lm_loss(logits, tokens, seq_lens)
+
+    @jax.jit
+    def step_fn(state: TrainState, tokens, seq_lens):
+        tokens = jax.lax.with_sharding_constraint(tokens, data_sharding)
+        loss, grads = jax.value_and_grad(loss_fn)(state.params, tokens, seq_lens)
+        updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        return TrainState(params, opt_state, state.step + 1), loss
+
+    def init_fn(rng: jax.Array) -> TrainState:
+        params = init_params(rng, cfg)
+        params = shard_params(params, mesh, specs, cfg)
+        opt_state = jax.jit(optimizer.init)(params)  # moments inherit shardings
+        return TrainState(params, opt_state, jnp.zeros((), jnp.int32))
+
+    def place_batch(tokens, seq_lens):
+        return (
+            jax.device_put(tokens, data_sharding),
+            jax.device_put(seq_lens, lens_sharding),
+        )
+
+    step_fn.place_batch = place_batch  # type: ignore[attr-defined]
+    return init_fn, step_fn

@@ -1,0 +1,109 @@
+"""CLI surface and metrics endpoint."""
+
+import json
+import urllib.request
+
+import pytest
+
+from k8s_llm_scheduler_tpu.observability.metrics import (
+    MetricsServer,
+    render_prometheus,
+)
+from k8s_llm_scheduler_tpu.observability.trace import PhaseRecorder
+
+
+class TestMetricsRendering:
+    def test_flatten_and_render(self):
+        stats = {
+            "total_scheduled": 5,
+            "client": {"avg_response_time_ms": 12.5, "circuit_breaker": {"state": "closed"}},
+        }
+        text = render_prometheus(stats)
+        assert "llm_scheduler_total_scheduled 5.0" in text
+        assert "llm_scheduler_client_avg_response_time_ms 12.5" in text
+        assert 'llm_scheduler_client_circuit_breaker_state{value="closed"} 1.0' in text
+
+
+class TestMetricsServer:
+    def test_endpoints(self):
+        server = MetricsServer(
+            lambda: {"total_scheduled": 3, "nested": {"x": 1}},
+            port=0,  # ephemeral
+            host="127.0.0.1",
+            is_alive=lambda: True,
+        )
+        server.start()
+        try:
+            base = f"http://127.0.0.1:{server.port}"
+            metrics = urllib.request.urlopen(f"{base}/metrics").read().decode()
+            assert "llm_scheduler_total_scheduled 3.0" in metrics
+            health = urllib.request.urlopen(f"{base}/healthz")
+            assert health.status == 200
+            stats = json.loads(urllib.request.urlopen(f"{base}/stats").read())
+            assert stats["nested"]["x"] == 1
+            with pytest.raises(urllib.error.HTTPError):
+                urllib.request.urlopen(f"{base}/nope")
+        finally:
+            server.stop()
+
+    def test_unhealthy(self):
+        server = MetricsServer(lambda: {}, port=0, host="127.0.0.1",
+                               is_alive=lambda: False)
+        server.start()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(f"http://127.0.0.1:{server.port}/healthz")
+            assert err.value.code == 503
+        finally:
+            server.stop()
+
+
+class TestPhaseRecorder:
+    def test_phases(self):
+        rec = PhaseRecorder()
+        with rec.phase("prefill"):
+            pass
+        with rec.phase("prefill"):
+            pass
+        rec.record("decode", 0.5)
+        snap = rec.snapshot()
+        assert snap["prefill"]["count"] == 2
+        assert snap["decode"]["total_ms"] == 500.0
+        rec.reset()
+        assert rec.snapshot() == {}
+
+
+class TestCLI:
+    def test_verify_fast(self, capsys):
+        from k8s_llm_scheduler_tpu.cli import main
+
+        rc = main(["verify", "--fast"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "[ok] import jax" in out
+        assert "all checks passed" in out
+
+    def test_demo_stub_backend(self, capsys, monkeypatch, tmp_path):
+        """`cli demo` with the stub backend schedules the 3 fixture pods on
+        the fake cluster — the reference's E2E flow with zero dependencies."""
+        cfg_file = tmp_path / "config.yaml"
+        cfg_file.write_text("llm:\n  backend: stub\nmetrics:\n  enabled: true\n  port: 0\n")
+        from k8s_llm_scheduler_tpu.cli import main
+
+        rc = main(["--config", str(cfg_file), "demo", "--fake-nodes", "3"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        stats = json.loads(out[out.index("{"):])
+        assert stats["total_scheduled"] == 3
+
+    def test_run_without_kubernetes_errors_cleanly(self, capsys, tmp_path):
+        from k8s_llm_scheduler_tpu.cli import main
+        from k8s_llm_scheduler_tpu.cluster.kube import KubeCluster
+
+        if KubeCluster.available():
+            pytest.skip("kubernetes client installed")
+        cfg_file = tmp_path / "config.yaml"
+        cfg_file.write_text("llm:\n  backend: stub\n")
+        rc = main(["--config", str(cfg_file), "run"])
+        assert rc == 2
+        assert "fake-cluster" in capsys.readouterr().err

@@ -1,0 +1,96 @@
+"""Sharded training step + graft entry points."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from k8s_llm_scheduler_tpu.models.configs import LlamaConfig
+from k8s_llm_scheduler_tpu.parallel.mesh import make_mesh
+from k8s_llm_scheduler_tpu.train.train_step import causal_lm_loss, make_train_step
+
+CFG = LlamaConfig(
+    name="train-test", vocab_size=64, d_model=64, n_layers=2, n_heads=4,
+    n_kv_heads=4, d_ff=128, max_seq_len=512, rope_theta=10000.0,
+    dtype=jnp.float32, tie_embeddings=True,
+)
+
+
+def batch(B=4, S=64, seed=0):
+    rng = jax.random.PRNGKey(seed)
+    tokens = jax.random.randint(rng, (B, S), 0, CFG.vocab_size, dtype=jnp.int32)
+    return tokens, jnp.full((B,), S, dtype=jnp.int32)
+
+
+class TestLoss:
+    def test_random_model_loss_near_log_vocab(self):
+        logits = jnp.zeros((2, 16, CFG.vocab_size))
+        tokens, lens = batch(2, 16)
+        loss = causal_lm_loss(logits, tokens, lens)
+        np.testing.assert_allclose(float(loss), np.log(CFG.vocab_size), rtol=1e-5)
+
+    def test_padding_masked(self):
+        logits = jax.random.normal(jax.random.PRNGKey(1), (1, 16, CFG.vocab_size))
+        tokens, _ = batch(1, 16)
+        full = causal_lm_loss(logits, tokens, jnp.array([16]))
+        # Corrupt logits beyond position 7 — loss with len 8 must not change.
+        corrupted = logits.at[:, 8:].set(999.0)
+        short1 = causal_lm_loss(logits, tokens, jnp.array([8]))
+        short2 = causal_lm_loss(corrupted, tokens, jnp.array([8]))
+        np.testing.assert_allclose(float(short1), float(short2), rtol=1e-6)
+        assert abs(float(full) - float(short1)) > 1e-6
+
+
+class TestTrainStep:
+    def test_loss_decreases_single_device(self):
+        import optax
+
+        mesh = make_mesh({"dp": 1})
+        init_fn, step_fn = make_train_step(CFG, mesh, optimizer=optax.adam(1e-2))
+        state = init_fn(jax.random.PRNGKey(0))
+        tokens, lens = batch(4, 64)
+        losses = []
+        for _ in range(5):
+            state, loss = step_fn(state, tokens, lens)
+            losses.append(float(loss))
+        assert losses[-1] < losses[0]  # overfitting a fixed batch
+        assert int(state.step) == 5
+
+    def test_full_mesh_matches_single_device(self):
+        """dp2 x sp2 x tp2 training step computes the same loss as one
+        device — the collectives are semantics-preserving."""
+        mesh1 = make_mesh({"dp": 1})
+        init1, step1 = make_train_step(CFG, mesh1)
+        s1 = init1(jax.random.PRNGKey(0))
+        tokens, lens = batch(4, 64)
+        _, loss1 = step1(s1, tokens, lens)
+
+        mesh8 = make_mesh({"dp": 2, "sp": 2, "tp": 2})
+        init8, step8 = make_train_step(CFG, mesh8)
+        s8 = init8(jax.random.PRNGKey(0))
+        t8, l8 = step8.place_batch(tokens, lens)
+        _, loss8 = step8(s8, t8, l8)
+        np.testing.assert_allclose(float(loss1), float(loss8), rtol=2e-4)
+
+    def test_fsdp_axis(self):
+        mesh = make_mesh({"dp": 2, "fsdp": 2, "tp": 2})
+        init_fn, step_fn = make_train_step(CFG, mesh)
+        state = init_fn(jax.random.PRNGKey(0))
+        tokens, lens = batch(4, 64)
+        tokens, lens = step_fn.place_batch(tokens, lens)
+        state, loss = step_fn(state, tokens, lens)
+        assert np.isfinite(float(loss))
+
+
+class TestGraftEntry:
+    def test_entry_compiles(self):
+        import __graft_entry__ as g
+
+        fn, args = g.entry()
+        out = jax.jit(fn)(*args)
+        assert out.shape[0] == args[1].shape[0]
+
+    def test_dryrun_multichip(self):
+        import __graft_entry__ as g
+
+        g.dryrun_multichip(8)
