@@ -86,7 +86,9 @@ async def bench(args) -> dict:
         max_slots=args.slots,
         num_pages=1024,
         page_size=128,
-        prefill_buckets=(2048, 4096, 8192, 16384),
+        # small buckets serve the per-pod suffixes (shared-prefix path);
+        # large ones serve the once-per-snapshot cluster-state prefix.
+        prefill_buckets=(256, 512, 1024, 2048, 4096, 8192, 16384),
         chunk_steps=args.chunk_steps,
         temperature=args.temperature,
         max_new_tokens=args.max_new_tokens,
@@ -154,7 +156,7 @@ def main() -> None:
     parser.add_argument("--pods", type=int, default=64)
     parser.add_argument("--nodes", type=int, default=32)
     parser.add_argument("--shapes", type=int, default=8)
-    parser.add_argument("--slots", type=int, default=8)
+    parser.add_argument("--slots", type=int, default=16)
     parser.add_argument("--model", default="bench")
     parser.add_argument("--chunk-steps", type=int, default=24)
     parser.add_argument("--max-new-tokens", type=int, default=72)

@@ -1,4 +1,4 @@
-"""The TPU inference engine: bucketed prefill + fused multi-token decode.
+"""The TPU inference engine: shared-prefix cascade prefill + fused decode.
 
 This is the component that replaces the reference's entire
 HuggingFaceClient network path (reference scheduler.py:418-433): where the
@@ -6,29 +6,43 @@ reference ships a prompt over HTTPS and waits for a remote 70B, this engine
 runs the model in-process on the TPU mesh.
 
 Design, driven by XLA semantics and the measured dispatch economics
-(~20 ms/dispatch over the axon tunnel):
+(~80-90 ms per blocking host<->device round trip over the axon tunnel;
+enqueueing is cheap — only SYNCS are expensive):
 
-- **Bucketed prefill**: prompts pad to the nearest bucket from
-  `prefill_buckets` (multiples of the KV page size), so there is exactly one
-  compiled prefill program per bucket. Static shapes, no recompiles in
-  steady state.
-- **Fused decode chunks**: decode runs `chunk_steps` tokens per device
-  dispatch inside one jit'd lax.scan — sampling, grammar masking, DFA state
-  transitions, KV scatters all stay on device. A ~40-token constrained JSON
-  decision completes in 2-3 dispatches instead of ~300 host round trips.
+- **Shared-prefix (cascade) prefill**: a scheduling burst shares its
+  (system + cluster-state) prompt prefix (core/prompt.py; the reference's
+  own cache key proves the equivalence class, scheduler.py:265-271). The
+  prefix prefills ONCE per cluster snapshot into a dense KV buffer; each
+  pod then prefills only its ~100-token suffix against that buffer
+  (models/llama.forward_prefill_suffix).
+- **Batched one-dispatch admission**: a whole burst's suffixes prefill,
+  scatter their KV into pages, and sample their first constrained token in
+  ONE jit'd program. No per-request host syncs.
+- **Fused + chained decode chunks**: decode runs `chunk_steps` tokens per
+  program inside lax.scan — sampling, grammar masking, DFA transitions, KV
+  scatters all on device — and `step(chunks=n)` chains n such programs
+  back-to-back with a SINGLE host sync at the end. A ~60-token constrained
+  JSON decision costs one sync total.
+- **Device-resident decode state**: current token / position / active /
+  DFA state / remaining-budget live on device between dispatches; the
+  budget makes max_new_tokens a device-side guarantee (no page overruns
+  from speculative chaining).
 - **Slot-based continuous batching**: a fixed decode batch of `max_slots`
-  sequence slots over the paged KV cache; requests join/leave between
-  chunks. Shapes never depend on how many requests are in flight.
-- **Grammar-constrained sampling** (engine/constrained.py): the DFA tables
-  ride along as device arrays padded to a fixed state capacity, so changing
-  the allowed node-name set never recompiles.
+  slots over the paged KV cache (own pages hold only suffix + generated
+  tokens; the prefix is the dense shared buffer). Requests join/leave
+  between chunks; shapes never depend on how many are in flight.
+- **Grammar-constrained sampling** (engine/constrained.py): DFA tables ride
+  along as fixed-capacity device arrays; changing the allowed node-name set
+  never recompiles.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import functools
 import logging
 import time
+from collections import OrderedDict
 from typing import Any
 
 import jax
@@ -41,8 +55,9 @@ from k8s_llm_scheduler_tpu.engine.tokenizer import ByteTokenizer, Tokenizer
 from k8s_llm_scheduler_tpu.models.configs import LlamaConfig
 from k8s_llm_scheduler_tpu.models.llama import (
     Params,
-    forward_decode,
+    forward_decode_prefixed,
     forward_prefill,
+    forward_prefill_suffix,
 )
 from k8s_llm_scheduler_tpu.ops.attention import NEG_INF
 
@@ -58,58 +73,96 @@ def _sample(logits, mask, rng, temperature):
     return jnp.where(temperature > 0, sampled, greedy).astype(jnp.int32)
 
 
-def _first_token_impl(logits_last, allowed, state, rng, temperature):
-    """Sample each slot's first generated token from prefill logits."""
-    mask = allowed[state]  # [B, V]
-    return _sample(logits_last, mask, rng, temperature)
+def _admit_impl(
+    params: Params,
+    cfg: LlamaConfig,  # static
+    tokens,        # [R, Ss] suffix tokens (R = admission-row bucket)
+    suffix_lens,   # [R] int32 (0 on padding rows)
+    prefix_k, prefix_v,  # [L, Sp, n_kv, hd] shared dense prefix KV
+    prefix_len,    # scalar int32
+    k_cache, v_cache,    # donated
+    page_ids,      # [R, Ss/page_size] scatter destinations (0 = scratch)
+    slot_ids,      # [R] int32 — target slot per row (trash slot M on padding)
+    tok, pos, act, st, budget, first,  # donated per-slot state [M+1]
+    new_budgets,   # [R] budget for admitted rows (max_new - 1; 0 on padding)
+    allowed, next_state, done_state, eos_id,
+    dfa_start,     # scalar int32
+    rng, temperature,
+):
+    """Batched admission: suffix prefill + KV scatter + first-token sample,
+    one device program. Rows scatter into their slot's state; padding rows
+    land in the reserved trash row (index M) and stay inactive."""
+    last_logits, k_cache, v_cache = forward_prefill_suffix(
+        params, cfg, tokens, suffix_lens, prefix_k, prefix_v, prefix_len,
+        k_cache, v_cache, page_ids,
+    )
+    R = tokens.shape[0]
+    start_vec = jnp.full((R,), dfa_start, dtype=jnp.int32)
+    mask = allowed[start_vec]
+    first_new = _sample(last_logits, mask, rng, temperature)
+    st_new = next_state[start_vec, first_new]
+    finished = (first_new == eos_id) | (st_new == done_state)
+    real = suffix_lens > 0  # padding rows must never activate the trash row
+
+    tok = tok.at[slot_ids].set(first_new)
+    pos = pos.at[slot_ids].set(prefix_len + suffix_lens)
+    act = act.at[slot_ids].set(real & ~finished)
+    st = st.at[slot_ids].set(st_new)
+    budget = budget.at[slot_ids].set(new_budgets)
+    first = first.at[slot_ids].set(first_new)
+    return k_cache, v_cache, tok, pos, act, st, budget, first
 
 
 def _decode_chunk_impl(
     params: Params,
-    cfg: LlamaConfig,
-    k_cache, v_cache,
-    page_tables,
-    tokens,      # [B] current input token per slot (sampled, not yet processed)
-    positions,   # [B] position of that token
-    active,      # [B] bool
-    dfa_state,   # [B] int32
-    allowed,     # [S, V] bool (padded to fixed S)
-    next_state,  # [S, V] int32
-    done_state,  # scalar int32
-    eos_id,      # scalar int32
-    pad_id,      # scalar int32 — emission sentinel for finished slots
-    rng,
-    temperature,  # scalar f32
-    n_steps: int,
+    cfg: LlamaConfig,  # static
+    k_cache, v_cache,  # donated
+    page_tables,       # [M, max_pages] own-page tables
+    prefix_k, prefix_v,  # [L, Sp, n_kv, hd]
+    prefix_len,        # scalar int32
+    tok, pos, act, st, budget,  # donated per-slot state [M]
+    allowed, next_state, done_state, eos_id, pad_id,
+    rng, temperature,
+    n_steps: int,      # static
 ):
     """`n_steps` decode iterations fused into one program. Emits the sampled
-    token per step; finished/inactive slots emit pad_id and idle in place."""
+    token per step; finished/exhausted/idle slots emit pad_id and idle."""
 
     def step(carry, _):
-        kc, vc, tok, pos, act, st, key = carry
-        logits, kc, vc = forward_decode(
-            params, cfg, tok, pos, kc, vc, page_tables, act
+        kc, vc, tok, pos, act, st, budget, key = carry
+        act_eff = act & (budget > 0)
+        logits, kc, vc = forward_decode_prefixed(
+            params, cfg, tok, pos, kc, vc, page_tables, act_eff,
+            prefix_k, prefix_v, prefix_len,
         )
         key, sub = jax.random.split(key)
-        mask = allowed[st]
-        nxt = _sample(logits, mask, sub, temperature)
+        nxt = _sample(logits, allowed[st], sub, temperature)
         new_st = next_state[st, nxt]
-        emitted = jnp.where(act, nxt, pad_id)
-        new_st = jnp.where(act, new_st, st)
+        emitted = jnp.where(act_eff, nxt, pad_id)
+        new_st = jnp.where(act_eff, new_st, st)
         finished = (new_st == done_state) | (nxt == eos_id)
-        new_act = act & ~finished
-        new_pos = jnp.where(act, pos + 1, pos)
-        return (kc, vc, emitted, new_pos, new_act, new_st, key), emitted
+        new_act = act_eff & ~finished
+        new_budget = jnp.where(act_eff, budget - 1, budget)
+        new_pos = jnp.where(act_eff, pos + 1, pos)
+        return (kc, vc, emitted, new_pos, new_act, new_st, new_budget, key), emitted
 
-    (k_cache, v_cache, tokens, positions, active, dfa_state, rng), toks = (
-        jax.lax.scan(
-            step,
-            (k_cache, v_cache, tokens, positions, active, dfa_state, rng),
-            None,
-            length=n_steps,
-        )
+    (k_cache, v_cache, tok, pos, act, st, budget, _), toks = jax.lax.scan(
+        step,
+        (k_cache, v_cache, tok, pos, act, st, budget, rng),
+        None,
+        length=n_steps,
     )
-    return k_cache, v_cache, tokens, positions, active, dfa_state, rng, toks.T  # [B, n]
+    return k_cache, v_cache, tok, pos, act, st, budget, toks.T  # [M, n]
+
+
+@dataclasses.dataclass
+class _PrefixKV:
+    """Dense KV of a burst-shared prompt prefix, prefilled once."""
+
+    k: jax.Array  # [L, Sp_bucket, n_kv, hd]
+    v: jax.Array
+    length: int
+    token_ids: tuple[int, ...]
 
 
 @dataclasses.dataclass
@@ -119,6 +172,7 @@ class _Request:
     prompt_len: int
     max_new_tokens: int
     generated: list[int] = dataclasses.field(default_factory=list)
+    first_pending: bool = True  # first token not yet harvested from device
     done: bool = False
     submitted_at: float = dataclasses.field(default_factory=time.perf_counter)
 
@@ -135,6 +189,7 @@ class InferenceEngine:
     """Single-owner (one thread/task) engine over one model + one KV cache."""
 
     DFA_STATE_CAPACITY = 4096
+    PREFIX_CACHE_SIZE = 2
 
     def __init__(
         self,
@@ -170,9 +225,21 @@ class InferenceEngine:
         self.max_slots = max_slots
 
         self._prefill = jax.jit(forward_prefill, static_argnums=(1,))
-        self._first = jax.jit(_first_token_impl)
+        # Prefix prefill needs KV only — skipping the LM head avoids a
+        # [bucket, vocab] logits tensor on the admission critical path.
+        self._prefill_kv = jax.jit(
+            functools.partial(forward_prefill, return_logits=False),
+            static_argnums=(1,),
+        )
+        self._admit = jax.jit(
+            _admit_impl,
+            static_argnums=(1,),
+            donate_argnums=(7, 8, 11, 12, 13, 14, 15, 16),
+        )
         self._chunk = jax.jit(
-            _decode_chunk_impl, static_argnums=(1, 16), donate_argnums=(2, 3)
+            _decode_chunk_impl,
+            static_argnums=(1, 20),
+            donate_argnums=(2, 3, 8, 9, 10, 11, 12),
         )
 
         # Grammar tables (fixed shapes; content swaps without recompiling).
@@ -183,22 +250,42 @@ class InferenceEngine:
         self._dfa_start = 0
         self.set_grammar(None)  # applies the pad-exclusion mask
 
+        # Shared-prefix store. The engine holds ONE active prefix at a time
+        # (all in-flight slots decode against it); recent prefixes stay
+        # cached on device keyed by their token ids.
+        self._prefix: _PrefixKV | None = None
+        self._prefix_cache: OrderedDict[tuple[int, ...], _PrefixKV] = OrderedDict()
+        self._empty_prefix: _PrefixKV | None = None
+
         self._rng = jax.random.PRNGKey(rng_seed)
         self._req_counter = 0
         self._by_slot: dict[int, _Request] = {}
-        # Host mirrors of per-slot decode state.
-        B = max_slots
-        self._tok_np = np.zeros(B, dtype=np.int32)
-        self._pos_np = np.zeros(B, dtype=np.int32)
-        self._act_np = np.zeros(B, dtype=bool)
-        self._st_np = np.zeros(B, dtype=np.int32)
+        # Device-resident per-slot decode state (+ post-sync host mirrors).
+        # Row M (one past the real slots) is the TRASH row: admission-padding
+        # rows scatter there and it never activates, so admission batches can
+        # be narrower than max_slots without per-row masking games.
+        M = max_slots + 1
+        self._tok_d = jnp.zeros(M, dtype=jnp.int32)
+        self._pos_d = jnp.zeros(M, dtype=jnp.int32)
+        self._act_d = jnp.zeros(M, dtype=bool)
+        self._st_d = jnp.zeros(M, dtype=jnp.int32)
+        self._budget_d = jnp.zeros(M, dtype=jnp.int32)
+        self._first_d = jnp.zeros(M, dtype=jnp.int32)
+        self._act_np = np.zeros(M, dtype=bool)      # post-sync mirror
+        self._budget_np = np.zeros(M, dtype=np.int32)
+        # Page tables padded with the trash row (all-zeros -> scratch page).
+        self._tables_src: jax.Array | None = None
+        self._tables_padded: jax.Array | None = None
         self.stats = {
             "requests": 0,
             "completed": 0,
             "prefill_tokens": 0,
+            "prefix_prefills": 0,
+            "prefix_hits": 0,
             "decode_tokens": 0,
             "chunks": 0,
             "prefills": 0,
+            "syncs": 0,
         }
 
     # ------------------------------------------------------------- grammar
@@ -233,6 +320,59 @@ class InferenceEngine:
         self._done_state = jnp.int32(dfa.done_state)
         self._dfa_start = dfa.start_state
 
+    # -------------------------------------------------------------- prefix
+    def _get_empty_prefix(self) -> _PrefixKV:
+        if self._empty_prefix is None:
+            shape = (
+                self.cfg.n_layers,
+                self.kv.page_size,
+                self.cfg.n_kv_heads,
+                self.cfg.head_dim,
+            )
+            self._empty_prefix = _PrefixKV(
+                k=jnp.zeros(shape, dtype=self.cfg.dtype),
+                v=jnp.zeros(shape, dtype=self.cfg.dtype),
+                length=0,
+                token_ids=(),
+            )
+        return self._empty_prefix
+
+    def set_prefix(self, prompt_ids: list[int] | None) -> None:
+        """Install the burst-shared prompt prefix (prefilling it once if not
+        cached on device). Requires the engine to be drained — all in-flight
+        slots decode against the same prefix buffer."""
+        if self._by_slot:
+            raise RuntimeError("cannot switch prefix with requests in flight")
+        if not prompt_ids:
+            self._prefix = self._get_empty_prefix()
+            return
+        key = tuple(prompt_ids)
+        cached = self._prefix_cache.get(key)
+        if cached is not None:
+            self._prefix_cache.move_to_end(key)
+            self._prefix = cached
+            self.stats["prefix_hits"] += 1
+            return
+        n = len(prompt_ids)
+        bucket = self._bucket_for(n)
+        pad = self.tokenizer.pad_id
+        tokens = np.full((1, bucket), pad, dtype=np.int32)
+        tokens[0, :n] = prompt_ids
+        _, k_all, v_all = self._prefill_kv(
+            self.params, self.cfg, jnp.asarray(tokens), jnp.asarray([n])
+        )
+        pfx = _PrefixKV(k=k_all[:, 0], v=v_all[:, 0], length=n, token_ids=key)
+        self._prefix_cache[key] = pfx
+        while len(self._prefix_cache) > self.PREFIX_CACHE_SIZE:
+            self._prefix_cache.popitem(last=False)
+        self._prefix = pfx
+        self.stats["prefix_prefills"] += 1
+        self.stats["prefill_tokens"] += n
+
+    @property
+    def prefix_len(self) -> int:
+        return self._prefix.length if self._prefix else 0
+
     # ------------------------------------------------------------ requests
     def _bucket_for(self, n: int) -> int:
         for bkt in self.prefill_buckets:
@@ -247,118 +387,200 @@ class InferenceEngine:
     def free_slots(self) -> int:
         return self.max_slots - len(self._by_slot)
 
+    def max_suffix_tokens(self, max_new_tokens: int) -> int:
+        """Longest admissible prompt/suffix for a given decode budget —
+        bounded by the page-table width and the largest prefill bucket.
+        Callers (engine/local.py) pre-check against this so one oversized
+        request fails alone instead of poisoning its admission batch."""
+        by_pages = (
+            self.kv.max_pages_per_seq * self.kv.page_size - (max_new_tokens + 1)
+        )
+        return min(by_pages, self.prefill_buckets[-1])
+
+    def _padded_tables(self) -> jax.Array:
+        """kv page tables + the all-zeros trash row, cached per table build."""
+        src = self.kv.page_tables()
+        if src is not self._tables_src:
+            self._tables_src = src
+            self._tables_padded = jnp.vstack(
+                [src, jnp.zeros((1, src.shape[1]), dtype=src.dtype)]
+            )
+        return self._tables_padded
+
     @property
     def has_active(self) -> bool:
         return bool(self._by_slot)
 
-    def add_request(
-        self,
-        prompt_ids: list[int],
-        max_new_tokens: int = 200,
-    ) -> int:
-        """Prefill a prompt into a free slot; returns req_id. The request
-        starts decoding at the next `step()` call.
+    def add_request(self, prompt_ids: list[int], max_new_tokens: int = 200) -> int:
+        """Single-request admission (tests, simple callers); see add_requests.
 
         max_new_tokens defaults to the reference's sampling cap
         (config.yaml:14)."""
-        if not prompt_ids:
-            raise ValueError("empty prompt")
-        if self.free_slots == 0:
-            raise RuntimeError("no free slots — backpressure the caller")
-        n = len(prompt_ids)
-        bucket = self._bucket_for(n)
-        pad = self.tokenizer.pad_id
-        tokens = np.full((1, bucket), pad, dtype=np.int32)
-        tokens[0, :n] = prompt_ids
-        reserve = max_new_tokens + self.chunk_steps
-        slot = self.kv.allocate_slot(n, reserve_decode=reserve)
+        return self.add_requests([prompt_ids], max_new_tokens)[0]
 
-        logits, k_all, v_all = self._prefill(
-            self.params, self.cfg, jnp.asarray(tokens), jnp.asarray([n])
-        )
-        self.kv.write_prefill(slot, k_all[:, 0], v_all[:, 0], n)
+    def add_requests(
+        self,
+        prompts: list[list[int]],
+        max_new_tokens: int = 200,
+    ) -> list[int]:
+        """Admit a batch of requests in ONE device dispatch (no host sync).
 
-        # First generated token from the prefill's last valid logits.
-        self._rng, sub = jax.random.split(self._rng)
-        state0 = jnp.asarray([self._dfa_start], dtype=jnp.int32)
-        first = self._first(
-            logits[:, n - 1], self._allowed, state0, sub,
-            jnp.float32(self.temperature),
-        )
-        first_tok = int(first[0])
-        next_st = int(self._next_state[self._dfa_start, first_tok])
-
-        req = _Request(
-            req_id=self._req_counter,
-            slot=slot,
-            prompt_len=n,
-            max_new_tokens=max_new_tokens,
-        )
-        self._req_counter += 1
-        self._by_slot[slot] = req
-        req.generated.append(first_tok)
-
-        self._tok_np[slot] = first_tok
-        self._pos_np[slot] = n  # the first generated token sits at index n
-        # A first token that is already terminal (EOS, or a one-token
-        # grammar) must not burn decode chunks.
-        already_done = first_tok == self.tokenizer.eos_id or next_st == int(
-            self._done_state
-        )
-        self._act_np[slot] = not already_done
-        self._st_np[slot] = next_st
-        self.stats["requests"] += 1
-        self.stats["prefills"] += 1
-        self.stats["prefill_tokens"] += n
-        return req.req_id
-
-    # ---------------------------------------------------------------- step
-    def step(self) -> list[Finished]:
-        """One fused decode chunk for all active slots; returns requests that
-        finished during this chunk."""
-        if not self._by_slot:
+        Each prompt is the per-request SUFFIX if a prefix is installed
+        (set_prefix), else the whole prompt. All prompts pad to one shared
+        bucket. Decoding starts at the next `step()` call.
+        """
+        if not prompts:
             return []
-        n = self.chunk_steps
-        any_active = any(self._act_np[slot] for slot in self._by_slot)
-        if any_active:
-            for slot in self._by_slot:
-                if self._act_np[slot]:
-                    self.kv.ensure_capacity(slot, int(self._pos_np[slot]) + n + 1)
+        if any(not p for p in prompts):
+            raise ValueError("empty prompt")
+        if len(prompts) > self.free_slots:
+            raise RuntimeError(
+                f"no free slots for {len(prompts)} request(s) "
+                f"({self.free_slots} free) — backpressure the caller"
+            )
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        prefix = self._prefix or self._get_empty_prefix()
+        self._prefix = prefix
+
+        ps = self.kv.page_size
+        bucket = self._bucket_for(max(len(p) for p in prompts))
+        n_blocks = bucket // ps
+        pad = self.tokenizer.pad_id
+        # Admission-row bucket: exactly 1 for single requests (generate,
+        # trickle traffic — avoids max_slots x the prefill memory/compute),
+        # else the full width. Two compiled programs per token bucket, and
+        # the padding rows scatter into the trash row.
+        R = 1 if len(prompts) == 1 else self.max_slots
+        trash = self.max_slots
+
+        tokens = np.full((R, bucket), pad, dtype=np.int32)
+        suffix_lens = np.zeros(R, dtype=np.int32)
+        page_ids = np.zeros((R, n_blocks), dtype=np.int32)
+        slot_ids = np.full(R, trash, dtype=np.int32)
+        new_budgets = np.zeros(R, dtype=np.int32)
+
+        reqs: list[_Request] = []
+        slots: list[int] = []
+        try:
+            for row, ids in enumerate(prompts):
+                n = len(ids)
+                slot = self.kv.allocate_slot(n, reserve_decode=max_new_tokens + 1)
+                slots.append(slot)
+                info_pages = self.kv.slot_pages(slot)
+                used = self.kv.pages_needed(n)
+                tokens[row, :n] = ids
+                suffix_lens[row] = n
+                slot_ids[row] = slot
+                new_budgets[row] = max_new_tokens - 1
+                for j in range(min(used, n_blocks)):
+                    page_ids[row, j] = info_pages[j]
+                req = _Request(
+                    req_id=self._req_counter,
+                    slot=slot,
+                    prompt_len=n,
+                    max_new_tokens=max_new_tokens,
+                )
+                self._req_counter += 1
+                reqs.append(req)
 
             self._rng, sub = jax.random.split(self._rng)
             (
                 self.kv.k, self.kv.v,
-                tok_d, pos_d, act_d, st_d, _, toks_d,
-            ) = self._chunk(
-                self.params, self.cfg, self.kv.k, self.kv.v,
-                self.kv.page_tables(),
-                jnp.asarray(self._tok_np), jnp.asarray(self._pos_np),
-                jnp.asarray(self._act_np), jnp.asarray(self._st_np),
+                self._tok_d, self._pos_d, self._act_d, self._st_d,
+                self._budget_d, self._first_d,
+            ) = self._admit(
+                self.params, self.cfg,
+                jnp.asarray(tokens), jnp.asarray(suffix_lens),
+                prefix.k, prefix.v, jnp.int32(prefix.length),
+                self.kv.k, self.kv.v,
+                jnp.asarray(page_ids), jnp.asarray(slot_ids),
+                self._tok_d, self._pos_d, self._act_d, self._st_d,
+                self._budget_d, self._first_d,
+                jnp.asarray(new_budgets),
                 self._allowed, self._next_state, self._done_state,
-                jnp.int32(self.tokenizer.eos_id), jnp.int32(self.tokenizer.pad_id),
-                sub, jnp.float32(self.temperature), n,
+                jnp.int32(self.tokenizer.eos_id), jnp.int32(self._dfa_start),
+                sub, jnp.float32(self.temperature),
             )
-            # One host sync for the whole chunk (np.array copies: the mirrors
-            # are mutated host-side, and views of jax buffers are read-only).
-            toks, self._tok_np, self._pos_np, self._act_np, self._st_np = (
-                np.asarray(toks_d), np.array(tok_d), np.array(pos_d),
-                np.array(act_d), np.array(st_d),
-            )
-            self.stats["chunks"] += 1
-        else:
-            toks = np.full((self.max_slots, n), self.tokenizer.pad_id, np.int32)
+        except Exception:
+            # Roll back BOTH the allocation loop and the device dispatch:
+            # these slots are not in _by_slot yet, so no later recovery path
+            # (abort_all) could ever free them.
+            for s in slots:
+                self.kv.free_slot(s)
+            raise
+        for req in reqs:
+            self._by_slot[req.slot] = req
+            # Optimistic mirrors until the next sync tells the truth.
+            self._act_np[req.slot] = True
+            self._budget_np[req.slot] = max_new_tokens - 1
+        self.stats["requests"] += len(reqs)
+        self.stats["prefills"] += 1
+        self.stats["prefill_tokens"] += int(suffix_lens.sum())
+        return [r.req_id for r in reqs]
+
+    # ---------------------------------------------------------------- step
+    def step(self, chunks: int = 1) -> list[Finished]:
+        """Run `chunks` fused decode chunks back-to-back (no intermediate
+        sync), then ONE host sync; returns requests that finished."""
+        if not self._by_slot:
+            return []
+        prefix = self._prefix or self._get_empty_prefix()
+        n = self.chunk_steps
+        emissions: list[jax.Array] = []
+        any_active = bool(
+            (self._act_np & (self._budget_np > 0))[list(self._by_slot)].any()
+        )
+        if any_active:
+            for _ in range(max(1, chunks)):
+                self._rng, sub = jax.random.split(self._rng)
+                (
+                    self.kv.k, self.kv.v,
+                    self._tok_d, self._pos_d, self._act_d, self._st_d,
+                    self._budget_d, toks_d,
+                ) = self._chunk(
+                    self.params, self.cfg, self.kv.k, self.kv.v,
+                    self._padded_tables(),
+                    prefix.k, prefix.v, jnp.int32(prefix.length),
+                    self._tok_d, self._pos_d, self._act_d, self._st_d,
+                    self._budget_d,
+                    self._allowed, self._next_state, self._done_state,
+                    jnp.int32(self.tokenizer.eos_id),
+                    jnp.int32(self.tokenizer.pad_id),
+                    sub, jnp.float32(self.temperature), n,
+                )
+                emissions.append(toks_d)
+                self.stats["chunks"] += 1
+
+        # ONE host sync for everything: emitted tokens + post-chunk state +
+        # first tokens of freshly admitted requests.
+        fetched = jax.device_get(
+            (emissions, self._act_d, self._budget_d, self._first_d)
+        )
+        emitted_np, act_np, budget_np, first_np = fetched
+        # np.array copies: device_get may hand back read-only views and the
+        # mirrors are mutated host-side (optimistic admission flags).
+        self._act_np = np.array(act_np)
+        self._budget_np = np.array(budget_np)
+        self.stats["syncs"] += 1
+        toks = (
+            np.concatenate(emitted_np, axis=1)
+            if emitted_np
+            else np.zeros((self.max_slots + 1, 0), dtype=np.int32)
+        )
 
         finished: list[Finished] = []
+        pad = self.tokenizer.pad_id
         for slot, req in list(self._by_slot.items()):
-            emitted = [int(t) for t in toks[slot] if t != self.tokenizer.pad_id]
+            if req.first_pending:
+                req.generated.append(int(first_np[slot]))
+                req.first_pending = False
+            emitted = [int(t) for t in toks[slot] if t != pad]
             # Tokens after the finishing token are pad, so emitted is exact
             # (pad is never sampleable for active slots — see set_grammar).
             req.generated.extend(emitted)
             self.stats["decode_tokens"] += len(emitted)
-            hit_cap = len(req.generated) >= req.max_new_tokens
-            if not self._act_np[slot] or hit_cap:
-                if hit_cap:
-                    self._act_np[slot] = False
+            if not self._act_np[slot] or self._budget_np[slot] <= 0:
                 req.done = True
                 self.kv.free_slot(slot)
                 del self._by_slot[slot]
@@ -376,11 +598,14 @@ class InferenceEngine:
 
     def abort_all(self) -> None:
         """Free every in-flight slot and its KV pages — recovery path after a
-        failed decode chunk so the engine never leaks capacity."""
+        failed dispatch so the engine never leaks capacity."""
         for slot in list(self._by_slot):
             self.kv.free_slot(slot)
             del self._by_slot[slot]
         self._act_np[:] = False
+        self._budget_np[:] = 0
+        self._act_d = jnp.zeros(self.max_slots + 1, dtype=bool)
+        self._budget_d = jnp.zeros(self.max_slots + 1, dtype=jnp.int32)
 
     # ------------------------------------------------------------ convenience
     def generate(

@@ -15,10 +15,17 @@ regime (SURVEY §7 hard part #2):
   calls; all device-side mutation happens inside jit'd scatters with
   donated buffers, so shapes never change and nothing recompiles.
 
-Prefix reuse: `fork_slot` lets a new sequence share the pages of a common
-prompt prefix (the burst-shared cluster-state block, core/prompt.py) with
-copy-on-write granularity of one page — sharing is at whole-page level, the
-partial tail page is copied.
+Prefix reuse lives OUTSIDE this cache: the burst-shared cluster-state block
+is prefilled once into a dense [L, Sp, n_kv, hd] buffer (engine/engine.py
+_PrefixKV) and attended via cascade attention (ops/attention.py), so slot
+pages hold only each request's suffix + generated tokens. That keeps page
+tables narrow — the decode gather reads a few pages per slot instead of the
+whole prompt.
+
+write_prefill / ensure_capacity / note_token_appended remain as the manual
+page-management API for driving forward_decode directly (tests, external
+callers); the engine reserves full capacity at admission and scatters KV
+inside its jit programs instead.
 """
 
 from __future__ import annotations
@@ -136,6 +143,10 @@ class PagedKVCache:
     def slot_length(self, slot: int) -> int:
         return self._slots[slot].length
 
+    def slot_pages(self, slot: int) -> list[int]:
+        """The slot's owned page ids, in logical-block order."""
+        return list(self._slots[slot].pages)
+
     def ensure_decode_capacity(self, slot: int) -> None:
         """Grow the slot by one page if the next token would overflow."""
         self.ensure_capacity(slot, self._slots[slot].length + 1)
@@ -185,40 +196,3 @@ class PagedKVCache:
         self.v = _scatter_pages(self.v, page_ids, blocks_v)
         info.length = seq_len
 
-    # ----------------------------------------------------- prefix sharing
-    def fork_slot(self, src_slot: int, shared_tokens: int, extra_tokens: int) -> int:
-        """New slot sharing the source's full pages covering `shared_tokens`;
-        the partial tail page (and room for extra_tokens) is freshly owned.
-
-        Page-granular copy-on-write: shared pages are refcounted, never
-        written by the fork (decode appends land in the fork's own pages).
-        Returns the new slot id; caller must write the non-shared suffix KV.
-        """
-        if not self._free_slots:
-            raise OutOfPagesError("no free sequence slots")
-        src = self._slots[src_slot]
-        full_shared = min(shared_tokens // self.page_size, len(src.pages))
-        shared_pages = src.pages[:full_shared]
-        total_tokens = shared_tokens + extra_tokens
-        need = self.pages_needed(total_tokens)
-        if need > self.max_pages_per_seq:
-            raise OutOfPagesError(
-                f"forked sequence needs {need} pages > max_pages_per_seq={self.max_pages_per_seq}"
-            )
-        # Allocate own pages FIRST — if the pool is exhausted this raises
-        # before any refcount is touched, so nothing leaks.
-        own_pages = self._alloc_pages(max(0, need - full_shared))
-        for p in shared_pages:
-            self._refcount[p] += 1
-        slot = self._free_slots.pop()
-        pages = shared_pages + own_pages
-        self._slots[slot] = SlotInfo(slot=slot, length=0, pages=pages)
-        row = np.zeros(self.max_pages_per_seq, dtype=np.int32)
-        row[: len(pages)] = pages
-        self._tables_np[slot] = row
-        self._tables_dirty = True
-        return slot
-
-    def shared_page_tokens(self, shared_tokens: int) -> int:
-        """How many tokens of a prefix are reusable at page granularity."""
-        return (shared_tokens // self.page_size) * self.page_size

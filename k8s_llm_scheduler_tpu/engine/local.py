@@ -11,16 +11,19 @@ network.
 Concurrency model: DecisionClient calls get_scheduling_decision from worker
 threads (one per in-flight pod, via asyncio.to_thread). Those calls enqueue
 a request and block on a Future. A single engine-owner thread drains the
-queue and drives the InferenceEngine: admit -> fused decode chunk -> admit
-more -> ... — so concurrent pod decisions share decode batches
-(continuous batching at chunk granularity), and a burst of N pods costs
-~N/max_slots decode streams instead of N serial ones.
+queue and drives the InferenceEngine: admit a whole batch in one dispatch ->
+chained fused decode chunks (one host sync) -> admit more -> ... — so
+concurrent pod decisions share decode batches (continuous batching at chunk
+granularity), and a burst of N pods costs one shared-prefix prefill plus
+~N/max_slots admission/decode waves instead of N serial streams.
 
-Grammar grouping: the engine holds ONE grammar at a time, keyed by the
-cluster snapshot's ready-node-name set. Requests are grouped by that key;
-a new group installs its DFA only when the engine drains. Within a burst
-(shared snapshot — the reference's own cache-key equivalence,
-scheduler.py:265-271) everything lands in one group.
+Group keying: the engine holds ONE (prompt prefix, grammar) pair at a time,
+both keyed by the cluster snapshot — the prefix is the burst-shared
+(system + cluster state) token block (core/prompt.py split_prompt), the
+grammar is the DFA over the snapshot's ready node names. Requests group by
+that pair; a new group installs its prefix KV + DFA only when the engine
+drains. Within a burst (shared snapshot — the reference's own cache-key
+equivalence, scheduler.py:265-271) everything lands in one group.
 """
 
 from __future__ import annotations
@@ -62,11 +65,12 @@ logger = logging.getLogger(__name__)
 
 
 class _WorkItem:
-    __slots__ = ("prompt_ids", "grammar_key", "future", "enqueued_at")
+    __slots__ = ("prefix_ids", "suffix_ids", "group_key", "future", "enqueued_at")
 
-    def __init__(self, prompt_ids, grammar_key):
-        self.prompt_ids = prompt_ids
-        self.grammar_key = grammar_key
+    def __init__(self, prefix_ids, suffix_ids, group_key):
+        self.prefix_ids = prefix_ids
+        self.suffix_ids = suffix_ids
+        self.group_key = group_key  # (prefix token tuple, grammar names) pair
         self.future: Future = Future()
         self.enqueued_at = time.perf_counter()
 
@@ -82,6 +86,7 @@ class LocalLLMBackend:
         constrained: bool = True,
         request_timeout_s: float = 60.0,
         admit_wait_s: float = 0.002,
+        chain_chunks: int | None = None,
     ) -> None:
         self.engine = engine
         self.tokenizer = tokenizer or engine.tokenizer
@@ -95,9 +100,15 @@ class LocalLLMBackend:
             )
         self.request_timeout_s = request_timeout_s
         self.admit_wait_s = admit_wait_s
+        # Chunks to chain right after an admission (one host sync covers the
+        # typical whole decision); stragglers then go one chunk at a time.
+        self.chain_chunks = chain_chunks if chain_chunks is not None else max(
+            1, -(-max_new_tokens // engine.chunk_steps)
+        )
         self._queue: queue.Queue[_WorkItem | None] = queue.Queue()
         self._dfa_cache: dict[tuple[str, ...], Any] = {}
-        self._current_group: tuple[str, ...] | None = None
+        self._current_group: tuple | None = None
+        self._fresh_admission = False
         self._worker = threading.Thread(
             target=self._run_worker, daemon=True, name="llm-engine"
         )
@@ -113,14 +124,18 @@ class LocalLLMBackend:
             raise NoFeasibleNodeError(
                 f"no feasible node for {pod.namespace}/{pod.name}"
             )
-        prompt_text = self.prompt_engine.construct_scheduling_prompt(pod, nodes)
-        prompt_ids = self.tokenizer.chat_prompt(
-            self.prompt_engine.system_prompt, prompt_text
+        cluster_part, pod_part = self.prompt_engine.split_prompt(pod, nodes)
+        prefix_ids, suffix_ids = self.tokenizer.chat_prompt_parts(
+            self.prompt_engine.system_prompt, cluster_part, pod_part
         )
         # Grammar over READY nodes of this snapshot (stable across the pods
         # of a burst); per-pod feasibility is enforced by validation upstream.
         ready_names = tuple(sorted(n.name for n in nodes if n.is_ready))
-        item = _WorkItem(prompt_ids, ready_names if self.constrained else None)
+        group_key = (
+            tuple(prefix_ids),
+            ready_names if self.constrained else None,
+        )
+        item = _WorkItem(prefix_ids, suffix_ids, group_key)
         self._queue.put(item)
         try:
             text = item.future.result(timeout=self.request_timeout_s)
@@ -166,29 +181,58 @@ class LocalLLMBackend:
         return self._dfa_cache[key]
 
     def _admit(self, pending: list[_WorkItem], inflight: dict[int, _WorkItem]) -> list[_WorkItem]:
-        """Admit queued items whose grammar matches the current group."""
+        """Admit queued items whose group matches, as ONE batched dispatch."""
         rest: list[_WorkItem] = []
+        batch: list[_WorkItem] = []
         for item in pending:
-            if self.engine.free_slots == 0:
+            if len(batch) >= self.engine.free_slots:
                 rest.append(item)
                 continue
-            try:
-                if not inflight and item.grammar_key != self._current_group:
-                    # Engine drained: switch grammar groups.
+            if len(item.suffix_ids) > self.engine.max_suffix_tokens(self.max_new_tokens):
+                # Oversized suffix can never admit — fail it alone instead of
+                # poisoning the whole batch's add_requests call.
+                item.future.set_exception(
+                    BackendError(
+                        f"pod prompt suffix of {len(item.suffix_ids)} tokens "
+                        f"exceeds engine capacity "
+                        f"{self.engine.max_suffix_tokens(self.max_new_tokens)}"
+                    )
+                )
+                continue
+            if not inflight and not batch and item.group_key != self._current_group:
+                # Engine drained: switch (prefix, grammar) groups. Invalidate
+                # first — a partial switch (prefix installed, grammar failed)
+                # must not leave old-group items matching a half-switched
+                # engine.
+                self._current_group = None
+                try:
+                    self.engine.set_prefix(item.prefix_ids)
+                    grammar_names = item.group_key[1]
                     self.engine.set_grammar(
-                        self._grammar_for(item.grammar_key)
-                        if item.grammar_key is not None
+                        self._grammar_for(grammar_names)
+                        if grammar_names is not None
                         else None
                     )
-                    self._current_group = item.grammar_key
-                if item.grammar_key != self._current_group:
-                    rest.append(item)
+                    self._current_group = item.group_key
+                except Exception as exc:  # prefix too long, grammar build
+                    item.future.set_exception(BackendError(str(exc)))
                     continue
-                req_id = self.engine.add_request(item.prompt_ids, self.max_new_tokens)
-            except Exception as exc:  # grammar build/install, slot/page pressure
-                item.future.set_exception(BackendError(str(exc)))
+            if item.group_key != self._current_group:
+                rest.append(item)
                 continue
-            inflight[req_id] = item
+            batch.append(item)
+        if batch:
+            try:
+                req_ids = self.engine.add_requests(
+                    [i.suffix_ids for i in batch], self.max_new_tokens
+                )
+            except Exception as exc:  # bucket overflow, slot/page pressure
+                for item in batch:
+                    item.future.set_exception(BackendError(str(exc)))
+            else:
+                for req_id, item in zip(req_ids, batch):
+                    inflight[req_id] = item
+                self._fresh_admission = True
         return rest
 
     def _drain_queue(self, pending: list[_WorkItem], block: bool) -> None:
@@ -243,7 +287,9 @@ class LocalLLMBackend:
         pending = self._admit(pending, inflight)
         if inflight:
             try:
-                for fin in self.engine.step():
+                chunks = self.chain_chunks if self._fresh_admission else 1
+                self._fresh_admission = False
+                for fin in self.engine.step(chunks=chunks):
                     item = inflight.pop(fin.req_id, None)
                     if item is not None:
                         item.future.set_result(fin.text)
@@ -275,11 +321,13 @@ def build_local_backend(
     max_slots: int = 8,
     num_pages: int = 512,
     page_size: int = 64,
+    max_pages_per_seq: int | None = None,
     prefill_buckets: tuple[int, ...] = (128, 256, 512, 1024, 2048, 4096, 8192),
     chunk_steps: int = 16,
     max_new_tokens: int = 200,
     constrained: bool = True,
     rng_seed: int = 0,
+    chain_chunks: int | None = None,
 ) -> LocalLLMBackend:
     """Construct the full local stack: params (random-init until a checkpoint
     is loaded — models/loader.py), mesh sharding, engine, backend."""
@@ -290,12 +338,22 @@ def build_local_backend(
         validate_specs_divisibility(cfg, mesh)
         params = shard_params(params, mesh, param_specs(cfg), cfg)
     tokenizer = ByteTokenizer()
+    if max_pages_per_seq is None:
+        # Own pages hold only the per-pod suffix + generated tokens (the
+        # shared cluster-state prefix lives in the dense prefix buffer), so
+        # the page-table width — which sets the decode gather size — stays
+        # tight: the largest suffix we expect (1024 tokens covers a pod spec
+        # with heavy selectors/tolerations; LocalLLMBackend fails bigger ones
+        # individually via max_suffix_tokens) + decode budget.
+        max_pages_per_seq = -(-(1024 + max_new_tokens + chunk_steps) // page_size)
     engine = InferenceEngine(
         params, cfg, tokenizer,
         num_pages=num_pages, page_size=page_size, max_slots=max_slots,
+        max_pages_per_seq=max_pages_per_seq,
         prefill_buckets=prefill_buckets, chunk_steps=chunk_steps,
         temperature=temperature,
     )
     return LocalLLMBackend(
-        engine, tokenizer, max_new_tokens=max_new_tokens, constrained=constrained
+        engine, tokenizer, max_new_tokens=max_new_tokens, constrained=constrained,
+        chain_chunks=chain_chunks,
     )

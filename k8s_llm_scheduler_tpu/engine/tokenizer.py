@@ -30,6 +30,14 @@ class Tokenizer(Protocol):
     def decode(self, ids: Sequence[int]) -> str: ...
     def chat_prompt(self, system: str, user: str) -> list[int]: ...
 
+    def chat_prompt_parts(
+        self, system: str, user_prefix: str, user_suffix: str
+    ) -> tuple[list[int], list[int]]:
+        """(prefix_ids, suffix_ids) such that prefix+suffix is a valid chat
+        prompt with user content user_prefix+user_suffix. The prefix part is
+        the burst-shared token block for on-device prefix caching."""
+        ...
+
 
 class ByteTokenizer:
     """Bytes 0-255 map to ids 1-256; specials above; vocab padded to 512."""
@@ -63,6 +71,20 @@ class ByteTokenizer:
             + [self.END_ROLE, self.ASSISTANT]
         )
 
+    def chat_prompt_parts(
+        self, system: str, user_prefix: str, user_suffix: str
+    ) -> tuple[list[int], list[int]]:
+        """Exact split: byte-level tokenization means the token split equals
+        the string split, so prefix+suffix == chat_prompt(system, pfx+sfx)."""
+        prefix = (
+            [self.BOS, self.SYSTEM]
+            + self.encode(system)
+            + [self.END_ROLE, self.USER]
+            + self.encode(user_prefix)
+        )
+        suffix = self.encode(user_suffix) + [self.END_ROLE, self.ASSISTANT]
+        return prefix, suffix
+
 
 class HFTokenizerAdapter:
     """Local-files-only wrapper over a HuggingFace fast tokenizer.
@@ -92,3 +114,28 @@ class HFTokenizerAdapter:
             {"role": "user", "content": user},
         ]
         return self._tok.apply_chat_template(messages, add_generation_prompt=True)
+
+    def chat_prompt_parts(
+        self, system: str, user_prefix: str, user_suffix: str
+    ) -> tuple[list[int], list[int]]:
+        """Split at the string boundary of the rendered template, encoding
+        each half separately. The suffix's first token may tokenize slightly
+        differently than in the unsplit prompt (standard prefix-caching
+        tradeoff at block boundaries); the prefix block is identical across
+        a burst, which is what the on-device prefix cache keys on."""
+        messages = [
+            {"role": "system", "content": system},
+            {"role": "user", "content": user_prefix + user_suffix},
+        ]
+        rendered = self._tok.apply_chat_template(
+            messages, add_generation_prompt=True, tokenize=False
+        )
+        split_at = rendered.rfind(user_suffix) if user_suffix else -1
+        if split_at <= 0:
+            # Template transformed the content (trim/escape) or the suffix is
+            # empty — degrade to no prefix sharing rather than mis-splitting
+            # or leaking a raw ValueError into the backend's error taxonomy.
+            return [], self.chat_prompt(system, user_prefix + user_suffix)
+        prefix = self._tok.encode(rendered[:split_at], add_special_tokens=False)
+        suffix = self._tok.encode(rendered[split_at:], add_special_tokens=False)
+        return prefix, suffix

@@ -29,6 +29,8 @@ import jax.numpy as jnp
 from k8s_llm_scheduler_tpu.models.configs import LlamaConfig
 from k8s_llm_scheduler_tpu.ops.attention import (
     causal_prefill_attention,
+    chunk_attention_with_prefix,
+    decode_attention_with_prefix,
     paged_decode_attention,
 )
 
@@ -150,11 +152,15 @@ def forward_prefill(
     tokens: jax.Array,  # [B, S] int32, left-aligned, padded
     seq_lens: jax.Array,  # [B]
     attn_impl: Any = None,  # (q,k,v,seq_lens)->out; default causal full attn
-) -> tuple[jax.Array, jax.Array, jax.Array]:
+    return_logits: bool = True,  # static; False skips the LM head (KV-only)
+) -> tuple[jax.Array | None, jax.Array, jax.Array]:
     """Full-prompt forward pass.
 
     Returns (logits [B,S,V] f32, k_all [L,B,S,n_kv,hd], v_all [...]) — the
     engine scatters k_all/v_all into KV cache pages (engine/kv_cache.py).
+    With return_logits=False, logits is None — the prefix-prefill path only
+    needs KV, and a full-bucket [S, vocab] logits tensor is pure waste
+    (~8 GB at 128k vocab x 16k bucket).
 
     `attn_impl` swaps the attention kernel: the training path passes a
     ring-attention wrapper (parallel/ring_attention.py) when the mesh has a
@@ -183,7 +189,143 @@ def forward_prefill(
         return x, (k, v)
 
     x, (k_all, v_all) = jax.lax.scan(body, x, params["layers"])
-    return _logits(params, cfg, x), k_all, v_all
+    logits = _logits(params, cfg, x) if return_logits else None
+    return logits, k_all, v_all
+
+
+# ------------------------------------------------- suffix prefill (cascade)
+def forward_prefill_suffix(
+    params: Params,
+    cfg: LlamaConfig,
+    tokens: jax.Array,  # [B, Ss] int32 — per-request suffix, left-aligned
+    suffix_lens: jax.Array,  # [B] valid suffix tokens (0 = row unused)
+    prefix_k_all: jax.Array,  # [L, Sp, n_kv, hd] — shared dense prefix KV
+    prefix_v_all: jax.Array,
+    prefix_len: jax.Array,  # scalar int32 — valid prefix tokens (0 = none)
+    k_cache: jax.Array,  # [L, num_pages, page_size, n_kv, hd] (donate)
+    v_cache: jax.Array,
+    page_ids: jax.Array,  # [B, Ss/page_size] dest page per suffix block (0=scratch)
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Batched suffix prefill against a shared dense prefix.
+
+    The whole burst's per-pod prompt tails prefill in ONE program: each row
+    attends to the burst-shared cluster-state prefix (read once from HBM via
+    cascade attention, ops/attention.py) plus causally within its own
+    suffix; the suffix K/V is scattered straight into the paged KV cache.
+    Returns (last_logits [B,V] f32 — logits at each row's final valid token,
+    k_cache, v_cache). This replaces per-request full-prompt prefill for the
+    scheduling-burst workload (the reference pays a full remote prefill per
+    pod, reference scheduler.py:425-433).
+    """
+    B, S = tokens.shape
+    hd = cfg.head_dim
+    page_size = k_cache.shape[2]
+    n_blocks = S // page_size
+    inv_freq = rope_inv_freq(cfg)
+    positions = prefix_len + jnp.broadcast_to(jnp.arange(S), (B, S))
+
+    x = params["embed"][tokens]  # [B, S, D]
+    layer_ids = jnp.arange(cfg.n_layers)
+
+    def body(carry, xs):
+        x, kc, vc = carry
+        lp, pk, pv, idx = xs
+        h = rms_norm(x, lp["attn_norm"], cfg.rms_eps)
+        q = jnp.einsum("bsd,dh->bsh", h, lp["wq"]).reshape(B, S, cfg.n_heads, hd)
+        k = jnp.einsum("bsd,dh->bsh", h, lp["wk"]).reshape(B, S, cfg.n_kv_heads, hd)
+        v = jnp.einsum("bsd,dh->bsh", h, lp["wv"]).reshape(B, S, cfg.n_kv_heads, hd)
+        q = apply_rope(q, positions, inv_freq)
+        k = apply_rope(k, positions, inv_freq)
+        attn = chunk_attention_with_prefix(
+            q, k, v, suffix_lens, pk, pv, prefix_len
+        )
+        attn = jnp.einsum("bsh,hd->bsd", attn.reshape(B, S, cfg.n_heads * hd), lp["wo"])
+        # Scatter this layer's suffix K/V blocks into their pages (padding
+        # blocks were routed to the reserved scratch page 0 by the caller).
+        blocks_k = k.reshape(B, n_blocks, page_size, cfg.n_kv_heads, hd)
+        blocks_v = v.reshape(B, n_blocks, page_size, cfg.n_kv_heads, hd)
+        kc = kc.at[idx, page_ids].set(blocks_k.astype(kc.dtype))
+        vc = vc.at[idx, page_ids].set(blocks_v.astype(vc.dtype))
+        x = x + attn
+        x = x + _mlp(lp, cfg, x)
+        return (x, kc, vc), None
+
+    (x, k_cache, v_cache), _ = jax.lax.scan(
+        body, (x, k_cache, v_cache),
+        (params["layers"], prefix_k_all, prefix_v_all, layer_ids),
+    )
+    last_idx = jnp.maximum(suffix_lens - 1, 0)
+    x_last = jnp.take_along_axis(x, last_idx[:, None, None], axis=1)[:, 0]  # [B, D]
+    return _logits(params, cfg, x_last), k_cache, v_cache
+
+
+def forward_decode_prefixed(
+    params: Params,
+    cfg: LlamaConfig,
+    tokens: jax.Array,  # [B] int32 — one new token per slot
+    positions: jax.Array,  # [B] ABSOLUTE position (prefix + own offset)
+    k_cache: jax.Array,  # [L, num_pages, page_size, n_kv, hd] — own pages only
+    v_cache: jax.Array,
+    page_tables: jax.Array,  # [B, max_pages]
+    active: jax.Array,  # [B] bool
+    prefix_k_all: jax.Array,  # [L, Sp, n_kv, hd] shared dense prefix
+    prefix_v_all: jax.Array,
+    prefix_len: jax.Array,  # scalar int32
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """One decode step with shared-prefix (cascade) attention.
+
+    The slot's paged KV covers only its suffix + generated tokens; the
+    burst-shared prompt prefix lives in a dense buffer attended via one
+    batched matmul (ops/attention.paged_decode_attention_with_prefix), so
+    decode HBM traffic no longer scales with batch x prefix length. The new
+    token's K/V scatters directly into the 5-D cache (no per-layer
+    slice/copy-back). prefix_len == 0 reproduces forward_decode exactly.
+    """
+    B = tokens.shape[0]
+    hd = cfg.head_dim
+    page_size = k_cache.shape[2]
+    inv_freq = rope_inv_freq(cfg)
+
+    own_pos = positions - prefix_len  # position within own pages
+    page_slot = own_pos // page_size
+    page_ids = jnp.take_along_axis(page_tables, page_slot[:, None], axis=1)[:, 0]
+    offsets = own_pos % page_size
+    page_ids = jnp.where(active, page_ids, 0)  # scratch for idle slots
+    offsets = jnp.where(active, offsets, 0)
+    own_lens = own_pos + 1
+
+    x = params["embed"][tokens]  # [B, D]
+    layer_ids = jnp.arange(cfg.n_layers)
+
+    def body(carry, xs):
+        x, kc, vc = carry
+        lp, pk, pv, idx = xs
+        h = rms_norm(x, lp["attn_norm"], cfg.rms_eps)
+        q = jnp.einsum("bd,dh->bh", h, lp["wq"]).reshape(B, cfg.n_heads, hd)
+        k = jnp.einsum("bd,dh->bh", h, lp["wk"]).reshape(B, cfg.n_kv_heads, hd)
+        v = jnp.einsum("bd,dh->bh", h, lp["wv"]).reshape(B, cfg.n_kv_heads, hd)
+        q = apply_rope(q, positions, inv_freq)
+        k = apply_rope(k, positions, inv_freq)
+
+        kc = kc.at[idx, page_ids, offsets].set(k.astype(kc.dtype))
+        vc = vc.at[idx, page_ids, offsets].set(v.astype(vc.dtype))
+        # Gather own pages straight from the 5-D cache (no layer-size copy).
+        P = page_tables.shape[1]
+        k_own = kc[idx, page_tables].reshape(B, P * page_size, cfg.n_kv_heads, hd)
+        v_own = vc[idx, page_tables].reshape(B, P * page_size, cfg.n_kv_heads, hd)
+        attn = decode_attention_with_prefix(
+            q, k_own, v_own, own_lens, pk, pv, prefix_len
+        )
+        attn = jnp.einsum("bh,hd->bd", attn.reshape(B, cfg.n_heads * hd), lp["wo"])
+        x = x + attn
+        x = x + _mlp(lp, cfg, x)
+        return (x, kc, vc), None
+
+    (x, k_cache, v_cache), _ = jax.lax.scan(
+        body, (x, k_cache, v_cache),
+        (params["layers"], prefix_k_all, prefix_v_all, layer_ids),
+    )
+    return _logits(params, cfg, x), k_cache, v_cache
 
 
 # ------------------------------------------------------------------- decode

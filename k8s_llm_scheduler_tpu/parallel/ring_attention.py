@@ -121,6 +121,13 @@ def make_ring_prefill_attention(
     )(wrapped)
 
     def attn(q, k, v, seq_lens=None):
+        if seq_lens is not None:
+            # Loud guard instead of silent corruption: ring chunks carry no
+            # per-chunk padding mask, so padded rows would attend pad K/V.
+            # A padded batch NaN-poisons the output (surfaces in the loss)
+            # rather than silently training on contaminated activations.
+            ok = jnp.all(seq_lens == q.shape[1])
+            q = jnp.where(ok, q, jnp.nan)
         return wrapped(q, k, v)
 
     return attn
