@@ -56,6 +56,8 @@ def _build_stack(cfg: Config, cluster) -> Any:
             prefill_buckets=tuple(cfg.get("llm.prefill_buckets")),
             max_new_tokens=cfg.get("llm.max_tokens"),
             constrained=cfg.get("llm.constrained_json"),
+            checkpoint_path=cfg.get("llm.checkpoint_path"),
+            tokenizer_path=cfg.get("llm.tokenizer_path"),
         )
 
     cache = (

@@ -242,6 +242,11 @@ class InferenceEngine:
         self.cfg = cfg
         self.params = params
         self.tokenizer = tokenizer or ByteTokenizer()
+        if self.tokenizer.vocab_size != cfg.vocab_size:
+            raise ValueError(
+                f"tokenizer vocab {self.tokenizer.vocab_size} != model vocab "
+                f"{cfg.vocab_size} — grammar masks and logits would misalign"
+            )
         self.kv = PagedKVCache(
             cfg,
             num_pages=num_pages,

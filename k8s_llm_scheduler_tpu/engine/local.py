@@ -35,6 +35,7 @@ import time
 from collections.abc import Sequence
 from concurrent.futures import Future
 from concurrent.futures import TimeoutError as FuturesTimeout
+from pathlib import Path
 from typing import Any
 
 import jax
@@ -332,16 +333,41 @@ def build_local_backend(
     constrained: bool = True,
     rng_seed: int = 0,
     chain_chunks: int | None = None,
+    checkpoint_path: str | None = None,
+    tokenizer_path: str | None = None,
 ) -> LocalLLMBackend:
-    """Construct the full local stack: params (random-init until a checkpoint
-    is loaded — models/loader.py), mesh sharding, engine, backend."""
+    """Construct the full local stack: params (from an HF safetensors or
+    orbax checkpoint when checkpoint_path is set, random-init otherwise —
+    models/loader.py), mesh sharding, engine, backend."""
     cfg = cfg or get_config(model)
     mesh = mesh_from_config(mesh_axes)
-    params = init_params(jax.random.PRNGKey(rng_seed), cfg)
-    if mesh.devices.size > 1:
+    multi = mesh.devices.size > 1
+    if multi:
         validate_specs_divisibility(cfg, mesh)
-        params = shard_params(params, mesh, param_specs(cfg), cfg)
-    tokenizer = ByteTokenizer()
+    if checkpoint_path:
+        from k8s_llm_scheduler_tpu.models.loader import (
+            load_hf_checkpoint,
+            restore_checkpoint,
+        )
+
+        ckpt = Path(checkpoint_path)
+        if list(ckpt.glob("*.safetensors")):
+            params = load_hf_checkpoint(ckpt, cfg, mesh if multi else None)
+        else:
+            params = restore_checkpoint(ckpt, cfg, mesh if multi else None)
+    else:
+        params = init_params(jax.random.PRNGKey(rng_seed), cfg)
+        if multi:
+            params = shard_params(params, mesh, param_specs(cfg), cfg)
+    if tokenizer_path is None and checkpoint_path:
+        if (Path(checkpoint_path) / "tokenizer.json").exists():
+            tokenizer_path = checkpoint_path
+    if tokenizer_path:
+        from k8s_llm_scheduler_tpu.engine.tokenizer import HFTokenizerAdapter
+
+        tokenizer = HFTokenizerAdapter(tokenizer_path)
+    else:
+        tokenizer = ByteTokenizer()
     if max_pages_per_seq is None:
         # Own pages hold only the per-pod suffix + generated tokens (the
         # shared cluster-state prefix lives in the dense prefix buffer), so

@@ -99,8 +99,32 @@ class HFTokenizerAdapter:
 
         self._tok = AutoTokenizer.from_pretrained(path, local_files_only=True)
         self.vocab_size = len(self._tok)
-        self.pad_id = self._tok.pad_token_id or 0
         self.eos_id = self._tok.eos_token_id
+        self.pad_id = self._pick_pad_sentinel()
+
+    def _pick_pad_sentinel(self) -> int:
+        """An id the engine can use as the idle-slot emission sentinel.
+
+        It must be a real embedding row the sampler can never legitimately
+        produce: token 0 is real text in Llama-3 ('!'), so defaulting to 0
+        would silently strip '!' from generated output (engine/engine.py
+        filters pad from emissions). Prefer the tokenizer's own pad token,
+        then a reserved special token; raise rather than guess."""
+        if self._tok.pad_token_id is not None:
+            return self._tok.pad_token_id
+        for name in ("<|finetune_right_pad_id|>",):
+            tid = self._tok.convert_tokens_to_ids(name)
+            if tid is not None and tid != getattr(self._tok, "unk_token_id", None):
+                return tid
+        for tok_str, tid in sorted(
+            self._tok.get_added_vocab().items(), key=lambda kv: -kv[1]
+        ):
+            if "reserved" in tok_str and tid not in (self.eos_id,):
+                return tid
+        raise ValueError(
+            "tokenizer has no pad token and no reserved special token to use "
+            "as the idle-slot sentinel; set tokenizer.pad_token explicitly"
+        )
 
     def encode(self, text: str) -> list[int]:
         return self._tok.encode(text, add_special_tokens=False)

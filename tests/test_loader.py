@@ -1,0 +1,185 @@
+"""Checkpoint loader: HF safetensors import, sharded placement, orbax."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from k8s_llm_scheduler_tpu.models.configs import LlamaConfig
+from k8s_llm_scheduler_tpu.models.llama import forward_prefill, init_params
+from k8s_llm_scheduler_tpu.models.loader import (
+    checkpoint_files,
+    load_hf_checkpoint,
+    restore_checkpoint,
+    save_checkpoint,
+)
+from k8s_llm_scheduler_tpu.parallel.mesh import make_mesh
+
+CFG = LlamaConfig(
+    name="loader-test", vocab_size=256, d_model=64, n_layers=3, n_heads=4,
+    n_kv_heads=2, d_ff=128, max_seq_len=512, rope_theta=10000.0,
+    dtype=jnp.float32, tie_embeddings=False,
+)
+
+TIED_CFG = LlamaConfig(
+    name="loader-tied", vocab_size=512, d_model=64, n_layers=2, n_heads=4,
+    n_kv_heads=2, d_ff=128, max_seq_len=512, rope_theta=10000.0,
+    dtype=jnp.float32, tie_embeddings=True,
+)
+
+
+def hf_state_dict(cfg: LlamaConfig, seed: int = 0) -> dict[str, np.ndarray]:
+    """A synthetic HF-layout Llama state dict (f32)."""
+    rng = np.random.default_rng(seed)
+    hd = cfg.head_dim
+    D, F = cfg.d_model, cfg.d_ff
+    sd = {
+        "model.embed_tokens.weight": rng.normal(size=(cfg.vocab_size, D)),
+        "model.norm.weight": rng.normal(size=(D,)),
+    }
+    if not cfg.tie_embeddings:
+        sd["lm_head.weight"] = rng.normal(size=(cfg.vocab_size, D))
+    for i in range(cfg.n_layers):
+        p = f"model.layers.{i}."
+        sd[p + "input_layernorm.weight"] = rng.normal(size=(D,))
+        sd[p + "self_attn.q_proj.weight"] = rng.normal(size=(cfg.n_heads * hd, D))
+        sd[p + "self_attn.k_proj.weight"] = rng.normal(size=(cfg.n_kv_heads * hd, D))
+        sd[p + "self_attn.v_proj.weight"] = rng.normal(size=(cfg.n_kv_heads * hd, D))
+        sd[p + "self_attn.o_proj.weight"] = rng.normal(size=(D, cfg.n_heads * hd))
+        sd[p + "post_attention_layernorm.weight"] = rng.normal(size=(D,))
+        sd[p + "mlp.gate_proj.weight"] = rng.normal(size=(F, D))
+        sd[p + "mlp.up_proj.weight"] = rng.normal(size=(F, D))
+        sd[p + "mlp.down_proj.weight"] = rng.normal(size=(D, F))
+    return {k: (v * 0.02).astype(np.float32) for k, v in sd.items()}
+
+
+def write_ckpt(tmp_path, sd, shards: int = 1):
+    from safetensors.numpy import save_file
+
+    names = sorted(sd)
+    if shards == 1:
+        save_file(sd, str(tmp_path / "model.safetensors"))
+    else:
+        per = -(-len(names) // shards)
+        weight_map = {}
+        for s in range(shards):
+            part = {n: sd[n] for n in names[s * per : (s + 1) * per]}
+            fname = f"model-{s:05d}-of-{shards:05d}.safetensors"
+            save_file(part, str(tmp_path / fname))
+            weight_map.update({n: fname for n in part})
+        with open(tmp_path / "model.safetensors.index.json", "w") as f:
+            json.dump({"weight_map": weight_map}, f)
+    return tmp_path
+
+
+class TestHFImport:
+    def test_roundtrip_forward_matches_manual_params(self, tmp_path):
+        sd = hf_state_dict(CFG)
+        write_ckpt(tmp_path, sd)
+        params = load_hf_checkpoint(tmp_path, CFG)
+
+        # manual construction of the same params
+        want_wq0 = sd["model.layers.0.self_attn.q_proj.weight"].T
+        np.testing.assert_allclose(
+            np.asarray(params["layers"]["wq"][0]), want_wq0, rtol=1e-6
+        )
+        assert params["embed"].shape == (CFG.vocab_size, CFG.d_model)
+        assert params["lm_head"].shape == (CFG.d_model, CFG.vocab_size)
+
+        tokens = jnp.asarray([[1, 2, 3, 4, 5, 6, 7, 8]], jnp.int32)
+        logits, _, _ = forward_prefill(params, CFG, tokens, jnp.asarray([8]))
+        assert logits.shape == (1, 8, CFG.vocab_size)
+        assert bool(jnp.isfinite(logits).all())
+
+    def test_sharded_load_places_on_mesh(self, tmp_path):
+        sd = hf_state_dict(CFG)
+        write_ckpt(tmp_path, sd, shards=3)
+        mesh = make_mesh({"tp": 2})
+        params = load_hf_checkpoint(tmp_path, CFG, mesh)
+        wq = params["layers"]["wq"]
+        assert wq.sharding.mesh.shape["tp"] == 2
+        # values identical to unsharded load
+        ref = load_hf_checkpoint(tmp_path, CFG)
+        np.testing.assert_allclose(np.asarray(wq), np.asarray(ref["layers"]["wq"]))
+
+    def test_tied_embeddings_ignores_lm_head(self, tmp_path):
+        sd = hf_state_dict(TIED_CFG)
+        sd["lm_head.weight"] = sd["model.embed_tokens.weight"]
+        write_ckpt(tmp_path, sd)
+        params = load_hf_checkpoint(tmp_path, TIED_CFG)
+        assert "lm_head" not in params
+
+    def test_missing_tensor_raises(self, tmp_path):
+        sd = hf_state_dict(CFG)
+        del sd["model.layers.1.mlp.up_proj.weight"]
+        write_ckpt(tmp_path, sd)
+        with pytest.raises(ValueError, match="incomplete"):
+            load_hf_checkpoint(tmp_path, CFG)
+
+    def test_wrong_shape_raises(self, tmp_path):
+        sd = hf_state_dict(CFG)
+        sd["model.layers.0.self_attn.q_proj.weight"] = np.zeros(
+            (7, CFG.d_model), np.float32
+        )
+        write_ckpt(tmp_path, sd)
+        with pytest.raises(ValueError, match="shape"):
+            load_hf_checkpoint(tmp_path, CFG)
+
+    def test_checkpoint_files_ordering(self, tmp_path):
+        sd = hf_state_dict(CFG)
+        write_ckpt(tmp_path, sd, shards=2)
+        files = checkpoint_files(tmp_path)
+        assert len(files) == 2
+        assert all(f.exists() for f in files)
+
+
+class TestBackendFromCheckpoint:
+    def test_build_local_backend_loads_checkpoint(self, tmp_path, three_nodes):
+        from k8s_llm_scheduler_tpu.engine.local import build_local_backend
+        from tests.conftest import make_pod
+
+        sd = hf_state_dict(TIED_CFG)
+        write_ckpt(tmp_path, sd)
+        backend = build_local_backend(
+            cfg=TIED_CFG,
+            checkpoint_path=str(tmp_path),
+            max_slots=2,
+            num_pages=64,
+            page_size=32,
+            prefill_buckets=(64, 128, 256, 512, 1024),
+            max_new_tokens=80,
+            temperature=0.0,
+        )
+        try:
+            # weights came from the checkpoint, not random init
+            want = sd["model.layers.0.self_attn.q_proj.weight"].T
+            got = np.asarray(backend.engine.params["layers"]["wq"][0])
+            np.testing.assert_allclose(got, want, rtol=1e-6)
+            decision = backend.get_scheduling_decision(make_pod(), three_nodes)
+            assert decision.selected_node in {n.name for n in three_nodes}
+        finally:
+            backend.close()
+
+
+class TestOrbax:
+    def test_save_restore_roundtrip(self, tmp_path):
+        params = init_params(jax.random.PRNGKey(0), CFG)
+        save_checkpoint(tmp_path / "ckpt", params)
+        restored = restore_checkpoint(tmp_path / "ckpt", CFG)
+        for a, b in zip(
+            jax.tree_util.tree_leaves(params), jax.tree_util.tree_leaves(restored)
+        ):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+
+    def test_restore_onto_mesh(self, tmp_path):
+        params = init_params(jax.random.PRNGKey(0), CFG)
+        save_checkpoint(tmp_path / "ckpt", params)
+        mesh = make_mesh({"tp": 2})
+        restored = restore_checkpoint(tmp_path / "ckpt", CFG, mesh)
+        assert restored["layers"]["wq"].sharding.mesh.shape["tp"] == 2
+        np.testing.assert_allclose(
+            np.asarray(restored["layers"]["wq"]),
+            np.asarray(params["layers"]["wq"]),
+        )
