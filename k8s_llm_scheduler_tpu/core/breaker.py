@@ -73,15 +73,9 @@ class CircuitBreaker:
             self._state = CircuitState.HALF_OPEN
         return self._state
 
-    def call(self, func: Callable[..., T], *args: Any, **kwargs: Any) -> T:
-        """Run `func` through the breaker (reference scheduler.py:309-332).
-
-        In HALF_OPEN at most `half_open_max_calls` probes run concurrently
-        (the reference declares this knob at config.yaml:43 but never reads
-        it); excess callers get CircuitOpenError rather than hammering a
-        backend that is still being probed.
-        """
-        half_open_probe = False
+    def _admit(self) -> bool:
+        """Shared admission gate; returns True when this call is the
+        HALF_OPEN probe (caller must release via _release_probe)."""
         with self._lock:
             state = self._effective_state()
             if state is CircuitState.OPEN:
@@ -92,7 +86,22 @@ class CircuitBreaker:
                 if self._half_open_inflight >= self.half_open_max_calls:
                     raise CircuitOpenError("circuit half-open, probe already in flight")
                 self._half_open_inflight += 1
-                half_open_probe = True
+                return True
+        return False
+
+    def _release_probe(self) -> None:
+        with self._lock:
+            self._half_open_inflight -= 1
+
+    def call(self, func: Callable[..., T], *args: Any, **kwargs: Any) -> T:
+        """Run `func` through the breaker (reference scheduler.py:309-332).
+
+        In HALF_OPEN at most `half_open_max_calls` probes run concurrently
+        (the reference declares this knob at config.yaml:43 but never reads
+        it); excess callers get CircuitOpenError rather than hammering a
+        backend that is still being probed.
+        """
+        half_open_probe = self._admit()
         try:
             result = func(*args, **kwargs)
         except self.non_failure_exceptions:
@@ -105,8 +114,28 @@ class CircuitBreaker:
             return result
         finally:
             if half_open_probe:
-                with self._lock:
-                    self._half_open_inflight -= 1
+                self._release_probe()
+
+    async def async_call(self, func: Callable[..., Any], *args: Any, **kwargs: Any):
+        """Async twin of call(): awaits a coroutine function through the same
+        state machine. Used by the natively-async decision backend path
+        (engine/local.py get_scheduling_decision_async), where holding a
+        worker thread per in-flight call would exhaust the pool on a
+        1000-pod burst."""
+        half_open_probe = self._admit()
+        try:
+            result = await func(*args, **kwargs)
+        except self.non_failure_exceptions:
+            raise
+        except Exception:
+            self.record_failure()
+            raise
+        else:
+            self.record_success()
+            return result
+        finally:
+            if half_open_probe:
+                self._release_probe()
 
     def record_success(self) -> None:
         with self._lock:

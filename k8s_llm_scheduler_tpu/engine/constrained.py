@@ -186,3 +186,71 @@ def first_token_of(dfa: DecisionDFA) -> int:
     (candidates,) = np.nonzero(dfa.allowed[dfa.start_state])
     assert len(candidates) == 1
     return int(candidates[0])
+
+
+def forced_token_table(dfa: DecisionDFA) -> np.ndarray:
+    """Per-state: the single allowed token id when the state is FORCED
+    (exactly one out-edge), else -1.
+
+    This is what makes grammar-accelerated block decoding work
+    (engine/engine.py _wave_impl): a forced token needs no logits — the
+    device expands whole forced runs (JSON skeleton spans) with table
+    gathers between model calls, so the model runs once per CHOICE point
+    instead of once per token. The done state reports -1 (its pad self-loop
+    exists only to keep finished slots well-defined, never to be taken).
+    """
+    counts = dfa.allowed.sum(axis=1)
+    forced = np.where(counts == 1, dfa.allowed.argmax(axis=1), -1).astype(np.int32)
+    forced[dfa.done_state] = -1
+    return forced
+
+
+def wave_iterations(dfa: DecisionDFA, block_size: int) -> int:
+    """Worst-case number of block-decode iterations to complete ANY path
+    through the grammar.
+
+    One iteration consumes 1 sampled token plus up to `block_size - 1`
+    forced continuations. Computed by DP over the DFA (acyclic by
+    construction, apart from the done state's pad self-loop): iters(s) =
+    1 + max over allowed t of iters(state reached from next(s, t) after
+    following at most block_size - 1 forced edges). The engine sizes the
+    wave's scan length with this, so completion inside one device program
+    stays guaranteed (the old per-token wave needed max_new_tokens
+    iterations; the decision grammar typically needs ~10-16).
+    """
+    if block_size < 1:
+        raise ValueError("block_size must be >= 1")
+    forced = forced_token_table(dfa)
+    done = dfa.done_state
+    memo: dict[int, int] = {done: 0}
+
+    def advance(state: int) -> int:
+        """Follow up to block_size-1 forced edges from `state`."""
+        for _ in range(block_size - 1):
+            if state == done:
+                break
+            ft = forced[state]
+            if ft < 0:
+                break
+            state = int(dfa.next_state[state, ft])
+        return state
+
+    # Iterative DFS (the reasoning chain can be hundreds of states deep).
+    stack = [dfa.start_state]
+    while stack:
+        s = stack[-1]
+        if s in memo:
+            stack.pop()
+            continue
+        succs = []
+        ready = True
+        for tok in np.nonzero(dfa.allowed[s])[0]:
+            nxt = advance(int(dfa.next_state[s, tok]))
+            succs.append(nxt)
+            if nxt not in memo:
+                stack.append(nxt)
+                ready = False
+        if ready:
+            memo[s] = 1 + max((memo[n] for n in succs), default=0)
+            stack.pop()
+    return memo[dfa.start_state]

@@ -49,15 +49,21 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from k8s_llm_scheduler_tpu.engine.constrained import DecisionDFA
+from k8s_llm_scheduler_tpu.engine.constrained import (
+    DecisionDFA,
+    forced_token_table,
+    wave_iterations,
+)
 from k8s_llm_scheduler_tpu.engine.kv_cache import PagedKVCache
 from k8s_llm_scheduler_tpu.engine.tokenizer import ByteTokenizer, Tokenizer
 from k8s_llm_scheduler_tpu.models.configs import LlamaConfig
 from k8s_llm_scheduler_tpu.models.llama import (
     Params,
+    forward_block_decode,
     forward_decode_buffered,
     forward_prefill,
     forward_prefill_suffix,
+    forward_prefill_suffix_dense,
 )
 from k8s_llm_scheduler_tpu.ops.attention import NEG_INF
 
@@ -188,6 +194,112 @@ def _decode_chunk_impl(
     return k_cache, v_cache, tok, pos, act, st, budget, toks.T  # [M, n]
 
 
+def _wave_impl(
+    params: Params,
+    cfg: LlamaConfig,  # static
+    tokens,        # [R, Ss] suffix tokens, left-aligned, padded
+    suffix_lens,   # [R] int32 (0 on padding rows)
+    prefix_k, prefix_v,  # [L, Sp, n_kv, hd] shared dense prefix KV
+    prefix_len,    # scalar int32
+    max_new,       # [R] total emission budget per row (0 on padding rows)
+    allowed, next_state, forced, done_state, eos_id, pad_id,
+    dfa_start,     # scalar int32
+    rng, temperature,
+    n_iters: int,  # static — worst-case block iterations (wave_iterations)
+    F: int,        # static — block width (sampled token + forced run)
+    cap: int,      # static — generated-KV capacity, >= max(max_new)
+):
+    """One whole decision wave in ONE device program, with
+    GRAMMAR-ACCELERATED BLOCK DECODING.
+
+    Pipeline: batched suffix prefill against the shared dense prefix, then
+    `n_iters` block iterations. Each iteration (a) samples ONE token from
+    logits carried from the previous model call, (b) expands the forced run
+    that follows it via DFA table gathers — no model call: every state with
+    exactly one out-edge (JSON skeleton spans, engine/constrained.py
+    forced_token_table) is consumed for free — and (c) runs one F-wide
+    mini-prefill (models/llama.forward_block_decode) over the whole block
+    to compute its K/V and the next choice point's logits. A ~70-token
+    constrained decision completes in ~10-16 model calls instead of 70.
+
+    Completion is guaranteed on device: `n_iters` comes from a DP over the
+    DFA (wave_iterations) and the per-row budget gates every emission, so
+    every request finishes inside the wave even for an unconstrained
+    grammar (forced = all -1 degrades to one token per iteration with
+    n_iters = max_new). No paged-cache traffic, one dispatch, one fetch.
+
+    Returns (emitted [R, n_iters*F] with pad_id holes, active [R]).
+    """
+    last_logits, k_sfx, v_sfx = forward_prefill_suffix_dense(
+        params, cfg, tokens, suffix_lens, prefix_k, prefix_v, prefix_len
+    )
+    R = tokens.shape[0]
+    n_kv, hd = cfg.n_kv_heads, cfg.head_dim
+    st = jnp.full((R,), dfa_start, dtype=jnp.int32)
+    act = suffix_lens > 0
+    # emitted doubles as the generated-KV write tail: waves start with an
+    # empty buffer and every emitted token lands at its emission index.
+    emitted = jnp.zeros(R, dtype=jnp.int32)
+    pos_next = prefix_len + suffix_lens  # absolute position of next token
+
+    gk = jnp.zeros((cfg.n_layers, R, cap + 1, n_kv, hd), prefix_k.dtype)
+    gv = jnp.zeros_like(gk)
+    jcol = jnp.arange(F)
+
+    def iteration(carry, _):
+        gk, gv, st, act, emitted, pos_next, logits, key = carry
+        key, sub = jax.random.split(key)
+        # (a) sample the block's first token from the carried logits
+        t0 = _sample(logits, allowed[st], sub, temperature)
+        emit0 = act & (emitted < max_new)
+        s_cur = jnp.where(emit0, next_state[st, t0], st)
+        fin0 = (t0 == eos_id) | (s_cur == done_state)
+        blk = [jnp.where(emit0, t0, pad_id)]
+        valid = [emit0]
+        alive = emit0 & ~fin0 & (emitted + 1 < max_new)
+        # (b) forced-run expansion: pure table gathers, no model calls
+        for j in range(1, F):
+            ft = forced[s_cur]
+            emit_j = alive & (ft >= 0)
+            t_j = jnp.where(emit_j, ft, pad_id)
+            s_nxt = next_state[s_cur, jnp.maximum(ft, 0)]
+            s_cur = jnp.where(emit_j, s_nxt, s_cur)
+            fin_j = (t_j == eos_id) | (s_cur == done_state)
+            blk.append(t_j)
+            valid.append(emit_j)
+            # paused-at-choice rows (ft < 0) stay alive for the next
+            # iteration's sample; emitted rows continue unless finished or
+            # out of budget
+            alive = jnp.where(
+                emit_j,
+                ~fin_j & (emitted + j + 1 < max_new),
+                alive & (ft < 0),
+            )
+        blk_tok = jnp.stack(blk, axis=1)      # [R, F]
+        blk_valid = jnp.stack(valid, axis=1)  # [R, F]
+        blk_len = blk_valid.sum(axis=1).astype(jnp.int32)
+        positions = pos_next[:, None] + jcol[None, :]
+        # (c) one model call for the whole block
+        new_logits, gk, gv = forward_block_decode(
+            params, cfg, blk_tok, blk_valid, blk_len, positions,
+            k_sfx, v_sfx, suffix_lens, gk, gv, emitted,
+            prefix_k, prefix_v, prefix_len,
+        )
+        carry = (
+            gk, gv, s_cur, alive, emitted + blk_len,
+            pos_next + blk_len, new_logits, key,
+        )
+        return carry, blk_tok
+
+    carry0 = (gk, gv, st, act, emitted, pos_next, last_logits, rng)
+    (gk, gv, st, act, emitted, pos_next, _, _), blocks = jax.lax.scan(
+        iteration, carry0, None, length=n_iters
+    )
+    # blocks: [n_iters, R, F] -> [R, n_iters*F] in temporal order
+    out = jnp.moveaxis(blocks, 1, 0).reshape(R, n_iters * F)
+    return out, act
+
+
 @dataclasses.dataclass
 class _PrefixKV:
     """Dense KV of a burst-shared prompt prefix, prefilled once."""
@@ -216,6 +328,29 @@ class Finished:
     token_ids: list[int]
     text: str
     latency_ms: float
+
+
+@dataclasses.dataclass
+class WaveHandle:
+    """An in-flight decision wave: dispatched, not yet harvested.
+
+    Waves pipeline — submit_wave returns immediately after enqueueing the
+    device program, so several waves can be in flight back-to-back and the
+    per-dispatch round-trip latency overlaps instead of serializing
+    (the dominant cost on a tunneled TPU backend; see _wave_impl)."""
+
+    toks_d: jax.Array   # [R, n_iters*F] emitted tokens (pad_id holes)
+    n: int              # real prompts in this wave (<= R)
+    max_new_tokens: int
+    req_ids: list[int]
+    submitted_at: float = dataclasses.field(default_factory=time.perf_counter)
+
+    def is_ready(self) -> bool:
+        """True once the device result landed (harvest won't block)."""
+        try:
+            return self.toks_d.is_ready()
+        except AttributeError:  # pragma: no cover - older jax fallback
+            return True
 
 
 class InferenceEngine:
@@ -279,6 +414,11 @@ class InferenceEngine:
             static_argnums=(1, 20),
             donate_argnums=(2, 3, 8, 9, 10, 11, 12),
         )
+        self._wave = jax.jit(_wave_impl, static_argnums=(1, 17, 18, 19))
+        # Block width for grammar-accelerated wave decoding: each iteration
+        # consumes 1 sampled + up to wave_block-1 forced tokens.
+        self.wave_block = 8
+        self._grammar_wave_iters: int | None = None
 
         # Grammar tables (fixed shapes; content swaps without recompiling).
         V = self.tokenizer.vocab_size
@@ -341,8 +481,10 @@ class InferenceEngine:
             allowed[:, self.tokenizer.pad_id] = False
             self._allowed = jnp.asarray(allowed)
             self._next_state = jnp.zeros((cap, V), dtype=jnp.int32)
+            self._forced = jnp.full((cap,), -1, dtype=jnp.int32)
             self._done_state = jnp.int32(-1)
             self._dfa_start = 0
+            self._grammar_wave_iters = None
             return
         if dfa.n_states > cap:
             raise ValueError(
@@ -351,12 +493,16 @@ class InferenceEngine:
             )
         allowed = np.zeros((cap, V), dtype=bool)
         nxt = np.zeros((cap, V), dtype=np.int32)
+        forced = np.full((cap,), -1, dtype=np.int32)
         allowed[: dfa.n_states] = dfa.allowed
         nxt[: dfa.n_states] = dfa.next_state
+        forced[: dfa.n_states] = forced_token_table(dfa)
         self._allowed = jnp.asarray(allowed)
         self._next_state = jnp.asarray(nxt)
+        self._forced = jnp.asarray(forced)
         self._done_state = jnp.int32(dfa.done_state)
         self._dfa_start = dfa.start_state
+        self._grammar_wave_iters = wave_iterations(dfa, self.wave_block)
 
     # -------------------------------------------------------------- prefix
     def _get_empty_prefix(self) -> _PrefixKV:
@@ -556,6 +702,125 @@ class InferenceEngine:
         self.stats["prefills"] += 1
         self.stats["prefill_tokens"] += int(suffix_lens.sum())
         return [r.req_id for r in reqs]
+
+    # ---------------------------------------------------------------- wave
+    def submit_wave(
+        self, prompts: list[list[int]], max_new_tokens: int = 200
+    ) -> WaveHandle:
+        """Dispatch a whole batch's decode-to-completion as ONE device
+        program and return WITHOUT syncing.
+
+        The burst fast path (_wave_impl): suffix prefill + first token +
+        full constrained decode fused into a single program that never
+        touches the paged KV cache. Independent of slot state — it can run
+        regardless of in-flight chunked requests (they share nothing but
+        the prefix buffer and grammar tables, which the wave only reads).
+        Every request finishes inside the wave: the device-side budget
+        guarantees it even for an unconstrained grammar.
+
+        Waves pipeline: submit several back-to-back, then harvest_wave in
+        submission order — round-trip latency overlaps across waves.
+        """
+        if not prompts:
+            raise ValueError("empty wave")
+        if any(not p for p in prompts):
+            raise ValueError("empty prompt")
+        if len(prompts) > self.max_slots:
+            raise RuntimeError(
+                f"wave of {len(prompts)} exceeds max_slots={self.max_slots}"
+            )
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        prefix = self._prefix or self._get_empty_prefix()
+        self._prefix = prefix
+
+        bucket = self._bucket_for(max(len(p) for p in prompts))
+        # Row bucket mirrors add_requests: 1 for singles, full width else —
+        # two compiled programs per (token bucket, wave geometry).
+        R = 1 if len(prompts) == 1 else self.max_slots
+        pad = self.tokenizer.pad_id
+        # Wave geometry: with a grammar, block decoding needs only
+        # wave_iterations(dfa) model calls (forced runs are free); without
+        # one, every token is a choice (F=1, one per iteration). n_iters is
+        # bucketed to multiples of 4 to bound compile variants.
+        if self._grammar_wave_iters is not None:
+            F = self.wave_block
+            n_iters = min(self._grammar_wave_iters, max_new_tokens)
+        else:
+            F = 1
+            n_iters = max_new_tokens
+        n_iters = max(4, -(-n_iters // 4) * 4)
+
+        tokens = np.full((R, bucket), pad, dtype=np.int32)
+        suffix_lens = np.zeros(R, dtype=np.int32)
+        max_new = np.zeros(R, dtype=np.int32)
+        for row, ids in enumerate(prompts):
+            tokens[row, : len(ids)] = ids
+            suffix_lens[row] = len(ids)
+            max_new[row] = max_new_tokens
+
+        self._rng, sub = jax.random.split(self._rng)
+        toks_d, _ = self._wave(
+            self.params, self.cfg,
+            jnp.asarray(tokens), jnp.asarray(suffix_lens),
+            prefix.k, prefix.v, jnp.int32(prefix.length),
+            jnp.asarray(max_new),
+            self._allowed, self._next_state, self._forced, self._done_state,
+            jnp.int32(self.tokenizer.eos_id), jnp.int32(pad),
+            jnp.int32(self._dfa_start),
+            sub, jnp.float32(self.temperature),
+            n_iters, F, max_new_tokens,
+        )
+        # Start the D2H transfer right behind the program so harvest finds
+        # the results already on host (a blocking device_get is its own
+        # round trip on a tunneled backend).
+        try:
+            toks_d.copy_to_host_async()
+        except AttributeError:  # pragma: no cover - backend without D2H async
+            pass
+        req_ids = list(range(self._req_counter, self._req_counter + len(prompts)))
+        self._req_counter += len(prompts)
+        self.stats["waves"] = self.stats.get("waves", 0) + 1
+        self.stats["wave_model_calls"] = (
+            self.stats.get("wave_model_calls", 0) + n_iters
+        )
+        self.stats["prefills"] += 1
+        self.stats["prefill_tokens"] += int(suffix_lens.sum())
+        self.stats["requests"] += len(prompts)
+        return WaveHandle(
+            toks_d=toks_d,
+            n=len(prompts),
+            max_new_tokens=max_new_tokens,
+            req_ids=req_ids,
+        )
+
+    def harvest_wave(self, handle: WaveHandle) -> list[Finished]:
+        """Sync one wave's results (blocks until the device program ran)."""
+        toks_np = jax.device_get(handle.toks_d)
+        self.stats["syncs"] += 1
+        pad = self.tokenizer.pad_id
+        latency_ms = (time.perf_counter() - handle.submitted_at) * 1000.0
+        out: list[Finished] = []
+        for row in range(handle.n):
+            ids = [int(t) for t in toks_np[row] if t != pad]
+            ids = ids[: handle.max_new_tokens]
+            self.stats["completed"] += 1
+            self.stats["decode_tokens"] += len(ids)
+            out.append(
+                Finished(
+                    req_id=handle.req_ids[row],
+                    token_ids=ids,
+                    text=self.tokenizer.decode(ids),
+                    latency_ms=latency_ms,
+                )
+            )
+        return out
+
+    def decide_wave(
+        self, prompts: list[list[int]], max_new_tokens: int = 200
+    ) -> list[Finished]:
+        """Synchronous wave: submit + harvest (tests, simple callers)."""
+        return self.harvest_wave(self.submit_wave(prompts, max_new_tokens))
 
     # ---------------------------------------------------------------- step
     def step(self, chunks: int = 1) -> list[Finished]:

@@ -11,11 +11,14 @@ network.
 Concurrency model: DecisionClient calls get_scheduling_decision from worker
 threads (one per in-flight pod, via asyncio.to_thread). Those calls enqueue
 a request and block on a Future. A single engine-owner thread drains the
-queue and drives the InferenceEngine: admit a whole batch in one dispatch ->
-chained fused decode chunks (one host sync) -> admit more -> ... — so
-concurrent pod decisions share decode batches (continuous batching at chunk
-granularity), and a burst of N pods costs one shared-prefix prefill plus
-~N/max_slots admission/decode waves instead of N serial streams.
+queue and drives the InferenceEngine with PIPELINED DECISION WAVES
+(engine.submit_wave / harvest_wave): each wave is one fused device program
+(suffix prefill + full constrained decode, no paged-cache traffic), and the
+worker keeps submitting waves while earlier ones are still executing — the
+per-dispatch round-trip latency (the dominant cost on a tunneled TPU
+backend) overlaps across waves instead of serializing. While waiting on the
+oldest wave's results it polls the queue, so stragglers of a burst join the
+next pipelined wave rather than stalling behind a blocking sync.
 
 Group keying: the engine holds ONE (prompt prefix, grammar) pair at a time,
 both keyed by the cluster snapshot — the prefix is the burst-shared
@@ -28,10 +31,12 @@ equivalence, scheduler.py:265-271) everything lands in one group.
 
 from __future__ import annotations
 
+import asyncio
 import logging
 import queue
 import threading
 import time
+from collections import deque
 from collections.abc import Sequence
 from concurrent.futures import Future
 from concurrent.futures import TimeoutError as FuturesTimeout
@@ -75,6 +80,18 @@ class _WorkItem:
         self.future: Future = Future()
         self.enqueued_at = time.perf_counter()
 
+    def resolve(self, text: str) -> None:
+        """Set the result unless the caller already cancelled/timed out —
+        the async client path (get_scheduling_decision_async) cancels the
+        underlying future via asyncio.wrap_future, and a bare set_result
+        would raise InvalidStateError and take down the whole worker tick."""
+        if not self.future.done():
+            self.future.set_result(text)
+
+    def fail(self, exc: Exception) -> None:
+        if not self.future.done():
+            self.future.set_exception(exc)
+
 
 class LocalLLMBackend:
     """DecisionBackend over an in-process InferenceEngine."""
@@ -87,7 +104,6 @@ class LocalLLMBackend:
         constrained: bool = True,
         request_timeout_s: float = 60.0,
         admit_wait_s: float = 0.002,
-        chain_chunks: int | None = None,
     ) -> None:
         self.engine = engine
         self.tokenizer = tokenizer or engine.tokenizer
@@ -101,19 +117,9 @@ class LocalLLMBackend:
             )
         self.request_timeout_s = request_timeout_s
         self.admit_wait_s = admit_wait_s
-        # Chunks to chain right after an admission: one host sync covers the
-        # TYPICAL decision (~64 tokens of constrained JSON), not the worst
-        # case — sizing it to max_new_tokens would burn worst-case decode
-        # compute on every wave and starve mid-flight admissions; the
-        # chunks=1 straggler path below mops up longer generations.
-        if chain_chunks is None:
-            typical = min(64, max_new_tokens)
-            chain_chunks = max(1, -(-typical // engine.chunk_steps))
-        self.chain_chunks = chain_chunks
         self._queue: queue.Queue[_WorkItem | None] = queue.Queue()
         self._dfa_cache: dict[tuple[str, ...], Any] = {}
         self._current_group: tuple | None = None
-        self._fresh_admission = False
         self._worker = threading.Thread(
             target=self._run_worker, daemon=True, name="llm-engine"
         )
@@ -121,9 +127,9 @@ class LocalLLMBackend:
         self._worker.start()
 
     # ------------------------------------------------------------- backend
-    def get_scheduling_decision(
+    def _prepare_item(
         self, pod: PodSpec, nodes: Sequence[NodeMetrics]
-    ) -> SchedulingDecision:
+    ) -> _WorkItem:
         candidates = feasible_nodes(pod, nodes)
         if not candidates:
             raise NoFeasibleNodeError(
@@ -140,7 +146,12 @@ class LocalLLMBackend:
             tuple(prefix_ids),
             ready_names if self.constrained else None,
         )
-        item = _WorkItem(prefix_ids, suffix_ids, group_key)
+        return _WorkItem(prefix_ids, suffix_ids, group_key)
+
+    def get_scheduling_decision(
+        self, pod: PodSpec, nodes: Sequence[NodeMetrics]
+    ) -> SchedulingDecision:
+        item = self._prepare_item(pod, nodes)
         self._queue.put(item)
         try:
             text = item.future.result(timeout=self.request_timeout_s)
@@ -148,6 +159,27 @@ class LocalLLMBackend:
             # (concurrent.futures.TimeoutError only aliases the builtin from
             # Python 3.11 — catch the futures one for 3.10.)
             raise BackendError(f"decision timed out after {self.request_timeout_s}s") from exc
+        return self._parse(text, pod)
+
+    async def get_scheduling_decision_async(
+        self, pod: PodSpec, nodes: Sequence[NodeMetrics]
+    ) -> SchedulingDecision:
+        """Natively-async decision: awaits the engine future WITHOUT holding
+        a worker thread. With the sync path, every in-flight pod pins one
+        asyncio.to_thread pool thread for the whole wave round trip — a
+        burst with more distinct pod shapes than pool threads
+        (min(32, cpus+4) by default) deadlocks the burst into serial waves.
+        DecisionClient prefers this method when present."""
+        item = self._prepare_item(pod, nodes)
+        self._queue.put(item)
+        try:
+            text = await asyncio.wait_for(
+                asyncio.wrap_future(item.future), timeout=self.request_timeout_s
+            )
+        except (TimeoutError, asyncio.TimeoutError) as exc:
+            raise BackendError(
+                f"decision timed out after {self.request_timeout_s}s"
+            ) from exc
         return self._parse(text, pod)
 
     def _parse(self, text: str, pod: PodSpec) -> SchedulingDecision:
@@ -185,27 +217,55 @@ class LocalLLMBackend:
             )
         return self._dfa_cache[key]
 
-    def _admit(self, pending: list[_WorkItem], inflight: dict[int, _WorkItem]) -> list[_WorkItem]:
-        """Admit queued items whose group matches, as ONE batched dispatch."""
+    def _submit_waves(
+        self,
+        pending: list[_WorkItem],
+        waves: "deque[tuple[Any, list[_WorkItem]]]",
+    ) -> list[_WorkItem]:
+        """Dispatch every admissible pending item as pipelined waves.
+
+        Items group by (prefix, grammar); a group switch needs the engine's
+        prefix/grammar tables repointed, which is only safe with no wave in
+        flight (in-flight wave programs hold their buffers by reference, but
+        the SWITCH itself prefills a new prefix — ordering it behind the
+        outstanding waves keeps the device timeline simple). Returns items
+        that must wait (other group while waves are in flight).
+        """
         rest: list[_WorkItem] = []
         batch: list[_WorkItem] = []
+
+        def flush() -> None:
+            if not batch:
+                return
+            try:
+                handle = self.engine.submit_wave(
+                    [i.suffix_ids for i in batch], self.max_new_tokens
+                )
+            except Exception as exc:  # bucket overflow, bad grammar state
+                for item in batch:
+                    item.fail(BackendError(str(exc)))
+            else:
+                waves.append((handle, list(batch)))
+            batch.clear()
+
         for item in pending:
-            if len(batch) >= self.engine.free_slots:
-                rest.append(item)
-                continue
-            if len(item.suffix_ids) > self.engine.max_suffix_tokens(self.max_new_tokens):
-                # Oversized suffix can never admit — fail it alone instead of
-                # poisoning the whole batch's add_requests call.
-                item.future.set_exception(
+            if len(item.suffix_ids) > self.engine.prefill_buckets[-1]:
+                # Oversized suffix can never admit (waves are bounded only by
+                # the largest prefill bucket — they never touch the paged
+                # cache) — fail it alone instead of poisoning its whole wave.
+                item.fail(
                     BackendError(
                         f"pod prompt suffix of {len(item.suffix_ids)} tokens "
-                        f"exceeds engine capacity "
-                        f"{self.engine.max_suffix_tokens(self.max_new_tokens)}"
+                        f"exceeds the largest prefill bucket "
+                        f"{self.engine.prefill_buckets[-1]}"
                     )
                 )
                 continue
-            if not inflight and not batch and item.group_key != self._current_group:
-                # Engine drained: switch (prefix, grammar) groups. Invalidate
+            if item.group_key != self._current_group:
+                if waves or batch:
+                    rest.append(item)
+                    continue
+                # Idle engine: switch (prefix, grammar) groups. Invalidate
                 # first — a partial switch (prefix installed, grammar failed)
                 # must not leave old-group items matching a half-switched
                 # engine.
@@ -220,24 +280,12 @@ class LocalLLMBackend:
                     )
                     self._current_group = item.group_key
                 except Exception as exc:  # prefix too long, grammar build
-                    item.future.set_exception(BackendError(str(exc)))
+                    item.fail(BackendError(str(exc)))
                     continue
-            if item.group_key != self._current_group:
-                rest.append(item)
-                continue
             batch.append(item)
-        if batch:
-            try:
-                req_ids = self.engine.add_requests(
-                    [i.suffix_ids for i in batch], self.max_new_tokens
-                )
-            except Exception as exc:  # bucket overflow, slot/page pressure
-                for item in batch:
-                    item.future.set_exception(BackendError(str(exc)))
-            else:
-                for req_id, item in zip(req_ids, batch):
-                    inflight[req_id] = item
-                self._fresh_admission = True
+            if len(batch) >= self.engine.max_slots:
+                flush()
+        flush()
         return rest
 
     def _drain_queue(self, pending: list[_WorkItem], block: bool) -> None:
@@ -258,54 +306,65 @@ class LocalLLMBackend:
 
     def _run_worker(self) -> None:
         pending: list[_WorkItem] = []
-        inflight: dict[int, _WorkItem] = {}
+        waves: deque[tuple[Any, list[_WorkItem]]] = deque()
         while not self._stopped.is_set():
-            self._drain_queue(pending, block=not pending and not inflight)
-            if self._stopped.is_set() or (not pending and not inflight):
+            self._drain_queue(pending, block=not pending and not waves)
+            if self._stopped.is_set() or (not pending and not waves):
                 continue
             # Nothing below may kill the engine-owner thread — a dead worker
             # bricks every future request.
             try:
-                pending = self._worker_tick(pending, inflight)
+                pending = self._worker_tick(pending, waves)
             except Exception as exc:  # pragma: no cover - last-resort guard
                 logger.exception("engine worker tick failed")
-                for item in pending + list(inflight.values()):
-                    if not item.future.done():
-                        item.future.set_exception(BackendError(str(exc)))
+                for _, items in waves:
+                    for item in items:
+                        item.fail(BackendError(str(exc)))
+                waves.clear()
+                for item in pending:
+                    item.fail(BackendError(str(exc)))
                 pending = []
-                inflight.clear()
-                self.engine.abort_all()
         # Shutdown: fail anything still queued or in flight.
         self._drain_queue(pending, block=False)
-        for item in pending + list(inflight.values()):
-            if not item.future.done():
-                item.future.set_exception(BackendError("backend closed"))
+        for _, items in waves:
+            pending.extend(items)
+        for item in pending:
+            item.fail(BackendError("backend closed"))
 
     def _worker_tick(
-        self, pending: list[_WorkItem], inflight: dict[int, _WorkItem]
+        self,
+        pending: list[_WorkItem],
+        waves: "deque[tuple[Any, list[_WorkItem]]]",
     ) -> list[_WorkItem]:
-        """One admit+decode cycle; returns the still-unadmitted items."""
-        if pending and self.admit_wait_s and not inflight:
-            # tiny window to let a burst coalesce into one batch
+        """One submit+harvest cycle; returns items still waiting on a group
+        switch."""
+        if pending and self.admit_wait_s and not waves:
+            # tiny window to let a burst coalesce into one wide wave
             time.sleep(self.admit_wait_s)
             self._drain_queue(pending, block=False)
-        pending = self._admit(pending, inflight)
-        if inflight:
+        pending = self._submit_waves(pending, waves)
+        if waves:
+            handle, items = waves[0]
+            # While the oldest wave executes, keep feeding the pipeline:
+            # stragglers arriving now become the NEXT wave, overlapping with
+            # this one on device instead of waiting behind a blocking sync.
+            while not handle.is_ready() and not self._stopped.is_set():
+                before = len(pending)
+                self._drain_queue(pending, block=False)
+                if len(pending) > before:
+                    pending = self._submit_waves(pending, waves)
+                else:
+                    time.sleep(0.0005)
+            waves.popleft()
             try:
-                chunks = self.chain_chunks if self._fresh_admission else 1
-                self._fresh_admission = False
-                for fin in self.engine.step(chunks=chunks):
-                    item = inflight.pop(fin.req_id, None)
-                    if item is not None:
-                        item.future.set_result(fin.text)
+                fins = self.engine.harvest_wave(handle)
             except Exception as exc:
-                logger.exception("engine chunk failed")
-                for item in inflight.values():
-                    item.future.set_exception(BackendError(str(exc)))
-                inflight.clear()
-                # Free wedged slots/pages or the engine's capacity leaks and
-                # every later request queues until timeout.
-                self.engine.abort_all()
+                logger.exception("wave harvest failed")
+                for item in items:
+                    item.fail(BackendError(str(exc)))
+            else:
+                for fin, item in zip(fins, items):
+                    item.resolve(fin.text)
         return pending
 
     def close(self) -> None:
@@ -332,7 +391,6 @@ def build_local_backend(
     max_new_tokens: int = 200,
     constrained: bool = True,
     rng_seed: int = 0,
-    chain_chunks: int | None = None,
     checkpoint_path: str | None = None,
     tokenizer_path: str | None = None,
 ) -> LocalLLMBackend:
@@ -385,5 +443,4 @@ def build_local_backend(
     )
     return LocalLLMBackend(
         engine, tokenizer, max_new_tokens=max_new_tokens, constrained=constrained,
-        chain_chunks=chain_chunks,
     )

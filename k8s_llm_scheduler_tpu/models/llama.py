@@ -260,6 +260,154 @@ def forward_prefill_suffix(
     return _logits(params, cfg, x_last), k_cache, v_cache
 
 
+def forward_prefill_suffix_dense(
+    params: Params,
+    cfg: LlamaConfig,
+    tokens: jax.Array,  # [B, Ss] int32 — per-request suffix, left-aligned
+    suffix_lens: jax.Array,  # [B] valid suffix tokens (0 = row unused)
+    prefix_k_all: jax.Array,  # [L, Sp, n_kv, hd] — shared dense prefix KV
+    prefix_v_all: jax.Array,
+    prefix_len: jax.Array,  # scalar int32 — valid prefix tokens (0 = none)
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Batched suffix prefill against a shared dense prefix, KV kept DENSE.
+
+    Identical attention semantics to forward_prefill_suffix, but instead of
+    scattering suffix K/V into paged-cache pages it returns the stacked
+    dense buffers (k_sfx, v_sfx) [L, B, Ss, n_kv, hd]. This is the first
+    stage of the fused decision wave (engine/engine.py _wave_impl): the wave
+    decodes to completion against (prefix | dense suffix | chunk buffer)
+    without ever touching the paged KV cache — no page allocation, no
+    gather/flush traffic, no multi-hundred-MB donation per dispatch.
+    Returns (last_logits [B, V] f32, k_sfx, v_sfx).
+    """
+    B, S = tokens.shape
+    hd = cfg.head_dim
+    inv_freq = rope_inv_freq(cfg)
+    positions = prefix_len + jnp.broadcast_to(jnp.arange(S), (B, S))
+
+    x = params["embed"][tokens]  # [B, S, D]
+
+    def body(x, xs):
+        lp, pk, pv = xs
+        h = rms_norm(x, lp["attn_norm"], cfg.rms_eps)
+        q = jnp.einsum("bsd,dh->bsh", h, lp["wq"]).reshape(B, S, cfg.n_heads, hd)
+        k = jnp.einsum("bsd,dh->bsh", h, lp["wk"]).reshape(B, S, cfg.n_kv_heads, hd)
+        v = jnp.einsum("bsd,dh->bsh", h, lp["wv"]).reshape(B, S, cfg.n_kv_heads, hd)
+        q = apply_rope(q, positions, inv_freq)
+        k = apply_rope(k, positions, inv_freq)
+        attn = chunk_attention_with_prefix(q, k, v, suffix_lens, pk, pv, prefix_len)
+        attn = jnp.einsum("bsh,hd->bsd", attn.reshape(B, S, cfg.n_heads * hd), lp["wo"])
+        x = x + attn
+        x = x + _mlp(lp, cfg, x)
+        return x, (k, v)
+
+    x, (k_sfx, v_sfx) = jax.lax.scan(
+        body, x, (params["layers"], prefix_k_all, prefix_v_all)
+    )
+    last_idx = jnp.maximum(suffix_lens - 1, 0)
+    x_last = jnp.take_along_axis(x, last_idx[:, None, None], axis=1)[:, 0]  # [B, D]
+    return _logits(params, cfg, x_last), k_sfx, v_sfx
+
+
+def forward_block_decode(
+    params: Params,
+    cfg: LlamaConfig,
+    blk_tok: jax.Array,  # [R, F] int32 — this iteration's token block
+    blk_valid: jax.Array,  # [R, F] bool — left-aligned valid tokens
+    blk_len: jax.Array,  # [R] int32 — number of valid tokens (= blk_valid sum)
+    positions: jax.Array,  # [R, F] absolute positions
+    k_sfx: jax.Array,  # [L, R, Ss, n_kv, hd] dense suffix KV
+    v_sfx: jax.Array,
+    suffix_lens: jax.Array,  # [R]
+    gen_k: jax.Array,  # [L, R, cap+1, n_kv, hd] generated-token KV (donated)
+    gen_v: jax.Array,
+    tail: jax.Array,  # [R] tokens already in gen_k/gen_v
+    prefix_k_all: jax.Array,  # [L, Sp, n_kv, hd] shared dense prefix
+    prefix_v_all: jax.Array,
+    prefix_len: jax.Array,  # scalar int32
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """One grammar-accelerated decode iteration: an F-wide mini-prefill.
+
+    Where per-token decode runs the model once per emitted token, block
+    decode runs it once per ITERATION, consuming a whole (sampled + forced)
+    token run: every valid block token attends to the shared dense prefix,
+    its row's dense suffix, the generated-so-far buffer, and causally within
+    the block, all in one pass — so a forced JSON-skeleton span costs one
+    model call instead of one per character. Invalid block slots write their
+    K/V to the buffer's trash slot (index cap).
+
+    Returns (logits [R, V] f32 at each row's LAST VALID block position,
+    gen_k, gen_v).
+    """
+    R, F = blk_tok.shape
+    hd = cfg.head_dim
+    cap1 = gen_k.shape[2]  # cap + 1 (trash slot at index cap)
+    inv_freq = rope_inv_freq(cfg)
+
+    x = params["embed"][blk_tok]  # [R, F, D]
+    Sp = prefix_k_all.shape[1]
+    Ss = k_sfx.shape[2]
+
+    pre_mask = (jnp.arange(Sp) < prefix_len)[None, None, None, None, :]
+    sfx_mask = (jnp.arange(Ss)[None, :] < suffix_lens[:, None])[
+        :, None, None, None, :
+    ]
+    gen_mask = (jnp.arange(cap1)[None, :] < tail[:, None])[:, None, None, None, :]
+    j = jnp.arange(F)
+    blk_mask = (
+        (j[:, None] >= j[None, :])[None, :, :] & blk_valid[:, None, :]
+    )[:, None, None, :, :]  # [R, 1, 1, F_q, F_kv]
+
+    # K/V scatter destinations: valid token j -> tail + j, invalid -> trash.
+    dest = jnp.where(blk_valid, tail[:, None] + j[None, :], cap1 - 1)  # [R, F]
+    row = jnp.arange(R)[:, None]
+
+    def body(carry, xs):
+        x, gk, gv = carry
+        lp, pk, pv, ks, vs, idx = xs
+        h = rms_norm(x, lp["attn_norm"], cfg.rms_eps)
+        q = jnp.einsum("bfd,dh->bfh", h, lp["wq"]).reshape(R, F, cfg.n_heads, hd)
+        k = jnp.einsum("bfd,dh->bfh", h, lp["wk"]).reshape(R, F, cfg.n_kv_heads, hd)
+        v = jnp.einsum("bfd,dh->bfh", h, lp["wv"]).reshape(R, F, cfg.n_kv_heads, hd)
+        q = apply_rope(q, positions, inv_freq)
+        k = apply_rope(k, positions, inv_freq)
+
+        qg = (q.astype(jnp.float32) * hd**-0.5).reshape(
+            R, F, cfg.n_kv_heads, cfg.q_per_kv, hd
+        )
+        # Read this layer's generated-token KV from the carry: gen_mask only
+        # exposes entries < tail (previous iterations), so the read never
+        # sees this iteration's (not yet written) block.
+        parts = [
+            attend_part(qg, pk, pv, pre_mask, "bqkgh,skh->bkgqs"),
+            attend_part(qg, ks, vs, sfx_mask, "bqkgh,bskh->bkgqs"),
+            attend_part(qg, gk[idx], gv[idx], gen_mask, "bqkgh,bskh->bkgqs"),
+            attend_part(qg, k, v, blk_mask, "bqkgh,bskh->bkgqs"),
+        ]
+        attn = merge_attention_parts(parts)  # [R, n_kv, g, F, hd]
+        attn = jnp.moveaxis(attn, 3, 1).reshape(R, F, cfg.n_heads * hd)
+        attn = jnp.einsum("bfh,hd->bfd", attn.astype(x.dtype), lp["wo"])
+        x = x + attn
+        x = x + _mlp(lp, cfg, x)
+        # write the block's K/V AFTER attention (in-block attention came
+        # from the dense k/v just computed)
+        gk = gk.at[idx, row, dest].set(k.astype(gk.dtype))
+        gv = gv.at[idx, row, dest].set(v.astype(gv.dtype))
+        return (x, gk, gv), None
+
+    (x, gen_k, gen_v), _ = jax.lax.scan(
+        body,
+        (x, gen_k, gen_v),
+        (
+            params["layers"], prefix_k_all, prefix_v_all,
+            k_sfx, v_sfx, jnp.arange(cfg.n_layers),
+        ),
+    )
+    last_idx = jnp.maximum(blk_len - 1, 0)
+    x_last = jnp.take_along_axis(x, last_idx[:, None, None], axis=1)[:, 0]  # [R, D]
+    return _logits(params, cfg, x_last), gen_k, gen_v
+
+
 def forward_decode_buffered(
     params: Params,
     cfg: LlamaConfig,

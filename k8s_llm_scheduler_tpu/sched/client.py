@@ -97,6 +97,20 @@ class DecisionClient:
             return self.breaker.call(self.backend.get_scheduling_decision, pod, nodes)
         return self.backend.get_scheduling_decision(pod, nodes)
 
+    async def _call_backend_async(
+        self, pod: PodSpec, nodes: Sequence[NodeMetrics]
+    ) -> SchedulingDecision:
+        """Prefer the backend's natively-async path (no worker thread held
+        per in-flight decision — a burst of N distinct pod shapes would pin
+        N pool threads for a full wave round trip otherwise); fall back to
+        asyncio.to_thread for sync-only backends (fakes, stubs)."""
+        afn = getattr(self.backend, "get_scheduling_decision_async", None)
+        if afn is not None:
+            if self.breaker is not None:
+                return await self.breaker.async_call(afn, pod, nodes)
+            return await afn(pod, nodes)
+        return await asyncio.to_thread(self._call_backend, pod, nodes)
+
     def _fallback(
         self, nodes: Sequence[NodeMetrics], reason: str, pod: PodSpec | None = None
     ) -> SchedulingDecision | None:
@@ -172,7 +186,7 @@ class DecisionClient:
         for attempt in range(self.max_retries):
             start = time.perf_counter()  # per attempt: excludes backoff sleeps
             try:
-                decision = await asyncio.to_thread(self._call_backend, pod, nodes)
+                decision = await self._call_backend_async(pod, nodes)
             except CircuitOpenError as exc:
                 logger.warning("circuit open, using fallback: %s", exc)
                 return self._fallback(nodes, "circuit_open", pod)

@@ -106,3 +106,36 @@ class TestHalfOpenProbeLimit:
         t.join(timeout=5)
         assert results["a"] == "probe-ok"
         assert cb.state is CircuitState.CLOSED
+
+
+class TestAsyncCall:
+    async def test_async_success_and_failure_counting(self):
+        cb = CircuitBreaker(failure_threshold=2, timeout_seconds=60.0)
+
+        async def ok():
+            return "fine"
+
+        async def boom():
+            raise ValueError("bad")
+
+        assert await cb.async_call(ok) == "fine"
+        with pytest.raises(ValueError):
+            await cb.async_call(boom)
+        with pytest.raises(ValueError):
+            await cb.async_call(boom)
+        assert cb.state is CircuitState.OPEN
+        with pytest.raises(CircuitOpenError):
+            await cb.async_call(ok)
+
+    async def test_async_non_failure_exception_passthrough(self):
+        class PodProblem(Exception):
+            pass
+
+        cb = CircuitBreaker(failure_threshold=1, non_failure_exceptions=(PodProblem,))
+
+        async def unschedulable():
+            raise PodProblem("no feasible node")
+
+        with pytest.raises(PodProblem):
+            await cb.async_call(unschedulable)
+        assert cb.state is CircuitState.CLOSED

@@ -2,7 +2,7 @@
 
 import pytest
 
-from k8s_llm_scheduler_tpu.core.breaker import CircuitBreaker
+from k8s_llm_scheduler_tpu.core.breaker import CircuitBreaker, CircuitState
 from k8s_llm_scheduler_tpu.core.cache import DecisionCache
 from k8s_llm_scheduler_tpu.engine.backend import BackendError, StubBackend
 from k8s_llm_scheduler_tpu.sched.client import DecisionClient
@@ -242,3 +242,52 @@ class TestSingleFlight:
         sources = sorted(d.source.value for d in r)
         # One fell back (leader), the other got a real LLM decision.
         assert "fallback" in sources and "llm" in sources
+
+
+class AsyncStubBackend:
+    """Backend exposing the natively-async path; records which was used."""
+
+    def __init__(self):
+        self.async_calls = 0
+        self.sync_calls = 0
+
+    def get_scheduling_decision(self, pod, nodes):
+        self.sync_calls += 1
+        return SchedulingDecision(
+            selected_node=nodes[0].name, confidence=0.9, reasoning="sync",
+            source=DecisionSource.LLM,
+        )
+
+    async def get_scheduling_decision_async(self, pod, nodes):
+        self.async_calls += 1
+        return SchedulingDecision(
+            selected_node=nodes[0].name, confidence=0.9, reasoning="async",
+            source=DecisionSource.LLM,
+        )
+
+
+class TestAsyncBackendPath:
+    async def test_async_method_preferred(self, three_nodes):
+        backend = AsyncStubBackend()
+        c = client(backend)
+        decision = await c.get_scheduling_decision(make_pod(), three_nodes)
+        assert decision.reasoning == "async"
+        assert backend.async_calls == 1 and backend.sync_calls == 0
+
+    async def test_async_failures_trip_breaker(self, three_nodes):
+        class FailingAsync:
+            async def get_scheduling_decision_async(self, pod, nodes):
+                raise RuntimeError("engine down")
+
+            def get_scheduling_decision(self, pod, nodes):
+                raise RuntimeError("engine down")
+
+        c = DecisionClient(
+            FailingAsync(),
+            breaker=CircuitBreaker(failure_threshold=2, timeout_seconds=60),
+            max_retries=3,
+            retry_delay=0.001,
+        )
+        decision = await c.get_scheduling_decision(make_pod(), three_nodes)
+        assert decision is not None and decision.fallback_needed
+        assert c.breaker.state is CircuitState.OPEN

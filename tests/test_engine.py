@@ -189,6 +189,71 @@ class TestEngine:
         assert stats["pages_free"] > 0
 
 
+class TestDecideWave:
+    """The fused single-dispatch decision wave (engine.decide_wave)."""
+
+    def test_wave_matches_chunked_greedy(self, engine):
+        names = ["node-0", "node-1", "node-2"]
+        engine.set_grammar(build_decision_dfa(TOK, names, max_reason_tokens=20))
+        try:
+            prompts = [
+                TOK.chat_prompt("pick a node", f"pod-{i} wants scheduling")
+                for i in range(3)
+            ]
+            fins = engine.decide_wave(prompts, max_new_tokens=150)
+            assert len(fins) == 3
+            # greedy (temperature=0) chunked path must produce identical ids
+            for prompt, fin in zip(prompts, fins):
+                chunked = engine.generate(prompt, max_new_tokens=150)
+                assert chunked.token_ids == fin.token_ids
+                obj = json.loads(fin.text)
+                assert obj["selected_node"] in names
+        finally:
+            engine.set_grammar(None)
+
+    def test_wave_single_prompt(self, engine):
+        prompt = TOK.chat_prompt("sys", "solo")
+        fins = engine.decide_wave([prompt], max_new_tokens=10)
+        assert len(fins) == 1
+        assert 1 <= len(fins[0].token_ids) <= 10
+
+    def test_wave_respects_budget_unconstrained(self, engine):
+        prompt = TOK.chat_prompt("sys", "budget check")
+        fins = engine.decide_wave([prompt] * 2, max_new_tokens=7)
+        for fin in fins:
+            assert 1 <= len(fin.token_ids) <= 7
+
+    def test_wave_leaves_slots_untouched(self, engine):
+        before = engine.free_slots
+        engine.decide_wave([TOK.chat_prompt("s", "u")], max_new_tokens=5)
+        assert engine.free_slots == before
+        assert engine.kv.pages_free == engine.kv.num_pages - 1  # scratch only
+
+    def test_wave_overflow_rejected(self, engine):
+        prompt = TOK.chat_prompt("s", "u")
+        with pytest.raises(RuntimeError, match="exceeds max_slots"):
+            engine.decide_wave([prompt] * (engine.max_slots + 1), 5)
+
+    def test_wave_runs_alongside_inflight_chunked(self, engine):
+        """The wave shares nothing with slot state — it may fire while a
+        chunked request is mid-decode, without corrupting it."""
+        names = ["node-0", "node-1"]
+        engine.set_grammar(build_decision_dfa(TOK, names, max_reason_tokens=10))
+        try:
+            req = engine.add_request(TOK.chat_prompt("s", "chunked pod"), 150)
+            fins = engine.decide_wave([TOK.chat_prompt("s", "wave pod")], 150)
+            assert json.loads(fins[0].text)["selected_node"] in names
+            done = {}
+            for _ in range(80):
+                for fin in engine.step():
+                    done[fin.req_id] = fin
+                if req in done:
+                    break
+            assert json.loads(done[req].text)["selected_node"] in names
+        finally:
+            engine.set_grammar(None)
+
+
 class TestGrammarBudget:
     def test_zero_reason_tokens_still_valid(self):
         dfa = build_decision_dfa(TOK, ["node-1"], max_reason_tokens=0)
@@ -266,3 +331,68 @@ class TestWorkerResilience:
         backend = LocalLLMBackend(engine, TOK, request_timeout_s=5)
         backend.close()
         assert not backend._worker.is_alive()
+
+
+class TestGrammarAcceleration:
+    """forced_token_table + wave_iterations: the block-decode foundations."""
+
+    NAMES = ["node-0", "node-1", "node-2"]
+
+    def test_forced_table_marks_skeleton(self):
+        from k8s_llm_scheduler_tpu.engine.constrained import forced_token_table
+
+        dfa = build_decision_dfa(TOK, self.NAMES, max_reason_tokens=10)
+        forced = forced_token_table(dfa)
+        # start state is forced (only '{' allowed)
+        assert forced[dfa.start_state] == TOK.encode("{")[0]
+        # done state must never force (its pad self-loop is a sentinel)
+        assert forced[dfa.done_state] == -1
+        # forced states have exactly one allowed token and it matches
+        counts = dfa.allowed.sum(axis=1)
+        for s in range(dfa.n_states):
+            if s == dfa.done_state:
+                continue
+            if counts[s] == 1:
+                assert forced[s] == dfa.allowed[s].argmax()
+            else:
+                assert forced[s] == -1
+
+    def test_wave_iterations_far_below_token_count(self):
+        from k8s_llm_scheduler_tpu.engine.constrained import wave_iterations
+
+        dfa = build_decision_dfa(TOK, self.NAMES, max_reason_tokens=3)
+        iters = wave_iterations(dfa, block_size=8)
+        # any full decision is ~69 tokens; choice points are the name
+        # branches, confidence digits, reasoning tokens and close choices
+        assert 4 <= iters <= 30
+
+    def test_wave_iterations_bounds_a_random_walk(self):
+        """Simulate block consumption along random DFA walks: the DP bound
+        must cover every path."""
+        from k8s_llm_scheduler_tpu.engine.constrained import (
+            forced_token_table,
+            wave_iterations,
+        )
+
+        F = 8
+        dfa = build_decision_dfa(TOK, self.NAMES, max_reason_tokens=6)
+        forced = forced_token_table(dfa)
+        bound = wave_iterations(dfa, F)
+        rng = np.random.default_rng(0)
+        for _ in range(50):
+            state, iters = dfa.start_state, 0
+            while state != dfa.done_state:
+                iters += 1  # one sampled token
+                (opts,) = np.nonzero(dfa.allowed[state])
+                state = int(dfa.next_state[state, rng.choice(opts)])
+                for _ in range(F - 1):  # forced continuation
+                    if state == dfa.done_state or forced[state] < 0:
+                        break
+                    state = int(dfa.next_state[state, forced[state]])
+                assert iters <= bound, "DP bound violated"
+
+    def test_wave_block_one_equals_unconstrained_tokens(self, engine):
+        """F=1 (unconstrained) wave must still respect budget exactly."""
+        prompt = TOK.chat_prompt("sys", "block one")
+        fins = engine.decide_wave([prompt], max_new_tokens=5)
+        assert 1 <= len(fins[0].token_ids) <= 5
