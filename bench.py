@@ -121,16 +121,24 @@ async def bench(args) -> dict:
             await asyncio.wait_for(task, timeout=30)
         return latencies, scheduler.get_stats()
 
-    # Warmup: compiles prefill bucket, first-token fn, and the decode chunk.
+    # Warmup: compiles the prefix-prefill bucket and the wave program.
     await one_round(max(args.shapes, 2), round_id=0, timeout_s=600.0)
 
-    latencies, stats = await one_round(args.pods, round_id=1, timeout_s=600.0)
+    # Median of N measured rounds: the tunneled backend's round-trip cost
+    # fluctuates by an order of magnitude over minutes (shared service), so
+    # a single burst round measures the weather as much as the code.
+    rounds = []
+    for r in range(args.rounds):
+        latencies, stats = await one_round(args.pods, round_id=r + 1, timeout_s=600.0)
+        values = sorted(latencies.values())
+        p50 = statistics.median(values)
+        p99 = values[min(len(values) - 1, int(len(values) * 0.99))]
+        total_s = max(values) / 1000.0
+        rounds.append((p50, p99, args.pods / total_s, stats))
     backend.close()
 
-    values = sorted(latencies.values())
-    p50 = statistics.median(values)
-    p99 = values[min(len(values) - 1, int(len(values) * 0.99))]
-    total_s = max(values) / 1000.0
+    rounds.sort(key=lambda t: t[0])
+    p50, p99, pods_per_sec, stats = rounds[len(rounds) // 2]
     return {
         "metric": "p50_decision_latency_ms",
         "value": round(p50, 2),
@@ -141,7 +149,8 @@ async def bench(args) -> dict:
             "pods": args.pods,
             "nodes": args.nodes,
             "shapes": args.shapes,
-            "pods_per_sec": round(args.pods / total_s, 2),
+            "pods_per_sec": round(pods_per_sec, 2),
+            "round_p50s_ms": [round(r[0], 2) for r in rounds],
             "llm_decisions": stats["llm_decisions"],
             "cache_decisions": stats["cache_decisions"],
             "fallback_decisions": stats["fallback_decisions"],
@@ -161,6 +170,7 @@ def main() -> None:
     parser.add_argument("--chunk-steps", type=int, default=24)
     parser.add_argument("--max-new-tokens", type=int, default=72)
     parser.add_argument("--temperature", type=float, default=0.3)
+    parser.add_argument("--rounds", type=int, default=3)
     args = parser.parse_args()
     result = asyncio.run(bench(args))
     print(json.dumps(result))

@@ -416,8 +416,10 @@ class InferenceEngine:
         )
         self._wave = jax.jit(_wave_impl, static_argnums=(1, 17, 18, 19))
         # Block width for grammar-accelerated wave decoding: each iteration
-        # consumes 1 sampled + up to wave_block-1 forced tokens.
-        self.wave_block = 8
+        # consumes 1 sampled + up to wave_block-1 forced tokens. 16 covers
+        # the longest JSON-skeleton span in one iteration; the extra
+        # per-call width is cheap next to a model call's fixed cost.
+        self.wave_block = 16
         self._grammar_wave_iters: int | None = None
 
         # Grammar tables (fixed shapes; content swaps without recompiling).
