@@ -195,6 +195,46 @@ def forward_prefill(
 
 
 # ------------------------------------------------- suffix prefill (cascade)
+def _suffix_layer(
+    lp: Params,
+    cfg: LlamaConfig,
+    x: jax.Array,  # [B, S, D]
+    positions: jax.Array,  # [B, S]
+    suffix_lens: jax.Array,  # [B]
+    pk: jax.Array,  # [Sp, n_kv, hd] this layer's shared prefix KV
+    pv: jax.Array,
+    prefix_len: jax.Array,
+    inv_freq: jax.Array,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """One transformer layer of cascade suffix prefill: attends to the
+    shared dense prefix + causally within the suffix. Shared by the paged
+    (forward_prefill_suffix) and dense/wave (forward_prefill_suffix_dense)
+    paths, which differ only in where the suffix K/V is sunk.
+    Returns (x_out, k, v)."""
+    B, S = x.shape[:2]
+    hd = cfg.head_dim
+    h = rms_norm(x, lp["attn_norm"], cfg.rms_eps)
+    q = jnp.einsum("bsd,dh->bsh", h, lp["wq"]).reshape(B, S, cfg.n_heads, hd)
+    k = jnp.einsum("bsd,dh->bsh", h, lp["wk"]).reshape(B, S, cfg.n_kv_heads, hd)
+    v = jnp.einsum("bsd,dh->bsh", h, lp["wv"]).reshape(B, S, cfg.n_kv_heads, hd)
+    q = apply_rope(q, positions, inv_freq)
+    k = apply_rope(k, positions, inv_freq)
+    attn = chunk_attention_with_prefix(q, k, v, suffix_lens, pk, pv, prefix_len)
+    attn = jnp.einsum("bsh,hd->bsd", attn.reshape(B, S, cfg.n_heads * hd), lp["wo"])
+    x = x + attn
+    x = x + _mlp(lp, cfg, x)
+    return x, k, v
+
+
+def _last_valid_logits(
+    params: Params, cfg: LlamaConfig, x: jax.Array, lens: jax.Array
+) -> jax.Array:
+    """Logits at each row's final valid token ([B, S, D], [B] -> [B, V])."""
+    last_idx = jnp.maximum(lens - 1, 0)
+    x_last = jnp.take_along_axis(x, last_idx[:, None, None], axis=1)[:, 0]
+    return _logits(params, cfg, x_last)
+
+
 def forward_prefill_suffix(
     params: Params,
     cfg: LlamaConfig,
@@ -231,33 +271,22 @@ def forward_prefill_suffix(
     def body(carry, xs):
         x, kc, vc = carry
         lp, pk, pv, idx = xs
-        h = rms_norm(x, lp["attn_norm"], cfg.rms_eps)
-        q = jnp.einsum("bsd,dh->bsh", h, lp["wq"]).reshape(B, S, cfg.n_heads, hd)
-        k = jnp.einsum("bsd,dh->bsh", h, lp["wk"]).reshape(B, S, cfg.n_kv_heads, hd)
-        v = jnp.einsum("bsd,dh->bsh", h, lp["wv"]).reshape(B, S, cfg.n_kv_heads, hd)
-        q = apply_rope(q, positions, inv_freq)
-        k = apply_rope(k, positions, inv_freq)
-        attn = chunk_attention_with_prefix(
-            q, k, v, suffix_lens, pk, pv, prefix_len
+        x, k, v = _suffix_layer(
+            lp, cfg, x, positions, suffix_lens, pk, pv, prefix_len, inv_freq
         )
-        attn = jnp.einsum("bsh,hd->bsd", attn.reshape(B, S, cfg.n_heads * hd), lp["wo"])
         # Scatter this layer's suffix K/V blocks into their pages (padding
         # blocks were routed to the reserved scratch page 0 by the caller).
         blocks_k = k.reshape(B, n_blocks, page_size, cfg.n_kv_heads, hd)
         blocks_v = v.reshape(B, n_blocks, page_size, cfg.n_kv_heads, hd)
         kc = kc.at[idx, page_ids].set(blocks_k.astype(kc.dtype))
         vc = vc.at[idx, page_ids].set(blocks_v.astype(vc.dtype))
-        x = x + attn
-        x = x + _mlp(lp, cfg, x)
         return (x, kc, vc), None
 
     (x, k_cache, v_cache), _ = jax.lax.scan(
         body, (x, k_cache, v_cache),
         (params["layers"], prefix_k_all, prefix_v_all, layer_ids),
     )
-    last_idx = jnp.maximum(suffix_lens - 1, 0)
-    x_last = jnp.take_along_axis(x, last_idx[:, None, None], axis=1)[:, 0]  # [B, D]
-    return _logits(params, cfg, x_last), k_cache, v_cache
+    return _last_valid_logits(params, cfg, x, suffix_lens), k_cache, v_cache
 
 
 def forward_prefill_suffix_dense(
@@ -281,7 +310,6 @@ def forward_prefill_suffix_dense(
     Returns (last_logits [B, V] f32, k_sfx, v_sfx).
     """
     B, S = tokens.shape
-    hd = cfg.head_dim
     inv_freq = rope_inv_freq(cfg)
     positions = prefix_len + jnp.broadcast_to(jnp.arange(S), (B, S))
 
@@ -289,24 +317,15 @@ def forward_prefill_suffix_dense(
 
     def body(x, xs):
         lp, pk, pv = xs
-        h = rms_norm(x, lp["attn_norm"], cfg.rms_eps)
-        q = jnp.einsum("bsd,dh->bsh", h, lp["wq"]).reshape(B, S, cfg.n_heads, hd)
-        k = jnp.einsum("bsd,dh->bsh", h, lp["wk"]).reshape(B, S, cfg.n_kv_heads, hd)
-        v = jnp.einsum("bsd,dh->bsh", h, lp["wv"]).reshape(B, S, cfg.n_kv_heads, hd)
-        q = apply_rope(q, positions, inv_freq)
-        k = apply_rope(k, positions, inv_freq)
-        attn = chunk_attention_with_prefix(q, k, v, suffix_lens, pk, pv, prefix_len)
-        attn = jnp.einsum("bsh,hd->bsd", attn.reshape(B, S, cfg.n_heads * hd), lp["wo"])
-        x = x + attn
-        x = x + _mlp(lp, cfg, x)
+        x, k, v = _suffix_layer(
+            lp, cfg, x, positions, suffix_lens, pk, pv, prefix_len, inv_freq
+        )
         return x, (k, v)
 
     x, (k_sfx, v_sfx) = jax.lax.scan(
         body, x, (params["layers"], prefix_k_all, prefix_v_all)
     )
-    last_idx = jnp.maximum(suffix_lens - 1, 0)
-    x_last = jnp.take_along_axis(x, last_idx[:, None, None], axis=1)[:, 0]  # [B, D]
-    return _logits(params, cfg, x_last), k_sfx, v_sfx
+    return _last_valid_logits(params, cfg, x, suffix_lens), k_sfx, v_sfx
 
 
 def forward_block_decode(
@@ -494,18 +513,30 @@ def forward_decode(
     v_cache: jax.Array,
     page_tables: jax.Array,  # [B, max_pages]
     active: jax.Array,  # [B] bool — inactive slots neither write nor matter
+    paged_attn: str = "xla",  # static: "xla" gather path | "pallas" kernel
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """One autoregressive decode step over the paged KV cache.
 
     Scatters the new token's K/V into the cache pages, attends over all
     cached tokens (including the new one), returns (logits [B,V] f32,
     k_cache, v_cache). Pass caches as donated args under jit so updates
-    happen in place.
+    happen in place. paged_attn="pallas" swaps the gather-then-attend XLA
+    path for the streaming Pallas kernel
+    (ops/pallas_paged_attention.py); must be static under jit.
     """
     B = tokens.shape[0]
     hd = cfg.head_dim
     page_size = k_cache.shape[2]
     inv_freq = rope_inv_freq(cfg)
+
+    if paged_attn == "pallas":
+        from k8s_llm_scheduler_tpu.ops.pallas_paged_attention import (
+            paged_decode_attention_pallas,
+        )
+
+        attn_kernel = paged_decode_attention_pallas
+    else:
+        attn_kernel = paged_decode_attention
 
     page_slot = positions // page_size  # which entry of the page table
     page_ids = jnp.take_along_axis(page_tables, page_slot[:, None], axis=1)[:, 0]
@@ -538,7 +569,7 @@ def forward_decode(
         kc = jax.lax.dynamic_update_index_in_dim(kc, layer_k, idx, axis=0)
         vc = jax.lax.dynamic_update_index_in_dim(vc, layer_v, idx, axis=0)
 
-        attn = paged_decode_attention(q, layer_k, layer_v, page_tables, seq_lens)
+        attn = attn_kernel(q, layer_k, layer_v, page_tables, seq_lens)
         attn = jnp.einsum("bh,hd->bd", attn.reshape(B, cfg.n_heads * hd), lp["wo"])
         x = x + attn
         x = x + _mlp(lp, cfg, x)
