@@ -37,12 +37,29 @@ def build_cfg(name: str):
     if name == "bench":
         # Big enough that the MXU does real work, small enough to compile in
         # seconds — the architecture is identical to the 1B/8B/70B ladder.
+        # max_seq_len covers the longctx preset's 256-node / ~41k-byte-token
+        # cluster prompt.
         return LlamaConfig(
             name="bench", vocab_size=512, d_model=512, n_layers=6, n_heads=8,
-            n_kv_heads=4, d_ff=1408, max_seq_len=16384, rope_theta=500000.0,
+            n_kv_heads=4, d_ff=1408, max_seq_len=65536, rope_theta=500000.0,
             tie_embeddings=True,
         )
     return get_config(name)
+
+
+# BASELINE.md burst configs (reference publishes no numbers; these mirror the
+# north-star table). Presets override only flags the user left at default.
+PRESETS = {
+    # standard operating point: mid-size cluster, bursty pods
+    "default": {},
+    # "1000-pod burst, continuous batching, 64-node cluster state"
+    "burst1000": {"pods": 1000, "nodes": 64, "shapes": 32},
+    # "256-node cluster, ~8k-token (BPE) per-node-metrics prompt" — with the
+    # byte tokenizer the same prompt is ~41k tokens: chunked-prefill stress
+    # fewer slots: admission batch attends (slots x suffix_bucket) queries
+    # against the ~48k prefix — 16 rows would be a multi-GB score block
+    "longctx": {"pods": 16, "nodes": 256, "shapes": 4, "rounds": 1, "slots": 4},
+}
 
 
 async def run_burst(scheduler, cluster, pods, timeout_s: float) -> dict[str, float]:
@@ -155,6 +172,7 @@ async def bench(args) -> dict:
             "cache_decisions": stats["cache_decisions"],
             "fallback_decisions": stats["fallback_decisions"],
             "model": args.model,
+            "preset": args.preset,
             "baseline_note": "reference publishes no numbers; target p50<200ms (BASELINE.md)",
         },
     }
@@ -171,7 +189,11 @@ def main() -> None:
     parser.add_argument("--max-new-tokens", type=int, default=72)
     parser.add_argument("--temperature", type=float, default=0.3)
     parser.add_argument("--rounds", type=int, default=3)
+    parser.add_argument("--preset", choices=sorted(PRESETS), default="default")
     args = parser.parse_args()
+    for key, value in PRESETS[args.preset].items():
+        if getattr(args, key) == parser.get_default(key):
+            setattr(args, key, value)
     if args.rounds < 1:
         parser.error("--rounds must be >= 1")
     result = asyncio.run(bench(args))

@@ -373,6 +373,7 @@ class InferenceEngine:
         chunk_steps: int = 16,
         temperature: float = 0.3,
         rng_seed: int = 0,
+        prefix_chunk: int = 2048,
     ) -> None:
         self.cfg = cfg
         self.params = params
@@ -393,6 +394,10 @@ class InferenceEngine:
         if bad:
             raise ValueError(f"prefill buckets {bad} not multiples of page_size={page_size}")
         self.prefill_buckets = tuple(sorted(prefill_buckets))
+        # Block width for chunked long-prefix prefill: bounds the per-layer
+        # cascade-attention intermediate at O(prefix_chunk x prefix) instead
+        # of O(prefix^2) — a 16k x 48k f32 score block would not fit HBM.
+        self.prefix_chunk = int(prefix_chunk)
         self.chunk_steps = int(chunk_steps)
         self.temperature = float(temperature)
         self.max_slots = max_slots
@@ -415,6 +420,10 @@ class InferenceEngine:
             donate_argnums=(2, 3, 8, 9, 10, 11, 12),
         )
         self._wave = jax.jit(_wave_impl, static_argnums=(1, 17, 18, 19))
+        # Chunked long-prefix prefill reuses the dense cascade directly.
+        self._suffix_dense = jax.jit(
+            forward_prefill_suffix_dense, static_argnums=(1,)
+        )
         # Block width for grammar-accelerated wave decoding: each iteration
         # consumes 1 sampled + up to wave_block-1 forced tokens. 16 covers
         # the longest JSON-skeleton span in one iteration; the extra
@@ -526,7 +535,12 @@ class InferenceEngine:
     def set_prefix(self, prompt_ids: list[int] | None) -> None:
         """Install the burst-shared prompt prefix (prefilling it once if not
         cached on device). Requires the engine to be drained — all in-flight
-        slots decode against the same prefix buffer."""
+        slots decode against the same prefix buffer.
+
+        Prefixes up to the largest prefill bucket run as ONE full-attention
+        prefill; longer ones (the 256-node cluster-state prompt is ~40k
+        byte-tokens, SURVEY §5 long-context) take the CHUNKED path — see
+        _prefill_prefix_chunked."""
         if self._by_slot:
             raise RuntimeError("cannot switch prefix with requests in flight")
         if not prompt_ids:
@@ -540,20 +554,74 @@ class InferenceEngine:
             self.stats["prefix_hits"] += 1
             return
         n = len(prompt_ids)
-        bucket = self._bucket_for(n)
-        pad = self.tokenizer.pad_id
-        tokens = np.full((1, bucket), pad, dtype=np.int32)
-        tokens[0, :n] = prompt_ids
-        _, k_all, v_all = self._prefill_kv(
-            self.params, self.cfg, jnp.asarray(tokens), jnp.asarray([n])
-        )
-        pfx = _PrefixKV(k=k_all[:, 0], v=v_all[:, 0], length=n, token_ids=key)
+        if n > self.cfg.max_seq_len:
+            # Advisory, not fatal: RoPE extrapolates beyond the trained
+            # window (quality degrades past it, correctness does not).
+            logger.warning(
+                "prefix of %d tokens exceeds model max_seq_len %d; "
+                "quality may degrade", n, self.cfg.max_seq_len,
+            )
+        if n > self.prefill_buckets[-1]:
+            k, v = self._prefill_prefix_chunked(prompt_ids)
+            pfx = _PrefixKV(k=k, v=v, length=n, token_ids=key)
+        else:
+            bucket = self._bucket_for(n)
+            pad = self.tokenizer.pad_id
+            tokens = np.full((1, bucket), pad, dtype=np.int32)
+            tokens[0, :n] = prompt_ids
+            _, k_all, v_all = self._prefill_kv(
+                self.params, self.cfg, jnp.asarray(tokens), jnp.asarray([n])
+            )
+            pfx = _PrefixKV(k=k_all[:, 0], v=v_all[:, 0], length=n, token_ids=key)
         self._prefix_cache[key] = pfx
         while len(self._prefix_cache) > self.PREFIX_CACHE_SIZE:
             self._prefix_cache.popitem(last=False)
         self._prefix = pfx
         self.stats["prefix_prefills"] += 1
         self.stats["prefill_tokens"] += n
+
+    def _prefill_prefix_chunked(
+        self, prompt_ids: list[int]
+    ) -> tuple[jax.Array, jax.Array]:
+        """Blockwise prefill for prefixes beyond the largest bucket.
+
+        Processes the prompt in largest-bucket chunks; each chunk attends to
+        the dense KV accumulated so far plus causally within itself (the
+        same cascade attention the per-pod suffixes use), then appends its
+        KV into the growing buffer. Memory stays O(chunk x prefix) per
+        layer instead of O(prefix^2), which is what makes the 256-node /
+        40k-token cluster prompt feasible on one chip. Returns (k, v) of
+        shape [L, cap, n_kv, hd] where cap rounds up to a chunk multiple.
+        """
+        chunk = min(self.prefix_chunk, self.prefill_buckets[-1])
+        n = len(prompt_ids)
+        cap = -(-n // chunk) * chunk
+        pad = self.tokenizer.pad_id
+        k_buf = jnp.zeros(
+            (self.cfg.n_layers, cap, self.cfg.n_kv_heads, self.cfg.head_dim),
+            dtype=self.cfg.dtype,
+        )
+        v_buf = jnp.zeros_like(k_buf)
+        done = 0
+        for start in range(0, n, chunk):
+            piece = prompt_ids[start : start + chunk]
+            m = len(piece)
+            tokens = np.full((1, chunk), pad, dtype=np.int32)
+            tokens[0, :m] = piece
+            _, k_c, v_c = self._suffix_dense(
+                self.params, self.cfg,
+                jnp.asarray(tokens), jnp.asarray([m], dtype=np.int32),
+                k_buf, v_buf, jnp.int32(done),
+            )
+            # k_c: [L, 1, chunk, n_kv, hd] -> append at `start`
+            k_buf = jax.lax.dynamic_update_slice_in_dim(
+                k_buf, k_c[:, 0].astype(k_buf.dtype), start, axis=1
+            )
+            v_buf = jax.lax.dynamic_update_slice_in_dim(
+                v_buf, v_c[:, 0].astype(v_buf.dtype), start, axis=1
+            )
+            done += m
+        return k_buf, v_buf
 
     @property
     def prefix_len(self) -> int:

@@ -388,6 +388,7 @@ def build_local_backend(
     max_pages_per_seq: int | None = None,
     prefill_buckets: tuple[int, ...] = (128, 256, 512, 1024, 2048, 4096, 8192),
     chunk_steps: int = 16,
+    prefix_chunk: int = 2048,
     max_new_tokens: int = 200,
     constrained: bool = True,
     rng_seed: int = 0,
@@ -439,7 +440,7 @@ def build_local_backend(
         num_pages=num_pages, page_size=page_size, max_slots=max_slots,
         max_pages_per_seq=max_pages_per_seq,
         prefill_buckets=prefill_buckets, chunk_steps=chunk_steps,
-        temperature=temperature,
+        prefix_chunk=prefix_chunk, temperature=temperature,
     )
     return LocalLLMBackend(
         engine, tokenizer, max_new_tokens=max_new_tokens, constrained=constrained,

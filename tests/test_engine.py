@@ -396,3 +396,44 @@ class TestGrammarAcceleration:
         prompt = TOK.chat_prompt("sys", "block one")
         fins = engine.decide_wave([prompt], max_new_tokens=5)
         assert 1 <= len(fins[0].token_ids) <= 5
+
+
+class TestChunkedPrefix:
+    """Long prefixes prefill blockwise; results must match single-shot."""
+
+    def _engine(self, buckets):
+        params = init_params(jax.random.PRNGKey(0), ENGINE_CFG)
+        return InferenceEngine(
+            params, ENGINE_CFG, TOK,
+            num_pages=64, page_size=64, max_slots=2, max_pages_per_seq=16,
+            prefill_buckets=buckets, chunk_steps=4, temperature=0.0,
+        )
+
+    def test_chunked_matches_single_shot(self):
+        import numpy as np
+
+        rng = np.random.default_rng(0)
+        prefix = [int(t) for t in rng.integers(1, 256, size=300)]
+        # small buckets force the chunked path (largest bucket 128 < 300)
+        chunked = self._engine((64, 128))
+        single = self._engine((64, 128, 512))
+        chunked.set_prefix(prefix)
+        single.set_prefix(prefix)
+        assert chunked.prefix_len == single.prefix_len == 300
+        k_c = np.asarray(chunked._prefix.k[:, :300])
+        k_s = np.asarray(single._prefix.k[:, :300])
+        np.testing.assert_allclose(k_c, k_s, rtol=1e-5, atol=1e-5)
+        # and decoding against either prefix gives identical greedy tokens
+        suffix = TOK.chat_prompt("sys", "after the long prefix")
+        a = chunked.decide_wave([suffix], max_new_tokens=8)[0]
+        b = single.decide_wave([suffix], max_new_tokens=8)[0]
+        assert a.token_ids == b.token_ids
+
+    def test_prefix_beyond_max_seq_len_warns_but_works(self, caplog):
+        import logging
+
+        eng = self._engine((64, 128, 4096))
+        with caplog.at_level(logging.WARNING):
+            eng.set_prefix([1] * (ENGINE_CFG.max_seq_len + 10))
+        assert any("max_seq_len" in r.message for r in caplog.records)
+        assert eng.prefix_len == ENGINE_CFG.max_seq_len + 10
