@@ -805,9 +805,12 @@ class InferenceEngine:
         self._prefix = prefix
 
         bucket = self._bucket_for(max(len(p) for p in prompts))
-        # Row bucket mirrors add_requests: 1 for singles, full width else —
-        # two compiled programs per (token bucket, wave geometry).
-        R = 1 if len(prompts) == 1 else self.max_slots
+        # ONE row bucket (full width), always: a narrower single-prompt
+        # variant would be a second compiled program per geometry, and its
+        # first compile (~5s) lands mid-burst the first time a burst
+        # straggler forms a 1-wide wave — padding rows are cheaper than a
+        # jit stall on the hot path.
+        R = self.max_slots
         pad = self.tokenizer.pad_id
         # Wave geometry: with a grammar, block decoding needs only
         # wave_iterations(dfa) model calls (forced runs are free); without

@@ -339,9 +339,16 @@ class LocalLLMBackend:
         """One submit+harvest cycle; returns items still waiting on a group
         switch."""
         if pending and self.admit_wait_s and not waves:
-            # tiny window to let a burst coalesce into one wide wave
-            time.sleep(self.admit_wait_s)
-            self._drain_queue(pending, block=False)
+            # Adaptive coalescing: a burst's leaders enqueue over a few ms;
+            # keep extending the window while items are still arriving (up
+            # to 5 extensions) so the whole burst lands in ONE wave instead
+            # of a wide wave plus straggler waves serialized behind it.
+            for _ in range(5):
+                before = len(pending)
+                time.sleep(self.admit_wait_s)
+                self._drain_queue(pending, block=False)
+                if len(pending) == before or len(pending) >= self.engine.max_slots:
+                    break
         pending = self._submit_waves(pending, waves)
         if waves:
             handle, items = waves[0]
