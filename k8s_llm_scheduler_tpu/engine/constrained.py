@@ -18,11 +18,14 @@ DFA over the decision grammar, so the model *cannot* emit anything but
 - `reasoning` is any non-quote printable text up to a length cap, then a
   forced closing quote+brace+EOS.
 
-The DFA compiles to two dense tables — allowed[state, vocab] (bool) and
-next_state[state, vocab] (int32) — applied INSIDE the fused decode loop on
-device (engine/engine.py): masking is a where(), transition is a gather.
-Nothing about decoding leaves the jit step, which also kills the per-token
-host round trips the axon tunnel punishes.
+The DFA is held as edge lists on host and compiles to SPARSE device tables
+(SparseDFATables: per-state allowed-token lists plus forced-run tables) —
+both vocab-independent, so the same machinery serves the 512-entry byte
+tokenizer and 128k-vocab BPE tokenizers. Sampling and transitions happen
+INSIDE the fused decode loop on device (engine/engine.py _sample_sparse):
+a K-space gather-pick-map, never a full-vocab mask. Nothing about decoding
+leaves the jit step, which also kills the per-token host round trips the
+axon tunnel punishes.
 
 Validation downstream (sched/client.py) stays as defense in depth.
 """
@@ -38,33 +41,41 @@ from k8s_llm_scheduler_tpu.engine.tokenizer import Tokenizer
 
 @dataclasses.dataclass
 class DecisionDFA:
-    """Dense DFA tables for constrained decoding (numpy; engine ships them
-    to device once per cluster snapshot)."""
+    """Edge-list DFA for constrained decoding. Host memory is O(edges) —
+    vocab-INDEPENDENT, which matters at 128k-vocab BPE tokenizers where a
+    dense [n_states, vocab] table would be hundreds of MB per grammar (and
+    the backend caches up to 17 grammars). The engine derives the sparse
+    device tables (sparse_tables) from this."""
 
-    allowed: np.ndarray  # [n_states, vocab] bool
-    next_state: np.ndarray  # [n_states, vocab] int32
+    edges: list[dict[int, int]]  # edges[s][token id] -> next state
     start_state: int
     done_state: int
+    vocab_size: int
 
     @property
     def n_states(self) -> int:
-        return self.allowed.shape[0]
+        return len(self.edges)
+
+    def allowed_tokens(self, state: int) -> list[int]:
+        """Allowed token ids from `state`, ascending (deterministic order —
+        greedy tie-breaks match the old dense argmax)."""
+        return sorted(self.edges[state])
+
+    def next(self, state: int, token: int) -> int:
+        return self.edges[state][token]
 
 
 class _Builder:
     def __init__(self, vocab_size: int) -> None:
         self.vocab = vocab_size
-        self.allowed: list[np.ndarray] = []
-        self.next_state: list[np.ndarray] = []
+        self.edges: list[dict[int, int]] = []
 
     def new_state(self) -> int:
-        self.allowed.append(np.zeros(self.vocab, dtype=bool))
-        self.next_state.append(np.zeros(self.vocab, dtype=np.int32))
-        return len(self.allowed) - 1
+        self.edges.append({})
+        return len(self.edges) - 1
 
     def edge(self, src: int, token: int, dst: int) -> None:
-        self.allowed[src][token] = True
-        self.next_state[src][token] = dst
+        self.edges[src][token] = dst
 
     def chain(self, src: int, tokens: list[int]) -> int:
         """Forced token sequence; returns the state after the last token."""
@@ -77,10 +88,10 @@ class _Builder:
 
     def finish(self, start: int, done: int) -> DecisionDFA:
         return DecisionDFA(
-            allowed=np.stack(self.allowed),
-            next_state=np.stack(self.next_state),
+            edges=self.edges,
             start_state=start,
             done_state=done,
+            vocab_size=self.vocab,
         )
 
 
@@ -116,7 +127,7 @@ def build_decision_dfa(
             if nxt_prefix not in trie:
                 trie[nxt_prefix] = b.new_state()
                 b.edge(trie[prefix], tok, trie[nxt_prefix])
-            elif not b.allowed[trie[prefix]][tok]:
+            elif tok not in b.edges[trie[prefix]]:
                 b.edge(trie[prefix], tok, trie[nxt_prefix])
             prefix = nxt_prefix
         # closing quote after a complete name
@@ -183,9 +194,9 @@ def build_decision_dfa(
 
 def first_token_of(dfa: DecisionDFA) -> int:
     """The single allowed first token (the opening brace)."""
-    (candidates,) = np.nonzero(dfa.allowed[dfa.start_state])
+    candidates = dfa.allowed_tokens(dfa.start_state)
     assert len(candidates) == 1
-    return int(candidates[0])
+    return candidates[0]
 
 
 def forced_token_table(dfa: DecisionDFA) -> np.ndarray:
@@ -199,10 +210,86 @@ def forced_token_table(dfa: DecisionDFA) -> np.ndarray:
     instead of once per token. The done state reports -1 (its pad self-loop
     exists only to keep finished slots well-defined, never to be taken).
     """
-    counts = dfa.allowed.sum(axis=1)
-    forced = np.where(counts == 1, dfa.allowed.argmax(axis=1), -1).astype(np.int32)
+    forced = np.full(dfa.n_states, -1, dtype=np.int32)
+    for s, out in enumerate(dfa.edges):
+        if len(out) == 1:
+            forced[s] = next(iter(out))
     forced[dfa.done_state] = -1
     return forced
+
+
+@dataclasses.dataclass
+class SparseDFATables:
+    """Vocab-independent device representation of a DecisionDFA.
+
+    The dense [n_states, vocab] tables are impossible at real-model vocab
+    sizes (128k vocab x 4096 states of int32 is ~2 GB); but the decision
+    grammar allows at most a few hundred tokens per state, so the device
+    tables list them instead:
+
+    - sp_tokens[s, k]: the k-th allowed token id from state s (-1 padding)
+    - sp_next[s, k]:   the state reached by taking it
+    - forced[s]:       the single allowed token when out-degree is 1, else -1
+    - forced_next[s]:  the state reached by the forced token (0 when none)
+
+    Sampling happens in K-space: gather the allowed tokens' logits, pick k,
+    map back through sp_tokens/sp_next — the full-vocab mask never exists.
+    K is bucketed to bound compile variants.
+    """
+
+    sp_tokens: np.ndarray  # [n_states, K] int32
+    sp_next: np.ndarray    # [n_states, K] int32
+    forced: np.ndarray     # [n_states] int32
+    forced_next: np.ndarray  # [n_states] int32
+    start_state: int
+    done_state: int
+
+    @property
+    def n_states(self) -> int:
+        return self.sp_tokens.shape[0]
+
+    @property
+    def k_width(self) -> int:
+        return self.sp_tokens.shape[1]
+
+
+_K_BUCKETS = (16, 32, 64, 128, 256, 512, 1024)
+
+
+def sparse_tables(dfa: DecisionDFA) -> SparseDFATables:
+    """Compile a DecisionDFA to its sparse device tables (cached on the DFA)."""
+    cached = getattr(dfa, "_sparse_cache", None)
+    if cached is not None:
+        return cached
+    max_deg = max((len(out) for out in dfa.edges), default=1)
+    for bucket in _K_BUCKETS:
+        if max_deg <= bucket:
+            K = bucket
+            break
+    else:
+        raise ValueError(f"DFA out-degree {max_deg} exceeds {_K_BUCKETS[-1]}")
+    n = dfa.n_states
+    sp_tokens = np.full((n, K), -1, dtype=np.int32)
+    sp_next = np.zeros((n, K), dtype=np.int32)
+    for s in range(n):
+        toks = dfa.allowed_tokens(s)
+        sp_tokens[s, : len(toks)] = toks
+        sp_next[s, : len(toks)] = [dfa.edges[s][t] for t in toks]
+    forced = forced_token_table(dfa)
+    forced_next = np.zeros(n, dtype=np.int32)
+    for s in range(n):
+        if forced[s] >= 0:
+            forced_next[s] = dfa.edges[s][int(forced[s])]
+    tables = SparseDFATables(
+        sp_tokens=sp_tokens,
+        sp_next=sp_next,
+        forced=forced,
+        forced_next=forced_next,
+        start_state=dfa.start_state,
+        done_state=dfa.done_state,
+    )
+    dfa._sparse_cache = tables  # type: ignore[attr-defined]
+    return tables
 
 
 def wave_iterations(dfa: DecisionDFA, block_size: int) -> int:
@@ -232,7 +319,7 @@ def wave_iterations(dfa: DecisionDFA, block_size: int) -> int:
             ft = forced[state]
             if ft < 0:
                 break
-            state = int(dfa.next_state[state, ft])
+            state = dfa.edges[state][int(ft)]
         return state
 
     # Iterative DFS (the reasoning chain can be hundreds of states deep).
@@ -244,8 +331,8 @@ def wave_iterations(dfa: DecisionDFA, block_size: int) -> int:
             continue
         succs = []
         ready = True
-        for tok in np.nonzero(dfa.allowed[s])[0]:
-            nxt = advance(int(dfa.next_state[s, tok]))
+        for tok in dfa.allowed_tokens(s):
+            nxt = advance(dfa.edges[s][tok])
             succs.append(nxt)
             if nxt not in memo:
                 stack.append(nxt)

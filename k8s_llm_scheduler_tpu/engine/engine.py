@@ -51,7 +51,7 @@ import numpy as np
 
 from k8s_llm_scheduler_tpu.engine.constrained import (
     DecisionDFA,
-    forced_token_table,
+    sparse_tables,
     wave_iterations,
 )
 from k8s_llm_scheduler_tpu.engine.kv_cache import PagedKVCache
@@ -70,13 +70,36 @@ from k8s_llm_scheduler_tpu.ops.attention import NEG_INF
 logger = logging.getLogger(__name__)
 
 
-def _sample(logits, mask, rng, temperature):
-    """Masked sampling: temperature>0 -> categorical, else argmax. f32."""
-    masked = jnp.where(mask, logits, NEG_INF)
+def _pick(masked, rng, temperature):
+    """temperature>0 -> categorical, else argmax, over masked f32 logits."""
     greedy = jnp.argmax(masked, axis=-1)
     scaled = masked / jnp.maximum(temperature, 1e-6)
     sampled = jax.random.categorical(rng, scaled, axis=-1)
     return jnp.where(temperature > 0, sampled, greedy).astype(jnp.int32)
+
+
+def _sample_unconstrained(logits, pad_id, rng, temperature):
+    """Full-vocab sampling with only pad excluded (pad is the idle-slot
+    emission sentinel — see set_grammar)."""
+    V = logits.shape[-1]
+    masked = jnp.where(jnp.arange(V)[None, :] == pad_id, NEG_INF, logits)
+    return _pick(masked, rng, temperature)
+
+
+def _sample_sparse(logits, tok_rows, next_rows, rng, temperature):
+    """Grammar sampling in K-space: gather the allowed tokens' logits, pick
+    among them, map back to (token id, next DFA state). The full-vocab mask
+    never materializes, so tables stay vocab-independent
+    (engine/constrained.py SparseDFATables — this is what makes constrained
+    decoding work at 128k-vocab BPE tokenizers).
+
+    logits [R, V]; tok_rows/next_rows [R, K] (-1 padded)."""
+    gathered = jnp.take_along_axis(logits, jnp.maximum(tok_rows, 0), axis=1)
+    masked = jnp.where(tok_rows >= 0, gathered, NEG_INF)
+    k = _pick(masked, rng, temperature)
+    tok = jnp.take_along_axis(tok_rows, k[:, None], axis=1)[:, 0]
+    nxt = jnp.take_along_axis(next_rows, k[:, None], axis=1)[:, 0]
+    return tok.astype(jnp.int32), nxt.astype(jnp.int32)
 
 
 def _admit_impl(
@@ -91,9 +114,10 @@ def _admit_impl(
     slot_ids,      # [R] int32 — target slot per row (trash slot M on padding)
     tok, pos, act, st, budget, first,  # donated per-slot state [M+1]
     new_budgets,   # [R] budget for admitted rows (max_new - 1; 0 on padding)
-    allowed, next_state, done_state, eos_id,
+    sp_tokens, sp_next, done_state, eos_id, pad_id,
     dfa_start,     # scalar int32
     rng, temperature,
+    constrained: bool,  # static
 ):
     """Batched admission: suffix prefill + KV scatter + first-token sample,
     one device program. Rows scatter into their slot's state; padding rows
@@ -104,9 +128,13 @@ def _admit_impl(
     )
     R = tokens.shape[0]
     start_vec = jnp.full((R,), dfa_start, dtype=jnp.int32)
-    mask = allowed[start_vec]
-    first_new = _sample(last_logits, mask, rng, temperature)
-    st_new = next_state[start_vec, first_new]
+    if constrained:
+        first_new, st_new = _sample_sparse(
+            last_logits, sp_tokens[start_vec], sp_next[start_vec], rng, temperature
+        )
+    else:
+        first_new = _sample_unconstrained(last_logits, pad_id, rng, temperature)
+        st_new = start_vec
     finished = (first_new == eos_id) | (st_new == done_state)
     real = suffix_lens > 0  # padding rows must never activate the trash row
 
@@ -127,9 +155,10 @@ def _decode_chunk_impl(
     prefix_k, prefix_v,  # [L, Sp, n_kv, hd]
     prefix_len,        # scalar int32
     tok, pos, act, st, budget,  # donated per-slot state [M]
-    allowed, next_state, done_state, eos_id, pad_id,
+    sp_tokens, sp_next, done_state, eos_id, pad_id,
     rng, temperature,
     n_steps: int,      # static
+    constrained: bool,  # static
 ):
     """`n_steps` decode iterations fused into one program. Emits the sampled
     token per step; finished/exhausted/idle slots emit pad_id and idle.
@@ -160,8 +189,13 @@ def _decode_chunk_impl(
             ck, cv, tail, prefix_k, prefix_v, prefix_len,
         )
         key, sub = jax.random.split(key)
-        nxt = _sample(logits, allowed[st], sub, temperature)
-        new_st = next_state[st, nxt]
+        if constrained:
+            nxt, new_st = _sample_sparse(
+                logits, sp_tokens[st], sp_next[st], sub, temperature
+            )
+        else:
+            nxt = _sample_unconstrained(logits, pad_id, sub, temperature)
+            new_st = st
         emitted = jnp.where(act_eff, nxt, pad_id)
         new_st = jnp.where(act_eff, new_st, st)
         finished = (new_st == done_state) | (nxt == eos_id)
@@ -202,12 +236,13 @@ def _wave_impl(
     prefix_k, prefix_v,  # [L, Sp, n_kv, hd] shared dense prefix KV
     prefix_len,    # scalar int32
     max_new,       # [R] total emission budget per row (0 on padding rows)
-    allowed, next_state, forced, done_state, eos_id, pad_id,
+    sp_tokens, sp_next, forced, forced_next, done_state, eos_id, pad_id,
     dfa_start,     # scalar int32
     rng, temperature,
     n_iters: int,  # static — worst-case block iterations (wave_iterations)
     F: int,        # static — block width (sampled token + forced run)
     cap: int,      # static — generated-KV capacity, >= max(max_new)
+    constrained: bool,  # static
 ):
     """One whole decision wave in ONE device program, with
     GRAMMAR-ACCELERATED BLOCK DECODING.
@@ -250,9 +285,15 @@ def _wave_impl(
         gk, gv, st, act, emitted, pos_next, logits, key = carry
         key, sub = jax.random.split(key)
         # (a) sample the block's first token from the carried logits
-        t0 = _sample(logits, allowed[st], sub, temperature)
+        if constrained:
+            t0, s_t0 = _sample_sparse(
+                logits, sp_tokens[st], sp_next[st], sub, temperature
+            )
+        else:
+            t0 = _sample_unconstrained(logits, pad_id, sub, temperature)
+            s_t0 = st
         emit0 = act & (emitted < max_new)
-        s_cur = jnp.where(emit0, next_state[st, t0], st)
+        s_cur = jnp.where(emit0, s_t0, st)
         fin0 = (t0 == eos_id) | (s_cur == done_state)
         blk = [jnp.where(emit0, t0, pad_id)]
         valid = [emit0]
@@ -262,8 +303,7 @@ def _wave_impl(
             ft = forced[s_cur]
             emit_j = alive & (ft >= 0)
             t_j = jnp.where(emit_j, ft, pad_id)
-            s_nxt = next_state[s_cur, jnp.maximum(ft, 0)]
-            s_cur = jnp.where(emit_j, s_nxt, s_cur)
+            s_cur = jnp.where(emit_j, forced_next[s_cur], s_cur)
             fin_j = (t_j == eos_id) | (s_cur == done_state)
             blk.append(t_j)
             valid.append(emit_j)
@@ -411,15 +451,15 @@ class InferenceEngine:
         )
         self._admit = jax.jit(
             _admit_impl,
-            static_argnums=(1,),
+            static_argnums=(1, 26),
             donate_argnums=(7, 8, 11, 12, 13, 14, 15, 16),
         )
         self._chunk = jax.jit(
             _decode_chunk_impl,
-            static_argnums=(1, 20),
+            static_argnums=(1, 20, 21),
             donate_argnums=(2, 3, 8, 9, 10, 11, 12),
         )
-        self._wave = jax.jit(_wave_impl, static_argnums=(1, 17, 18, 19))
+        self._wave = jax.jit(_wave_impl, static_argnums=(1, 18, 19, 20, 21))
         # Chunked long-prefix prefill reuses the dense cascade directly.
         self._suffix_dense = jax.jit(
             forward_prefill_suffix_dense, static_argnums=(1,)
@@ -431,13 +471,16 @@ class InferenceEngine:
         self.wave_block = 16
         self._grammar_wave_iters: int | None = None
 
-        # Grammar tables (fixed shapes; content swaps without recompiling).
-        V = self.tokenizer.vocab_size
-        self._allowed = jnp.ones((self.DFA_STATE_CAPACITY, V), dtype=bool)
-        self._next_state = jnp.zeros((self.DFA_STATE_CAPACITY, V), dtype=jnp.int32)
+        # Grammar tables (sparse, vocab-independent; content swaps without
+        # recompiling for a same-K grammar — see SparseDFATables).
+        self._constrained = False
+        self._sp_tokens = jnp.full((1, 1), -1, dtype=jnp.int32)
+        self._sp_next = jnp.zeros((1, 1), dtype=jnp.int32)
+        self._forced = jnp.full((1,), -1, dtype=jnp.int32)
+        self._forced_next = jnp.zeros((1,), dtype=jnp.int32)
         self._done_state = jnp.int32(-1)  # unconstrained: nothing reaches done
         self._dfa_start = 0
-        self.set_grammar(None)  # applies the pad-exclusion mask
+        self.set_grammar(None)
 
         # Shared-prefix store. The engine holds ONE active prefix at a time
         # (all in-flight slots decode against it); recent prefixes stay
@@ -479,20 +522,23 @@ class InferenceEngine:
 
     # ------------------------------------------------------------- grammar
     def set_grammar(self, dfa: DecisionDFA | None) -> None:
-        """Install (or clear) the decision grammar. Padded to fixed capacity
-        so this never changes compiled shapes."""
-        V = self.tokenizer.vocab_size
+        """Install (or clear) the decision grammar as SPARSE device tables.
+
+        States pad to DFA_STATE_CAPACITY and the K axis to a bucket
+        (constrained.py sparse_tables), so same-structure grammars (every
+        cluster snapshot's node-name set) reuse one compiled program.
+        Unconstrained mode samples the full vocab minus pad — pad is the
+        idle-slot emission sentinel and must never be sampleable, or
+        emitted pads would be dropped from output and max_new_tokens
+        accounting (generate() could spin forever on a pad-argmaxing
+        model)."""
         cap = self.DFA_STATE_CAPACITY
         if dfa is None:
-            allowed = np.ones((cap, V), dtype=bool)
-            # pad is the idle-slot emission sentinel — never sampleable, or
-            # emitted pads would be dropped from output and max_new_tokens
-            # accounting (generate() could spin forever on a pad-argmaxing
-            # model).
-            allowed[:, self.tokenizer.pad_id] = False
-            self._allowed = jnp.asarray(allowed)
-            self._next_state = jnp.zeros((cap, V), dtype=jnp.int32)
-            self._forced = jnp.full((cap,), -1, dtype=jnp.int32)
+            self._constrained = False
+            self._sp_tokens = jnp.full((1, 1), -1, dtype=jnp.int32)
+            self._sp_next = jnp.zeros((1, 1), dtype=jnp.int32)
+            self._forced = jnp.full((1,), -1, dtype=jnp.int32)
+            self._forced_next = jnp.zeros((1,), dtype=jnp.int32)
             self._done_state = jnp.int32(-1)
             self._dfa_start = 0
             self._grammar_wave_iters = None
@@ -502,15 +548,21 @@ class InferenceEngine:
                 f"DFA has {dfa.n_states} states > capacity {cap} "
                 "(raise DFA_STATE_CAPACITY or shrink max_reason_tokens)"
             )
-        allowed = np.zeros((cap, V), dtype=bool)
-        nxt = np.zeros((cap, V), dtype=np.int32)
+        t = sparse_tables(dfa)
+        K = t.k_width
+        sp_tokens = np.full((cap, K), -1, dtype=np.int32)
+        sp_next = np.zeros((cap, K), dtype=np.int32)
         forced = np.full((cap,), -1, dtype=np.int32)
-        allowed[: dfa.n_states] = dfa.allowed
-        nxt[: dfa.n_states] = dfa.next_state
-        forced[: dfa.n_states] = forced_token_table(dfa)
-        self._allowed = jnp.asarray(allowed)
-        self._next_state = jnp.asarray(nxt)
+        forced_next = np.zeros((cap,), dtype=np.int32)
+        sp_tokens[: t.n_states] = t.sp_tokens
+        sp_next[: t.n_states] = t.sp_next
+        forced[: t.n_states] = t.forced
+        forced_next[: t.n_states] = t.forced_next
+        self._constrained = True
+        self._sp_tokens = jnp.asarray(sp_tokens)
+        self._sp_next = jnp.asarray(sp_next)
         self._forced = jnp.asarray(forced)
+        self._forced_next = jnp.asarray(forced_next)
         self._done_state = jnp.int32(dfa.done_state)
         self._dfa_start = dfa.start_state
         self._grammar_wave_iters = wave_iterations(dfa, self.wave_block)
@@ -752,9 +804,10 @@ class InferenceEngine:
                 self._tok_d, self._pos_d, self._act_d, self._st_d,
                 self._budget_d, self._first_d,
                 jnp.asarray(new_budgets),
-                self._allowed, self._next_state, self._done_state,
-                jnp.int32(self.tokenizer.eos_id), jnp.int32(self._dfa_start),
-                sub, jnp.float32(self.temperature),
+                self._sp_tokens, self._sp_next, self._done_state,
+                jnp.int32(self.tokenizer.eos_id),
+                jnp.int32(self.tokenizer.pad_id), jnp.int32(self._dfa_start),
+                sub, jnp.float32(self.temperature), self._constrained,
             )
         except Exception:
             # Roll back BOTH the allocation loop and the device dispatch:
@@ -816,7 +869,7 @@ class InferenceEngine:
         # wave_iterations(dfa) model calls (forced runs are free); without
         # one, every token is a choice (F=1, one per iteration). n_iters is
         # bucketed to multiples of 4 to bound compile variants.
-        if self._grammar_wave_iters is not None:
+        if self._constrained and self._grammar_wave_iters is not None:
             F = self.wave_block
             n_iters = min(self._grammar_wave_iters, max_new_tokens)
         else:
@@ -838,11 +891,12 @@ class InferenceEngine:
             jnp.asarray(tokens), jnp.asarray(suffix_lens),
             prefix.k, prefix.v, jnp.int32(prefix.length),
             jnp.asarray(max_new),
-            self._allowed, self._next_state, self._forced, self._done_state,
+            self._sp_tokens, self._sp_next, self._forced, self._forced_next,
+            self._done_state,
             jnp.int32(self.tokenizer.eos_id), jnp.int32(pad),
             jnp.int32(self._dfa_start),
             sub, jnp.float32(self.temperature),
-            n_iters, F, max_new_tokens,
+            n_iters, F, max_new_tokens, self._constrained,
         )
         # Start the D2H transfer right behind the program so harvest finds
         # the results already on host (a blocking device_get is its own
@@ -920,10 +974,10 @@ class InferenceEngine:
                     prefix.k, prefix.v, jnp.int32(prefix.length),
                     self._tok_d, self._pos_d, self._act_d, self._st_d,
                     self._budget_d,
-                    self._allowed, self._next_state, self._done_state,
+                    self._sp_tokens, self._sp_next, self._done_state,
                     jnp.int32(self.tokenizer.eos_id),
                     jnp.int32(self.tokenizer.pad_id),
-                    sub, jnp.float32(self.temperature), n,
+                    sub, jnp.float32(self.temperature), n, self._constrained,
                 )
                 emissions.append(toks_d)
                 self.stats["chunks"] += 1

@@ -109,12 +109,10 @@ class LocalLLMBackend:
         self.tokenizer = tokenizer or engine.tokenizer
         self.prompt_engine = PromptEngine()
         self.max_new_tokens = max_new_tokens
-        self.constrained = constrained and self.tokenizer.vocab_size <= 2048
-        if constrained and not self.constrained:
-            logger.warning(
-                "constrained decoding disabled: vocab %d too large for dense DFA tables",
-                self.tokenizer.vocab_size,
-            )
+        # Sparse DFA tables are vocab-independent (engine/constrained.py
+        # SparseDFATables), so constrained decoding works at any vocab size
+        # — including 128k-vocab BPE tokenizers for real checkpoints.
+        self.constrained = constrained
         self.request_timeout_s = request_timeout_s
         self.admit_wait_s = admit_wait_s
         self._queue: queue.Queue[_WorkItem | None] = queue.Queue()

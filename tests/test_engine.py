@@ -59,7 +59,7 @@ class TestDecisionDFA:
 
     def test_every_state_has_an_out_edge(self):
         dfa = build_decision_dfa(TOK, self.NAMES, max_reason_tokens=10)
-        assert dfa.allowed[: dfa.n_states].any(axis=1).all()
+        assert all(len(out) > 0 for out in dfa.edges)
 
     def test_first_token_is_open_brace(self):
         dfa = build_decision_dfa(TOK, self.NAMES)
@@ -71,10 +71,10 @@ class TestDecisionDFA:
         for _ in range(max_len):
             if state == dfa.done_state:
                 break
-            (opts,) = np.nonzero(dfa.allowed[state])
+            opts = dfa.allowed_tokens(state)
             tok = int(rng.choice(opts))
             out.append(tok)
-            state = int(dfa.next_state[state, tok])
+            state = dfa.next(state, tok)
         assert state == dfa.done_state, "walk must reach done"
         return out
 
@@ -263,10 +263,10 @@ class TestGrammarBudget:
         for _ in range(200):
             if state == dfa.done_state:
                 break
-            (opts,) = np.nonzero(dfa.allowed[state])
+            opts = dfa.allowed_tokens(state)
             tok = int(rng.choice(opts))
             out.append(tok)
-            state = int(dfa.next_state[state, tok])
+            state = dfa.next(state, tok)
         assert state == dfa.done_state
         obj = json.loads(TOK.decode([t for t in out if t != TOK.EOS]))
         assert obj["reasoning"] == ""
@@ -285,10 +285,10 @@ class TestGrammarBudget:
             state = dfa.start_state
             count = 0
             while state != dfa.done_state and count < max_new + 50:
-                (opts,) = np.nonzero(dfa.allowed[state])
+                opts = dfa.allowed_tokens(state)
                 # adversarial: always pick the longest continuation (non-quote)
                 tok = int(rng.choice(opts))
-                state = int(dfa.next_state[state, tok])
+                state = dfa.next(state, tok)
                 count += 1
             assert state == dfa.done_state
             assert count <= max_new, f"emitted {count} > {max_new}"
@@ -348,12 +348,11 @@ class TestGrammarAcceleration:
         # done state must never force (its pad self-loop is a sentinel)
         assert forced[dfa.done_state] == -1
         # forced states have exactly one allowed token and it matches
-        counts = dfa.allowed.sum(axis=1)
         for s in range(dfa.n_states):
             if s == dfa.done_state:
                 continue
-            if counts[s] == 1:
-                assert forced[s] == dfa.allowed[s].argmax()
+            if len(dfa.edges[s]) == 1:
+                assert forced[s] == next(iter(dfa.edges[s]))
             else:
                 assert forced[s] == -1
 
@@ -383,12 +382,12 @@ class TestGrammarAcceleration:
             state, iters = dfa.start_state, 0
             while state != dfa.done_state:
                 iters += 1  # one sampled token
-                (opts,) = np.nonzero(dfa.allowed[state])
-                state = int(dfa.next_state[state, rng.choice(opts)])
+                opts = dfa.allowed_tokens(state)
+                state = dfa.next(state, int(rng.choice(opts)))
                 for _ in range(F - 1):  # forced continuation
                     if state == dfa.done_state or forced[state] < 0:
                         break
-                    state = int(dfa.next_state[state, forced[state]])
+                    state = dfa.next(state, int(forced[state]))
                 assert iters <= bound, "DP bound violated"
 
     def test_wave_block_one_equals_unconstrained_tokens(self, engine):
@@ -437,3 +436,87 @@ class TestChunkedPrefix:
             eng.set_prefix([1] * (ENGINE_CFG.max_seq_len + 10))
         assert any("max_seq_len" in r.message for r in caplog.records)
         assert eng.prefix_len == ENGINE_CFG.max_seq_len + 10
+
+
+class TestSparseGrammar:
+    """Sparse DFA tables: vocab-independent constrained decoding."""
+
+    NAMES = ["node-a", "node-b", "node-abc"]
+
+    def test_sparse_tables_match_dense(self):
+        from k8s_llm_scheduler_tpu.engine.constrained import sparse_tables
+
+        dfa = build_decision_dfa(TOK, self.NAMES, max_reason_tokens=10)
+        t = sparse_tables(dfa)
+        for s in range(dfa.n_states):
+            sp = t.sp_tokens[s]
+            sparse_toks = [int(x) for x in sp[sp >= 0]]
+            assert sparse_toks == dfa.allowed_tokens(s)
+            for k, tok in enumerate(sp):
+                if tok >= 0:
+                    assert t.sp_next[s, k] == dfa.next(s, int(tok))
+        # forced_next consistency
+        for s in range(dfa.n_states):
+            if t.forced[s] >= 0:
+                assert t.forced_next[s] == dfa.next(s, int(t.forced[s]))
+
+    def test_large_vocab_constrained_decision(self):
+        """Constrained decoding at a vocab size where dense tables would be
+        gigabytes — the real-checkpoint (BPE) regime."""
+        class BigVocabTokenizer(ByteTokenizer):
+            @property
+            def vocab_size(self):
+                return 100_000
+
+        big_tok = BigVocabTokenizer()
+        cfg = LlamaConfig(
+            name="bigvocab", vocab_size=100_000, d_model=64, n_layers=2,
+            n_heads=4, n_kv_heads=2, d_ff=128, max_seq_len=1024,
+            rope_theta=10000.0, dtype=jnp.float32, tie_embeddings=True,
+        )
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        eng = InferenceEngine(
+            params, cfg, big_tok, num_pages=32, page_size=64, max_slots=2,
+            max_pages_per_seq=8, prefill_buckets=(128, 256), chunk_steps=4,
+            temperature=0.0,
+        )
+        names = ["node-0", "node-1"]
+        eng.set_grammar(build_decision_dfa(big_tok, names, max_reason_tokens=5))
+        fins = eng.decide_wave(
+            [big_tok.chat_prompt("sys", "pick"), big_tok.chat_prompt("sys", "pick 2")],
+            max_new_tokens=120,
+        )
+        for fin in fins:
+            obj = json.loads(fin.text)
+            assert obj["selected_node"] in names
+            assert 0.0 <= obj["confidence"] <= 1.0
+
+    def test_backend_keeps_constraint_for_large_vocab(self):
+        from k8s_llm_scheduler_tpu.engine.local import LocalLLMBackend
+
+        class BigVocabTokenizer(ByteTokenizer):
+            @property
+            def vocab_size(self):
+                return 100_000
+
+        big_tok = BigVocabTokenizer()
+        cfg = LlamaConfig(
+            name="bigvocab2", vocab_size=100_000, d_model=64, n_layers=2,
+            n_heads=4, n_kv_heads=2, d_ff=128, max_seq_len=1024,
+            rope_theta=10000.0, dtype=jnp.float32, tie_embeddings=True,
+        )
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        eng = InferenceEngine(
+            params, cfg, big_tok, num_pages=32, page_size=64, max_slots=2,
+            max_pages_per_seq=8, prefill_buckets=(512, 1024), chunk_steps=4,
+        )
+        backend = LocalLLMBackend(eng, big_tok, max_new_tokens=120)
+        try:
+            assert backend.constrained is True
+            from conftest import make_node, make_pod
+
+            nodes = [make_node("node-x"), make_node("node-y")]
+            decision = backend.get_scheduling_decision(make_pod(), nodes)
+            assert decision.selected_node in ("node-x", "node-y")
+        finally:
+            backend.close()
