@@ -431,7 +431,9 @@ def build_local_backend(
 
         tokenizer = HFTokenizerAdapter(tokenizer_path)
     else:
-        tokenizer = ByteTokenizer()
+        # Vocab-padded byte tokenizer: checkpoint-shaped configs (128k
+        # vocab) run hermetically without a tokenizer file.
+        tokenizer = ByteTokenizer(vocab_size=max(512, cfg.vocab_size))
     if max_pages_per_seq is None:
         # Own pages hold only the per-pod suffix + generated tokens (the
         # shared cluster-state prefix lives in the dense prefix buffer), so

@@ -40,7 +40,12 @@ class Tokenizer(Protocol):
 
 
 class ByteTokenizer:
-    """Bytes 0-255 map to ids 1-256; specials above; vocab padded to 512."""
+    """Bytes 0-255 map to ids 1-256; specials above; vocab padded to 512.
+
+    `vocab_size` can be overridden upward (e.g. to a real model config's
+    128256) so checkpoint-shaped models run without a tokenizer file —
+    token ids stay < 512, the embedding rows above are simply never hit.
+    """
 
     PAD = 0
     BOS = 257
@@ -50,9 +55,13 @@ class ByteTokenizer:
     ASSISTANT = 261
     END_ROLE = 262
 
-    vocab_size = 512
     pad_id = PAD
     eos_id = EOS
+
+    def __init__(self, vocab_size: int = 512) -> None:
+        if vocab_size < 512:
+            raise ValueError("ByteTokenizer needs vocab_size >= 512")
+        self.vocab_size = vocab_size
 
     def encode(self, text: str) -> list[int]:
         return [b + 1 for b in text.encode("utf-8")]

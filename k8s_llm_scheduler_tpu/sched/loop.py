@@ -31,6 +31,7 @@ from k8s_llm_scheduler_tpu.cluster.interface import (
     RawPod,
     raw_pod_to_spec,
 )
+from k8s_llm_scheduler_tpu.observability.trace import PhaseRecorder
 from k8s_llm_scheduler_tpu.sched.client import DecisionClient
 from k8s_llm_scheduler_tpu.types import DecisionSource, NodeMetrics
 
@@ -60,6 +61,9 @@ class Scheduler:
         self._tasks: set[asyncio.Task] = set()
         self._stop_event = asyncio.Event()
         self.running = False
+        # Per-phase wall time of the decision pipeline (SURVEY §5 tracing:
+        # the reference has none) — surfaces via get_stats and /metrics.
+        self.phases = PhaseRecorder()
         self.stats = {
             "total_scheduled": 0,
             "llm_decisions": 0,
@@ -83,13 +87,15 @@ class Scheduler:
         """One pod through the full pipeline (reference scheduler.py:690-729).
         Returns True iff the pod was bound."""
         pod = raw_pod_to_spec(raw)
-        nodes = await self._node_snapshot()
+        with self.phases.phase("snapshot"):
+            nodes = await self._node_snapshot()
         if not nodes:
             logger.warning("no nodes in cluster, leaving %s pending", pod.name)
             self.stats["unschedulable"] += 1
             return False
 
-        decision = await self.client.get_scheduling_decision(pod, nodes)
+        with self.phases.phase("decide"):
+            decision = await self.client.get_scheduling_decision(pod, nodes)
         if decision is None:
             self.stats["unschedulable"] += 1
             return False
@@ -101,9 +107,11 @@ class Scheduler:
         else:
             self.stats["llm_decisions"] += 1
 
-        ok = await asyncio.to_thread(
-            self.binder.bind_pod_to_node, pod.name, pod.namespace, decision.selected_node
-        )
+        with self.phases.phase("bind"):
+            ok = await asyncio.to_thread(
+                self.binder.bind_pod_to_node,
+                pod.name, pod.namespace, decision.selected_node,
+            )
         if not ok:
             self.stats["failed_bindings"] += 1
             logger.error(
@@ -186,4 +194,8 @@ class Scheduler:
         self._stop_event.set()
 
     def get_stats(self) -> dict:
-        return {**self.stats, "client": self.client.get_stats()}
+        return {
+            **self.stats,
+            "client": self.client.get_stats(),
+            "phases": self.phases.snapshot(),
+        }

@@ -463,12 +463,7 @@ class TestSparseGrammar:
     def test_large_vocab_constrained_decision(self):
         """Constrained decoding at a vocab size where dense tables would be
         gigabytes — the real-checkpoint (BPE) regime."""
-        class BigVocabTokenizer(ByteTokenizer):
-            @property
-            def vocab_size(self):
-                return 100_000
-
-        big_tok = BigVocabTokenizer()
+        big_tok = ByteTokenizer(vocab_size=100_000)
         cfg = LlamaConfig(
             name="bigvocab", vocab_size=100_000, d_model=64, n_layers=2,
             n_heads=4, n_kv_heads=2, d_ff=128, max_seq_len=1024,
@@ -494,12 +489,7 @@ class TestSparseGrammar:
     def test_backend_keeps_constraint_for_large_vocab(self):
         from k8s_llm_scheduler_tpu.engine.local import LocalLLMBackend
 
-        class BigVocabTokenizer(ByteTokenizer):
-            @property
-            def vocab_size(self):
-                return 100_000
-
-        big_tok = BigVocabTokenizer()
+        big_tok = ByteTokenizer(vocab_size=100_000)
         cfg = LlamaConfig(
             name="bigvocab2", vocab_size=100_000, d_model=64, n_layers=2,
             n_heads=4, n_kv_heads=2, d_ff=128, max_seq_len=1024,
