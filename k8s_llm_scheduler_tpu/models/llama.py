@@ -147,6 +147,33 @@ def _mlp(lp: Params, cfg: LlamaConfig, x: jax.Array) -> jax.Array:
 
 
 # ------------------------------------------------------------------ prefill
+def prefill_layer(
+    lp: Params,
+    cfg: LlamaConfig,
+    x: jax.Array,  # [B, S, D]
+    positions: jax.Array,  # [B, S]
+    seq_lens: jax.Array,  # [B]
+    inv_freq: jax.Array,
+    attn_fn: Any = None,
+) -> jax.Array:
+    """One transformer layer of full-prompt prefill (shared by
+    forward_prefill and the pipeline-parallel trunk, train/pipeline.py)."""
+    B, S = x.shape[:2]
+    hd = cfg.head_dim
+    attn_impl = attn_fn if attn_fn is not None else causal_prefill_attention
+    h = rms_norm(x, lp["attn_norm"], cfg.rms_eps)
+    q = jnp.einsum("bsd,dh->bsh", h, lp["wq"]).reshape(B, S, cfg.n_heads, hd)
+    k = jnp.einsum("bsd,dh->bsh", h, lp["wk"]).reshape(B, S, cfg.n_kv_heads, hd)
+    v = jnp.einsum("bsd,dh->bsh", h, lp["wv"]).reshape(B, S, cfg.n_kv_heads, hd)
+    q = apply_rope(q, positions, inv_freq)
+    k = apply_rope(k, positions, inv_freq)
+    attn = attn_impl(q, k, v, seq_lens)
+    attn = jnp.einsum("bsh,hd->bsd", attn.reshape(B, S, cfg.n_heads * hd), lp["wo"])
+    x = x + attn
+    x = x + _mlp(lp, cfg, x)
+    return x, (k, v)
+
+
 def forward_prefill(
     params: Params,
     cfg: LlamaConfig,
@@ -169,25 +196,13 @@ def forward_prefill(
     static_argnums).
     """
     B, S = tokens.shape
-    hd = cfg.head_dim
     inv_freq = rope_inv_freq(cfg)
     positions = jnp.broadcast_to(jnp.arange(S), (B, S))
-    attn_fn = attn_impl if attn_impl is not None else causal_prefill_attention
 
     x = params["embed"][tokens]  # [B, S, D]
 
     def body(x, lp):
-        h = rms_norm(x, lp["attn_norm"], cfg.rms_eps)
-        q = jnp.einsum("bsd,dh->bsh", h, lp["wq"]).reshape(B, S, cfg.n_heads, hd)
-        k = jnp.einsum("bsd,dh->bsh", h, lp["wk"]).reshape(B, S, cfg.n_kv_heads, hd)
-        v = jnp.einsum("bsd,dh->bsh", h, lp["wv"]).reshape(B, S, cfg.n_kv_heads, hd)
-        q = apply_rope(q, positions, inv_freq)
-        k = apply_rope(k, positions, inv_freq)
-        attn = attn_fn(q, k, v, seq_lens)
-        attn = jnp.einsum("bsh,hd->bsd", attn.reshape(B, S, cfg.n_heads * hd), lp["wo"])
-        x = x + attn
-        x = x + _mlp(lp, cfg, x)
-        return x, (k, v)
+        return prefill_layer(lp, cfg, x, positions, seq_lens, inv_freq, attn_impl)
 
     x, (k_all, v_all) = jax.lax.scan(body, x, params["layers"])
     logits = _logits(params, cfg, x) if return_logits else None

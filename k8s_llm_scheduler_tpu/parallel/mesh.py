@@ -24,7 +24,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
-AXIS_ORDER = ("dp", "fsdp", "sp", "tp")
+AXIS_ORDER = ("dp", "pp", "fsdp", "sp", "tp")
 
 
 def make_mesh(

@@ -13,10 +13,10 @@ Parallelism mapping (axes from parallel/mesh.py):
     tp    Megatron column/row sharding from parallel/sharding.py
     sp    sequence dimension via ring attention (parallel/ring_attention.py)
 
-pp/ep deliberately absent: layers run under one lax.scan (pipelining would
-fight the scan fusion for no win at decision-model scale) and Llama 3.x is
-dense, so there are no experts to place. Cited capability gap in the
-reference: SURVEY §2.3 — all parallelism happened server-side at HF.
+pp lives in train/pipeline.py (GPipe-style stage pipeline over a pp mesh
+axis; composes with dp). ep is inapplicable: Llama 3.x is dense, there are
+no experts to place. Cited capability gap in the reference: SURVEY §2.3 —
+all parallelism happened server-side at HF.
 """
 
 from __future__ import annotations
