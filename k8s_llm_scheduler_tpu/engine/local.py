@@ -246,6 +246,19 @@ class LocalLLMBackend:
                 waves.append((handle, list(batch)))
             batch.clear()
 
+        def flush_or_hold() -> list[_WorkItem]:
+            """Submit a PARTIAL batch only when the pipeline is empty.
+            While a wave is executing (~150ms+), more of the burst's
+            leaders keep arriving — holding the partial until then turns
+            seven ragged waves into two full ones, and the held items lose
+            no time (the device is busy with the earlier wave anyway)."""
+            if batch and waves:
+                held = list(batch)
+                batch.clear()
+                return held
+            flush()
+            return []
+
         for item in pending:
             if len(item.suffix_ids) > self.engine.prefill_buckets[-1]:
                 # Oversized suffix can never admit (waves are bounded only by
@@ -283,7 +296,7 @@ class LocalLLMBackend:
             batch.append(item)
             if len(batch) >= self.engine.max_slots:
                 flush()
-        flush()
+        rest = flush_or_hold() + rest
         return rest
 
     def _drain_queue(self, pending: list[_WorkItem], block: bool) -> None:
