@@ -7,16 +7,17 @@ LLM makes weight loading a real subsystem (SURVEY §5 checkpoint/resume;
 
 - **Streaming HF import**: `load_hf_checkpoint` walks the model's
   safetensors shard files tensor by tensor. Each per-layer tensor is
-  transposed to this framework's [in, out] einsum layout, written into a
-  preallocated per-parameter numpy buffer (one stacked [L, ...] array per
-  parameter kind), then `device_put` with its mesh sharding. Peak host
-  memory is ONE stacked parameter (~38 GB for the 70B MLP matrix in bf16
-  — large, but ~4x below the full 140 GB checkpoint, and freed as soon as
-  the parameter is placed), never the whole model.
+  transposed to this framework's [in, out] einsum layout and written
+  straight into its stacked parameter's DEVICE buffer — preallocated
+  sharded on the mesh, updated in place via a donated
+  dynamic_update_index_in_dim. Peak host memory is ONE LAYER tensor
+  (~0.5 GB for the 70B MLP matrix in bf16), never a stacked parameter
+  and never the model: HF shards interleave parameter kinds, so
+  accumulating stacked host buffers would approach the full 140 GB.
 - **Direct-to-shard placement**: with a mesh + PartitionSpecs
-  (parallel/sharding.py), each finished parameter is placed via
-  `jax.device_put(x, NamedSharding(mesh, spec))` — XLA slices the host
-  array straight onto the devices; nothing is ever replicated on host.
+  (parallel/sharding.py), layer slices and top-level tensors are placed
+  via `jax.device_put(x, NamedSharding(mesh, spec))` — XLA slices the
+  host array straight onto the devices; nothing is replicated on host.
 - **Native checkpoints**: orbax save/restore of the params pytree for
   fast resume (resharding happens at restore via the same specs).
 
@@ -48,7 +49,7 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh, NamedSharding
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from k8s_llm_scheduler_tpu.models.configs import LlamaConfig
 from k8s_llm_scheduler_tpu.models.llama import Params
@@ -137,10 +138,13 @@ def load_hf_checkpoint(
 ) -> Params:
     """Stream an HF Llama safetensors checkpoint into (sharded) JAX params.
 
-    Walks shard files tensor by tensor; per-layer tensors accumulate into
-    one stacked host buffer per parameter kind, which is placed onto the
-    mesh (NamedSharding from parallel/sharding.param_specs) as soon as its
-    last layer arrives. Host peak = one stacked parameter, not the model.
+    Walks shard files tensor by tensor. Each per-layer tensor is written
+    STRAIGHT into its stacked parameter's device buffer (allocated sharded
+    on the mesh up front; the write is a donated
+    dynamic_update_index_in_dim, so it is in-place) — host peak is ONE
+    LAYER tensor, not a stacked parameter. HF shard files interleave the
+    parameter kinds, so accumulating stacked host buffers per kind would
+    hold nearly the whole model in host RAM at 70B scale (~140 GB).
     """
     from safetensors import safe_open
 
@@ -153,7 +157,19 @@ def load_hf_checkpoint(
             return jax.device_put(host, NamedSharding(mesh, flat_specs[name]))
         return jnp.asarray(host)
 
-    buffers: dict[str, np.ndarray] = {}
+    def alloc(name: str) -> jax.Array:
+        if mesh is not None:
+            return jax.jit(
+                lambda: jnp.zeros(shapes[name], dtype),
+                out_shardings=NamedSharding(mesh, flat_specs[name]),
+            )()
+        return jnp.zeros(shapes[name], dtype)
+
+    set_layer = jax.jit(
+        lambda buf, x, i: jax.lax.dynamic_update_index_in_dim(buf, x, i, 0),
+        donate_argnums=(0,),
+    )
+
     filled: dict[str, int] = {}
     out_flat: dict[str, jax.Array] = {}
 
@@ -175,18 +191,27 @@ def load_hf_checkpoint(
                     tensor = f.get_tensor(hf_name)
                     if transpose:
                         tensor = np.ascontiguousarray(tensor.T)
-                    if name not in buffers:
-                        buffers[name] = np.empty(shapes[name], dtype=tensor.dtype)
-                        filled[name] = 0
                     if tensor.shape != shapes[name][1:]:
                         raise ValueError(
                             f"{hf_name}: shape {tensor.shape} != expected "
                             f"{shapes[name][1:]}"
                         )
-                    buffers[name][layer] = tensor
+                    if name not in out_flat:
+                        out_flat[name] = alloc(name)
+                        filled[name] = 0
+                    host = _cast(tensor, dtype)
+                    if mesh is not None:
+                        spec = flat_specs[name]
+                        slice_spec = P(*spec[1:]) if len(spec) > 1 else P()
+                        dev = jax.device_put(
+                            host, NamedSharding(mesh, slice_spec)
+                        )
+                    else:
+                        dev = jnp.asarray(host)
+                    out_flat[name] = set_layer(
+                        out_flat[name], dev, jnp.int32(layer)
+                    )
                     filled[name] += 1
-                    if filled[name] == cfg.n_layers:
-                        out_flat[name] = place(name, _cast(buffers.pop(name), dtype))
                 elif hf_name in _TOP_MAP:
                     name, transpose = _TOP_MAP[hf_name]
                     if name == "lm_head" and cfg.tie_embeddings:
@@ -204,8 +229,12 @@ def load_hf_checkpoint(
                     logger.warning("skipping unknown tensor %s", hf_name)
 
     missing = set(shapes) - set(out_flat)
-    partial = {n: f"{filled[n]}/{cfg.n_layers}" for n in buffers}
-    if missing:
+    partial = {
+        n: f"{filled[n]}/{cfg.n_layers}"
+        for n in filled
+        if filled[n] < cfg.n_layers
+    }
+    if missing or partial:
         raise ValueError(
             f"checkpoint incomplete: missing {sorted(missing)}"
             + (f"; partial layer stacks {partial}" if partial else "")
