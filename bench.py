@@ -179,20 +179,28 @@ async def bench(args) -> dict:
 
 
 def main() -> None:
+    # Flag defaults are None sentinels so presets only fill flags the user
+    # did NOT pass (an explicit `--pods 64` must survive `--preset burst1000`).
+    defaults = {
+        "pods": 64, "nodes": 32, "shapes": 8, "slots": 16, "model": "bench",
+        "chunk_steps": 24, "max_new_tokens": 72, "temperature": 0.3,
+        "rounds": 3,
+    }
     parser = argparse.ArgumentParser()
-    parser.add_argument("--pods", type=int, default=64)
-    parser.add_argument("--nodes", type=int, default=32)
-    parser.add_argument("--shapes", type=int, default=8)
-    parser.add_argument("--slots", type=int, default=16)
-    parser.add_argument("--model", default="bench")
-    parser.add_argument("--chunk-steps", type=int, default=24)
-    parser.add_argument("--max-new-tokens", type=int, default=72)
-    parser.add_argument("--temperature", type=float, default=0.3)
-    parser.add_argument("--rounds", type=int, default=3)
+    parser.add_argument("--pods", type=int, default=None)
+    parser.add_argument("--nodes", type=int, default=None)
+    parser.add_argument("--shapes", type=int, default=None)
+    parser.add_argument("--slots", type=int, default=None)
+    parser.add_argument("--model", default=None)
+    parser.add_argument("--chunk-steps", type=int, default=None)
+    parser.add_argument("--max-new-tokens", type=int, default=None)
+    parser.add_argument("--temperature", type=float, default=None)
+    parser.add_argument("--rounds", type=int, default=None)
     parser.add_argument("--preset", choices=sorted(PRESETS), default="default")
     args = parser.parse_args()
-    for key, value in PRESETS[args.preset].items():
-        if getattr(args, key) == parser.get_default(key):
+    merged = {**defaults, **PRESETS[args.preset]}
+    for key, value in merged.items():
+        if getattr(args, key) is None:
             setattr(args, key, value)
     if args.rounds < 1:
         parser.error("--rounds must be >= 1")

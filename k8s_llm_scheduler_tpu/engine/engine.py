@@ -159,25 +159,33 @@ def _decode_chunk_impl(
     rng, temperature,
     n_steps: int,      # static
     constrained: bool,  # static
+    paged_attn: str = "gather",  # static: "gather" | "pallas"
 ):
     """`n_steps` decode iterations fused into one program. Emits the sampled
     token per step; finished/exhausted/idle slots emit pad_id and idle.
 
-    Paged-cache traffic is hoisted out of the step loop: own pages gather to
-    a dense buffer ONCE (they are frozen during the chunk — new K/V goes to
-    a small chunk buffer, models/llama.forward_decode_buffered), and the
-    chunk buffer flushes back to pages in ONE scatter at the end. Measured
-    on the bench size class this cut the per-step cost ~2.5x vs scattering/
-    gathering the paged cache every step.
+    Paged-cache traffic is hoisted out of the step loop in one of two ways
+    (the pages are frozen during a chunk — new K/V goes to a small chunk
+    buffer and flushes back to pages in ONE scatter at the end):
+    - "gather": own pages gather to a dense buffer once per chunk, then
+      every step reads the dense buffer (measured ~2.5x over per-step
+      paged scatter/gather on the bench size class);
+    - "pallas": no gather at all — each step's own-token attention streams
+      the pages HBM->VMEM through the hand-tiled kernel
+      (ops/pallas_paged_attention.py), which wins when the gathered
+      working set would be large (long sequences, many slots).
     """
     M, P = page_tables.shape
     ps = k_cache.shape[2]
     n_kv, hd = cfg.n_kv_heads, cfg.head_dim
 
     own_start = pos - prefix_len  # [M] tokens already in own pages
-    # Frozen own-page KV for the whole chunk: [L, M, P*ps, n_kv, hd].
-    k_own = k_cache[:, page_tables].reshape(-1, M, P * ps, n_kv, hd)
-    v_own = v_cache[:, page_tables].reshape(-1, M, P * ps, n_kv, hd)
+    if paged_attn == "pallas":
+        k_own, v_own = k_cache, v_cache  # [L, num_pages, ps, n_kv, hd]
+    else:
+        # Frozen own-page KV for the whole chunk: [L, M, P*ps, n_kv, hd].
+        k_own = k_cache[:, page_tables].reshape(-1, M, P * ps, n_kv, hd)
+        v_own = v_cache[:, page_tables].reshape(-1, M, P * ps, n_kv, hd)
     ck = jnp.zeros((cfg.n_layers, M, n_steps, n_kv, hd), k_cache.dtype)
     cv = jnp.zeros_like(ck)
 
@@ -187,6 +195,8 @@ def _decode_chunk_impl(
         logits, ck, cv = forward_decode_buffered(
             params, cfg, tok, pos, k_own, v_own, own_start,
             ck, cv, tail, prefix_k, prefix_v, prefix_len,
+            page_tables=page_tables,
+            own_impl="pallas" if paged_attn == "pallas" else "dense",
         )
         key, sub = jax.random.split(key)
         if constrained:
@@ -414,6 +424,7 @@ class InferenceEngine:
         temperature: float = 0.3,
         rng_seed: int = 0,
         prefix_chunk: int = 2048,
+        paged_attn: str = "gather",
     ) -> None:
         self.cfg = cfg
         self.params = params
@@ -438,6 +449,11 @@ class InferenceEngine:
         # cascade-attention intermediate at O(prefix_chunk x prefix) instead
         # of O(prefix^2) — a 16k x 48k f32 score block would not fit HBM.
         self.prefix_chunk = int(prefix_chunk)
+        # Chunked-decode own-token attention: "gather" (dense pre-gather per
+        # chunk) or "pallas" (stream pages through the hand-tiled kernel).
+        if paged_attn not in ("gather", "pallas"):
+            raise ValueError(f"paged_attn must be 'gather' or 'pallas', got {paged_attn!r}")
+        self.paged_attn = paged_attn
         self.chunk_steps = int(chunk_steps)
         self.temperature = float(temperature)
         self.max_slots = max_slots
@@ -456,7 +472,7 @@ class InferenceEngine:
         )
         self._chunk = jax.jit(
             _decode_chunk_impl,
-            static_argnums=(1, 20, 21),
+            static_argnums=(1, 20, 21, 22),
             donate_argnums=(2, 3, 8, 9, 10, 11, 12),
         )
         self._wave = jax.jit(_wave_impl, static_argnums=(1, 18, 19, 20, 21))
@@ -694,10 +710,13 @@ class InferenceEngine:
         return self.max_slots - len(self._by_slot)
 
     def max_suffix_tokens(self, max_new_tokens: int) -> int:
-        """Longest admissible prompt/suffix for a given decode budget —
-        bounded by the page-table width and the largest prefill bucket.
-        Callers (engine/local.py) pre-check against this so one oversized
-        request fails alone instead of poisoning its admission batch."""
+        """Longest admissible prompt/suffix for the PAGED (add_requests/
+        step) path — bounded by the page-table width and the largest
+        prefill bucket. The wave path never touches pages, so it is
+        bounded only by prefill_buckets[-1] (what engine/local.py
+        pre-checks); callers of the paged path should pre-check against
+        this so one oversized request fails alone instead of poisoning
+        its admission batch."""
         by_pages = (
             self.kv.max_pages_per_seq * self.kv.page_size - (max_new_tokens + 1)
         )
@@ -978,6 +997,7 @@ class InferenceEngine:
                     jnp.int32(self.tokenizer.eos_id),
                     jnp.int32(self.tokenizer.pad_id),
                     sub, jnp.float32(self.temperature), n, self._constrained,
+                    self.paged_attn,
                 )
                 emissions.append(toks_d)
                 self.stats["chunks"] += 1

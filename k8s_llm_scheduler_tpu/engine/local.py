@@ -366,13 +366,20 @@ class LocalLLMBackend:
             # While the oldest wave executes, keep feeding the pipeline:
             # stragglers arriving now become the NEXT wave, overlapping with
             # this one on device instead of waiting behind a blocking sync.
+            # The wait blocks on the queue (2ms granularity for the
+            # is_ready re-check) rather than busy-polling, so an idle wait
+            # costs no CPU and a straggler wakes the worker immediately.
             while not handle.is_ready() and not self._stopped.is_set():
-                before = len(pending)
+                try:
+                    got = self._queue.get(timeout=0.002)
+                except queue.Empty:
+                    continue
+                if got is None:
+                    self._stopped.set()
+                    break
+                pending.append(got)
                 self._drain_queue(pending, block=False)
-                if len(pending) > before:
-                    pending = self._submit_waves(pending, waves)
-                else:
-                    time.sleep(0.0005)
+                pending = self._submit_waves(pending, waves)
             waves.popleft()
             try:
                 fins = self.engine.harvest_wave(handle)
@@ -407,6 +414,7 @@ def build_local_backend(
     prefill_buckets: tuple[int, ...] = (128, 256, 512, 1024, 2048, 4096, 8192),
     chunk_steps: int = 16,
     prefix_chunk: int = 2048,
+    paged_attn: str = "gather",
     max_new_tokens: int = 200,
     constrained: bool = True,
     rng_seed: int = 0,
@@ -460,7 +468,8 @@ def build_local_backend(
         num_pages=num_pages, page_size=page_size, max_slots=max_slots,
         max_pages_per_seq=max_pages_per_seq,
         prefill_buckets=prefill_buckets, chunk_steps=chunk_steps,
-        prefix_chunk=prefix_chunk, temperature=temperature,
+        prefix_chunk=prefix_chunk, paged_attn=paged_attn,
+        temperature=temperature,
     )
     return LocalLLMBackend(
         engine, tokenizer, max_new_tokens=max_new_tokens, constrained=constrained,

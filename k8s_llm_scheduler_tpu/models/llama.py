@@ -437,9 +437,7 @@ def forward_block_decode(
             k_sfx, v_sfx, jnp.arange(cfg.n_layers),
         ),
     )
-    last_idx = jnp.maximum(blk_len - 1, 0)
-    x_last = jnp.take_along_axis(x, last_idx[:, None, None], axis=1)[:, 0]  # [R, D]
-    return _logits(params, cfg, x_last), gen_k, gen_v
+    return _last_valid_logits(params, cfg, x, blk_len), gen_k, gen_v
 
 
 def forward_decode_buffered(
@@ -447,17 +445,19 @@ def forward_decode_buffered(
     cfg: LlamaConfig,
     tokens: jax.Array,  # [B] int32 — one new token per slot
     positions: jax.Array,  # [B] ABSOLUTE position of that token
-    k_own: jax.Array,  # [L, B, L_own, n_kv, hd] — own pages, pre-gathered,
-    v_own: jax.Array,  #   FROZEN for the whole decode chunk
-    own_lens: jax.Array,  # [B] valid tokens in k_own (chunk-start lengths)
+    k_own: jax.Array,  # own-token KV, layout per own_impl (see below)
+    v_own: jax.Array,
+    own_lens: jax.Array,  # [B] valid own tokens (chunk-start lengths)
     chunk_k: jax.Array,  # [L, B, n_steps, n_kv, hd] — this chunk's new KV
     chunk_v: jax.Array,
     tail_len: jax.Array,  # [B] entries already in the chunk buffer
     prefix_k_all: jax.Array,  # [L, Sp, n_kv, hd] shared dense prefix
     prefix_v_all: jax.Array,
     prefix_len: jax.Array,  # scalar int32
+    page_tables: jax.Array | None = None,  # [B, P] (own_impl="pallas" only)
+    own_impl: str = "dense",  # static: "dense" pre-gathered | "pallas" kernel
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
-    """One decode step against (prefix | frozen own pages | chunk buffer).
+    """One decode step against (prefix | own tokens | chunk buffer).
 
     The fused-chunk fast path (engine/engine.py): per-step K/V appends go to
     a small dense chunk buffer instead of the big paged cache — the paged
@@ -465,7 +465,12 @@ def forward_decode_buffered(
     buffer append; the engine flushes the buffer to pages ONCE per chunk.
     Attention is a 3-part cascade merged exactly via log-sum-exp:
       A. shared dense prefix (read once for the whole batch),
-      B. the slot's own pages as pre-gathered dense KV (frozen this chunk),
+      B. the slot's own tokens — own_impl="dense": pre-gathered dense KV
+         [L, B, L_own, n_kv, hd] frozen for the chunk; own_impl="pallas":
+         the paged caches [L, num_pages, ps, n_kv, hd] + page_tables,
+         streamed page-by-page by the Pallas kernel
+         (ops/pallas_paged_attention.paged_decode_attention_parts) with no
+         materialized gather,
       C. the chunk buffer (this chunk's tokens, including the current one).
     Returns (logits [B,V] f32, chunk_k, chunk_v).
     """
@@ -473,6 +478,10 @@ def forward_decode_buffered(
     hd = cfg.head_dim
     n_steps = chunk_k.shape[2]
     inv_freq = rope_inv_freq(cfg)
+    if own_impl == "pallas":
+        from k8s_llm_scheduler_tpu.ops.pallas_paged_attention import (
+            paged_decode_attention_parts,
+        )
 
     x = params["embed"][tokens]  # [B, D]
     layer_ids = jnp.arange(cfg.n_layers)
@@ -480,9 +489,10 @@ def forward_decode_buffered(
     row = jnp.arange(B)
 
     Sp = prefix_k_all.shape[1]
-    L_own = k_own.shape[2]
     pre_mask = (jnp.arange(Sp) < prefix_len)[None, None, None, :]
-    own_mask = (jnp.arange(L_own)[None, :] < own_lens[:, None])[:, None, None, :]
+    if own_impl == "dense":
+        L_own = k_own.shape[2]
+        own_mask = (jnp.arange(L_own)[None, :] < own_lens[:, None])[:, None, None, :]
     # current token attends itself: include the entry written this step
     tail_mask = (jnp.arange(n_steps)[None, :] <= tail_len[:, None])[:, None, None, :]
 
@@ -500,9 +510,15 @@ def forward_decode_buffered(
         cv = cv.at[idx, row, tail_len].set(v.astype(cv.dtype))
 
         qg = (q.astype(jnp.float32) * hd**-0.5).reshape(B, cfg.n_kv_heads, q_per_kv, hd)
+        if own_impl == "pallas":
+            own_part = paged_decode_attention_parts(
+                q, ko, vo, page_tables, own_lens
+            )
+        else:
+            own_part = attend_part(qg, ko, vo, own_mask, "bkgh,blkh->bkgl")
         parts = [
             attend_part(qg, pk, pv, pre_mask, "bkgh,skh->bkgs"),
-            attend_part(qg, ko, vo, own_mask, "bkgh,blkh->bkgl"),
+            own_part,
             attend_part(qg, ck[idx], cv[idx], tail_mask, "bkgh,blkh->bkgl"),
         ]
         attn = merge_attention_parts(parts).reshape(B, cfg.n_heads * hd).astype(x.dtype)

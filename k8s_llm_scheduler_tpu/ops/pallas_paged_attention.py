@@ -41,13 +41,18 @@ def _decode_kernel(
     q_ref,   # [1, n_heads, hd]
     k_ref,   # [1, page_size, n_kv, hd] — the page this program attends to
     v_ref,   # [1, page_size, n_kv, hd]
-    # blocked output
-    o_ref,   # [1, n_heads, hd]
-    # VMEM scratch (persist across the page dimension of the grid)
-    m_scr,   # [n_heads, 128] f32 running max (all lanes equal)
-    l_scr,   # [n_heads, 128] f32 running sum of exp
-    acc_scr,  # [n_heads, hd] f32 unnormalized output
+    # blocked output(s): normalized [1, n_heads, hd], or with
+    # normalize=False the flash partials (acc, m, l) for cascade merging
+    *out_refs,
+    normalize: bool,
 ):
+    if normalize:
+        (o_ref,), (m_scr, l_scr, acc_scr) = out_refs[:1], out_refs[1:]
+    else:
+        (acc_ref, m_ref, l_ref), (m_scr, l_scr, acc_scr) = (
+            out_refs[:3],
+            out_refs[3:],
+        )
     b = pl.program_id(0)
     p = pl.program_id(1)
     page_size = k_ref.shape[1]
@@ -117,8 +122,13 @@ def _decode_kernel(
 
     @pl.when(p == pl.num_programs(1) - 1)
     def _finish():
-        denom = jnp.maximum(l_scr[:, :1], 1e-30)
-        o_ref[0] = (acc_scr[:] / denom).astype(o_ref.dtype)
+        if normalize:
+            denom = jnp.maximum(l_scr[:, :1], 1e-30)
+            o_ref[0] = (acc_scr[:] / denom).astype(o_ref.dtype)
+        else:
+            acc_ref[0] = acc_scr[:]
+            m_ref[0] = m_scr[:]
+            l_ref[0] = l_scr[:]
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
@@ -143,6 +153,66 @@ def paged_decode_attention_pallas(
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
 
+    out = _paged_call(
+        q, k_cache, v_cache, page_table, seq_lens,
+        normalize=True, interpret=interpret,
+    )
+    return out
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def paged_decode_attention_parts(
+    q: jax.Array,  # [B, n_heads, head_dim]
+    k_cache: jax.Array,  # [num_pages, page_size, n_kv, head_dim]
+    v_cache: jax.Array,
+    page_table: jax.Array,  # [B, max_pages]
+    seq_lens: jax.Array,  # [B] valid tokens in the paged region
+    *,
+    interpret: bool | None = None,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Flash PARTIALS over the paged region: (o, m, l) shaped
+    ([B, n_kv, g, hd], [B, n_kv, g], [B, n_kv, g]) for
+    ops.attention.merge_attention_parts — this is how the kernel joins the
+    cascade (dense shared prefix | paged own tokens | chunk buffer) inside
+    the engine's chunked decode without a materialized page gather.
+    A fully-masked region (seq_len 0) reports m = NEG_INF, weight 0."""
+    B, n_heads, head_dim = q.shape
+    n_kv = k_cache.shape[2]
+    g = n_heads // n_kv
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    acc, m, l = _paged_call(
+        q, k_cache, v_cache, page_table, seq_lens,
+        normalize=False, interpret=interpret,
+    )
+    o = acc.reshape(B, n_kv, g, head_dim)
+    return o, m[:, :, 0].reshape(B, n_kv, g), l[:, :, 0].reshape(B, n_kv, g)
+
+
+def _paged_call(q, k_cache, v_cache, page_table, seq_lens, *, normalize, interpret):
+    B, n_heads, head_dim = q.shape
+    num_pages, page_size, n_kv, _ = k_cache.shape
+    max_pages = page_table.shape[1]
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    if normalize:
+        out_shape = jax.ShapeDtypeStruct((B, n_heads, head_dim), q.dtype)
+        out_specs = pl.BlockSpec(
+            (1, n_heads, head_dim), lambda b, p, pt, sl: (b, 0, 0)
+        )
+    else:
+        out_shape = (
+            jax.ShapeDtypeStruct((B, n_heads, head_dim), jnp.float32),
+            jax.ShapeDtypeStruct((B, n_heads, 128), jnp.float32),
+            jax.ShapeDtypeStruct((B, n_heads, 128), jnp.float32),
+        )
+        out_specs = (
+            pl.BlockSpec((1, n_heads, head_dim), lambda b, p, pt, sl: (b, 0, 0)),
+            pl.BlockSpec((1, n_heads, 128), lambda b, p, pt, sl: (b, 0, 0)),
+            pl.BlockSpec((1, n_heads, 128), lambda b, p, pt, sl: (b, 0, 0)),
+        )
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(B, max_pages),
@@ -159,9 +229,7 @@ def paged_decode_attention_pallas(
                 lambda b, p, pt, sl: (pt[b, p], 0, 0, 0),
             ),
         ],
-        out_specs=pl.BlockSpec(
-            (1, n_heads, head_dim), lambda b, p, pt, sl: (b, 0, 0)
-        ),
+        out_specs=out_specs,
         scratch_shapes=[
             pltpu.VMEM((n_heads, 128), jnp.float32),
             pltpu.VMEM((n_heads, 128), jnp.float32),
@@ -169,8 +237,8 @@ def paged_decode_attention_pallas(
         ],
     )
     return pl.pallas_call(
-        _decode_kernel,
-        out_shape=jax.ShapeDtypeStruct((B, n_heads, head_dim), q.dtype),
+        functools.partial(_decode_kernel, normalize=normalize),
+        out_shape=out_shape,
         grid_spec=grid_spec,
         interpret=interpret,
         compiler_params=pltpu.CompilerParams(
