@@ -141,6 +141,13 @@ async def bench(args) -> dict:
     # Warmup: compiles the prefix-prefill bucket and the wave program.
     await one_round(max(args.shapes, 2), round_id=0, timeout_s=600.0)
 
+    profile_cm = None
+    if getattr(args, "profile_dir", None):
+        from k8s_llm_scheduler_tpu.observability.trace import device_trace
+
+        profile_cm = device_trace(args.profile_dir)
+        profile_cm.__enter__()
+
     # Median of N measured rounds: the tunneled backend's round-trip cost
     # fluctuates by an order of magnitude over minutes (shared service), so
     # a single burst round measures the weather as much as the code.
@@ -152,6 +159,8 @@ async def bench(args) -> dict:
         p99 = values[min(len(values) - 1, int(len(values) * 0.99))]
         total_s = max(values) / 1000.0
         rounds.append((p50, p99, args.pods / total_s, stats))
+    if profile_cm is not None:
+        profile_cm.__exit__(None, None, None)
     backend.close()
 
     rounds.sort(key=lambda t: t[0])
@@ -197,6 +206,11 @@ def main() -> None:
     parser.add_argument("--temperature", type=float, default=None)
     parser.add_argument("--rounds", type=int, default=None)
     parser.add_argument("--preset", choices=sorted(PRESETS), default="default")
+    parser.add_argument(
+        "--profile-dir", default=None,
+        help="capture a jax.profiler device trace of the measured rounds "
+             "(TensorBoard format) into this directory",
+    )
     args = parser.parse_args()
     merged = {**defaults, **PRESETS[args.preset]}
     for key, value in merged.items():

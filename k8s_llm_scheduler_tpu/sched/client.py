@@ -233,4 +233,9 @@ class DecisionClient:
             out["cache"] = self.cache.stats()
         if self.breaker is not None:
             out["circuit_breaker"] = self.breaker.stats()
+        backend_stats = getattr(self.backend, "get_stats", None)
+        if backend_stats is not None:
+            # engine-level counters (waves, prefix hits, decode tokens, ...)
+            # surface through /metrics alongside the scheduling stats
+            out["engine"] = backend_stats()
         return out

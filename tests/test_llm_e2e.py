@@ -175,3 +175,48 @@ class TestShardedBackend:
         finally:
             backend.close()
             cluster.close()
+
+
+class TestGroupSwitching:
+    """Interleaved cluster snapshots force (prefix, grammar) group switches
+    in the wave worker — including with held partial batches in flight."""
+
+    async def test_interleaved_clusters_all_decide(self):
+        cfg = LlamaConfig(
+            name="group-e2e", vocab_size=512, d_model=64, n_layers=2,
+            n_heads=4, n_kv_heads=2, d_ff=128, max_seq_len=4096,
+            rope_theta=10000.0, dtype=jnp.float32, tie_embeddings=True,
+        )
+        backend = build_local_backend(
+            cfg=cfg, max_slots=2, num_pages=128, page_size=64,
+            prefill_buckets=(512, 1024, 2048, 4096),
+            chunk_steps=8, temperature=0.0, max_new_tokens=160,
+        )
+        try:
+            from conftest import make_node, make_pod
+
+            # three DISTINCT snapshots (different node sets -> different
+            # prefixes and grammars)
+            snapshots = [
+                [make_node(f"grp{g}-node-{i}") for i in range(3)]
+                for g in range(3)
+            ]
+            # interleave decisions across groups from concurrent tasks
+            async def decide(g, i):
+                pod = make_pod(name=f"pod-g{g}-{i}", cpu=0.1 * (i + 1))
+                d = await backend.get_scheduling_decision_async(
+                    pod, snapshots[g]
+                )
+                assert d.selected_node.startswith(f"grp{g}-"), (
+                    g, d.selected_node,
+                )
+                return d
+
+            results = await asyncio.gather(
+                *(decide(g, i) for i in range(4) for g in range(3))
+            )
+            assert len(results) == 12
+            stats = backend.get_stats()
+            assert stats["completed"] >= 12
+        finally:
+            backend.close()
