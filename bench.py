@@ -98,17 +98,24 @@ async def bench(args) -> dict:
         synthetic_cluster,
     )
 
+    cfg = build_cfg(args.model)
+    # Size the paged KV pool from the model: a fixed page count that is fine
+    # for the bench-size model is 17 GB at 8B scale. Budget ~1 GB.
+    page_size = 128
+    page_bytes = cfg.n_layers * page_size * cfg.n_kv_heads * cfg.head_dim * 2 * 2
+    num_pages = max(64, min(1024, int(1e9 // page_bytes)))
     backend = build_local_backend(
-        cfg=build_cfg(args.model),
+        cfg=cfg,
         max_slots=args.slots,
-        num_pages=1024,
-        page_size=128,
+        num_pages=num_pages,
+        page_size=page_size,
         # small buckets serve the per-pod suffixes (shared-prefix path);
         # large ones serve the once-per-snapshot cluster-state prefix.
         prefill_buckets=(256, 512, 1024, 2048, 4096, 8192, 16384),
         chunk_steps=args.chunk_steps,
         temperature=args.temperature,
         max_new_tokens=args.max_new_tokens,
+        quantize=getattr(args, "quantize", None),
     )
 
     async def one_round(n_pods: int, round_id: int, timeout_s: float):
@@ -205,6 +212,7 @@ def main() -> None:
     parser.add_argument("--max-new-tokens", type=int, default=None)
     parser.add_argument("--temperature", type=float, default=None)
     parser.add_argument("--rounds", type=int, default=None)
+    parser.add_argument("--quantize", choices=["int8"], default=None)
     parser.add_argument("--preset", choices=sorted(PRESETS), default="default")
     parser.add_argument(
         "--profile-dir", default=None,

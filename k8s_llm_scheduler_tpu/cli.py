@@ -58,6 +58,7 @@ def _build_stack(cfg: Config, cluster) -> Any:
             constrained=cfg.get("llm.constrained_json"),
             checkpoint_path=cfg.get("llm.checkpoint_path"),
             tokenizer_path=cfg.get("llm.tokenizer_path"),
+            quantize=cfg.get("llm.quantization"),
         )
 
     cache = (

@@ -629,7 +629,11 @@ class InferenceEngine:
                 "prefix of %d tokens exceeds model max_seq_len %d; "
                 "quality may degrade", n, self.cfg.max_seq_len,
             )
-        if n > self.prefill_buckets[-1]:
+        # Chunked path whenever the prompt exceeds one chunk — not just the
+        # largest bucket: single-shot prefill materializes O(S^2 x heads)
+        # attention scores (8.6 GB at 8B scale for an 8k prompt), while the
+        # chunked cascade is bounded at O(prefix_chunk x S).
+        if n > min(self.prefix_chunk, self.prefill_buckets[-1]):
             k, v = self._prefill_prefix_chunked(prompt_ids)
             pfx = _PrefixKV(k=k, v=v, length=n, token_ids=key)
         else:

@@ -415,6 +415,7 @@ def build_local_backend(
     chunk_steps: int = 16,
     prefix_chunk: int = 2048,
     paged_attn: str = "gather",
+    quantize: str | None = None,
     max_new_tokens: int = 200,
     constrained: bool = True,
     rng_seed: int = 0,
@@ -429,6 +430,8 @@ def build_local_backend(
     multi = mesh.devices.size > 1
     if multi:
         validate_specs_divisibility(cfg, mesh)
+    if quantize is not None and quantize != "int8":
+        raise ValueError(f"unknown quantization {quantize!r} (only 'int8')")
     if checkpoint_path:
         from k8s_llm_scheduler_tpu.models.loader import (
             load_hf_checkpoint,
@@ -437,13 +440,34 @@ def build_local_backend(
 
         ckpt = Path(checkpoint_path)
         if list(ckpt.glob("*.safetensors")):
-            params = load_hf_checkpoint(ckpt, cfg, mesh if multi else None)
+            # quantizes per stacked parameter as it completes — the bf16
+            # form of at most one parameter is ever resident
+            params = load_hf_checkpoint(
+                ckpt, cfg, mesh if multi else None, quantize=quantize
+            )
         else:
             params = restore_checkpoint(ckpt, cfg, mesh if multi else None)
+            if quantize is not None:
+                from k8s_llm_scheduler_tpu.models.quant import quantize_params
+
+                params = quantize_params(params)
+    elif multi:
+        # shard bf16 first (param_specs match the unquantized tree), then
+        # quantize in place — per-device bf16 residency is already 1/N
+        params = init_params(jax.random.PRNGKey(rng_seed), cfg)
+        params = shard_params(params, mesh, param_specs(cfg), cfg)
+        if quantize is not None:
+            from k8s_llm_scheduler_tpu.models.quant import quantize_params
+
+            params = quantize_params(params)
+    elif quantize == "int8":
+        # single device: init + quantize HOST-SIDE, ship only int8 — even
+        # per-weight bf16 device transients overflow a 16 GB chip at 8B
+        from k8s_llm_scheduler_tpu.models.quant import init_params_int8_host
+
+        params = init_params_int8_host(rng_seed, cfg)
     else:
         params = init_params(jax.random.PRNGKey(rng_seed), cfg)
-        if multi:
-            params = shard_params(params, mesh, param_specs(cfg), cfg)
     if tokenizer_path is None and checkpoint_path:
         if (Path(checkpoint_path) / "tokenizer.json").exists():
             tokenizer_path = checkpoint_path

@@ -88,19 +88,35 @@ def apply_rope(
 
 
 # --------------------------------------------------------------------- init
-def init_params(rng: jax.Array, cfg: LlamaConfig) -> Params:
-    """Random-init params with stacked layers (leading axis = n_layers)."""
+def init_params(
+    rng: jax.Array, cfg: LlamaConfig, quantize: str | None = None
+) -> Params:
+    """Random-init params with stacked layers (leading axis = n_layers).
+
+    quantize="int8" converts each dense weight AS IT IS CREATED
+    (models/quant.py, donated) — peak device memory is the int8 model plus
+    one bf16 weight, which is what lets an 8B config random-init on a
+    single 16 GB chip.
+    """
     hd = cfg.head_dim
     keys = jax.random.split(rng, 10)
+    if quantize is not None:
+        from k8s_llm_scheduler_tpu.models.quant import _quantize_weight_donated
+
+        if quantize != "int8":
+            raise ValueError(f"unknown quantization {quantize!r}")
 
     def norm_init(shape):
         return jnp.ones(shape, dtype=cfg.dtype)
 
     def dense_init(key, shape, in_dim):
         scale = in_dim**-0.5
-        return (jax.random.normal(key, shape, dtype=jnp.float32) * scale).astype(
+        w = (jax.random.normal(key, shape, dtype=jnp.float32) * scale).astype(
             cfg.dtype
         )
+        if quantize is not None and len(shape) == 3:  # stacked layer weights
+            return _quantize_weight_donated(w)
+        return w
 
     L, D, F = cfg.n_layers, cfg.d_model, cfg.d_ff
     params: Params = {
@@ -130,20 +146,39 @@ def _layer_slice(layers: Params, i: int | jax.Array) -> Params:
 
 
 def _logits(params: Params, cfg: LlamaConfig, x: jax.Array) -> jax.Array:
+    """LM head with f32 ACCUMULATION but native-dtype operands: casting a
+    128k-vocab embedding to f32 materializes a multi-GB transient per model
+    call (it OOMed the 8B single-chip config); preferred_element_type gets
+    the f32 accumulate without the f32 copy."""
     x = rms_norm(x, params["final_norm"], cfg.rms_eps)
     if cfg.tie_embeddings:
-        return jnp.einsum("...d,vd->...v", x.astype(jnp.float32),
-                          params["embed"].astype(jnp.float32))
-    return jnp.einsum("...d,dv->...v", x.astype(jnp.float32),
-                      params["lm_head"].astype(jnp.float32))
+        return jnp.einsum(
+            "...d,vd->...v", x, params["embed"],
+            preferred_element_type=jnp.float32,
+        )
+    return jnp.einsum(
+        "...d,dv->...v", x, params["lm_head"],
+        preferred_element_type=jnp.float32,
+    )
+
+
+def _dense(x: jax.Array, w, eq: str) -> jax.Array:
+    """Dense projection dispatching on weight form: plain array, or the
+    int8 weight-only pair {"q", "scale"} (models/quant.py) — the dequant
+    convert fuses into the matmul, the per-channel scale broadcasts over
+    the output axis."""
+    if isinstance(w, dict):
+        out = jnp.einsum(eq, x, w["q"].astype(x.dtype))
+        return (out.astype(jnp.float32) * w["scale"]).astype(x.dtype)
+    return jnp.einsum(eq, x, w)
 
 
 def _mlp(lp: Params, cfg: LlamaConfig, x: jax.Array) -> jax.Array:
     h = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
-    gate = jnp.einsum("...d,df->...f", h, lp["w_gate"])
-    up = jnp.einsum("...d,df->...f", h, lp["w_up"])
+    gate = _dense(h, lp["w_gate"], "...d,df->...f")
+    up = _dense(h, lp["w_up"], "...d,df->...f")
     fused = jax.nn.silu(gate.astype(jnp.float32)).astype(x.dtype) * up
-    return jnp.einsum("...f,fd->...d", fused, lp["w_down"])
+    return _dense(fused, lp["w_down"], "...f,fd->...d")
 
 
 # ------------------------------------------------------------------ prefill
@@ -162,13 +197,13 @@ def prefill_layer(
     hd = cfg.head_dim
     attn_impl = attn_fn if attn_fn is not None else causal_prefill_attention
     h = rms_norm(x, lp["attn_norm"], cfg.rms_eps)
-    q = jnp.einsum("bsd,dh->bsh", h, lp["wq"]).reshape(B, S, cfg.n_heads, hd)
-    k = jnp.einsum("bsd,dh->bsh", h, lp["wk"]).reshape(B, S, cfg.n_kv_heads, hd)
-    v = jnp.einsum("bsd,dh->bsh", h, lp["wv"]).reshape(B, S, cfg.n_kv_heads, hd)
+    q = _dense(h, lp["wq"], "bsd,dh->bsh").reshape(B, S, cfg.n_heads, hd)
+    k = _dense(h, lp["wk"], "bsd,dh->bsh").reshape(B, S, cfg.n_kv_heads, hd)
+    v = _dense(h, lp["wv"], "bsd,dh->bsh").reshape(B, S, cfg.n_kv_heads, hd)
     q = apply_rope(q, positions, inv_freq)
     k = apply_rope(k, positions, inv_freq)
     attn = attn_impl(q, k, v, seq_lens)
-    attn = jnp.einsum("bsh,hd->bsd", attn.reshape(B, S, cfg.n_heads * hd), lp["wo"])
+    attn = _dense(attn.reshape(B, S, cfg.n_heads * hd), lp["wo"], "bsh,hd->bsd")
     x = x + attn
     x = x + _mlp(lp, cfg, x)
     return x, (k, v)
@@ -229,13 +264,13 @@ def _suffix_layer(
     B, S = x.shape[:2]
     hd = cfg.head_dim
     h = rms_norm(x, lp["attn_norm"], cfg.rms_eps)
-    q = jnp.einsum("bsd,dh->bsh", h, lp["wq"]).reshape(B, S, cfg.n_heads, hd)
-    k = jnp.einsum("bsd,dh->bsh", h, lp["wk"]).reshape(B, S, cfg.n_kv_heads, hd)
-    v = jnp.einsum("bsd,dh->bsh", h, lp["wv"]).reshape(B, S, cfg.n_kv_heads, hd)
+    q = _dense(h, lp["wq"], "bsd,dh->bsh").reshape(B, S, cfg.n_heads, hd)
+    k = _dense(h, lp["wk"], "bsd,dh->bsh").reshape(B, S, cfg.n_kv_heads, hd)
+    v = _dense(h, lp["wv"], "bsd,dh->bsh").reshape(B, S, cfg.n_kv_heads, hd)
     q = apply_rope(q, positions, inv_freq)
     k = apply_rope(k, positions, inv_freq)
     attn = chunk_attention_with_prefix(q, k, v, suffix_lens, pk, pv, prefix_len)
-    attn = jnp.einsum("bsh,hd->bsd", attn.reshape(B, S, cfg.n_heads * hd), lp["wo"])
+    attn = _dense(attn.reshape(B, S, cfg.n_heads * hd), lp["wo"], "bsh,hd->bsd")
     x = x + attn
     x = x + _mlp(lp, cfg, x)
     return x, k, v
@@ -400,9 +435,9 @@ def forward_block_decode(
         x, gk, gv = carry
         lp, pk, pv, ks, vs, idx = xs
         h = rms_norm(x, lp["attn_norm"], cfg.rms_eps)
-        q = jnp.einsum("bfd,dh->bfh", h, lp["wq"]).reshape(R, F, cfg.n_heads, hd)
-        k = jnp.einsum("bfd,dh->bfh", h, lp["wk"]).reshape(R, F, cfg.n_kv_heads, hd)
-        v = jnp.einsum("bfd,dh->bfh", h, lp["wv"]).reshape(R, F, cfg.n_kv_heads, hd)
+        q = _dense(h, lp["wq"], "bfd,dh->bfh").reshape(R, F, cfg.n_heads, hd)
+        k = _dense(h, lp["wk"], "bfd,dh->bfh").reshape(R, F, cfg.n_kv_heads, hd)
+        v = _dense(h, lp["wv"], "bfd,dh->bfh").reshape(R, F, cfg.n_kv_heads, hd)
         q = apply_rope(q, positions, inv_freq)
         k = apply_rope(k, positions, inv_freq)
 
@@ -420,7 +455,7 @@ def forward_block_decode(
         ]
         attn = merge_attention_parts(parts)  # [R, n_kv, g, F, hd]
         attn = jnp.moveaxis(attn, 3, 1).reshape(R, F, cfg.n_heads * hd)
-        attn = jnp.einsum("bfh,hd->bfd", attn.astype(x.dtype), lp["wo"])
+        attn = _dense(attn.astype(x.dtype), lp["wo"], "bfh,hd->bfd")
         x = x + attn
         x = x + _mlp(lp, cfg, x)
         # write the block's K/V AFTER attention (in-block attention came
@@ -500,9 +535,9 @@ def forward_decode_buffered(
         x, ck, cv = carry
         lp, pk, pv, ko, vo, idx = xs
         h = rms_norm(x, lp["attn_norm"], cfg.rms_eps)
-        q = jnp.einsum("bd,dh->bh", h, lp["wq"]).reshape(B, cfg.n_heads, hd)
-        k = jnp.einsum("bd,dh->bh", h, lp["wk"]).reshape(B, cfg.n_kv_heads, hd)
-        v = jnp.einsum("bd,dh->bh", h, lp["wv"]).reshape(B, cfg.n_kv_heads, hd)
+        q = _dense(h, lp["wq"], "bd,dh->bh").reshape(B, cfg.n_heads, hd)
+        k = _dense(h, lp["wk"], "bd,dh->bh").reshape(B, cfg.n_kv_heads, hd)
+        v = _dense(h, lp["wv"], "bd,dh->bh").reshape(B, cfg.n_kv_heads, hd)
         q = apply_rope(q, positions, inv_freq)
         k = apply_rope(k, positions, inv_freq)
 
@@ -522,7 +557,7 @@ def forward_decode_buffered(
             attend_part(qg, ck[idx], cv[idx], tail_mask, "bkgh,blkh->bkgl"),
         ]
         attn = merge_attention_parts(parts).reshape(B, cfg.n_heads * hd).astype(x.dtype)
-        attn = jnp.einsum("bh,hd->bd", attn, lp["wo"])
+        attn = _dense(attn, lp["wo"], "bh,hd->bd")
         x = x + attn
         x = x + _mlp(lp, cfg, x)
         return (x, ck, cv), None
@@ -585,9 +620,9 @@ def forward_decode(
         x, kc, vc = carry
         lp, idx = lp_with_idx
         h = rms_norm(x, lp["attn_norm"], cfg.rms_eps)
-        q = jnp.einsum("bd,dh->bh", h, lp["wq"]).reshape(B, cfg.n_heads, hd)
-        k = jnp.einsum("bd,dh->bh", h, lp["wk"]).reshape(B, cfg.n_kv_heads, hd)
-        v = jnp.einsum("bd,dh->bh", h, lp["wv"]).reshape(B, cfg.n_kv_heads, hd)
+        q = _dense(h, lp["wq"], "bd,dh->bh").reshape(B, cfg.n_heads, hd)
+        k = _dense(h, lp["wk"], "bd,dh->bh").reshape(B, cfg.n_kv_heads, hd)
+        v = _dense(h, lp["wv"], "bd,dh->bh").reshape(B, cfg.n_kv_heads, hd)
         q = apply_rope(q, positions, inv_freq)
         k = apply_rope(k, positions, inv_freq)
 
@@ -601,7 +636,7 @@ def forward_decode(
         vc = jax.lax.dynamic_update_index_in_dim(vc, layer_v, idx, axis=0)
 
         attn = attn_kernel(q, layer_k, layer_v, page_tables, seq_lens)
-        attn = jnp.einsum("bh,hd->bd", attn.reshape(B, cfg.n_heads * hd), lp["wo"])
+        attn = _dense(attn.reshape(B, cfg.n_heads * hd), lp["wo"], "bh,hd->bd")
         x = x + attn
         x = x + _mlp(lp, cfg, x)
         return (x, kc, vc), None

@@ -57,6 +57,11 @@ from k8s_llm_scheduler_tpu.parallel.sharding import param_specs
 
 logger = logging.getLogger(__name__)
 
+from k8s_llm_scheduler_tpu.models.quant import (  # noqa: E402
+    QUANT_KEYS as _QUANT_KEYS,
+    _quantize_weight_donated as _quantize_donated,
+)
+
 _LAYER_RE = re.compile(r"^model\.layers\.(\d+)\.(.+)\.weight$")
 
 # HF suffix -> (param key under "layers", transpose?)
@@ -135,6 +140,7 @@ def load_hf_checkpoint(
     tp: str | None = "tp",
     fsdp: str | None = None,
     dtype: Any | None = None,
+    quantize: str | None = None,
 ) -> Params:
     """Stream an HF Llama safetensors checkpoint into (sharded) JAX params.
 
@@ -212,6 +218,12 @@ def load_hf_checkpoint(
                         out_flat[name], dev, jnp.int32(layer)
                     )
                     filled[name] += 1
+                    if (
+                        quantize == "int8"
+                        and filled[name] == cfg.n_layers
+                        and name.split(".", 1)[1] in _QUANT_KEYS
+                    ):
+                        out_flat[name] = _quantize_donated(out_flat[name])
                 elif hf_name in _TOP_MAP:
                     name, transpose = _TOP_MAP[hf_name]
                     if name == "lm_head" and cfg.tie_embeddings:

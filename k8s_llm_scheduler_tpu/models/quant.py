@@ -1,0 +1,139 @@
+"""Weight-only int8 quantization for the decision model.
+
+Why this exists (BASELINE.md config 2): Llama-3.1-8B in bf16 is ~16 GB of
+weights — it does not fit a single v5e chip (16 GB HBM) next to KV buffers
+and activations. Per-channel int8 weight storage halves that to ~8 GB and
+halves the weight HBM traffic that dominates decode steps; activations stay
+bf16 and the dequantize is fused by XLA into the matmul (the int8->bf16
+convert happens in registers feeding the MXU, never materialized).
+
+Scheme: symmetric per-output-channel. For a stacked weight [L, in, out]:
+    scale[L, 1, out] = max(|w|) over `in` / 127
+    q[L, in, out]    = round(w / scale)  (int8)
+Matmuls compute einsum(x, q.astype(x.dtype)) * scale — the scale multiply
+broadcasts over the output channel, preserving each channel's dynamic
+range (the reason per-channel beats per-tensor at zero runtime cost).
+
+The quantized pytree swaps each dense weight leaf for {"q": int8,
+"scale": f32}; models/llama._dense dispatches on that shape, so every
+forward path (prefill, suffix cascade, waves, chunked decode) runs
+quantized without further changes. Training stays full-precision —
+quantize at serving time (build_local_backend(quantize="int8")).
+
+The reference has no quantization surface at all — its model capacity
+decisions live server-side behind the HF API (SURVEY §2.3).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+Params = dict[str, Any]
+
+# Dense weight leaves that quantize (stacked [L, in, out] / [L, out, in]).
+QUANT_KEYS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+
+
+def quantize_weight(w: jax.Array) -> dict[str, jax.Array]:
+    """Symmetric per-output-channel int8 quantization of [..., in, out]."""
+    wf = w.astype(jnp.float32)
+    scale = jnp.max(jnp.abs(wf), axis=-2, keepdims=True) / 127.0  # [..., 1, out]
+    scale = jnp.maximum(scale, 1e-12)
+    q = jnp.clip(jnp.round(wf / scale), -127, 127).astype(jnp.int8)
+    return {"q": q, "scale": scale.astype(jnp.float32)}
+
+
+# Donated form: the bf16 source buffer is released as its int8+scale pair
+# materializes, so quantizing never needs source + result resident together.
+_quantize_weight_donated = jax.jit(quantize_weight, donate_argnums=(0,))
+
+
+def quantize_params(params: Params) -> Params:
+    """Quantize the transformer's dense weights; embed/norms stay as-is.
+
+    Weight-by-weight with donation — peak device memory is the int8 model
+    plus ONE bf16 weight, not bf16 + int8 models side by side (8B bf16
+    alone is ~16 GB, the whole v5e; a tree-level jit would OOM before the
+    first int8 byte lands). Sharded inputs produce identically-sharded
+    outputs (elementwise + per-channel reduction — GSPMD keeps layouts).
+    """
+    out = dict(params)
+    layers = dict(params["layers"])
+    for key in QUANT_KEYS:
+        layers[key] = _quantize_weight_donated(layers[key])
+    out["layers"] = layers
+    return out
+
+
+def init_params_int8_host(rng_seed: int, cfg) -> Params:
+    """Random-init an int8-quantized model HOST-SIDE, shipping only int8.
+
+    The device-side quantized init (init_params(quantize="int8")) still
+    materializes each bf16 weight on device before donating it away — a
+    ~3.8 GB transient for the 8B stacked MLP matrix, which together with
+    the accumulating int8 model overflows a 16 GB chip. Here the random
+    weights never exist in bf16 on device at all: numpy generates and
+    quantizes per channel on host, and only the int8 tensors (+ f32
+    scales + bf16 embed/norms) transfer. Peak device memory = the final
+    quantized model.
+    """
+    import numpy as np
+
+    import jax.numpy as _jnp
+
+    rng = np.random.default_rng(rng_seed)
+    hd = cfg.head_dim
+    L, D, F = cfg.n_layers, cfg.d_model, cfg.d_ff
+
+    def host_quant(shape, in_dim):
+        scale_init = in_dim**-0.5
+        out = {}
+        # per-layer to bound host transients at one layer slice
+        qs, ss = [], []
+        for _ in range(shape[0]):
+            w = rng.standard_normal(shape[1:], dtype=np.float32) * scale_init
+            s = np.maximum(np.abs(w).max(axis=-2, keepdims=True) / 127.0, 1e-12)
+            qs.append(np.clip(np.round(w / s), -127, 127).astype(np.int8))
+            ss.append(s.astype(np.float32))
+        out["q"] = jnp.asarray(np.stack(qs))
+        out["scale"] = jnp.asarray(np.stack(ss))
+        return out
+
+    def norm(shape):
+        return jnp.ones(shape, dtype=cfg.dtype)
+
+    embed = (rng.standard_normal((cfg.vocab_size, D), dtype=np.float32) * 0.02)
+    params: Params = {
+        "embed": jnp.asarray(embed).astype(cfg.dtype),
+        "final_norm": norm((D,)),
+        "layers": {
+            "attn_norm": norm((L, D)),
+            "wq": host_quant((L, D, cfg.n_heads * hd), D),
+            "wk": host_quant((L, D, cfg.n_kv_heads * hd), D),
+            "wv": host_quant((L, D, cfg.n_kv_heads * hd), D),
+            "wo": host_quant((L, cfg.n_heads * hd, D), cfg.n_heads * hd),
+            "mlp_norm": norm((L, D)),
+            "w_gate": host_quant((L, D, F), D),
+            "w_up": host_quant((L, D, F), D),
+            "w_down": host_quant((L, F, D), F),
+        },
+    }
+    if not cfg.tie_embeddings:
+        lm = rng.standard_normal((D, cfg.vocab_size), dtype=np.float32) * D**-0.5
+        params["lm_head"] = jnp.asarray(lm).astype(cfg.dtype)
+    del _jnp
+    return params
+
+
+def is_quantized(w) -> bool:
+    return isinstance(w, dict) and "q" in w and "scale" in w
+
+
+def param_bytes(params: Params) -> int:
+    return sum(
+        leaf.size * leaf.dtype.itemsize
+        for leaf in jax.tree_util.tree_leaves(params)
+    )
