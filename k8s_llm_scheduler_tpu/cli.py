@@ -223,6 +223,25 @@ def cmd_verify(args: argparse.Namespace, cfg: Config) -> int:
     return 0
 
 
+def cmd_train(args: argparse.Namespace, cfg: Config) -> int:
+    """Fine-tune the decision model on heuristic-teacher pairs and save an
+    orbax checkpoint servable via llm.checkpoint_path (train/distill.py)."""
+    from k8s_llm_scheduler_tpu.models.configs import get_config
+    from k8s_llm_scheduler_tpu.train.distill import train_and_save
+
+    model_cfg = get_config(args.model or cfg.get("llm.model"))
+    loss = train_and_save(
+        model_cfg,
+        out_dir=args.out,
+        steps=args.steps,
+        batch_size=args.batch_size,
+        seq_len=args.seq_len,
+        mesh_axes=cfg.get("llm.mesh"),
+    )
+    print(f"final loss {loss:.4f}; checkpoint at {args.out}")
+    return 0
+
+
 def cmd_bench(args: argparse.Namespace, cfg: Config) -> int:
     import subprocess
 
@@ -248,6 +267,15 @@ def main(argv: list[str] | None = None) -> int:
     p_bench = sub.add_parser("bench", help="run the benchmark")
     p_bench.add_argument("bench_args", nargs="*")
 
+    p_train = sub.add_parser(
+        "train", help="fine-tune the decision model; save an orbax checkpoint"
+    )
+    p_train.add_argument("--out", required=True, help="checkpoint output dir")
+    p_train.add_argument("--steps", type=int, default=20)
+    p_train.add_argument("--batch-size", type=int, default=4)
+    p_train.add_argument("--seq-len", type=int, default=1024)
+    p_train.add_argument("--model", default=None, help="config name (default: llm.model)")
+
     args = parser.parse_args(argv)
     cfg = load_config(yaml_path=args.config)
     setup_logging(
@@ -260,6 +288,7 @@ def main(argv: list[str] | None = None) -> int:
         "demo": cmd_demo,
         "verify": cmd_verify,
         "bench": cmd_bench,
+        "train": cmd_train,
     }
     return handlers[args.command](args, cfg)
 

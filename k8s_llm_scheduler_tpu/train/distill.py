@@ -1,0 +1,127 @@
+"""Fine-tune the decision model on scheduler decisions (self-distillation).
+
+The reference consumes a frozen hosted model; there is no way to improve
+its decisions from operational experience. This module closes that loop:
+generate (cluster-state prompt -> decision JSON) pairs — from the heuristic
+fallback scorer as a bootstrap teacher, or in production from logged
+(prompt, accepted placement) records — and train the in-tree decision
+model on them with the sharded train step (train/train_step.py), saving an
+orbax checkpoint that `build_local_backend(checkpoint_path=...)` serves
+directly.
+
+Surface: `python -m k8s_llm_scheduler_tpu.cli train --steps N --out DIR`.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from typing import Iterator
+
+import numpy as np
+
+from k8s_llm_scheduler_tpu.core.fallback import fallback_decision
+from k8s_llm_scheduler_tpu.core.prompt import PromptEngine
+from k8s_llm_scheduler_tpu.engine.tokenizer import Tokenizer
+
+logger = logging.getLogger(__name__)
+
+
+def teacher_pairs(
+    tokenizer: Tokenizer,
+    n_nodes: int = 5,
+    seed: int = 0,
+) -> Iterator[list[int]]:
+    """Endless (prompt + decision) token sequences from the heuristic
+    teacher over randomized synthetic clusters.
+
+    Each sample is the full chat prompt (system + cluster state + pod)
+    followed by the teacher's decision JSON and EOS — exactly the
+    sequence the serving path decodes, so the causal-LM loss teaches the
+    decision distribution in place.
+    """
+    from k8s_llm_scheduler_tpu.cluster.interface import raw_pod_to_spec
+    from k8s_llm_scheduler_tpu.testing import pod_burst, synthetic_cluster
+
+    rng = np.random.default_rng(seed)
+    pe = PromptEngine()
+    while True:
+        cluster = synthetic_cluster(int(rng.integers(2, n_nodes + 1)))
+        nodes = cluster.get_node_metrics()
+        cluster.close()
+        pods = [raw_pod_to_spec(p) for p in pod_burst(4, distinct_shapes=4)]
+        for pod in pods:
+            decision = fallback_decision(
+                nodes, reason="teacher", strategy="resource_balanced", pod=pod
+            )
+            if decision is None:
+                continue
+            cluster_part, pod_part = pe.split_prompt(pod, nodes)
+            prompt = tokenizer.chat_prompt(
+                pe.system_prompt, cluster_part + pod_part
+            )
+            answer = json.dumps(
+                {
+                    "selected_node": decision.selected_node,
+                    "confidence": round(decision.confidence, 2),
+                    "reasoning": "resource balanced",
+                }
+            )
+            yield prompt + tokenizer.encode(answer) + [tokenizer.eos_id]
+
+
+def make_batches(
+    tokenizer: Tokenizer,
+    batch_size: int,
+    seq_len: int,
+    n_nodes: int = 5,
+    seed: int = 0,
+) -> Iterator[tuple[np.ndarray, np.ndarray]]:
+    """Batched, padded (tokens, seq_lens) for the train step."""
+    pairs = teacher_pairs(tokenizer, n_nodes=n_nodes, seed=seed)
+    pad = tokenizer.pad_id
+    while True:
+        tokens = np.full((batch_size, seq_len), pad, dtype=np.int32)
+        lens = np.zeros(batch_size, dtype=np.int32)
+        for b in range(batch_size):
+            ids = next(pairs)[:seq_len]
+            tokens[b, : len(ids)] = ids
+            lens[b] = len(ids)
+        yield tokens, lens
+
+
+def train_and_save(
+    cfg,
+    out_dir: str,
+    steps: int = 20,
+    batch_size: int = 4,
+    seq_len: int = 1024,
+    mesh_axes: dict[str, int] | None = None,
+    log_every: int = 5,
+    seed: int = 0,
+) -> float:
+    """Run `steps` of causal-LM fine-tuning on teacher pairs and save an
+    orbax checkpoint servable via checkpoint_path. Returns the final loss."""
+    import jax
+
+    from k8s_llm_scheduler_tpu.engine.tokenizer import ByteTokenizer
+    from k8s_llm_scheduler_tpu.models.loader import save_checkpoint
+    from k8s_llm_scheduler_tpu.parallel.mesh import mesh_from_config
+    from k8s_llm_scheduler_tpu.train.train_step import make_train_step
+
+    tokenizer = ByteTokenizer(vocab_size=max(512, cfg.vocab_size))
+    mesh = mesh_from_config(mesh_axes)
+    init_fn, step_fn = make_train_step(cfg, mesh)
+    state = init_fn(jax.random.PRNGKey(seed))
+    batches = make_batches(tokenizer, batch_size, seq_len, seed=seed)
+    loss = float("nan")
+    for step in range(1, steps + 1):
+        tokens, lens = next(batches)
+        tokens, lens = step_fn.place_batch(tokens, lens)
+        state, loss_arr = step_fn(state, tokens, lens)
+        if step % log_every == 0 or step == steps:
+            loss = float(loss_arr)
+            logger.info("step %d/%d loss %.4f", step, steps, loss)
+    save_checkpoint(out_dir, state.params)
+    logger.info("checkpoint saved to %s", out_dir)
+    return loss
