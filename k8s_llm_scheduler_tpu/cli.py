@@ -229,7 +229,7 @@ def cmd_train(args: argparse.Namespace, cfg: Config) -> int:
     from k8s_llm_scheduler_tpu.models.configs import get_config
     from k8s_llm_scheduler_tpu.train.distill import train_and_save
 
-    model_cfg = get_config(args.model or cfg.get("llm.model"))
+    model_cfg = get_config(args.model)
     loss = train_and_save(
         model_cfg,
         out_dir=args.out,
@@ -273,8 +273,12 @@ def main(argv: list[str] | None = None) -> int:
     p_train.add_argument("--out", required=True, help="checkpoint output dir")
     p_train.add_argument("--steps", type=int, default=20)
     p_train.add_argument("--batch-size", type=int, default=4)
-    p_train.add_argument("--seq-len", type=int, default=1024)
-    p_train.add_argument("--model", default=None, help="config name (default: llm.model)")
+    p_train.add_argument("--seq-len", type=int, default=2048)
+    p_train.add_argument(
+        "--model", default="tiny",
+        help="config name (default tiny — bootstrap distillation targets "
+             "small configs; pass llm.model sizes deliberately)",
+    )
 
     args = parser.parse_args(argv)
     cfg = load_config(yaml_path=args.config)

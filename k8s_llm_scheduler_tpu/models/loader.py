@@ -282,12 +282,14 @@ def _cast(host: np.ndarray, dtype) -> np.ndarray:
 
 # ------------------------------------------------------------------ orbax
 def save_checkpoint(path: str | Path, params: Params) -> None:
-    """Write a native orbax checkpoint of the params pytree."""
+    """Write a native orbax checkpoint of the params pytree (overwrites —
+    orbax's default refuses an existing dir AFTER a full training run has
+    already been spent)."""
     import orbax.checkpoint as ocp
 
     path = Path(path).resolve()
     with ocp.StandardCheckpointer() as ckptr:
-        ckptr.save(path, params)
+        ckptr.save(path, params, force=True)
         ckptr.wait_until_finished()
 
 

@@ -40,6 +40,8 @@ def teacher_pairs(
     sequence the serving path decodes, so the causal-LM loss teaches the
     decision distribution in place.
     """
+    import dataclasses
+
     from k8s_llm_scheduler_tpu.cluster.interface import raw_pod_to_spec
     from k8s_llm_scheduler_tpu.testing import pod_burst, synthetic_cluster
 
@@ -47,9 +49,28 @@ def teacher_pairs(
     pe = PromptEngine()
     while True:
         cluster = synthetic_cluster(int(rng.integers(2, n_nodes + 1)))
-        nodes = cluster.get_node_metrics()
+        base_nodes = cluster.get_node_metrics()
         cluster.close()
+        # synthetic_cluster's load levels are deterministic — without this
+        # perturbation the corpus collapses to ~16 distinct sequences
+        nodes = [
+            dataclasses.replace(
+                n,
+                cpu_usage_percent=float(rng.uniform(5, 95)),
+                memory_usage_percent=float(rng.uniform(5, 95)),
+                pod_count=int(rng.integers(0, n.max_pods // 2)),
+            )
+            for n in base_nodes
+        ]
         pods = [raw_pod_to_spec(p) for p in pod_burst(4, distinct_shapes=4)]
+        pods = [
+            dataclasses.replace(
+                p,
+                cpu_request=round(float(rng.uniform(0.05, 2.0)), 3),
+                memory_request=round(float(rng.uniform(0.064, 2.0)), 3),
+            )
+            for p in pods
+        ]
         for pod in pods:
             decision = fallback_decision(
                 nodes, reason="teacher", strategy="resource_balanced", pod=pod
@@ -80,11 +101,23 @@ def make_batches(
     """Batched, padded (tokens, seq_lens) for the train step."""
     pairs = teacher_pairs(tokenizer, n_nodes=n_nodes, seed=seed)
     pad = tokenizer.pad_id
+    warned = False
     while True:
         tokens = np.full((batch_size, seq_len), pad, dtype=np.int32)
         lens = np.zeros(batch_size, dtype=np.int32)
         for b in range(batch_size):
-            ids = next(pairs)[:seq_len]
+            ids = next(pairs)
+            if len(ids) > seq_len:
+                # Truncate from the LEFT: the decision JSON lives at the
+                # tail, and a distillation batch that drops the answer
+                # trains on prompt text only (silently learning nothing).
+                ids = ids[-seq_len:]
+                if not warned:
+                    logger.warning(
+                        "teacher pairs exceed seq_len=%d; truncating prompt "
+                        "context from the left (answers preserved)", seq_len,
+                    )
+                    warned = True
             tokens[b, : len(ids)] = ids
             lens[b] = len(ids)
         yield tokens, lens
@@ -95,7 +128,7 @@ def train_and_save(
     out_dir: str,
     steps: int = 20,
     batch_size: int = 4,
-    seq_len: int = 1024,
+    seq_len: int = 2048,
     mesh_axes: dict[str, int] | None = None,
     log_every: int = 5,
     seed: int = 0,
