@@ -1,39 +1,44 @@
-"""The TPU inference engine: shared-prefix cascade prefill + fused decode.
+"""The TPU inference engine: cascade prefill, decision waves, fused decode.
 
 This is the component that replaces the reference's entire
 HuggingFaceClient network path (reference scheduler.py:418-433): where the
 reference ships a prompt over HTTPS and waits for a remote 70B, this engine
 runs the model in-process on the TPU mesh.
 
-Design, driven by XLA semantics and the measured dispatch economics
-(~80-90 ms per blocking host<->device round trip over the axon tunnel;
-enqueueing is cheap — only SYNCS are expensive):
+Design, driven by XLA semantics (everything hot is one traced program with
+static shapes) and dispatch economics (host<->device round trips dominate
+small-model latency; only syncs are expensive, enqueues pipeline):
 
 - **Shared-prefix (cascade) prefill**: a scheduling burst shares its
   (system + cluster-state) prompt prefix (core/prompt.py; the reference's
   own cache key proves the equivalence class, scheduler.py:265-271). The
-  prefix prefills ONCE per cluster snapshot into a dense KV buffer; each
-  pod then prefills only its ~100-token suffix against that buffer
-  (models/llama.forward_prefill_suffix).
-- **Batched one-dispatch admission**: a whole burst's suffixes prefill,
-  scatter their KV into pages, and sample their first constrained token in
-  ONE jit'd program. No per-request host syncs.
-- **Fused + chained decode chunks**: decode runs `chunk_steps` tokens per
-  program inside lax.scan — sampling, grammar masking, DFA transitions, KV
-  scatters all on device — and `step(chunks=n)` chains n such programs
-  back-to-back with a SINGLE host sync at the end. A ~60-token constrained
-  JSON decision costs one sync total.
+  prefix prefills ONCE per cluster snapshot into a dense KV buffer —
+  blockwise for long prompts (_prefill_prefix_chunked: a 256-node cluster
+  is ~41k byte-tokens and O(S^2) single-shot scores would not fit HBM) —
+  and every request decodes against it.
+- **Decision waves** (submit_wave/harvest_wave — the burst fast path): one
+  fused device program runs the whole batch's suffix prefill, first-token
+  sample, and GRAMMAR-ACCELERATED BLOCK DECODE to completion. Each block
+  iteration samples one token from carried logits, expands the forced run
+  that follows via DFA table gathers (free: no model call for the JSON
+  skeleton), and runs one block-wide mini-prefill — a ~70-token decision
+  costs ~9 model calls. Waves never touch the paged cache, pipeline
+  back-to-back (round-trip latency overlaps), and start their D2H copy at
+  submit so harvest finds results on host.
+- **Sparse grammar tables** (engine/constrained.py SparseDFATables):
+  per-state allowed-token lists, sampled in K-space — vocab-independent,
+  so constrained decoding works unchanged at 128k-vocab BPE tokenizers.
+  Changing the node-name set never recompiles.
+- **Chunked continuous batching** (add_requests/step — the general path):
+  a fixed decode batch of `max_slots` slots over the paged KV cache;
+  `chunk_steps` fused decode steps per program, chained with one host sync;
+  own-token attention either pre-gathers pages to a dense buffer or
+  streams them through the Pallas kernel (paged_attn="pallas"). Requests
+  join/leave between chunks; shapes never depend on how many are in
+  flight.
 - **Device-resident decode state**: current token / position / active /
   DFA state / remaining-budget live on device between dispatches; the
-  budget makes max_new_tokens a device-side guarantee (no page overruns
-  from speculative chaining).
-- **Slot-based continuous batching**: a fixed decode batch of `max_slots`
-  slots over the paged KV cache (own pages hold only suffix + generated
-  tokens; the prefix is the dense shared buffer). Requests join/leave
-  between chunks; shapes never depend on how many are in flight.
-- **Grammar-constrained sampling** (engine/constrained.py): DFA tables ride
-  along as fixed-capacity device arrays; changing the allowed node-name set
-  never recompiles.
+  budget makes max_new_tokens a device-side guarantee.
 """
 
 from __future__ import annotations
