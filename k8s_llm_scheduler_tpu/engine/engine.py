@@ -639,8 +639,12 @@ class InferenceEngine:
         # largest bucket: single-shot prefill materializes O(S^2 x heads)
         # attention scores (8.6 GB at 8B scale for an 8k prompt), while the
         # chunked cascade is bounded at O(prefix_chunk x S).
+        prefilled = n
         if n > min(self.prefix_chunk, self.prefill_buckets[-1]):
-            k, v = self._prefill_prefix_chunked(prompt_ids)
+            seed = self._best_lcp_seed(key)
+            k, v = self._prefill_prefix_chunked(prompt_ids, seed=seed)
+            if seed is not None:
+                prefilled = n - seed[2]  # reused tokens were not re-prefilled
             pfx = _PrefixKV(k=k, v=v, length=n, token_ids=key)
         else:
             bucket = self._bucket_for(n)
@@ -656,10 +660,42 @@ class InferenceEngine:
             self._prefix_cache.popitem(last=False)
         self._prefix = pfx
         self.stats["prefix_prefills"] += 1
-        self.stats["prefill_tokens"] += n
+        self.stats["prefill_tokens"] += prefilled
+
+    def _best_lcp_seed(
+        self, key: tuple[int, ...]
+    ) -> tuple[jax.Array, jax.Array, int] | None:
+        """Find the cached prefix sharing the longest common token prefix
+        with `key`, rounded down to whole chunks.
+
+        Cluster snapshots drift incrementally (a pod count here, a usage
+        figure there), and causal attention makes the KV of every token
+        BEFORE the first changed token bit-identical — so a new snapshot's
+        prefix re-prefills only its changed tail. The prompt renders nodes
+        in stable sorted order (core/prompt.py) precisely so this prefix
+        stays long under drift."""
+        chunk = min(self.prefix_chunk, self.prefill_buckets[-1])
+        key_arr = np.asarray(key, dtype=np.int64)
+        best: _PrefixKV | None = None
+        best_reuse = 0
+        for old_key, pfx in self._prefix_cache.items():
+            m = min(len(old_key), len(key))
+            if m < chunk:
+                continue
+            old_arr = np.asarray(old_key[:m], dtype=np.int64)
+            mismatch = np.nonzero(old_arr != key_arr[:m])[0]
+            lcp = int(mismatch[0]) if mismatch.size else m
+            reuse = (lcp // chunk) * chunk
+            if reuse > best_reuse:
+                best_reuse, best = reuse, pfx
+        if best is None or best_reuse < chunk:
+            return None
+        return best.k, best.v, best_reuse
 
     def _prefill_prefix_chunked(
-        self, prompt_ids: list[int]
+        self,
+        prompt_ids: list[int],
+        seed: tuple[jax.Array, jax.Array, int] | None = None,
     ) -> tuple[jax.Array, jax.Array]:
         """Blockwise prefill for prefixes beyond the largest bucket.
 
@@ -668,8 +704,13 @@ class InferenceEngine:
         same cascade attention the per-pod suffixes use), then appends its
         KV into the growing buffer. Memory stays O(chunk x prefix) per
         layer instead of O(prefix^2), which is what makes the 256-node /
-        40k-token cluster prompt feasible on one chip. Returns (k, v) of
-        shape [L, cap, n_kv, hd] where cap rounds up to a chunk multiple.
+        40k-token cluster prompt feasible on one chip.
+
+        `seed` = (k, v, reuse_len) from _best_lcp_seed: the first reuse_len
+        tokens' KV copies from the cached buffer and prefill starts there —
+        incremental prefix caching for drifting cluster snapshots.
+
+        Returns (k, v) of shape [L, cap, n_kv, hd], cap a chunk multiple.
         """
         chunk = min(self.prefix_chunk, self.prefill_buckets[-1])
         n = len(prompt_ids)
@@ -681,7 +722,23 @@ class InferenceEngine:
         )
         v_buf = jnp.zeros_like(k_buf)
         done = 0
-        for start in range(0, n, chunk):
+        if seed is not None:
+            seed_k, seed_v, reuse = seed
+            k_buf = jax.lax.dynamic_update_slice_in_dim(
+                k_buf,
+                jax.lax.slice_in_dim(seed_k, 0, reuse, axis=1).astype(k_buf.dtype),
+                0, axis=1,
+            )
+            v_buf = jax.lax.dynamic_update_slice_in_dim(
+                v_buf,
+                jax.lax.slice_in_dim(seed_v, 0, reuse, axis=1).astype(v_buf.dtype),
+                0, axis=1,
+            )
+            done = reuse
+            self.stats["prefix_reused_tokens"] = (
+                self.stats.get("prefix_reused_tokens", 0) + reuse
+            )
+        for start in range(done, n, chunk):
             piece = prompt_ids[start : start + chunk]
             m = len(piece)
             tokens = np.full((1, chunk), pad, dtype=np.int32)

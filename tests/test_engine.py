@@ -510,3 +510,77 @@ class TestSparseGrammar:
             assert decision.selected_node in ("node-x", "node-y")
         finally:
             backend.close()
+
+
+class TestIncrementalPrefix:
+    """LCP-seeded chunked prefill == fresh full prefill, exactly."""
+
+    def _engine(self):
+        params = init_params(jax.random.PRNGKey(0), ENGINE_CFG)
+        return InferenceEngine(
+            params, ENGINE_CFG, TOK,
+            num_pages=64, page_size=64, max_slots=2, max_pages_per_seq=16,
+            prefill_buckets=(64, 128), chunk_steps=4, temperature=0.0,
+            prefix_chunk=64,
+        )
+
+    def test_tail_change_reuses_and_matches(self):
+        rng = np.random.default_rng(0)
+        base = [int(t) for t in rng.integers(1, 256, size=300)]
+        drifted = list(base)
+        drifted[280] = (drifted[280] % 255) + 1  # change near the tail
+
+        warm = self._engine()
+        warm.set_prefix(base)
+        warm.set_prefix(drifted)
+        assert warm.stats.get("prefix_reused_tokens", 0) >= 256  # 4 chunks
+
+        fresh = self._engine()
+        fresh.set_prefix(drifted)
+        np.testing.assert_allclose(
+            np.asarray(warm._prefix.k[:, :300]),
+            np.asarray(fresh._prefix.k[:, :300]),
+            rtol=1e-6, atol=1e-6,
+        )
+        # decisions against the incremental prefix match the fresh one
+        suffix = TOK.chat_prompt("sys", "after drift")
+        a = warm.decide_wave([suffix], max_new_tokens=8)[0]
+        b = fresh.decide_wave([suffix], max_new_tokens=8)[0]
+        assert a.token_ids == b.token_ids
+
+    def test_early_change_falls_back_to_full_prefill(self):
+        rng = np.random.default_rng(1)
+        base = [int(t) for t in rng.integers(1, 256, size=300)]
+        drifted = list(base)
+        drifted[3] = (drifted[3] % 255) + 1  # change before the first chunk
+
+        warm = self._engine()
+        warm.set_prefix(base)
+        before = warm.stats.get("prefix_reused_tokens", 0)
+        warm.set_prefix(drifted)
+        assert warm.stats.get("prefix_reused_tokens", 0) == before
+
+        fresh = self._engine()
+        fresh.set_prefix(drifted)
+        np.testing.assert_allclose(
+            np.asarray(warm._prefix.k[:, :300]),
+            np.asarray(fresh._prefix.k[:, :300]),
+            rtol=1e-6, atol=1e-6,
+        )
+
+    def test_extension_reuses_whole_old_prefix(self):
+        rng = np.random.default_rng(2)
+        base = [int(t) for t in rng.integers(1, 256, size=192)]  # 3 chunks
+        extended = base + [int(t) for t in rng.integers(1, 256, size=100)]
+
+        warm = self._engine()
+        warm.set_prefix(base)
+        warm.set_prefix(extended)
+        assert warm.stats.get("prefix_reused_tokens", 0) >= 192
+        fresh = self._engine()
+        fresh.set_prefix(extended)
+        np.testing.assert_allclose(
+            np.asarray(warm._prefix.k[:, :292]),
+            np.asarray(fresh._prefix.k[:, :292]),
+            rtol=1e-6, atol=1e-6,
+        )
