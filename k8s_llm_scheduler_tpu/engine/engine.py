@@ -666,29 +666,31 @@ class InferenceEngine:
         self, key: tuple[int, ...]
     ) -> tuple[jax.Array, jax.Array, int] | None:
         """Find the cached prefix sharing the longest common token prefix
-        with `key`, rounded down to whole chunks.
+        with `key`.
 
         Cluster snapshots drift incrementally (a pod count here, a usage
         figure there), and causal attention makes the KV of every token
         BEFORE the first changed token bit-identical — so a new snapshot's
         prefix re-prefills only its changed tail. The prompt renders nodes
-        in stable sorted order (core/prompt.py) precisely so this prefix
-        stays long under drift."""
+        in a stable order (core/prompt.py) precisely so this prefix stays
+        long under drift. The reuse length is the exact LCP (the resume
+        loop prefills from any offset); seeding is skipped below a small
+        threshold where a fresh prefill is just as cheap."""
         chunk = min(self.prefix_chunk, self.prefill_buckets[-1])
+        threshold = max(chunk // 2, 64)
         key_arr = np.asarray(key, dtype=np.int64)
         best: _PrefixKV | None = None
         best_reuse = 0
         for old_key, pfx in self._prefix_cache.items():
             m = min(len(old_key), len(key))
-            if m < chunk:
+            if m < threshold:
                 continue
             old_arr = np.asarray(old_key[:m], dtype=np.int64)
             mismatch = np.nonzero(old_arr != key_arr[:m])[0]
             lcp = int(mismatch[0]) if mismatch.size else m
-            reuse = (lcp // chunk) * chunk
-            if reuse > best_reuse:
-                best_reuse, best = reuse, pfx
-        if best is None or best_reuse < chunk:
+            if lcp > best_reuse:
+                best_reuse, best = lcp, pfx
+        if best is None or best_reuse < threshold:
             return None
         return best.k, best.v, best_reuse
 
@@ -715,13 +717,20 @@ class InferenceEngine:
         chunk = min(self.prefix_chunk, self.prefill_buckets[-1])
         n = len(prompt_ids)
         cap = -(-n // chunk) * chunk
+        done = 0 if seed is None else seed[2]
+        if done % chunk:
+            # Resume writes are chunk-wide from an UNALIGNED start: the last
+            # write spans up to done + k*chunk > n. Without this extra chunk
+            # of headroom, dynamic_update_slice CLAMPS the out-of-bounds
+            # start and silently overwrites good copied KV with the write's
+            # padding garbage.
+            cap += chunk
         pad = self.tokenizer.pad_id
         k_buf = jnp.zeros(
             (self.cfg.n_layers, cap, self.cfg.n_kv_heads, self.cfg.head_dim),
             dtype=self.cfg.dtype,
         )
         v_buf = jnp.zeros_like(k_buf)
-        done = 0
         if seed is not None:
             seed_k, seed_v, reuse = seed
             k_buf = jax.lax.dynamic_update_slice_in_dim(
@@ -734,7 +743,6 @@ class InferenceEngine:
                 jax.lax.slice_in_dim(seed_v, 0, reuse, axis=1).astype(v_buf.dtype),
                 0, axis=1,
             )
-            done = reuse
             self.stats["prefix_reused_tokens"] = (
                 self.stats.get("prefix_reused_tokens", 0) + reuse
             )

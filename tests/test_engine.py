@@ -533,14 +533,16 @@ class TestIncrementalPrefix:
         warm = self._engine()
         warm.set_prefix(base)
         warm.set_prefix(drifted)
-        assert warm.stats.get("prefix_reused_tokens", 0) >= 256  # 4 chunks
+        assert warm.stats.get("prefix_reused_tokens", 0) >= 280  # exact LCP
 
         fresh = self._engine()
         fresh.set_prefix(drifted)
+        # resume chunks are unaligned vs a fresh prefill, so f32 reduction
+        # splits differ — equivalence is to accumulation tolerance
         np.testing.assert_allclose(
             np.asarray(warm._prefix.k[:, :300]),
             np.asarray(fresh._prefix.k[:, :300]),
-            rtol=1e-6, atol=1e-6,
+            rtol=1e-4, atol=1e-4,
         )
         # decisions against the incremental prefix match the fresh one
         suffix = TOK.chat_prompt("sys", "after drift")
@@ -582,5 +584,5 @@ class TestIncrementalPrefix:
         np.testing.assert_allclose(
             np.asarray(warm._prefix.k[:, :292]),
             np.asarray(fresh._prefix.k[:, :292]),
-            rtol=1e-6, atol=1e-6,
+            rtol=1e-4, atol=1e-4,
         )
