@@ -421,12 +421,16 @@ def build_local_backend(
     rng_seed: int = 0,
     checkpoint_path: str | None = None,
     tokenizer_path: str | None = None,
+    devices: Sequence[Any] | None = None,
 ) -> LocalLLMBackend:
     """Construct the full local stack: params (from an HF safetensors or
     orbax checkpoint when checkpoint_path is set, random-init otherwise —
-    models/loader.py), mesh sharding, engine, backend."""
+    models/loader.py), mesh sharding, engine, backend.
+
+    `devices` overrides the mesh's device pool (default: jax.devices()) —
+    used by the driver dryrun to target the virtual CPU mesh explicitly."""
     cfg = cfg or get_config(model)
-    mesh = mesh_from_config(mesh_axes)
+    mesh = mesh_from_config(mesh_axes, devices=devices)
     multi = mesh.devices.size > 1
     if multi:
         validate_specs_divisibility(cfg, mesh)

@@ -57,11 +57,14 @@ def make_mesh(
     return Mesh(grid, names)
 
 
-def mesh_from_config(mesh_cfg: Mapping[str, int] | None) -> Mesh:
+def mesh_from_config(
+    mesh_cfg: Mapping[str, int] | None,
+    devices: Sequence[jax.Device] | None = None,
+) -> Mesh:
     """Mesh from the `llm.mesh` config block; defaults to all of one axis."""
     if not mesh_cfg:
-        return make_mesh({"dp": 1})
-    return make_mesh(mesh_cfg)
+        return make_mesh({"dp": 1}, devices=devices)
+    return make_mesh(mesh_cfg, devices=devices)
 
 
 def axis_size(mesh: Mesh, name: str) -> int:
