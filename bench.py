@@ -1,8 +1,9 @@
-"""Benchmark: p50 scheduling-decision latency on a pod burst (BASELINE metric).
+"""Benchmark suite: decision latency, burst throughput, long-context prefill,
+and model-level MFU/throughput (BASELINE metrics).
 
 Drives the COMPLETE stack — FakeCluster snapshot -> prompt -> in-tree JAX
 Llama with grammar-constrained fused decode -> validation -> bind — on the
-real TPU chip, and reports the p50 per-pod decision latency for a burst.
+real TPU chip.
 
 The reference publishes no numbers (BASELINE.md: "not published"); its
 operating point is a remote HF chat_completion per pod with a 60s timeout
@@ -10,8 +11,17 @@ operating point is a remote HF chat_completion per pod with a 60s timeout
 north-star target is p50 < 200 ms on a burst, zero external API calls —
 vs_baseline here is target_ms / measured_p50 (>1.0 beats the target).
 
-Usage: python bench.py [--pods N] [--nodes N] [--shapes N] [--model NAME]
-Prints exactly one JSON line on stdout.
+Default run (`python bench.py`) executes the SUITE: every BASELINE preset
+(default, burst1000, longctx) plus model-throughput microbenches (prefill
+tok/s, decode tok/s, MFU) for the bench-size model and a 1B-scale model.
+One JSON line per preset is printed as it completes; the LAST line is the
+headline default-preset result with the whole suite folded into `extra`.
+
+Usage:
+    python bench.py                          # full suite
+    python bench.py --preset burst1000       # one preset, one line
+    python bench.py --preset throughput --model llama-3.1-8b-instruct \
+        --quantize int8                      # model microbench only
 """
 
 from __future__ import annotations
@@ -26,9 +36,18 @@ from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent))
 
-import jax.numpy as jnp
-
 TARGET_P50_MS = 200.0
+
+# Peak dense bf16 TFLOP/s by device_kind (public spec sheets). Used for MFU;
+# overridable with --peak-tflops for unlisted hardware.
+PEAK_BF16_TFLOPS = {
+    "TPU v4": 275.0,
+    "TPU v5 lite": 197.0,
+    "TPU v5e": 197.0,
+    "TPU v5p": 459.0,
+    "TPU v6 lite": 918.0,
+    "TPU v6e": 918.0,
+}
 
 
 def build_cfg(name: str):
@@ -45,6 +64,41 @@ def build_cfg(name: str):
             tie_embeddings=True,
         )
     return get_config(name)
+
+
+# ----------------------------------------------------------- FLOP accounting
+def matmul_flops_per_token(cfg) -> float:
+    """Dense matmul FLOPs for one token's forward pass (2*MACs)."""
+    d, hd = cfg.d_model, cfg.head_dim
+    attn_proj = d * cfg.n_heads * hd + 2 * d * cfg.n_kv_heads * hd + cfg.n_heads * hd * d
+    mlp = 3 * d * cfg.d_ff
+    lm_head = d * cfg.vocab_size
+    return 2.0 * (cfg.n_layers * (attn_proj + mlp) + lm_head)
+
+
+def attn_flops_per_token(cfg, ctx: float) -> float:
+    """Attention score+value FLOPs for one token attending to `ctx` keys."""
+    return 4.0 * cfg.n_layers * cfg.n_heads * cfg.head_dim * ctx
+
+
+def param_count(cfg) -> int:
+    d, hd = cfg.d_model, cfg.head_dim
+    per_layer = (
+        d * cfg.n_heads * hd + 2 * d * cfg.n_kv_heads * hd
+        + cfg.n_heads * hd * d + 3 * d * cfg.d_ff + 2 * d  # norms
+    )
+    embed = cfg.vocab_size * d
+    head = 0 if cfg.tie_embeddings else cfg.vocab_size * d
+    return int(cfg.n_layers * per_layer + embed + head + d)
+
+
+def detect_peak_tflops(override: float | None) -> tuple[float | None, str]:
+    import jax
+
+    kind = jax.devices()[0].device_kind
+    if override is not None:
+        return override, kind
+    return PEAK_BF16_TFLOPS.get(kind), kind
 
 
 # BASELINE.md burst configs (reference publishes no numbers; these mirror the
@@ -86,17 +140,8 @@ async def run_burst(scheduler, cluster, pods, timeout_s: float) -> dict[str, flo
         cluster.bind_pod_to_node = orig_bind
 
 
-async def bench(args) -> dict:
-    from k8s_llm_scheduler_tpu.core.breaker import CircuitBreaker
-    from k8s_llm_scheduler_tpu.core.cache import DecisionCache
+def build_backend(args):
     from k8s_llm_scheduler_tpu.engine.local import build_local_backend
-    from k8s_llm_scheduler_tpu.sched.client import DecisionClient
-    from k8s_llm_scheduler_tpu.sched.loop import Scheduler
-    from k8s_llm_scheduler_tpu.testing import (
-        SCHEDULER_NAME,
-        pod_burst,
-        synthetic_cluster,
-    )
 
     cfg = build_cfg(args.model)
     # Size the paged KV pool from the model: a fixed page count that is fine
@@ -104,7 +149,7 @@ async def bench(args) -> dict:
     page_size = 128
     page_bytes = cfg.n_layers * page_size * cfg.n_kv_heads * cfg.head_dim * 2 * 2
     num_pages = max(64, min(1024, int(1e9 // page_bytes)))
-    backend = build_local_backend(
+    return build_local_backend(
         cfg=cfg,
         max_slots=args.slots,
         num_pages=num_pages,
@@ -118,7 +163,23 @@ async def bench(args) -> dict:
         quantize=getattr(args, "quantize", None),
     )
 
-    async def one_round(n_pods: int, round_id: int, timeout_s: float):
+
+async def bench_preset(args, backend=None) -> dict:
+    from k8s_llm_scheduler_tpu.core.breaker import CircuitBreaker
+    from k8s_llm_scheduler_tpu.core.cache import DecisionCache
+    from k8s_llm_scheduler_tpu.sched.client import DecisionClient
+    from k8s_llm_scheduler_tpu.sched.loop import Scheduler
+    from k8s_llm_scheduler_tpu.testing import (
+        SCHEDULER_NAME,
+        pod_burst,
+        synthetic_cluster,
+    )
+
+    own_backend = backend is None
+    if own_backend:
+        backend = build_backend(args)
+
+    async def one_round(n_pods: int, round_id: str, timeout_s: float):
         cluster = synthetic_cluster(args.nodes)
         client = DecisionClient(
             backend,
@@ -136,7 +197,7 @@ async def bench(args) -> dict:
         # distinct names per round so bind bookkeeping stays unambiguous
         import dataclasses as _dc
 
-        pods = [_dc.replace(p, name=f"r{round_id}-{p.name}") for p in pods]
+        pods = [_dc.replace(p, name=f"{round_id}-{p.name}") for p in pods]
         try:
             latencies = await run_burst(scheduler, cluster, pods, timeout_s)
         finally:
@@ -145,8 +206,11 @@ async def bench(args) -> dict:
             await asyncio.wait_for(task, timeout=30)
         return latencies, scheduler.get_stats()
 
-    # Warmup: compiles the prefix-prefill bucket and the wave program.
-    await one_round(max(args.shapes, 2), round_id=0, timeout_s=600.0)
+    # Warmup at FULL burst size: compiles every program geometry the measured
+    # rounds hit (prefix bucket for this node count, this grammar's wave
+    # n_iters bucket) AND absorbs the first-full-round host-side overhead
+    # (round-1 p50 ran ~40 ms hotter when warmup used fewer pods).
+    await one_round(args.pods, round_id=f"{args.preset}-w", timeout_s=600.0)
 
     profile_cm = None
     if getattr(args, "profile_dir", None):
@@ -160,7 +224,9 @@ async def bench(args) -> dict:
     # a single burst round measures the weather as much as the code.
     rounds = []
     for r in range(args.rounds):
-        latencies, stats = await one_round(args.pods, round_id=r + 1, timeout_s=600.0)
+        latencies, stats = await one_round(
+            args.pods, round_id=f"{args.preset}-{r + 1}", timeout_s=600.0
+        )
         values = sorted(latencies.values())
         p50 = statistics.median(values)
         p99 = values[min(len(values) - 1, int(len(values) * 0.99))]
@@ -168,10 +234,12 @@ async def bench(args) -> dict:
         rounds.append((p50, p99, args.pods / total_s, stats))
     if profile_cm is not None:
         profile_cm.__exit__(None, None, None)
-    backend.close()
+    if own_backend:
+        backend.close()
 
     rounds.sort(key=lambda t: t[0])
     p50, p99, pods_per_sec, stats = rounds[len(rounds) // 2]
+    decide = stats["phases"]["decide"]
     return {
         "metric": "p50_decision_latency_ms",
         "value": round(p50, 2),
@@ -183,6 +251,10 @@ async def bench(args) -> dict:
             "nodes": args.nodes,
             "shapes": args.shapes,
             "pods_per_sec": round(pods_per_sec, 2),
+            # per-decision wall time inside the loop (excludes burst queue
+            # wait) — semantically the reference's own latency metric
+            # (reference scheduler.py:420 running avg of LLM call wall time)
+            "decide_avg_ms": round(decide["avg_ms"], 2),
             "round_p50s_ms": [round(r[0], 2) for r in rounds],
             "llm_decisions": stats["llm_decisions"],
             "cache_decisions": stats["cache_decisions"],
@@ -194,14 +266,190 @@ async def bench(args) -> dict:
     }
 
 
+# ------------------------------------------------------- model throughput/MFU
+def _synthetic_text(seed: int, n_tokens: int) -> str:
+    """Deterministic ASCII filler, distinct per seed from the first byte
+    (so prefix prefills never LCP-seed off each other)."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    body = rng.integers(ord("a"), ord("z") + 1, size=n_tokens - 8, dtype=np.uint8)
+    return f"[seed {seed}]" + bytes(body).decode("ascii")
+
+
+def model_throughput(model: str, quantize: str | None, peak_override: float | None) -> dict:
+    """Engine-level microbench: prefill tok/s, pipelined decision-wave decode
+    tok/s + decisions/s, and MFU against the chip's peak bf16 FLOP/s.
+
+    Bypasses the scheduler loop: this measures the MODEL path (the thing that
+    scales with model size), not cache hits or asyncio. Random-init weights,
+    byte tokenizer — tokenization does not change the math.
+    """
+    import jax
+    import numpy as np
+
+    from k8s_llm_scheduler_tpu.engine.constrained import build_decision_dfa
+    from k8s_llm_scheduler_tpu.engine.engine import InferenceEngine
+    from k8s_llm_scheduler_tpu.engine.tokenizer import ByteTokenizer
+    from k8s_llm_scheduler_tpu.models.llama import init_params
+
+    cfg = build_cfg(model)
+    tok = ByteTokenizer(vocab_size=max(512, cfg.vocab_size))
+    peak_tflops, device_kind = detect_peak_tflops(peak_override)
+
+    if quantize == "int8":
+        from k8s_llm_scheduler_tpu.models.quant import init_params_int8_host
+
+        params = init_params_int8_host(0, cfg)
+    else:
+        params = init_params(jax.random.PRNGKey(0), cfg)
+
+    prefill_n = 4000
+    eng = InferenceEngine(
+        params, cfg, tok,
+        num_pages=64, page_size=128, max_slots=16, max_pages_per_seq=16,
+        prefill_buckets=(512, 4096), chunk_steps=8, prefix_chunk=4096,
+        temperature=0.0,
+    )
+
+    # Tiny jitted probe: device_get of one element forces the whole queued
+    # program chain to complete WITHOUT fetching the multi-GB KV over the
+    # tunnel (on this backend block_until_ready acknowledges dispatch, not
+    # completion, and a full device_get pays tunnel bandwidth).
+    probe = jax.jit(lambda a: a[0, :1, 0, 0])
+
+    def sync_prefix():
+        jax.device_get(probe(eng._prefix.k))
+
+    # --- prefill: K back-to-back 4000-token single-shot prefills (bucket
+    # 4096), one sync at the end — amortizes the ~100 ms tunnel round trip.
+    n_prefills = 8
+    eng.set_prefix(tok.encode(_synthetic_text(1, prefill_n)))  # compiles
+    sync_prefix()  # also compiles the probe
+    t0 = time.perf_counter()
+    for i in range(n_prefills):
+        eng.set_prefix(tok.encode(_synthetic_text(2 + i, prefill_n)))
+    sync_prefix()
+    prefill_dt = (time.perf_counter() - t0) / n_prefills
+    prefill_tps = prefill_n / prefill_dt
+    # prefill attends causally: average context = n/2
+    prefill_flops = prefill_n * (
+        matmul_flops_per_token(cfg) + attn_flops_per_token(cfg, prefill_n / 2)
+    )
+
+    # --- decision waves: 16 distinct pod suffixes, 6 waves pipelined.
+    names = [f"bench-node-{i:03d}" for i in range(32)]
+    eng.set_grammar(build_decision_dfa(tok, names, max_reason_tokens=60))
+    suffixes = [
+        tok.encode(_synthetic_text(100 + i, 250)) for i in range(16)
+    ]
+    eng.decide_wave(suffixes, max_new_tokens=72)  # compile + warm
+    n_waves = 6
+    c0 = dict(eng.stats)
+    t0 = time.perf_counter()
+    handles = [eng.submit_wave(suffixes, max_new_tokens=72) for _ in range(n_waves)]
+    finished = [f for h in handles for f in eng.harvest_wave(h)]
+    decode_dt = time.perf_counter() - t0
+    decisions = len(finished)
+    decode_tokens = eng.stats["decode_tokens"] - c0.get("decode_tokens", 0)
+    model_calls = eng.stats["wave_model_calls"] - c0.get("wave_model_calls", 0)
+    ctx = eng.prefix_len + 250 + 36  # prefix + suffix + half the emission
+    decode_flops = decode_tokens * (
+        matmul_flops_per_token(cfg) + attn_flops_per_token(cfg, ctx)
+    )
+    assert all(f.token_ids for f in finished), "empty decision in throughput bench"
+
+    out = {
+        "metric": "model_throughput",
+        "value": round(decode_tokens / decode_dt, 1),
+        "unit": "decode_tok_per_s",
+        "extra": {
+            "model": model,
+            "quantize": quantize,
+            "params_m": round(param_count(cfg) / 1e6, 1),
+            "device_kind": device_kind,
+            "prefill_tok_per_s": round(prefill_tps, 1),
+            "prefill_ms": round(prefill_dt * 1000.0, 2),
+            "decisions_per_s": round(decisions / decode_dt, 2),
+            # throughput-derived mean wall time per pipelined wave (NOT a
+            # per-decision latency percentile — all waves are in flight at
+            # once); wave_latency_ms is the first wave's real submit->done.
+            "wave_avg_ms": round(decode_dt / n_waves * 1000.0, 2),
+            "wave_latency_ms": round(finished[0].latency_ms, 2),
+            "decode_tok_per_s": round(decode_tokens / decode_dt, 1),
+            "wave_model_calls": model_calls,
+            "decode_tokens": decode_tokens,
+        },
+    }
+    if peak_tflops:
+        peak = peak_tflops * 1e12
+        out["extra"]["mfu_prefill"] = round(prefill_flops / prefill_dt / peak, 4)
+        out["extra"]["mfu_decode"] = round(decode_flops / decode_dt / peak, 4)
+        out["extra"]["peak_bf16_tflops"] = peak_tflops
+    del eng, params
+    return out
+
+
+# ----------------------------------------------------------------- suite/main
+DEFAULTS = {
+    "pods": 64, "nodes": 32, "shapes": 8, "slots": 16, "model": "bench",
+    "chunk_steps": 24, "max_new_tokens": 72, "temperature": 0.3,
+    "rounds": 3,
+}
+
+
+def _preset_ns(preset: str, base: argparse.Namespace | None = None) -> argparse.Namespace:
+    ns = argparse.Namespace(**{**DEFAULTS, **PRESETS[preset]})
+    ns.preset = preset
+    ns.quantize = getattr(base, "quantize", None) if base else None
+    ns.profile_dir = None
+    return ns
+
+
+def _emit(line: dict) -> None:
+    print(json.dumps(line), flush=True)
+
+
+def run_suite(args) -> None:
+    async def suite():
+        # default + burst1000 share the model/slots -> ONE backend, one set
+        # of compiled programs (a rebuilt engine re-jits everything).
+        ns_def = _preset_ns("default")
+        ns_burst = _preset_ns("burst1000")
+        backend = build_backend(ns_def)
+        try:
+            r_def = await bench_preset(ns_def, backend)
+            r_burst = await bench_preset(ns_burst, backend)
+        finally:
+            backend.close()
+        _emit(r_burst)
+
+        ns_long = _preset_ns("longctx")
+        r_long = await bench_preset(ns_long)
+        _emit(r_long)
+        return r_def, r_burst, r_long
+
+    r_def, r_burst, r_long = asyncio.run(suite())
+
+    tp_bench = model_throughput("bench", None, args.peak_tflops)
+    _emit(tp_bench)
+    tp_1b = model_throughput("llama-3.2-1b-instruct", None, args.peak_tflops)
+    _emit(tp_1b)
+
+    r_def["extra"]["presets"] = {
+        "burst1000": r_burst["extra"],
+        "longctx": r_long["extra"],
+    }
+    r_def["extra"]["throughput"] = {
+        "bench": tp_bench["extra"],
+        "llama-3.2-1b": tp_1b["extra"],
+    }
+    _emit(r_def)
+
+
 def main() -> None:
     # Flag defaults are None sentinels so presets only fill flags the user
     # did NOT pass (an explicit `--pods 64` must survive `--preset burst1000`).
-    defaults = {
-        "pods": 64, "nodes": 32, "shapes": 8, "slots": 16, "model": "bench",
-        "chunk_steps": 24, "max_new_tokens": 72, "temperature": 0.3,
-        "rounds": 3,
-    }
     parser = argparse.ArgumentParser()
     parser.add_argument("--pods", type=int, default=None)
     parser.add_argument("--nodes", type=int, default=None)
@@ -213,21 +461,55 @@ def main() -> None:
     parser.add_argument("--temperature", type=float, default=None)
     parser.add_argument("--rounds", type=int, default=None)
     parser.add_argument("--quantize", choices=["int8"], default=None)
-    parser.add_argument("--preset", choices=sorted(PRESETS), default="default")
+    parser.add_argument(
+        "--preset", choices=sorted(PRESETS) + ["suite", "throughput"],
+        default="suite",
+    )
+    parser.add_argument(
+        "--peak-tflops", type=float, default=None,
+        help="chip peak dense bf16 TFLOP/s for MFU (auto-detected for known "
+             "TPU device kinds)",
+    )
     parser.add_argument(
         "--profile-dir", default=None,
         help="capture a jax.profiler device trace of the measured rounds "
              "(TensorBoard format) into this directory",
     )
     args = parser.parse_args()
-    merged = {**defaults, **PRESETS[args.preset]}
+
+    if args.preset == "suite":
+        # The suite measures the FIXED BASELINE configurations; tuning flags
+        # would silently not apply — demand an explicit preset for them.
+        ignored = [
+            name for name in (
+                "pods", "nodes", "shapes", "slots", "model", "chunk_steps",
+                "max_new_tokens", "temperature", "rounds", "quantize",
+                "profile_dir",
+            )
+            if getattr(args, name) is not None
+        ]
+        if ignored:
+            parser.error(
+                f"--{'/--'.join(ignored)} have no effect on the default suite; "
+                "pass an explicit --preset (or --preset throughput) with them"
+            )
+        run_suite(args)
+        return
+    if args.preset == "throughput":
+        result = model_throughput(
+            args.model or DEFAULTS["model"], args.quantize, args.peak_tflops
+        )
+        _emit(result)
+        return
+
+    merged = {**DEFAULTS, **PRESETS[args.preset]}
     for key, value in merged.items():
         if getattr(args, key) is None:
             setattr(args, key, value)
     if args.rounds < 1:
         parser.error("--rounds must be >= 1")
-    result = asyncio.run(bench(args))
-    print(json.dumps(result))
+    result = asyncio.run(bench_preset(args))
+    _emit(result)
 
 
 if __name__ == "__main__":

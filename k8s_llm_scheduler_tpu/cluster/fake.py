@@ -50,6 +50,10 @@ class FakeNode:
 class FakeCluster:
     """ClusterState + Binder backed by dicts and an asyncio watch queue."""
 
+    # Binds are lock+dict operations — the scheduler loop may call them
+    # inline on the event loop instead of paying an executor round trip.
+    bind_is_nonblocking = True
+
     def __init__(self) -> None:
         self._nodes: dict[str, FakeNode] = {}
         self._pods: dict[tuple[str, str], RawPod] = {}

@@ -30,6 +30,38 @@ from collections.abc import Sequence
 from k8s_llm_scheduler_tpu.types import NodeMetrics, PodSpec, SchedulingDecision
 
 
+# Snapshot-digest memo: a burst shares ONE node-metrics snapshot object
+# across every pod (sched/loop.py snapshot_ttl_s), but the digest of those
+# nodes was being recomputed per pod — ~180 us of the ~400 us per-pod host
+# budget at 1000-pod burst scale. Keyed on identity (and verified by
+# identity, so a recycled id can't alias); holds strong refs so an id is
+# never reused while its entry lives. Assumes snapshots are not mutated
+# in place after first use — the loop builds a fresh list per refresh.
+_NODES_DIGEST_MEMO: OrderedDict[int, tuple[object, bytes]] = OrderedDict()
+_NODES_DIGEST_LOCK = threading.Lock()
+
+
+def _nodes_digest(nodes: Sequence[NodeMetrics]) -> bytes:
+    key = id(nodes)
+    with _NODES_DIGEST_LOCK:
+        entry = _NODES_DIGEST_MEMO.get(key)
+        if entry is not None and entry[0] is nodes:
+            _NODES_DIGEST_MEMO.move_to_end(key)
+            return entry[1]
+    h = hashlib.blake2b(digest_size=16)
+    for node in sorted(nodes, key=lambda n: n.name):
+        h.update(
+            f"|{node.name}|{node.cpu_usage_percent:.2f}|{node.memory_usage_percent:.2f}"
+            f"|{int(node.is_ready)}".encode()
+        )
+    digest = h.digest()
+    with _NODES_DIGEST_LOCK:
+        _NODES_DIGEST_MEMO[key] = (nodes, digest)
+        while len(_NODES_DIGEST_MEMO) > 8:
+            _NODES_DIGEST_MEMO.popitem(last=False)
+    return digest
+
+
 def decision_cache_key(pod: PodSpec, nodes: Sequence[NodeMetrics]) -> str:
     """Digest of the decision-relevant state.
 
@@ -48,11 +80,7 @@ def decision_cache_key(pod: PodSpec, nodes: Sequence[NodeMetrics]) -> str:
         h.update(f"|tol:{sorted(tol.items())!r}".encode())
     if pod.affinity_rules:
         h.update(f"|aff:{sorted(pod.affinity_rules.items())!r}".encode())
-    for node in sorted(nodes, key=lambda n: n.name):
-        h.update(
-            f"|{node.name}|{node.cpu_usage_percent:.2f}|{node.memory_usage_percent:.2f}"
-            f"|{int(node.is_ready)}".encode()
-        )
+    h.update(_nodes_digest(nodes))
     return h.hexdigest()
 
 

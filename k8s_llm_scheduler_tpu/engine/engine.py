@@ -123,13 +123,14 @@ def _admit_impl(
     dfa_start,     # scalar int32
     rng, temperature,
     constrained: bool,  # static
+    prefix_impl: str | None = None,  # static
 ):
     """Batched admission: suffix prefill + KV scatter + first-token sample,
     one device program. Rows scatter into their slot's state; padding rows
     land in the reserved trash row (index M) and stay inactive."""
     last_logits, k_cache, v_cache = forward_prefill_suffix(
         params, cfg, tokens, suffix_lens, prefix_k, prefix_v, prefix_len,
-        k_cache, v_cache, page_ids,
+        k_cache, v_cache, page_ids, prefix_impl=prefix_impl,
     )
     R = tokens.shape[0]
     start_vec = jnp.full((R,), dfa_start, dtype=jnp.int32)
@@ -258,6 +259,7 @@ def _wave_impl(
     F: int,        # static — block width (sampled token + forced run)
     cap: int,      # static — generated-KV capacity, >= max(max_new)
     constrained: bool,  # static
+    prefix_impl: str | None = None,  # static
 ):
     """One whole decision wave in ONE device program, with
     GRAMMAR-ACCELERATED BLOCK DECODING.
@@ -278,10 +280,19 @@ def _wave_impl(
     grammar (forced = all -1 degrades to one token per iteration with
     n_iters = max_new). No paged-cache traffic, one dispatch, one fetch.
 
-    Returns (emitted [R, n_iters*F] with pad_id holes, active [R]).
+    The block loop is a `lax.while_loop` bounded by `n_iters` that exits as
+    soon as no row is alive: `n_iters` is a worst-case bound (and rounded up
+    to bucket compile variants — engine.submit_wave), but typical decisions
+    finish in fewer iterations, and a finished wave's remaining iterations
+    would emit only pads. Early exit makes both the rounding padding and the
+    post-completion tail free, so the bound can stay conservative.
+
+    Returns (emitted [R, n_iters*F] with pad_id holes, active [R],
+    iters_run scalar int32 — the number of model calls actually executed).
     """
     last_logits, k_sfx, v_sfx = forward_prefill_suffix_dense(
-        params, cfg, tokens, suffix_lens, prefix_k, prefix_v, prefix_len
+        params, cfg, tokens, suffix_lens, prefix_k, prefix_v, prefix_len,
+        prefix_impl=prefix_impl,
     )
     R = tokens.shape[0]
     n_kv, hd = cfg.n_kv_heads, cfg.head_dim
@@ -296,7 +307,7 @@ def _wave_impl(
     gv = jnp.zeros_like(gk)
     jcol = jnp.arange(F)
 
-    def iteration(carry, _):
+    def iteration(carry):
         gk, gv, st, act, emitted, pos_next, logits, key = carry
         key, sub = jax.random.split(key)
         # (a) sample the block's first token from the carried logits
@@ -338,7 +349,7 @@ def _wave_impl(
         new_logits, gk, gv = forward_block_decode(
             params, cfg, blk_tok, blk_valid, blk_len, positions,
             k_sfx, v_sfx, suffix_lens, gk, gv, emitted,
-            prefix_k, prefix_v, prefix_len,
+            prefix_k, prefix_v, prefix_len, prefix_impl=prefix_impl,
         )
         carry = (
             gk, gv, s_cur, alive, emitted + blk_len,
@@ -347,12 +358,23 @@ def _wave_impl(
         return carry, blk_tok
 
     carry0 = (gk, gv, st, act, emitted, pos_next, last_logits, rng)
-    (gk, gv, st, act, emitted, pos_next, _, _), blocks = jax.lax.scan(
-        iteration, carry0, None, length=n_iters
+    out0 = jnp.full((R, n_iters * F), pad_id, dtype=tokens.dtype)
+
+    def cond(state):
+        i, _, carry = state
+        alive = carry[3]
+        return (i < n_iters) & jnp.any(alive)
+
+    def body(state):
+        i, out, carry = state
+        carry, blk_tok = iteration(carry)
+        out = jax.lax.dynamic_update_slice(out, blk_tok, (0, i * F))
+        return i + 1, out, carry
+
+    iters_run, out, (gk, gv, st, act, emitted, pos_next, _, _) = (
+        jax.lax.while_loop(cond, body, (jnp.int32(0), out0, carry0))
     )
-    # blocks: [n_iters, R, F] -> [R, n_iters*F] in temporal order
-    out = jnp.moveaxis(blocks, 1, 0).reshape(R, n_iters * F)
-    return out, act
+    return out, act, iters_run
 
 
 @dataclasses.dataclass
@@ -395,6 +417,7 @@ class WaveHandle:
     (the dominant cost on a tunneled TPU backend; see _wave_impl)."""
 
     toks_d: jax.Array   # [R, n_iters*F] emitted tokens (pad_id holes)
+    iters_d: jax.Array  # scalar int32 — model calls actually run (early exit)
     n: int              # real prompts in this wave (<= R)
     max_new_tokens: int
     req_ids: list[int]
@@ -430,6 +453,7 @@ class InferenceEngine:
         rng_seed: int = 0,
         prefix_chunk: int = 2048,
         paged_attn: str = "gather",
+        prefix_attn_impl: str | None = None,
     ) -> None:
         self.cfg = cfg
         self.params = params
@@ -463,6 +487,13 @@ class InferenceEngine:
         self.temperature = float(temperature)
         self.max_slots = max_slots
 
+        # Per-instance shared-prefix attention impl (None = the module
+        # default, "auto"): bound into the jitted programs as a closure
+        # constant. engine/local.build_local_backend passes "xla" for
+        # multi-device meshes — GSPMD cannot partition a pallas_call — so
+        # the choice is per-engine, never a process-global mutation.
+        self.prefix_attn_impl = prefix_attn_impl
+
         self._prefill = jax.jit(forward_prefill, static_argnums=(1,))
         # Prefix prefill needs KV only — skipping the LM head avoids a
         # [bucket, vocab] logits tensor on the admission critical path.
@@ -471,7 +502,7 @@ class InferenceEngine:
             static_argnums=(1,),
         )
         self._admit = jax.jit(
-            _admit_impl,
+            functools.partial(_admit_impl, prefix_impl=prefix_attn_impl),
             static_argnums=(1, 26),
             donate_argnums=(7, 8, 11, 12, 13, 14, 15, 16),
         )
@@ -480,10 +511,16 @@ class InferenceEngine:
             static_argnums=(1, 20, 21, 22),
             donate_argnums=(2, 3, 8, 9, 10, 11, 12),
         )
-        self._wave = jax.jit(_wave_impl, static_argnums=(1, 18, 19, 20, 21))
+        self._wave = jax.jit(
+            functools.partial(_wave_impl, prefix_impl=prefix_attn_impl),
+            static_argnums=(1, 18, 19, 20, 21),
+        )
         # Chunked long-prefix prefill reuses the dense cascade directly.
         self._suffix_dense = jax.jit(
-            forward_prefill_suffix_dense, static_argnums=(1,)
+            functools.partial(
+                forward_prefill_suffix_dense, prefix_impl=prefix_attn_impl
+            ),
+            static_argnums=(1,),
         )
         # Block width for grammar-accelerated wave decoding: each iteration
         # consumes 1 sampled + up to wave_block-1 forced tokens. 24 packs
@@ -716,15 +753,17 @@ class InferenceEngine:
         """
         chunk = min(self.prefix_chunk, self.prefill_buckets[-1])
         n = len(prompt_ids)
-        cap = -(-n // chunk) * chunk
+        # Always reserve one chunk of headroom beyond the rounded length:
+        # an UNALIGNED LCP resume writes chunk-wide blocks from a non-chunk
+        # start, so its last write spans past n — without headroom,
+        # dynamic_update_slice CLAMPS the out-of-bounds start and silently
+        # overwrites good copied KV with padding garbage. Reserving it
+        # unconditionally (not just for unaligned resumes) keeps seeded and
+        # fresh prefills of the same prompt length on ONE buffer shape, so
+        # _suffix_dense/_wave/_admit/_chunk compile once per length bucket
+        # instead of twice (a mid-burst jit-stall class).
+        cap = -(-n // chunk) * chunk + chunk
         done = 0 if seed is None else seed[2]
-        if done % chunk:
-            # Resume writes are chunk-wide from an UNALIGNED start: the last
-            # write spans up to done + k*chunk > n. Without this extra chunk
-            # of headroom, dynamic_update_slice CLAMPS the out-of-bounds
-            # start and silently overwrites good copied KV with the write's
-            # padding garbage.
-            cap += chunk
         pad = self.tokenizer.pad_id
         k_buf = jnp.zeros(
             (self.cfg.n_layers, cap, self.cfg.n_kv_heads, self.cfg.head_dim),
@@ -980,7 +1019,7 @@ class InferenceEngine:
             max_new[row] = max_new_tokens
 
         self._rng, sub = jax.random.split(self._rng)
-        toks_d, _ = self._wave(
+        toks_d, _, iters_d = self._wave(
             self.params, self.cfg,
             jnp.asarray(tokens), jnp.asarray(suffix_lens),
             prefix.k, prefix.v, jnp.int32(prefix.length),
@@ -997,19 +1036,18 @@ class InferenceEngine:
         # round trip on a tunneled backend).
         try:
             toks_d.copy_to_host_async()
+            iters_d.copy_to_host_async()
         except AttributeError:  # pragma: no cover - backend without D2H async
             pass
         req_ids = list(range(self._req_counter, self._req_counter + len(prompts)))
         self._req_counter += len(prompts)
         self.stats["waves"] = self.stats.get("waves", 0) + 1
-        self.stats["wave_model_calls"] = (
-            self.stats.get("wave_model_calls", 0) + n_iters
-        )
         self.stats["prefills"] += 1
         self.stats["prefill_tokens"] += int(suffix_lens.sum())
         self.stats["requests"] += len(prompts)
         return WaveHandle(
             toks_d=toks_d,
+            iters_d=iters_d,
             n=len(prompts),
             max_new_tokens=max_new_tokens,
             req_ids=req_ids,
@@ -1018,6 +1056,12 @@ class InferenceEngine:
     def harvest_wave(self, handle: WaveHandle) -> list[Finished]:
         """Sync one wave's results (blocks until the device program ran)."""
         toks_np = jax.device_get(handle.toks_d)
+        # Actual model calls this wave ran: the while-loop's early exit means
+        # this is <= the compiled n_iters bound (no phantom iterations are
+        # ever counted — or executed).
+        self.stats["wave_model_calls"] = (
+            self.stats.get("wave_model_calls", 0) + int(jax.device_get(handle.iters_d))
+        )
         self.stats["syncs"] += 1
         pad = self.tokenizer.pad_id
         latency_ms = (time.perf_counter() - handle.submitted_at) * 1000.0

@@ -498,6 +498,9 @@ def build_local_backend(
         prefill_buckets=prefill_buckets, chunk_steps=chunk_steps,
         prefix_chunk=prefix_chunk, paged_attn=paged_attn,
         temperature=temperature,
+        # GSPMD cannot auto-partition a pallas_call: the sharded serving
+        # path stays on the XLA cascade, per-engine (no global mutation).
+        prefix_attn_impl="xla" if multi else None,
     )
     return LocalLLMBackend(
         engine, tokenizer, max_new_tokens=max_new_tokens, constrained=constrained,

@@ -33,6 +33,7 @@ from k8s_llm_scheduler_tpu.ops.attention import (
     chunk_attention_with_prefix,
     merge_attention_parts,
     paged_decode_attention,
+    prefix_attend_parts,
 )
 
 Params = dict[str, Any]
@@ -255,6 +256,7 @@ def _suffix_layer(
     pv: jax.Array,
     prefix_len: jax.Array,
     inv_freq: jax.Array,
+    prefix_impl: str | None = None,  # static — ops/attention.prefix_attend_parts
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """One transformer layer of cascade suffix prefill: attends to the
     shared dense prefix + causally within the suffix. Shared by the paged
@@ -269,7 +271,9 @@ def _suffix_layer(
     v = _dense(h, lp["wv"], "bsd,dh->bsh").reshape(B, S, cfg.n_kv_heads, hd)
     q = apply_rope(q, positions, inv_freq)
     k = apply_rope(k, positions, inv_freq)
-    attn = chunk_attention_with_prefix(q, k, v, suffix_lens, pk, pv, prefix_len)
+    attn = chunk_attention_with_prefix(
+        q, k, v, suffix_lens, pk, pv, prefix_len, prefix_impl=prefix_impl
+    )
     attn = _dense(attn.reshape(B, S, cfg.n_heads * hd), lp["wo"], "bsh,hd->bsd")
     x = x + attn
     x = x + _mlp(lp, cfg, x)
@@ -296,6 +300,7 @@ def forward_prefill_suffix(
     k_cache: jax.Array,  # [L, num_pages, page_size, n_kv, hd] (donate)
     v_cache: jax.Array,
     page_ids: jax.Array,  # [B, Ss/page_size] dest page per suffix block (0=scratch)
+    prefix_impl: str | None = None,  # static
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Batched suffix prefill against a shared dense prefix.
 
@@ -322,7 +327,8 @@ def forward_prefill_suffix(
         x, kc, vc = carry
         lp, pk, pv, idx = xs
         x, k, v = _suffix_layer(
-            lp, cfg, x, positions, suffix_lens, pk, pv, prefix_len, inv_freq
+            lp, cfg, x, positions, suffix_lens, pk, pv, prefix_len, inv_freq,
+            prefix_impl=prefix_impl,
         )
         # Scatter this layer's suffix K/V blocks into their pages (padding
         # blocks were routed to the reserved scratch page 0 by the caller).
@@ -347,6 +353,7 @@ def forward_prefill_suffix_dense(
     prefix_k_all: jax.Array,  # [L, Sp, n_kv, hd] — shared dense prefix KV
     prefix_v_all: jax.Array,
     prefix_len: jax.Array,  # scalar int32 — valid prefix tokens (0 = none)
+    prefix_impl: str | None = None,  # static
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Batched suffix prefill against a shared dense prefix, KV kept DENSE.
 
@@ -368,7 +375,8 @@ def forward_prefill_suffix_dense(
     def body(x, xs):
         lp, pk, pv = xs
         x, k, v = _suffix_layer(
-            lp, cfg, x, positions, suffix_lens, pk, pv, prefix_len, inv_freq
+            lp, cfg, x, positions, suffix_lens, pk, pv, prefix_len, inv_freq,
+            prefix_impl=prefix_impl,
         )
         return x, (k, v)
 
@@ -394,6 +402,7 @@ def forward_block_decode(
     prefix_k_all: jax.Array,  # [L, Sp, n_kv, hd] shared dense prefix
     prefix_v_all: jax.Array,
     prefix_len: jax.Array,  # scalar int32
+    prefix_impl: str | None = None,  # static
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """One grammar-accelerated decode iteration: an F-wide mini-prefill.
 
@@ -414,10 +423,8 @@ def forward_block_decode(
     inv_freq = rope_inv_freq(cfg)
 
     x = params["embed"][blk_tok]  # [R, F, D]
-    Sp = prefix_k_all.shape[1]
     Ss = k_sfx.shape[2]
 
-    pre_mask = (jnp.arange(Sp) < prefix_len)[None, None, None, None, :]
     sfx_mask = (jnp.arange(Ss)[None, :] < suffix_lens[:, None])[
         :, None, None, None, :
     ]
@@ -448,7 +455,7 @@ def forward_block_decode(
         # exposes entries < tail (previous iterations), so the read never
         # sees this iteration's (not yet written) block.
         parts = [
-            attend_part(qg, pk, pv, pre_mask, "bqkgh,skh->bkgqs"),
+            prefix_attend_parts(q, qg, pk, pv, prefix_len, impl=prefix_impl),
             attend_part(qg, ks, vs, sfx_mask, "bqkgh,bskh->bkgqs"),
             attend_part(qg, gk[idx], gv[idx], gen_mask, "bqkgh,bskh->bkgqs"),
             attend_part(qg, k, v, blk_mask, "bqkgh,bskh->bkgqs"),

@@ -16,6 +16,51 @@ import jax.numpy as jnp
 
 NEG_INF = -1e30  # large-negative mask value; avoids NaN from -inf * 0
 
+# Default shared-prefix attention implementation: "auto" picks the Pallas
+# flash kernel (ops/pallas_prefix_attention.py) on TPU when the shapes meet
+# its tiling constraints, else the XLA einsum path. "xla" forces the einsum
+# path — the engine passes it per-instance for multi-device meshes (GSPMD
+# cannot partition a pallas_call without an explicit sharding rule);
+# "pallas" forces the kernel (interpret-mode on CPU — parity tests only).
+PREFIX_ATTN_IMPL = "auto"
+
+
+def set_prefix_attn_impl(impl: str) -> None:
+    global PREFIX_ATTN_IMPL
+    if impl not in ("auto", "xla", "pallas"):
+        raise ValueError(f"unknown prefix attention impl {impl!r}")
+    PREFIX_ATTN_IMPL = impl
+
+
+def prefix_attend_parts(q, qg, prefix_k, prefix_v, prefix_len, impl=None):
+    """Flash partials (o, m, l) of queries vs the shared dense prefix.
+
+    `q` is [B, S, n_heads, hd] post-RoPE (kernel layout); `qg` is the same
+    queries pre-scaled and grouped [B, S, n_kv, g, hd] (einsum layout) —
+    callers already have both, so the dispatch costs nothing. `impl`
+    overrides the module default per call site (the engine plumbs its
+    per-instance setting through; None falls back to PREFIX_ATTN_IMPL).
+    """
+    impl = PREFIX_ATTN_IMPL if impl is None else impl
+    use_pallas = impl == "pallas"
+    if impl == "auto" and jax.default_backend() == "tpu":
+        from k8s_llm_scheduler_tpu.ops.pallas_prefix_attention import (
+            prefix_attention_supported,
+        )
+
+        use_pallas = prefix_attention_supported(
+            q.shape, prefix_k.shape[1], prefix_k.shape[0]
+        )
+    if use_pallas:
+        from k8s_llm_scheduler_tpu.ops.pallas_prefix_attention import (
+            flash_prefix_attention_parts,
+        )
+
+        return flash_prefix_attention_parts(q, prefix_k, prefix_v, prefix_len)
+    Sp = prefix_k.shape[0]
+    pre_mask = (jnp.arange(Sp) < prefix_len)[None, None, None, None, :]
+    return attend_part(qg, prefix_k, prefix_v, pre_mask, "bqkgh,skh->bkgqs")
+
 
 def causal_prefill_attention(
     q: jax.Array,  # [B, S, n_heads, head_dim]
@@ -107,6 +152,7 @@ def chunk_attention_with_prefix(
     prefix_k: jax.Array,  # [Sp, n_kv, head_dim] — SHARED dense prefix KV
     prefix_v: jax.Array,  # [Sp, n_kv, head_dim]
     prefix_len: jax.Array,  # scalar — valid prefix tokens
+    prefix_impl: str | None = None,  # static — see prefix_attend_parts
 ) -> jax.Array:
     """Suffix-chunk attention with a shared dense prefix (cascade attention).
 
@@ -126,11 +172,9 @@ def chunk_attention_with_prefix(
     q_per_kv = n_heads // n_kv
     qg = (q.astype(jnp.float32) * head_dim**-0.5).reshape(B, S, n_kv, q_per_kv, head_dim)
 
-    Sp = prefix_k.shape[0]
-    pre_mask = (jnp.arange(Sp) < prefix_len)[None, None, None, None, :]
-    o_p, m_p, l_p = attend_part(
-        qg, prefix_k, prefix_v, pre_mask, "bqkgh,skh->bkgqs"
-    )  # o: [B, n_kv, g, S_q, hd] via derived swap -> [B,S?,..]
+    o_p, m_p, l_p = prefix_attend_parts(
+        q, qg, prefix_k, prefix_v, prefix_len, impl=prefix_impl
+    )  # o: [B, n_kv, g, S_q, hd]
 
     pos = jnp.arange(S)
     causal = pos[:, None] >= pos[None, :]

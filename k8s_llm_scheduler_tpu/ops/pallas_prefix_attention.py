@@ -1,0 +1,209 @@
+"""Hand-tiled Pallas TPU kernel for shared-prefix flash attention.
+
+The XLA cascade path (ops/attention.attend_part on the prefix) materializes
+a [B, n_kv, g, Sq, Sp] f32 score tensor per layer — at burst geometry
+(16 rows x 512-token suffixes against a ~14k-token cluster-state prefix)
+that is ~3 GB of HBM traffic per layer, and it dominates the decision-wave
+latency (engine/engine.py _wave_impl). This kernel streams the prefix KV in
+blocks with an online softmax instead: the grid walks
+(kv_head, query_block, key_block), scores for one (q_block x k_block) tile
+live in VMEM only, and a flash accumulator (m, l, acc scratch) folds each
+key block into the output. Nothing [.., Sq, Sp]-shaped ever exists.
+
+Emits UNNORMALIZED flash partials (o, m, l) in exactly the shapes
+ops/attention.attend_part produces for the prefix part, so the caller
+merges them with the in-chunk part via merge_attention_parts — the cascade
+semantics (and tests) stay shared with the XLA path. Used by both cascade
+callsites: the suffix prefill (models/llama._suffix_layer via
+chunk_attention_with_prefix) and the wave block decode
+(models/llama.forward_block_decode).
+
+Replaces the remote prefill the reference pays per pod (reference
+scheduler.py:425-433) with an in-tree flash kernel on the burst hot path.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _largest_divisor(n: int, cap: int, multiple: int) -> int | None:
+    """Largest d <= cap with n % d == 0 and d % multiple == 0."""
+    for d in range(min(cap, n), multiple - 1, -1):
+        if n % d == 0 and d % multiple == 0:
+            return d
+    return None
+
+
+def _prefix_kernel(
+    # scalar prefetch
+    plen_ref,  # [1] int32 (SMEM) — valid prefix tokens
+    # blocked inputs
+    q_ref,  # [1, q_block, hd] f32, pre-scaled
+    k_ref,  # [1, k_block, hd]
+    v_ref,  # [1, k_block, hd]
+    # blocked outputs
+    o_ref,  # [1, q_block, hd] f32 (unnormalized flash acc)
+    m_ref,  # [1, q_block, 128] f32 (running max, lane-broadcast)
+    l_ref,  # [1, q_block, 128] f32 (running denom, lane-broadcast)
+    # scratch
+    m_scr,  # [q_block, 128]
+    l_scr,  # [q_block, 128]
+    acc_scr,  # [q_block, hd]
+):
+    kb = pl.program_id(2)
+    k_block = k_ref.shape[1]
+
+    @pl.when(kb == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    start = kb * k_block
+    valid = plen_ref[0] - start  # prefix tokens inside this key block
+
+    @pl.when(valid > 0)
+    def _attend():
+        # bf16 operands, f32 accumulation: the MXU's native mode (f32xf32
+        # runs at a fraction of the rate). Standard flash practice; the
+        # parity tests bound the error.
+        q = q_ref[0].astype(jnp.bfloat16)  # [q_block, hd] (scaled by caller)
+        k = k_ref[0].astype(jnp.bfloat16)  # [k_block, hd]
+        v = v_ref[0].astype(jnp.bfloat16)
+        scores = jax.lax.dot_general(
+            q, k,
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [q_block, k_block]
+        inblk = jax.lax.broadcasted_iota(jnp.int32, (1, k_block), 1) < valid
+        scores = jnp.where(inblk, scores, NEG_INF)
+
+        m_prev = m_scr[:, :1]  # [q_block, 1]
+        m_new = jnp.maximum(m_prev, jnp.max(scores, axis=1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        probs = jnp.exp(scores - m_new)
+        probs = jnp.where(inblk, probs, 0.0)  # exp(NEG_INF-NEG_INF)=1 guard
+
+        l_scr[:] = jnp.broadcast_to(
+            l_scr[:, :1] * alpha + jnp.sum(probs, axis=1, keepdims=True),
+            l_scr.shape,
+        )
+        pv = jax.lax.dot_general(
+            probs.astype(jnp.bfloat16), v,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [q_block, hd]
+        acc_scr[:] = acc_scr[:] * alpha + pv
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+
+    @pl.when(kb == pl.num_programs(2) - 1)
+    def _finish():
+        o_ref[0] = acc_scr[:]
+        m_ref[0] = m_scr[:]
+        l_ref[0] = l_scr[:]
+
+
+def prefix_attention_supported(
+    q_shape: tuple[int, ...], n_kv: int, prefix_cap: int
+) -> bool:
+    """Whether the kernel's tiling constraints hold for these static shapes."""
+    B, S, n_heads, hd = q_shape
+    if n_heads % n_kv:
+        return False
+    nq = B * (n_heads // n_kv) * S  # query rows per kv head
+    return (
+        _largest_divisor(nq, 2048, 8) is not None
+        and _largest_divisor(prefix_cap, 512, 128) is not None
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def flash_prefix_attention_parts(
+    q: jax.Array,  # [B, S, n_heads, hd] post-RoPE queries (UNscaled)
+    prefix_k: jax.Array,  # [Sp, n_kv, hd] shared dense prefix KV
+    prefix_v: jax.Array,
+    prefix_len: jax.Array,  # scalar int32 — valid prefix tokens
+    *,
+    interpret: bool | None = None,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Flash partials of suffix-queries vs the shared prefix.
+
+    Returns (o, m, l) shaped ([B, n_kv, g, S, hd] f32, [B, n_kv, g, S],
+    [B, n_kv, g, S]) — bit-compatible with
+    ops.attention.attend_part(qg, prefix_k, prefix_v, mask, "bqkgh,skh->bkgqs")
+    for merge_attention_parts. A fully-masked prefix (prefix_len == 0)
+    reports m = NEG_INF, l = 0 (the merge then weights it to zero).
+    """
+    B, S, n_heads, hd = q.shape
+    Sp, n_kv, _ = prefix_k.shape
+    g = n_heads // n_kv
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    nq = B * g * S
+    q_block = _largest_divisor(nq, 1024, 8)
+    k_block = _largest_divisor(Sp, 1024, 128)
+    if q_block is None or k_block is None:
+        raise ValueError(
+            f"unsupported shapes for flash prefix attention: nq={nq}, Sp={Sp}"
+        )
+
+    # [B, S, n_kv, g, hd] -> [n_kv, B, g, S, hd] -> [n_kv, nq, hd]
+    # (row index = (b*g + gi)*S + s; inverted exactly on the way out)
+    qr = q.reshape(B, S, n_kv, g, hd).transpose(2, 0, 3, 1, 4)
+    qr = (qr.astype(jnp.float32) * hd**-0.5).reshape(n_kv, nq, hd)
+    # kv-head-major KV so key blocks tile (1, k_block, hd) — the Pallas TPU
+    # lowering requires the last two block dims divisible by (8, 128) or
+    # equal to the array dims. ~tens of MB of relayout vs the GBs of score
+    # traffic the kernel eliminates.
+    pk_t = prefix_k.transpose(1, 0, 2)  # [n_kv, Sp, hd]
+    pv_t = prefix_v.transpose(1, 0, 2)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n_kv, nq // q_block, Sp // k_block),
+        in_specs=[
+            pl.BlockSpec((1, q_block, hd), lambda kv, qb, kb, pl_: (kv, qb, 0)),
+            pl.BlockSpec((1, k_block, hd), lambda kv, qb, kb, pl_: (kv, kb, 0)),
+            pl.BlockSpec((1, k_block, hd), lambda kv, qb, kb, pl_: (kv, kb, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, q_block, hd), lambda kv, qb, kb, pl_: (kv, qb, 0)),
+            pl.BlockSpec((1, q_block, 128), lambda kv, qb, kb, pl_: (kv, qb, 0)),
+            pl.BlockSpec((1, q_block, 128), lambda kv, qb, kb, pl_: (kv, qb, 0)),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((q_block, 128), jnp.float32),
+            pltpu.VMEM((q_block, 128), jnp.float32),
+            pltpu.VMEM((q_block, hd), jnp.float32),
+        ],
+    )
+    o, m, l = pl.pallas_call(
+        _prefix_kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct((n_kv, nq, hd), jnp.float32),
+            jax.ShapeDtypeStruct((n_kv, nq, 128), jnp.float32),
+            jax.ShapeDtypeStruct((n_kv, nq, 128), jnp.float32),
+        ),
+        grid_spec=grid_spec,
+        interpret=interpret,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+    )(
+        jnp.asarray(prefix_len, dtype=jnp.int32).reshape(1),
+        qr, pk_t, pv_t,
+    )
+    # [n_kv, nq, ...] -> [n_kv, B, g, S, ...] -> [B, n_kv, g, S, ...]
+    o = o.reshape(n_kv, B, g, S, hd).transpose(1, 0, 2, 3, 4)
+    m = m[:, :, 0].reshape(n_kv, B, g, S).transpose(1, 0, 2, 3)
+    l = l[:, :, 0].reshape(n_kv, B, g, S).transpose(1, 0, 2, 3)
+    return o, m, l

@@ -108,10 +108,18 @@ class Scheduler:
             self.stats["llm_decisions"] += 1
 
         with self.phases.phase("bind"):
-            ok = await asyncio.to_thread(
-                self.binder.bind_pod_to_node,
-                pod.name, pod.namespace, decision.selected_node,
-            )
+            if getattr(self.binder, "bind_is_nonblocking", False):
+                # In-memory binders (FakeCluster) finish in microseconds; the
+                # executor round trip would cost more than the bind and its
+                # queue serializes a 1000-pod drain.
+                ok = self.binder.bind_pod_to_node(
+                    pod.name, pod.namespace, decision.selected_node
+                )
+            else:
+                ok = await asyncio.to_thread(
+                    self.binder.bind_pod_to_node,
+                    pod.name, pod.namespace, decision.selected_node,
+                )
         if not ok:
             self.stats["failed_bindings"] += 1
             logger.error(
