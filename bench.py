@@ -56,10 +56,12 @@ def build_cfg(name: str):
     if name == "bench":
         # Big enough that the MXU does real work, small enough to compile in
         # seconds — the architecture is identical to the 1B/8B/70B ladder.
-        # max_seq_len covers the longctx preset's 256-node / ~41k-byte-token
-        # cluster prompt.
+        # vocab matches the committed BPE fixture (assets/bpe4k): the preset
+        # benches run REAL BPE-length prompts (a 64-node cluster prompt is
+        # ~3.7k BPE tokens vs ~10.5k byte tokens). max_seq_len covers the
+        # longctx preset's 256-node prompt.
         return LlamaConfig(
-            name="bench", vocab_size=512, d_model=512, n_layers=6, n_heads=8,
+            name="bench", vocab_size=1280, d_model=512, n_layers=6, n_heads=8,
             n_kv_heads=4, d_ff=1408, max_seq_len=65536, rope_theta=500000.0,
             tie_embeddings=True,
         )
@@ -140,6 +142,12 @@ async def run_burst(scheduler, cluster, pods, timeout_s: float) -> dict[str, flo
         cluster.bind_pod_to_node = orig_bind
 
 
+BPE_FIXTURE = str(
+    Path(__file__).resolve().parent
+    / "k8s_llm_scheduler_tpu" / "assets" / "bpe4k"
+)
+
+
 def build_backend(args):
     from k8s_llm_scheduler_tpu.engine.local import build_local_backend
 
@@ -151,12 +159,15 @@ def build_backend(args):
     num_pages = max(64, min(1024, int(1e9 // page_bytes)))
     return build_local_backend(
         cfg=cfg,
+        # the committed BPE fixture: preset benches measure real-tokenizer
+        # prompt lengths, not byte-inflated ones
+        tokenizer_path=BPE_FIXTURE if args.model == "bench" else None,
         max_slots=args.slots,
         num_pages=num_pages,
         page_size=page_size,
         # small buckets serve the per-pod suffixes (shared-prefix path);
         # large ones serve the once-per-snapshot cluster-state prefix.
-        prefill_buckets=(256, 512, 1024, 2048, 4096, 8192, 16384),
+        prefill_buckets=(128, 256, 512, 1024, 2048, 4096, 8192, 16384),
         chunk_steps=args.chunk_steps,
         temperature=args.temperature,
         max_new_tokens=args.max_new_tokens,
@@ -392,6 +403,11 @@ def model_throughput(model: str, quantize: str | None, peak_override: float | No
 
 # ----------------------------------------------------------------- suite/main
 DEFAULTS = {
+    # 16 slots: one 32-row wave measured WORSE than two pipelined 16-row
+    # waves for burst1000 (wave compute dominates and pipelining both
+    # overlaps the dispatch round trip and binds wave-1 followers early).
+    # The default preset's 8 leaders ride the engine's half-width row
+    # bucket, so its waves run at R=8.
     "pods": 64, "nodes": 32, "shapes": 8, "slots": 16, "model": "bench",
     "chunk_steps": 24, "max_new_tokens": 72, "temperature": 0.3,
     "rounds": 3,

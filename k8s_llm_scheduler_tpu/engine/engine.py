@@ -991,12 +991,16 @@ class InferenceEngine:
         self._prefix = prefix
 
         bucket = self._bucket_for(max(len(p) for p in prompts))
-        # ONE row bucket (full width), always: a narrower single-prompt
-        # variant would be a second compiled program per geometry, and its
-        # first compile (~5s) lands mid-burst the first time a burst
-        # straggler forms a 1-wide wave — padding rows are cheaper than a
-        # jit stall on the hot path.
-        R = self.max_slots
+        # TWO row buckets: half width and full width. Wave compute scales
+        # with R (every padding row still runs masked through the model), so
+        # a burst whose leaders fit the half bucket — the common case —
+        # pays half the prefill/decode. Exactly two buckets bounds the
+        # compiled-variant count; a full-size warmup burst exercises both
+        # (stragglers form narrow waves), and a cold bucket mid-burst costs
+        # one jit (~5s) once per geometry, amortized by the median-of-rounds
+        # bench and by steady-state serving.
+        half = self.max_slots // 2
+        R = half if 0 < len(prompts) <= half else self.max_slots
         pad = self.tokenizer.pad_id
         # Wave geometry: with a grammar, block decoding needs only
         # wave_iterations(dfa) model calls (forced runs are free); without
