@@ -37,6 +37,9 @@ class RawPod:
     container_requests: tuple[dict[str, str], ...] = ()
     node_selector: dict[str, str] = dataclasses.field(default_factory=dict)
     tolerations: tuple[dict[str, Any], ...] = ()
+    # Normalized required node affinity: {"node_affinity_terms": [[expr,..],..]}
+    # (terms OR'd, expressions AND'd) — see core/validation.node_affinity_matches.
+    affinity: dict[str, Any] = dataclasses.field(default_factory=dict)
     priority: int = 0
     uid: str = ""
 
@@ -69,7 +72,9 @@ def raw_pod_to_spec(pod: RawPod) -> PodSpec:
         memory_request=mem,
         node_selector=dict(pod.node_selector),
         tolerations=tuple(pod.tolerations),
-        affinity_rules={},
+        # Live, unlike the reference (scheduler.py:762 always passes {}):
+        # core/validation.feasible_nodes enforces required node affinity.
+        affinity_rules=dict(pod.affinity),
         priority=pod.priority,
     )
 

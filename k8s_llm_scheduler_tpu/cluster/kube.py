@@ -64,6 +64,28 @@ def _pod_to_raw(pod) -> RawPod:
         }
         for t in (spec.tolerations or [])
     )
+    # Required node affinity -> the normalized terms form validation checks.
+    # (The reference extracts affinity but always discards it,
+    # scheduler.py:762.) Preferred affinity is scoring-only in K8s and the
+    # decision model weighs load instead, so only `required` gates here.
+    affinity: dict = {}
+    node_aff = getattr(getattr(spec, "affinity", None), "node_affinity", None)
+    required = getattr(
+        node_aff, "required_during_scheduling_ignored_during_execution", None
+    )
+    terms = [
+        [
+            {
+                "key": e.key or "",
+                "operator": e.operator or "In",
+                "values": list(e.values or []),
+            }
+            for e in (term.match_expressions or [])
+        ]
+        for term in (getattr(required, "node_selector_terms", None) or [])
+    ]
+    if terms:
+        affinity = {"node_affinity_terms": terms}
     return RawPod(
         name=pod.metadata.name,
         namespace=pod.metadata.namespace,
@@ -73,13 +95,18 @@ def _pod_to_raw(pod) -> RawPod:
         container_requests=tuple(requests),
         node_selector=dict(spec.node_selector or {}),
         tolerations=tolerations,
+        affinity=affinity,
         priority=spec.priority or 0,
         uid=pod.metadata.uid or "",
     )
 
 
-class KubeCluster:  # pragma: no cover - requires a live cluster
-    """ClusterState + Binder against a real K8s API server."""
+class KubeCluster:
+    """ClusterState + Binder against a real K8s API server.
+
+    Hermetically tested with a scripted fake kubernetes module
+    (tests/test_kube_cluster.py); only the import gate above needs a real
+    package."""
 
     def __init__(self, watch_timeout_seconds: int = 60) -> None:
         if not _KUBERNETES_AVAILABLE:

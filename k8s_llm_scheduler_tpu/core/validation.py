@@ -53,6 +53,50 @@ def tolerates_taints(pod: PodSpec, node: NodeMetrics) -> bool:
     return True
 
 
+def _affinity_expr_matches(expr: dict, labels: dict[str, str]) -> bool:
+    key = expr.get("key", "")
+    op = expr.get("operator", "In")
+    values = expr.get("values") or []
+    present = key in labels
+    val = labels.get(key)
+    if op == "In":
+        return present and val in values
+    if op == "NotIn":
+        # K8s semantics: NotIn (like DoesNotExist) also matches nodes
+        # WITHOUT the label.
+        return not present or val not in values
+    if op == "Exists":
+        return present
+    if op == "DoesNotExist":
+        return not present
+    if op in ("Gt", "Lt"):
+        try:
+            have, want = int(val), int(values[0])
+        except (TypeError, ValueError, IndexError):
+            return False
+        return have > want if op == "Gt" else have < want
+    return False  # unknown operator: fail closed
+
+
+def node_affinity_matches(pod: PodSpec, node: NodeMetrics) -> bool:
+    """requiredDuringSchedulingIgnoredDuringExecution node affinity.
+
+    `affinity_rules["node_affinity_terms"]` is a list of terms (OR), each a
+    list of match expressions (AND) — the normalized form
+    cluster/interface.raw_pod_to_spec produces from a V1Pod. No rules =
+    match everything. The reference carries affinity but always drops it
+    (reference scheduler.py:762 `affinity_rules={}`); this predicate makes
+    the field live.
+    """
+    terms = pod.affinity_rules.get("node_affinity_terms") or []
+    if not terms:
+        return True
+    return any(
+        term and all(_affinity_expr_matches(e, node.labels) for e in term)
+        for term in terms
+    )
+
+
 def resources_fit(pod: PodSpec, node: NodeMetrics) -> bool:
     return (
         pod.cpu_request <= node.available_cpu_cores
@@ -73,6 +117,7 @@ def feasible_nodes(
         for n in nodes
         if n.is_ready
         and selector_matches(pod, n)
+        and node_affinity_matches(pod, n)
         and tolerates_taints(pod, n)
         and resources_fit(pod, n)
     ]

@@ -60,3 +60,97 @@ class TestFeasibility:
         ]
         names = {n.name for n in feasible_nodes(make_pod(), nodes)}
         assert names == {"node-a", "node-b", "node-c"}
+
+
+class TestNodeAffinity:
+    """requiredDuringScheduling node affinity — live here, always {} in the
+    reference (scheduler.py:762)."""
+
+    def _pod(self, terms):
+        from conftest import make_pod
+        import dataclasses
+
+        pod = make_pod()
+        return dataclasses.replace(
+            pod, affinity_rules={"node_affinity_terms": terms}
+        )
+
+    def test_no_rules_matches_everything(self):
+        from k8s_llm_scheduler_tpu.core.validation import node_affinity_matches
+        from conftest import make_node, make_pod
+
+        assert node_affinity_matches(make_pod(), make_node(labels={}))
+
+    def test_in_and_notin(self):
+        from k8s_llm_scheduler_tpu.core.validation import node_affinity_matches
+        from conftest import make_node
+
+        pod = self._pod([[{"key": "zone", "operator": "In", "values": ["z1", "z2"]}]])
+        assert node_affinity_matches(pod, make_node(labels={"zone": "z1"}))
+        assert not node_affinity_matches(pod, make_node(labels={"zone": "z9"}))
+        assert not node_affinity_matches(pod, make_node(labels={}))
+
+        pod = self._pod([[{"key": "arch", "operator": "NotIn", "values": ["arm64"]}]])
+        assert not node_affinity_matches(pod, make_node(labels={"arch": "arm64"}))
+        assert node_affinity_matches(pod, make_node(labels={"arch": "amd64"}))
+        # K8s: NotIn also matches nodes without the label
+        assert node_affinity_matches(pod, make_node(labels={}))
+
+    def test_exists_doesnotexist_gt_lt(self):
+        from k8s_llm_scheduler_tpu.core.validation import node_affinity_matches
+        from conftest import make_node
+
+        pod = self._pod([[{"key": "gpu", "operator": "Exists"}]])
+        assert node_affinity_matches(pod, make_node(labels={"gpu": "a100"}))
+        assert not node_affinity_matches(pod, make_node(labels={}))
+
+        pod = self._pod([[{"key": "gpu", "operator": "DoesNotExist"}]])
+        assert not node_affinity_matches(pod, make_node(labels={"gpu": "a100"}))
+        assert node_affinity_matches(pod, make_node(labels={}))
+
+        pod = self._pod([[{"key": "cores", "operator": "Gt", "values": ["8"]}]])
+        assert node_affinity_matches(pod, make_node(labels={"cores": "16"}))
+        assert not node_affinity_matches(pod, make_node(labels={"cores": "4"}))
+        assert not node_affinity_matches(pod, make_node(labels={"cores": "lots"}))
+
+        pod = self._pod([[{"key": "cores", "operator": "Lt", "values": ["8"]}]])
+        assert node_affinity_matches(pod, make_node(labels={"cores": "4"}))
+        assert not node_affinity_matches(pod, make_node(labels={"cores": "16"}))
+
+    def test_terms_or_expressions_and(self):
+        from k8s_llm_scheduler_tpu.core.validation import node_affinity_matches
+        from conftest import make_node
+
+        pod = self._pod([
+            [
+                {"key": "zone", "operator": "In", "values": ["z1"]},
+                {"key": "gpu", "operator": "Exists"},
+            ],
+            [{"key": "pool", "operator": "In", "values": ["batch"]}],
+        ])
+        # first term: BOTH expressions must hold
+        assert not node_affinity_matches(pod, make_node(labels={"zone": "z1"}))
+        assert node_affinity_matches(
+            pod, make_node(labels={"zone": "z1", "gpu": "a100"})
+        )
+        # OR: second term alone suffices
+        assert node_affinity_matches(pod, make_node(labels={"pool": "batch"}))
+        assert not node_affinity_matches(pod, make_node(labels={"pool": "web"}))
+
+    def test_unknown_operator_fails_closed(self):
+        from k8s_llm_scheduler_tpu.core.validation import node_affinity_matches
+        from conftest import make_node
+
+        pod = self._pod([[{"key": "zone", "operator": "Regex", "values": [".*"]}]])
+        assert not node_affinity_matches(pod, make_node(labels={"zone": "z1"}))
+
+    def test_feasible_nodes_enforces_affinity(self):
+        from k8s_llm_scheduler_tpu.core.validation import feasible_nodes
+        from conftest import make_node
+
+        nodes = [
+            make_node("zoned", labels={"zone": "z1"}),
+            make_node("other", labels={"zone": "z2"}),
+        ]
+        pod = self._pod([[{"key": "zone", "operator": "In", "values": ["z1"]}]])
+        assert [n.name for n in feasible_nodes(pod, nodes)] == ["zoned"]
