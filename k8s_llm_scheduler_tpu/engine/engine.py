@@ -435,7 +435,11 @@ class InferenceEngine:
     """Single-owner (one thread/task) engine over one model + one KV cache."""
 
     DFA_STATE_CAPACITY = 4096
-    PREFIX_CACHE_SIZE = 2
+    # On-device prefix KV cache budget, in BYTES (not entries): a cached
+    # prefix costs L x cap x n_kv x hd x 2 x dtype — ~6 MB at bench scale
+    # but ~800 MB at 8B with a 4k-token prompt, so a count cap is the wrong
+    # unit. At least one entry (the active prefix) is always kept.
+    PREFIX_CACHE_BYTES = 1 << 30
 
     def __init__(
         self,
@@ -693,8 +697,14 @@ class InferenceEngine:
             )
             pfx = _PrefixKV(k=k_all[:, 0], v=v_all[:, 0], length=n, token_ids=key)
         self._prefix_cache[key] = pfx
-        while len(self._prefix_cache) > self.PREFIX_CACHE_SIZE:
-            self._prefix_cache.popitem(last=False)
+
+        def nbytes(p: _PrefixKV) -> int:
+            return int(p.k.nbytes) + int(p.v.nbytes)
+
+        total = sum(nbytes(p) for p in self._prefix_cache.values())
+        while total > self.PREFIX_CACHE_BYTES and len(self._prefix_cache) > 1:
+            _, evicted = self._prefix_cache.popitem(last=False)
+            total -= nbytes(evicted)
         self._prefix = pfx
         self.stats["prefix_prefills"] += 1
         self.stats["prefill_tokens"] += prefilled

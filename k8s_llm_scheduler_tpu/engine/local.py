@@ -104,11 +104,15 @@ class LocalLLMBackend:
         constrained: bool = True,
         request_timeout_s: float = 60.0,
         admit_wait_s: float = 0.002,
+        group_switch_after_s: float = 0.25,
     ) -> None:
         self.engine = engine
         self.tokenizer = tokenizer or engine.tokenizer
         self.prompt_engine = PromptEngine()
         self.max_new_tokens = max_new_tokens
+        # Fairness bound for (prefix, grammar) group switches under load —
+        # see _submit_waves.
+        self.group_switch_after_s = group_switch_after_s
         # Sparse DFA tables are vocab-independent (engine/constrained.py
         # SparseDFATables), so constrained decoding works at any vocab size
         # — including 128k-vocab BPE tokenizers for real checkpoints.
@@ -222,19 +226,24 @@ class LocalLLMBackend:
     ) -> list[_WorkItem]:
         """Dispatch every admissible pending item as pipelined waves.
 
-        Items group by (prefix, grammar); a group switch needs the engine's
-        prefix/grammar tables repointed, which is only safe with no wave in
-        flight (in-flight wave programs hold their buffers by reference, but
-        the SWITCH itself prefills a new prefix — ordering it behind the
-        outstanding waves keeps the device timeline simple). Returns items
-        that must wait (other group while waves are in flight).
+        Items group by (prefix, grammar). A wave captures its prefix buffers
+        and grammar tables BY REFERENCE at submit, so repointing the engine
+        at another group while waves are in flight is device-safe (the new
+        prefix's prefill simply queues behind the outstanding waves; only
+        the chunked slot path requires a drain, and set_prefix guards it).
+        Switching still costs a prefill dispatch and sparse-table upload, so
+        under load it happens at most once per tick and only when the
+        other group's oldest item has waited group_switch_after_s — a
+        fairness bound: interleaved snapshots round-robin at that period
+        instead of starving behind a sustained hot group until the request
+        timeout (60 s).
+
+        Returns items that must keep waiting (held ragged tails, other
+        groups not yet switched to).
         """
         rest: list[_WorkItem] = []
-        batch: list[_WorkItem] = []
 
-        def flush() -> None:
-            if not batch:
-                return
+        def submit(batch: list[_WorkItem]) -> None:
             try:
                 handle = self.engine.submit_wave(
                     [i.suffix_ids for i in batch], self.max_new_tokens
@@ -243,22 +252,28 @@ class LocalLLMBackend:
                 for item in batch:
                     item.fail(BackendError(str(exc)))
             else:
-                waves.append((handle, list(batch)))
-            batch.clear()
+                waves.append((handle, batch))
 
-        def flush_or_hold() -> list[_WorkItem]:
-            """Submit a PARTIAL batch only when the pipeline is empty.
-            While a wave is executing (~150ms+), more of the burst's
+        def run_group(items: list[_WorkItem]) -> None:
+            """Full waves submit; a ragged tail holds while the pipeline is
+            busy. While a wave is executing (~150ms+), more of the burst's
             leaders keep arriving — holding the partial until then turns
             seven ragged waves into two full ones, and the held items lose
             no time (the device is busy with the earlier wave anyway)."""
-            if batch and waves:
-                held = list(batch)
-                batch.clear()
-                return held
-            flush()
-            return []
+            batch: list[_WorkItem] = []
+            for item in items:
+                batch.append(item)
+                if len(batch) >= self.engine.max_slots:
+                    submit(batch)
+                    batch = []
+            if batch:
+                if waves:
+                    rest.extend(batch)
+                else:
+                    submit(batch)
 
+        current: list[_WorkItem] = []
+        others: list[_WorkItem] = []
         for item in pending:
             if len(item.suffix_ids) > self.engine.prefill_buckets[-1]:
                 # Oversized suffix can never admit (waves are bounded only by
@@ -271,32 +286,42 @@ class LocalLLMBackend:
                         f"{self.engine.prefill_buckets[-1]}"
                     )
                 )
-                continue
-            if item.group_key != self._current_group:
-                if waves or batch:
-                    rest.append(item)
-                    continue
-                # Idle engine: switch (prefix, grammar) groups. Invalidate
-                # first — a partial switch (prefix installed, grammar failed)
-                # must not leave old-group items matching a half-switched
-                # engine.
-                self._current_group = None
-                try:
-                    self.engine.set_prefix(item.prefix_ids)
-                    grammar_names = item.group_key[1]
-                    self.engine.set_grammar(
-                        self._grammar_for(grammar_names)
-                        if grammar_names is not None
-                        else None
-                    )
-                    self._current_group = item.group_key
-                except Exception as exc:  # prefix too long, grammar build
-                    item.fail(BackendError(str(exc)))
-                    continue
-            batch.append(item)
-            if len(batch) >= self.engine.max_slots:
-                flush()
-        rest = flush_or_hold() + rest
+            elif item.group_key == self._current_group:
+                current.append(item)
+            else:
+                others.append(item)
+
+        run_group(current)
+        if not others:
+            return rest
+
+        oldest = min(others, key=lambda i: i.enqueued_at)
+        waited = time.perf_counter() - oldest.enqueued_at
+        if waves and waited < self.group_switch_after_s:
+            rest.extend(others)
+            return rest
+
+        target = oldest.group_key
+        switch_items = [i for i in others if i.group_key == target]
+        rest.extend(i for i in others if i.group_key != target)
+        # Invalidate first — a partial switch (prefix installed, grammar
+        # failed) must not leave old-group items matching a half-switched
+        # engine.
+        self._current_group = None
+        try:
+            self.engine.set_prefix(switch_items[0].prefix_ids)
+            grammar_names = target[1]
+            self.engine.set_grammar(
+                self._grammar_for(grammar_names)
+                if grammar_names is not None
+                else None
+            )
+            self._current_group = target
+        except Exception as exc:  # prefix too long, grammar build
+            for item in switch_items:
+                item.fail(BackendError(str(exc)))
+            return rest
+        run_group(switch_items)
         return rest
 
     def _drain_queue(self, pending: list[_WorkItem], block: bool) -> None:

@@ -220,3 +220,65 @@ class TestGroupSwitching:
             assert stats["completed"] >= 12
         finally:
             backend.close()
+
+    async def test_sustained_hot_group_cannot_starve_other_group(self):
+        """ADVICE r1: a sustained stream of current-group requests used to
+        defer other-group items until the 60s request timeout. The fairness
+        bound (group_switch_after_s) must get the cold group decided while
+        the hot stream keeps the pipeline non-empty throughout."""
+        cfg = LlamaConfig(
+            name="fair-e2e", vocab_size=512, d_model=64, n_layers=2,
+            n_heads=4, n_kv_heads=2, d_ff=128, max_seq_len=4096,
+            rope_theta=10000.0, dtype=jnp.float32, tie_embeddings=True,
+        )
+        backend = build_local_backend(
+            cfg=cfg, max_slots=2, num_pages=128, page_size=64,
+            prefill_buckets=(512, 1024, 2048, 4096),
+            chunk_steps=8, temperature=0.0, max_new_tokens=160,
+        )
+        backend.group_switch_after_s = 0.2
+        try:
+            from conftest import make_node, make_pod
+
+            hot = [make_node(f"hot-node-{i}") for i in range(3)]
+            cold = [make_node(f"cold-node-{i}") for i in range(3)]
+
+            stop_feeding = asyncio.Event()
+
+            async def hot_stream():
+                """Keep >= max_slots hot decisions in flight continuously."""
+                n = 0
+                done = 0
+                inflight: set[asyncio.Task] = set()
+                while not stop_feeding.is_set():
+                    while len(inflight) < 4:
+                        pod = make_pod(name=f"hot-{n}", cpu=0.01 * (n % 7 + 1))
+                        inflight.add(asyncio.create_task(
+                            backend.get_scheduling_decision_async(pod, hot)
+                        ))
+                        n += 1
+                    finished, inflight = await asyncio.wait(
+                        inflight, return_when=asyncio.FIRST_COMPLETED
+                    )
+                    done += len(finished)
+                await asyncio.gather(*inflight, return_exceptions=True)
+                return done
+
+            feeder = asyncio.create_task(hot_stream())
+            # let the hot pipeline get going
+            await asyncio.sleep(0.3)
+            pod = make_pod(name="cold-pod")
+            t0 = asyncio.get_running_loop().time()
+            async with asyncio.timeout(30):
+                d = await backend.get_scheduling_decision_async(pod, cold)
+            waited = asyncio.get_running_loop().time() - t0
+            stop_feeding.set()
+            hot_done = await feeder
+            assert d.selected_node.startswith("cold-"), d.selected_node
+            # the hot stream really was saturating the engine the whole time
+            assert hot_done >= 4, hot_done
+            # bounded by the fairness window + a few wave lengths, nowhere
+            # near the 60s timeout (generous for slow CI)
+            assert waited < 20.0, waited
+        finally:
+            backend.close()

@@ -119,8 +119,11 @@ class TestPrefixStore:
         eng = make_engine()
         a, b, c = (TOK.encode(f"state {i} " * 8) for i in range(3))
         eng.set_prefix(a)
+        pfx = next(iter(eng._prefix_cache.values()))
+        # byte-budgeted cache: room for two of these prefixes, not three
+        eng.PREFIX_CACHE_BYTES = int(pfx.k.nbytes + pfx.v.nbytes) * 2
         eng.set_prefix(b)
-        eng.set_prefix(c)  # evicts a (capacity 2)
+        eng.set_prefix(c)  # evicts a (budget = 2 entries)
         eng.set_prefix(a)
         assert eng.stats["prefix_prefills"] == 4
         assert eng.stats["prefix_hits"] == 0
@@ -143,3 +146,46 @@ class TestPrefixStore:
         assert eng.prefix_len == 0
         fin = eng.generate(PREFIX + SUFFIXES[0], max_new_tokens=8)
         assert len(fin.token_ids) == 8
+
+
+class TestPrefixCacheByteBudget:
+    def test_eviction_is_byte_budgeted_and_keeps_active(self):
+        """The cache cap is BYTES (an 8B-scale prefix is ~800MB; a count cap
+        is the wrong unit); the newest (active) entry always survives."""
+        import jax.numpy as jnp
+        from k8s_llm_scheduler_tpu.engine.engine import InferenceEngine
+        from k8s_llm_scheduler_tpu.engine.tokenizer import ByteTokenizer
+        from k8s_llm_scheduler_tpu.models.configs import LlamaConfig
+        from k8s_llm_scheduler_tpu.models.llama import init_params
+        import jax
+
+        tok = ByteTokenizer()
+        cfg = LlamaConfig(
+            name="pfx-bytes", vocab_size=512, d_model=64, n_layers=2,
+            n_heads=4, n_kv_heads=2, d_ff=128, max_seq_len=2048,
+            rope_theta=10000.0, dtype=jnp.float32, tie_embeddings=True,
+        )
+        eng = InferenceEngine(
+            init_params(jax.random.PRNGKey(0), cfg), cfg, tok,
+            num_pages=32, page_size=64, max_slots=2, max_pages_per_seq=4,
+            prefill_buckets=(128, 256), chunk_steps=4, temperature=0.0,
+        )
+        one_prefix_bytes = None
+        for i in range(4):
+            eng.set_prefix(tok.encode(f"[{i}]" + "x" * 200))
+            if one_prefix_bytes is None:
+                pfx = next(iter(eng._prefix_cache.values()))
+                one_prefix_bytes = int(pfx.k.nbytes) + int(pfx.v.nbytes)
+        assert len(eng._prefix_cache) == 4  # default budget holds them all
+
+        # shrink the budget to ~2 entries and install one more
+        eng.PREFIX_CACHE_BYTES = one_prefix_bytes * 2
+        eng.set_prefix(tok.encode("[5]" + "x" * 200))
+        assert len(eng._prefix_cache) == 2
+        assert list(eng._prefix_cache.values())[-1] is eng._prefix
+
+        # a budget below one entry still keeps the active prefix
+        eng.PREFIX_CACHE_BYTES = 1
+        eng.set_prefix(tok.encode("[6]" + "x" * 200))
+        assert len(eng._prefix_cache) == 1
+        assert next(iter(eng._prefix_cache.values())) is eng._prefix
