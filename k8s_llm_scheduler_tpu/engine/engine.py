@@ -595,7 +595,6 @@ class InferenceEngine:
         emitted pads would be dropped from output and max_new_tokens
         accounting (generate() could spin forever on a pad-argmaxing
         model)."""
-        cap = self.DFA_STATE_CAPACITY
         if dfa is None:
             self._constrained = False
             self._sp_tokens = jnp.full((1, 1), -1, dtype=jnp.int32)
@@ -606,11 +605,13 @@ class InferenceEngine:
             self._dfa_start = 0
             self._grammar_wave_iters = None
             return
-        if dfa.n_states > cap:
-            raise ValueError(
-                f"DFA has {dfa.n_states} states > capacity {cap} "
-                "(raise DFA_STATE_CAPACITY or shrink max_reason_tokens)"
-            )
+        # Capacity buckets by powers of two above the floor: a 256-node
+        # cluster's grammar (~2.5k states) fits the floor; a 500+-node or
+        # long-name grammar doubles the bucket (one extra compile per
+        # bucket) instead of hard-failing.
+        cap = self.DFA_STATE_CAPACITY
+        while cap < dfa.n_states:
+            cap *= 2
         t = sparse_tables(dfa)
         K = t.k_width
         sp_tokens = np.full((cap, K), -1, dtype=np.int32)
