@@ -110,6 +110,12 @@ class HFTokenizerAdapter:
         self.vocab_size = len(self._tok)
         self.eos_id = self._tok.eos_token_id
         self.pad_id = self._pick_pad_sentinel()
+        # (system, user_prefix) -> (prefix_ids, rendered tail after the
+        # user suffix). A burst shares ONE cluster-state prefix across every
+        # pod; re-rendering + re-encoding its ~10k chars per pod costs ~ms
+        # each, which staggers the burst's leaders past the engine's
+        # admission-coalescing window and fragments one wave into several.
+        self._parts_memo: dict[tuple[str, str], tuple[list[int], str]] = {}
 
     def _pick_pad_sentinel(self) -> int:
         """An id the engine can use as the idle-slot emission sentinel.
@@ -155,7 +161,22 @@ class HFTokenizerAdapter:
         each half separately. The suffix's first token may tokenize slightly
         differently than in the unsplit prompt (standard prefix-caching
         tradeoff at block boundaries); the prefix block is identical across
-        a burst, which is what the on-device prefix cache keys on."""
+        a burst, which is what the on-device prefix cache keys on.
+
+        The prefix's render + encode is memoized per (system, user_prefix):
+        after a burst's first pod, each further pod pays only its own small
+        suffix encode. The memoized `tail` (the rendered text the template
+        appends AFTER the user content, e.g. '<|eot_id|>...assistant...')
+        reproduces the full-render split exactly — the split itself requires
+        the template to embed user_suffix verbatim, so prefix + suffix + tail
+        == the unsplit render by construction."""
+        memo_key = (system, user_prefix)
+        cached = self._parts_memo.get(memo_key)
+        if cached is not None and user_suffix:
+            prefix_ids, tail = cached
+            return list(prefix_ids), self._tok.encode(
+                user_suffix + tail, add_special_tokens=False
+            )
         messages = [
             {"role": "system", "content": system},
             {"role": "user", "content": user_prefix + user_suffix},
@@ -171,4 +192,10 @@ class HFTokenizerAdapter:
             return [], self.chat_prompt(system, user_prefix + user_suffix)
         prefix = self._tok.encode(rendered[:split_at], add_special_tokens=False)
         suffix = self._tok.encode(rendered[split_at:], add_special_tokens=False)
-        return prefix, suffix
+        if len(self._parts_memo) > 8:
+            self._parts_memo.clear()
+        self._parts_memo[memo_key] = (
+            prefix,
+            rendered[split_at + len(user_suffix):],
+        )
+        return list(prefix), suffix

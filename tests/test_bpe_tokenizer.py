@@ -63,6 +63,24 @@ class TestHFTokenizerAdapter:
             pfx, skip_special_tokens=False
         )
 
+    def test_chat_prompt_parts_memo_hit_is_identical(self, adapter):
+        """The burst's 2nd..Nth pods hit the (system, user_prefix) memo;
+        the memoized path must produce exactly the cold path's tokens."""
+        system = "sys prompt"
+        cluster = "CLUSTER STATE:\n" + "Node: node-7\n" * 40
+        adapter._parts_memo.clear()
+        cold = [
+            adapter.chat_prompt_parts(system, cluster, f"POD {i}: spec\n")
+            for i in range(3)
+        ]
+        adapter._parts_memo.clear()
+        # re-run in reverse so each call that WAS a memo hit is now cold
+        warm = [
+            adapter.chat_prompt_parts(system, cluster, f"POD {i}: spec\n")
+            for i in reversed(range(3))
+        ]
+        assert cold == list(reversed(warm))
+
     def test_chat_prompt_parts_degrades_without_suffix(self, adapter):
         pfx, sfx = adapter.chat_prompt_parts("sys", "cluster", "")
         assert pfx == []
