@@ -110,8 +110,6 @@ def measure_dispatch_rtt_ms(samples: int = 5) -> float:
     ~100-250 ms over hours; a decision's latency floor is ONE such round
     trip, so p50 figures are only interpretable next to this number (on a
     local chip it is ~1 ms)."""
-    import statistics as stats
-
     import jax
     import jax.numpy as jnp
 
@@ -123,7 +121,7 @@ def measure_dispatch_rtt_ms(samples: int = 5) -> float:
         t0 = time.perf_counter()
         jax.device_get(f(x))
         out.append((time.perf_counter() - t0) * 1000.0)
-    return round(stats.median(out), 1)
+    return round(statistics.median(out), 1)
 
 
 # BASELINE.md burst configs (reference publishes no numbers; these mirror the

@@ -110,12 +110,14 @@ class HFTokenizerAdapter:
         self.vocab_size = len(self._tok)
         self.eos_id = self._tok.eos_token_id
         self.pad_id = self._pick_pad_sentinel()
-        # (system, user_prefix) -> (prefix_ids, rendered tail after the
-        # user suffix). A burst shares ONE cluster-state prefix across every
-        # pod; re-rendering + re-encoding its ~10k chars per pod costs ~ms
-        # each, which staggers the burst's leaders past the engine's
-        # admission-coalescing window and fragments one wave into several.
-        self._parts_memo: dict[tuple[str, str], tuple[list[int], str]] = {}
+        # rendered-prefix STRING -> its token ids. A burst shares ONE
+        # cluster-state prefix across every pod; re-encoding its ~10k chars
+        # per pod costs ~6 ms each, which staggers the burst's leaders past
+        # the engine's admission-coalescing window and fragments one wave
+        # into several. Keying on the exact rendered text (not the inputs)
+        # makes a hit trivially sound; the cheap parts — template render
+        # (~0.1 ms) and the split validation — still run per call.
+        self._prefix_encode_memo: dict[str, list[int]] = {}
 
     def _pick_pad_sentinel(self) -> int:
         """An id the engine can use as the idle-slot emission sentinel.
@@ -163,20 +165,14 @@ class HFTokenizerAdapter:
         tradeoff at block boundaries); the prefix block is identical across
         a burst, which is what the on-device prefix cache keys on.
 
-        The prefix's render + encode is memoized per (system, user_prefix):
-        after a burst's first pod, each further pod pays only its own small
-        suffix encode. The memoized `tail` (the rendered text the template
-        appends AFTER the user content, e.g. '<|eot_id|>...assistant...')
-        reproduces the full-render split exactly — the split itself requires
-        the template to embed user_suffix verbatim, so prefix + suffix + tail
-        == the unsplit render by construction."""
-        memo_key = (system, user_prefix)
-        cached = self._parts_memo.get(memo_key)
-        if cached is not None and user_suffix:
-            prefix_ids, tail = cached
-            return list(prefix_ids), self._tok.encode(
-                user_suffix + tail, add_special_tokens=False
-            )
+        The split point is located by finding user_prefix in the render and
+        verifying user_suffix follows it VERBATIM — searching for the suffix
+        alone could match a later occurrence of its text inside the
+        template's tail, and a template that transforms the content
+        (trim/escape) fails the verbatim check; both degrade to no prefix
+        sharing instead of mis-splitting. Only the ~10k-char prefix ENCODE
+        (~6 ms) is memoized, keyed on the exact rendered prefix text; the
+        render (~0.1 ms) and this validation run on every call."""
         messages = [
             {"role": "system", "content": system},
             {"role": "user", "content": user_prefix + user_suffix},
@@ -184,18 +180,19 @@ class HFTokenizerAdapter:
         rendered = self._tok.apply_chat_template(
             messages, add_generation_prompt=True, tokenize=False
         )
-        split_at = rendered.rfind(user_suffix) if user_suffix else -1
+        split_at = -1
+        if user_prefix and user_suffix:
+            pos = rendered.rfind(user_prefix)
+            if pos >= 0 and rendered.startswith(user_suffix, pos + len(user_prefix)):
+                split_at = pos + len(user_prefix)
         if split_at <= 0:
-            # Template transformed the content (trim/escape) or the suffix is
-            # empty — degrade to no prefix sharing rather than mis-splitting
-            # or leaking a raw ValueError into the backend's error taxonomy.
             return [], self.chat_prompt(system, user_prefix + user_suffix)
-        prefix = self._tok.encode(rendered[:split_at], add_special_tokens=False)
+        prefix_str = rendered[:split_at]
+        prefix = self._prefix_encode_memo.get(prefix_str)
+        if prefix is None:
+            prefix = self._tok.encode(prefix_str, add_special_tokens=False)
+            if len(self._prefix_encode_memo) > 8:
+                self._prefix_encode_memo.clear()
+            self._prefix_encode_memo[prefix_str] = prefix
         suffix = self._tok.encode(rendered[split_at:], add_special_tokens=False)
-        if len(self._parts_memo) > 8:
-            self._parts_memo.clear()
-        self._parts_memo[memo_key] = (
-            prefix,
-            rendered[split_at + len(user_suffix):],
-        )
         return list(prefix), suffix

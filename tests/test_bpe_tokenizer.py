@@ -64,22 +64,38 @@ class TestHFTokenizerAdapter:
         )
 
     def test_chat_prompt_parts_memo_hit_is_identical(self, adapter):
-        """The burst's 2nd..Nth pods hit the (system, user_prefix) memo;
-        the memoized path must produce exactly the cold path's tokens."""
+        """The burst's 2nd..Nth pods hit the prefix-encode memo; the
+        memoized path must produce exactly the cold path's tokens."""
         system = "sys prompt"
         cluster = "CLUSTER STATE:\n" + "Node: node-7\n" * 40
-        adapter._parts_memo.clear()
+        adapter._prefix_encode_memo.clear()
         cold = [
             adapter.chat_prompt_parts(system, cluster, f"POD {i}: spec\n")
             for i in range(3)
         ]
-        adapter._parts_memo.clear()
+        adapter._prefix_encode_memo.clear()
         # re-run in reverse so each call that WAS a memo hit is now cold
         warm = [
             adapter.chat_prompt_parts(system, cluster, f"POD {i}: spec\n")
             for i in reversed(range(3))
         ]
         assert cold == list(reversed(warm))
+
+    def test_split_rejects_suffix_text_recurring_in_tail(self, adapter):
+        """A suffix whose text also appears later in the render (e.g. it
+        ends with the template's own tail text) must not be mis-split —
+        the split validates user_suffix follows user_prefix verbatim."""
+        # suffix deliberately equal to a string that also appears in the
+        # template tail region
+        pfx, sfx = adapter.chat_prompt_parts(
+            "sys", "CLUSTER:\nNode: n1\n", "POD: x<|eot_id|>"
+        )
+        joint = adapter._tok.decode(
+            adapter.chat_prompt("sys", "CLUSTER:\nNode: n1\nPOD: x<|eot_id|>"),
+            skip_special_tokens=False,
+        )
+        split = adapter._tok.decode(pfx + sfx, skip_special_tokens=False)
+        assert split == joint
 
     def test_chat_prompt_parts_degrades_without_suffix(self, adapter):
         pfx, sfx = adapter.chat_prompt_parts("sys", "cluster", "")
