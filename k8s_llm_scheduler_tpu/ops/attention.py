@@ -42,12 +42,15 @@ def prefix_attend_parts(q, qg, prefix_k, prefix_v, prefix_len, impl=None):
     per-instance setting through; None falls back to PREFIX_ATTN_IMPL).
     """
     impl = PREFIX_ATTN_IMPL if impl is None else impl
-    use_pallas = impl == "pallas"
-    if impl == "auto" and jax.default_backend() == "tpu":
+    use_pallas = False
+    if impl == "pallas" or (impl == "auto" and jax.default_backend() == "tpu"):
         from k8s_llm_scheduler_tpu.ops.pallas_prefix_attention import (
             prefix_attention_supported,
         )
 
+        # "pallas" forces the kernel wherever the tiling supports it (incl.
+        # interpret mode off-TPU — parity tests); unsupported shapes always
+        # take the einsum path.
         use_pallas = prefix_attention_supported(
             q.shape, prefix_k.shape[1], prefix_k.shape[0]
         )
@@ -60,6 +63,34 @@ def prefix_attend_parts(q, qg, prefix_k, prefix_v, prefix_len, impl=None):
     Sp = prefix_k.shape[0]
     pre_mask = (jnp.arange(Sp) < prefix_len)[None, None, None, None, :]
     return attend_part(qg, prefix_k, prefix_v, pre_mask, "bqkgh,skh->bkgqs")
+
+
+def causal_chunk_attend_parts(q, qg, k_chunk, v_chunk, chunk_lens, impl=None):
+    """Flash partials (o, m, l) of causal in-chunk self-attention.
+
+    Same dispatch contract as prefix_attend_parts: `q` [B, S, n_heads, hd]
+    post-RoPE for the kernel, `qg` the pre-scaled grouped layout for the
+    einsum fallback."""
+    impl = PREFIX_ATTN_IMPL if impl is None else impl
+    use_pallas = False
+    if impl == "pallas" or (impl == "auto" and jax.default_backend() == "tpu"):
+        from k8s_llm_scheduler_tpu.ops.pallas_prefix_attention import (
+            causal_attention_supported,
+        )
+
+        use_pallas = causal_attention_supported(q.shape, k_chunk.shape[2])
+    if use_pallas:
+        from k8s_llm_scheduler_tpu.ops.pallas_prefix_attention import (
+            flash_causal_attention_parts,
+        )
+
+        return flash_causal_attention_parts(q, k_chunk, v_chunk, chunk_lens)
+    S = q.shape[1]
+    pos = jnp.arange(S)
+    causal = pos[:, None] >= pos[None, :]
+    valid = pos[None, :] < chunk_lens[:, None]
+    chunk_mask = causal[None, None, None, :, :] & valid[:, None, None, None, :]
+    return attend_part(qg, k_chunk, v_chunk, chunk_mask, "bqkgh,bskh->bkgqs")
 
 
 def causal_prefill_attention(
@@ -176,12 +207,8 @@ def chunk_attention_with_prefix(
         q, qg, prefix_k, prefix_v, prefix_len, impl=prefix_impl
     )  # o: [B, n_kv, g, S_q, hd]
 
-    pos = jnp.arange(S)
-    causal = pos[:, None] >= pos[None, :]
-    valid = pos[None, :] < chunk_lens[:, None]
-    chunk_mask = causal[None, None, None, :, :] & valid[:, None, None, None, :]
-    o_c, m_c, l_c = attend_part(
-        qg, k_chunk, v_chunk, chunk_mask, "bqkgh,bskh->bkgqs"
+    o_c, m_c, l_c = causal_chunk_attend_parts(
+        q, qg, k_chunk, v_chunk, chunk_lens, impl=prefix_impl
     )
 
     out = merge_attention_parts([(o_p, m_p, l_p), (o_c, m_c, l_c)])  # [B,n_kv,g,S,hd]

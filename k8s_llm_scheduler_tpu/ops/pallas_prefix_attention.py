@@ -125,6 +125,165 @@ def prefix_attention_supported(
     )
 
 
+def _causal_kernel(
+    # scalar prefetch
+    lens_ref,  # [B] int32 (SMEM) — valid kv tokens per row
+    # blocked inputs
+    q_ref,  # [1, 1, q_block, hd] f32, pre-scaled
+    k_ref,  # [1, 1, k_block, hd]
+    v_ref,  # [1, 1, k_block, hd]
+    # blocked outputs
+    o_ref,  # [1, 1, q_block, hd] f32 (unnormalized flash acc)
+    m_ref,  # [1, 1, q_block, 128]
+    l_ref,  # [1, 1, q_block, 128]
+    # scratch
+    m_scr,  # [q_block, 128]
+    l_scr,  # [q_block, 128]
+    acc_scr,  # [q_block, hd]
+    *,
+    S: int,
+    q_block: int,
+    k_block: int,
+):
+    b = pl.program_id(0)
+    qb = pl.program_id(2)
+    kb = pl.program_id(3)
+
+    @pl.when(kb == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # q rows are (g, s) flattened with q_block | S, so one block spans one
+    # contiguous position range [p0, p0 + q_block) of a single query group.
+    p0 = (qb * q_block) % S
+    start = kb * k_block
+    # contributes iff some kv position < min(lens, causal end)
+    limit = jnp.minimum(lens_ref[b], p0 + q_block)
+
+    @pl.when(limit > start)
+    def _attend():
+        q = q_ref[0, 0].astype(jnp.bfloat16)  # [q_block, hd] (scaled)
+        k = k_ref[0, 0].astype(jnp.bfloat16)
+        v = v_ref[0, 0].astype(jnp.bfloat16)
+        scores = jax.lax.dot_general(
+            q, k,
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [q_block, k_block]
+        qpos = p0 + jax.lax.broadcasted_iota(jnp.int32, (q_block, 1), 0)
+        kpos = start + jax.lax.broadcasted_iota(jnp.int32, (1, k_block), 1)
+        mask = (kpos <= qpos) & (kpos < lens_ref[b])
+        scores = jnp.where(mask, scores, NEG_INF)
+
+        m_prev = m_scr[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(scores, axis=1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        probs = jnp.exp(scores - m_new)
+        probs = jnp.where(mask, probs, 0.0)
+        l_scr[:] = jnp.broadcast_to(
+            l_scr[:, :1] * alpha + jnp.sum(probs, axis=1, keepdims=True),
+            l_scr.shape,
+        )
+        pv = jax.lax.dot_general(
+            probs.astype(jnp.bfloat16), v,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc_scr[:] = acc_scr[:] * alpha + pv
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+
+    @pl.when(kb == pl.num_programs(3) - 1)
+    def _finish():
+        o_ref[0, 0] = acc_scr[:]
+        m_ref[0, 0] = m_scr[:]
+        l_ref[0, 0] = l_scr[:]
+
+
+def causal_attention_supported(q_shape: tuple[int, ...], n_kv: int) -> bool:
+    B, S, n_heads, hd = q_shape
+    if n_heads % n_kv:
+        return False
+    return (
+        _largest_divisor(S, 1024, 8) is not None
+        and _largest_divisor(S, 1024, 128) is not None
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def flash_causal_attention_parts(
+    q: jax.Array,  # [B, S, n_heads, hd] post-RoPE queries (UNscaled)
+    k: jax.Array,  # [B, S, n_kv, hd]
+    v: jax.Array,
+    lens: jax.Array,  # [B] int32 — valid kv tokens per row
+    *,
+    interpret: bool | None = None,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Flash partials of causal self-attention within a chunk.
+
+    Returns (o, m, l) shaped like
+    ops.attention.attend_part(qg, k, v, mask, "bqkgh,bskh->bkgqs") —
+    [B, n_kv, g, S, hd] f32 and [B, n_kv, g, S] — for
+    merge_attention_parts. Upper-triangle key blocks are skipped entirely
+    (~2x fewer tiles than a dense mask), and nothing [.., S, S]-shaped is
+    materialized — the per-layer in-chunk score block of the chunked
+    long-context prefill is ~540 MB at 1B/2048 on the XLA path.
+    """
+    B, S, n_heads, hd = q.shape
+    n_kv = k.shape[2]
+    g = n_heads // n_kv
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    q_block = _largest_divisor(S, 1024, 8)
+    k_block = _largest_divisor(S, 1024, 128)
+    if q_block is None or k_block is None:
+        raise ValueError(f"unsupported chunk length {S} for flash causal attention")
+
+    # [B, S, n_kv, g, hd] -> [B, n_kv, g, S, hd] -> [B, n_kv, g*S, hd]
+    qr = q.reshape(B, S, n_kv, g, hd).transpose(0, 2, 3, 1, 4)
+    qr = (qr.astype(jnp.float32) * hd**-0.5).reshape(B, n_kv, g * S, hd)
+    kt = k.transpose(0, 2, 1, 3)  # [B, n_kv, S, hd]
+    vt = v.transpose(0, 2, 1, 3)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(B, n_kv, g * S // q_block, S // k_block),
+        in_specs=[
+            pl.BlockSpec((1, 1, q_block, hd), lambda b, kv, qb, kb, l_: (b, kv, qb, 0)),
+            pl.BlockSpec((1, 1, k_block, hd), lambda b, kv, qb, kb, l_: (b, kv, kb, 0)),
+            pl.BlockSpec((1, 1, k_block, hd), lambda b, kv, qb, kb, l_: (b, kv, kb, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, 1, q_block, hd), lambda b, kv, qb, kb, l_: (b, kv, qb, 0)),
+            pl.BlockSpec((1, 1, q_block, 128), lambda b, kv, qb, kb, l_: (b, kv, qb, 0)),
+            pl.BlockSpec((1, 1, q_block, 128), lambda b, kv, qb, kb, l_: (b, kv, qb, 0)),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((q_block, 128), jnp.float32),
+            pltpu.VMEM((q_block, 128), jnp.float32),
+            pltpu.VMEM((q_block, hd), jnp.float32),
+        ],
+    )
+    o, m, l = pl.pallas_call(
+        functools.partial(_causal_kernel, S=S, q_block=q_block, k_block=k_block),
+        out_shape=(
+            jax.ShapeDtypeStruct((B, n_kv, g * S, hd), jnp.float32),
+            jax.ShapeDtypeStruct((B, n_kv, g * S, 128), jnp.float32),
+            jax.ShapeDtypeStruct((B, n_kv, g * S, 128), jnp.float32),
+        ),
+        grid_spec=grid_spec,
+        interpret=interpret,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
+        ),
+    )(lens.astype(jnp.int32), qr, kt, vt)
+    o = o.reshape(B, n_kv, g, S, hd)
+    m = m[..., 0].reshape(B, n_kv, g, S)
+    l = l[..., 0].reshape(B, n_kv, g, S)
+    return o, m, l
+
+
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def flash_prefix_attention_parts(
     q: jax.Array,  # [B, S, n_heads, hd] post-RoPE queries (UNscaled)
