@@ -269,7 +269,7 @@ class TestGroupSwitching:
             await asyncio.sleep(0.3)
             pod = make_pod(name="cold-pod")
             t0 = asyncio.get_running_loop().time()
-            async with asyncio.timeout(30):
+            async with asyncio.timeout(55):
                 d = await backend.get_scheduling_decision_async(pod, cold)
             waited = asyncio.get_running_loop().time() - t0
             stop_feeding.set()
@@ -277,8 +277,11 @@ class TestGroupSwitching:
             assert d.selected_node.startswith("cold-"), d.selected_node
             # the hot stream really was saturating the engine the whole time
             assert hot_done >= 4, hot_done
-            # bounded by the fairness window + a few wave lengths, nowhere
-            # near the 60s timeout (generous for slow CI)
-            assert waited < 20.0, waited
+            # bounded by the fairness window + a few wave lengths — nowhere
+            # near the 60s starvation timeout this guards against. The bound
+            # is deliberately loose: CPU waves run seconds each on a
+            # contended CI host, and the OLD behavior failed by hitting the
+            # full 60s timeout, not by being slow.
+            assert waited < 40.0, waited
         finally:
             backend.close()
