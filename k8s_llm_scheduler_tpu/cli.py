@@ -59,6 +59,8 @@ def _build_stack(cfg: Config, cluster) -> Any:
             checkpoint_path=cfg.get("llm.checkpoint_path"),
             tokenizer_path=cfg.get("llm.tokenizer_path"),
             quantize=cfg.get("llm.quantization"),
+            request_timeout_s=float(cfg.get("llm.timeout")),
+            group_switch_after_s=float(cfg.get("llm.group_switch_after_s")),
         )
 
     cache = (

@@ -57,6 +57,9 @@ DEFAULTS: dict[str, Any] = {
         "checkpoint_path": None,
         "quantization": None,  # None | "int8" (weight-only, models/quant.py)
         "tokenizer_path": None,
+        # fairness bound for (prefix, grammar) group switches under load
+        # (engine/local.py _submit_waves)
+        "group_switch_after_s": 0.25,
     },
     "cache": {
         "enabled": True,

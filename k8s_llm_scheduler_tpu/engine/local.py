@@ -447,6 +447,8 @@ def build_local_backend(
     checkpoint_path: str | None = None,
     tokenizer_path: str | None = None,
     devices: Sequence[Any] | None = None,
+    request_timeout_s: float = 60.0,
+    group_switch_after_s: float = 0.25,
 ) -> LocalLLMBackend:
     """Construct the full local stack: params (from an HF safetensors or
     orbax checkpoint when checkpoint_path is set, random-init otherwise —
@@ -529,4 +531,6 @@ def build_local_backend(
     )
     return LocalLLMBackend(
         engine, tokenizer, max_new_tokens=max_new_tokens, constrained=constrained,
+        request_timeout_s=request_timeout_s,
+        group_switch_after_s=group_switch_after_s,
     )
