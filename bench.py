@@ -459,6 +459,12 @@ def run_suite(args) -> None:
         backend = build_backend(ns_def)
         try:
             r_def = await bench_preset(ns_def, backend)
+            # Emit the headline early AND (enriched) last: if a driver
+            # timeout kills the suite midway, the last complete line is
+            # still a real headline metric. The early copy is marked
+            # partial so metric-filtering consumers can dedupe.
+            early = {**r_def, "extra": {**r_def["extra"], "partial": True}}
+            _emit(early)
             r_burst = await bench_preset(ns_burst, backend)
         finally:
             backend.close()

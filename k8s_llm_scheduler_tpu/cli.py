@@ -218,6 +218,37 @@ def cmd_verify(args: argparse.Namespace, cfg: Config) -> int:
 
     check("cluster access", kube_check)
 
+    def tokenizer_check():
+        from pathlib import Path
+
+        # Resolve EXACTLY like engine/local.build_local_backend: explicit
+        # tokenizer_path, else the checkpoint dir when it bundles one, else
+        # the runtime falls back to the hermetic ByteTokenizer (in which
+        # case the bundled BPE fixture is checked as a packaging smoke).
+        path = cfg.get("llm.tokenizer_path")
+        label = "configured tokenizer"
+        if not path:
+            ckpt = cfg.get("llm.checkpoint_path")
+            if ckpt and (Path(ckpt) / "tokenizer.json").exists():
+                path, label = ckpt, "checkpoint tokenizer"
+        if not path:
+            path = str(Path(__file__).resolve().parent / "assets" / "bpe4k")
+            label = "bundled BPE fixture (runtime default is ByteTokenizer)"
+        try:
+            from k8s_llm_scheduler_tpu.engine.tokenizer import HFTokenizerAdapter
+
+            tok = HFTokenizerAdapter(path)
+        except ImportError:
+            # transformers is an optional extra; the hermetic byte-level
+            # path needs no files (mirror kube_check's degrade).
+            return "transformers not installed (ByteTokenizer available)"
+        sample = "Node: node-1"
+        assert tok.decode(tok.encode(sample)) == sample
+        return f"{label}: vocab {tok.vocab_size}, pad {tok.pad_id}, eos {tok.eos_id}"
+
+    if not args.fast:
+        check("tokenizer loads + round-trips", tokenizer_check)
+
     if failures:
         print(f"\n{len(failures)} check(s) failed")
         return 1
