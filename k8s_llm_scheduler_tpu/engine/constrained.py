@@ -108,6 +108,17 @@ def build_decision_dfa(
     """
     if not node_names:
         raise ValueError("constrained decoding needs at least one allowed node name")
+    for name in node_names:
+        # Names embed RAW inside the JSON string the grammar forces; a
+        # quote/backslash/control char would make every decision unparseable
+        # (and such a name cannot be a legal DNS-1123 K8s node name anyway —
+        # a ClusterState handing one over is broken; fail loudly, not with
+        # per-decision parse errors).
+        if any(c in '"\\' or ord(c) < 0x20 for c in name):
+            raise ValueError(
+                f"node name {name!r} contains JSON-breaking characters and "
+                "cannot appear in the decision grammar"
+            )
     b = _Builder(tokenizer.vocab_size)
 
     start = b.new_state()

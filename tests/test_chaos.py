@@ -1,0 +1,136 @@
+"""Chaos tests: engine-level fault injection and a concurrency hammer.
+
+SURVEY §5 failure-detection/recovery and race-testing subsystems, driven
+END TO END: transient device-path failures must degrade to heuristic
+fallbacks through retry + circuit breaker and then RECOVER to LLM
+decisions; concurrent mixed-group load from many threads must neither
+deadlock nor lose a future. (The reference's resilience code paths exist
+but have no tests at all — SURVEY §4.)
+"""
+
+import threading
+
+import jax.numpy as jnp
+import pytest
+
+from conftest import make_node, make_pod
+from k8s_llm_scheduler_tpu.core.breaker import CircuitBreaker
+from k8s_llm_scheduler_tpu.engine.local import build_local_backend
+from k8s_llm_scheduler_tpu.models.configs import LlamaConfig
+from k8s_llm_scheduler_tpu.sched.client import DecisionClient
+from k8s_llm_scheduler_tpu.types import DecisionSource
+
+
+def tiny_backend(**kw):
+    cfg = LlamaConfig(
+        name="chaos", vocab_size=512, d_model=64, n_layers=2, n_heads=4,
+        n_kv_heads=2, d_ff=128, max_seq_len=4096, rope_theta=10000.0,
+        dtype=jnp.float32, tie_embeddings=True,
+    )
+    return build_local_backend(
+        cfg=cfg, max_slots=4, num_pages=128, page_size=64,
+        prefill_buckets=(512, 1024, 2048, 4096),
+        chunk_steps=8, temperature=0.0, max_new_tokens=160, **kw,
+    )
+
+
+class TestDeviceFaultRecovery:
+    async def test_transient_wave_failures_fall_back_then_recover(self):
+        backend = tiny_backend()
+        inject = threading.Event()
+        real_submit = backend.engine.submit_wave
+
+        def flaky_submit(*args, **kwargs):
+            if inject.is_set():
+                raise RuntimeError("injected device failure")
+            return real_submit(*args, **kwargs)
+
+        backend.engine.submit_wave = flaky_submit
+        client = DecisionClient(
+            backend,
+            cache=None,
+            breaker=CircuitBreaker(failure_threshold=3, timeout_seconds=0.3),
+            retry_delay=0.0,
+        )
+        nodes = [make_node(f"node-{i}", cpu_pct=20.0 + 20 * i) for i in range(3)]
+        try:
+            # Phase 1: device path down -> every decision must still come
+            # back, as heuristic fallbacks (retries exhausted or circuit
+            # open), never an exception to the caller.
+            inject.set()
+            for i in range(4):
+                d = await client.get_scheduling_decision(
+                    make_pod(name=f"down-{i}", cpu=0.01 * (i + 1)), nodes
+                )
+                assert d is not None
+                assert d.source is DecisionSource.FALLBACK, d.source
+                assert d.selected_node in {n.name for n in nodes}
+            assert client.stats["fallback_decisions"] >= 4
+
+            # Phase 2: device heals; after the breaker cooldown decisions
+            # come from the model again.
+            inject.clear()
+            import asyncio
+
+            await asyncio.sleep(0.35)  # let the circuit half-open
+            recovered = None
+            for i in range(3):
+                d = await client.get_scheduling_decision(
+                    make_pod(name=f"up-{i}", cpu=0.02 * (i + 1)), nodes
+                )
+                assert d is not None
+                if d.source is DecisionSource.LLM:
+                    recovered = d
+                    break
+            assert recovered is not None, "no LLM decision after recovery"
+            assert recovered.selected_node in {n.name for n in nodes}
+        finally:
+            backend.engine.submit_wave = real_submit
+            backend.close()
+
+
+class TestConcurrencyHammer:
+    def test_mixed_group_thread_hammer(self):
+        """12 threads x mixed (prefix, grammar) groups through the SYNC
+        path: every call must resolve with a grammar-guaranteed node from
+        ITS OWN cluster, and engine bookkeeping must balance."""
+        backend = tiny_backend()
+        backend.group_switch_after_s = 0.1
+        clusters = [
+            [make_node(f"g{g}-node-{i}") for i in range(3)] for g in range(3)
+        ]
+        errors: list[Exception] = []
+        results: list[tuple[int, str]] = []
+        lock = threading.Lock()
+
+        def worker(tid: int) -> None:
+            try:
+                for i in range(4):
+                    g = (tid + i) % 3
+                    d = backend.get_scheduling_decision(
+                        make_pod(name=f"t{tid}-{i}", cpu=0.01 * (tid + 1)),
+                        clusters[g],
+                    )
+                    with lock:
+                        results.append((g, d.selected_node))
+            except Exception as exc:  # noqa: BLE001 - recorded for assertion
+                with lock:
+                    errors.append(exc)
+
+        threads = [
+            threading.Thread(target=worker, args=(t,)) for t in range(12)
+        ]
+        try:
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=300)
+            assert not any(t.is_alive() for t in threads), "hammer deadlocked"
+            assert not errors, errors[:3]
+            assert len(results) == 48
+            for g, node in results:
+                assert node.startswith(f"g{g}-"), (g, node)
+            stats = backend.get_stats()
+            assert stats["completed"] == stats["requests"] == 48
+        finally:
+            backend.close()

@@ -629,3 +629,18 @@ class TestGrammarCapacity:
         finally:
             engine.set_grammar(None)
             engine.set_prefix(None)
+
+
+class TestGrammarNameSafety:
+    def test_json_breaking_names_rejected(self):
+        """Names embed raw in the forced JSON string: quotes/backslashes/
+        control chars would make every decision unparseable, and none of
+        them can appear in a legal DNS-1123 node name."""
+        from k8s_llm_scheduler_tpu.engine.constrained import build_decision_dfa
+
+        for bad in ('no"de', "back\\slash", "ctrl\x01char", "new\nline"):
+            with pytest.raises(ValueError, match="JSON-breaking"):
+                build_decision_dfa(TOK, ["node-ok", bad], max_reason_tokens=10)
+        # legal DNS-1123-ish names still fine
+        dfa = build_decision_dfa(TOK, ["node-ok", "a.b-c"], max_reason_tokens=10)
+        assert dfa.n_states > 0
