@@ -1070,12 +1070,15 @@ class InferenceEngine:
 
     def harvest_wave(self, handle: WaveHandle) -> list[Finished]:
         """Sync one wave's results (blocks until the device program ran)."""
-        toks_np = jax.device_get(handle.toks_d)
+        # ONE device_get for both results: on a tunneled backend each fetch
+        # can be its own round trip, and the wave sync is the per-decision
+        # critical path.
+        toks_np, iters_np = jax.device_get((handle.toks_d, handle.iters_d))
         # Actual model calls this wave ran: the while-loop's early exit means
         # this is <= the compiled n_iters bound (no phantom iterations are
         # ever counted — or executed).
         self.stats["wave_model_calls"] = (
-            self.stats.get("wave_model_calls", 0) + int(jax.device_get(handle.iters_d))
+            self.stats.get("wave_model_calls", 0) + int(iters_np)
         )
         self.stats["syncs"] += 1
         pad = self.tokenizer.pad_id
