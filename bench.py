@@ -138,12 +138,23 @@ PRESETS = {
     # stray compile (one suite run recorded 4.4s where the preset
     # standalone measures ~130ms).
     "longctx": {"pods": 16, "nodes": 256, "shapes": 4, "rounds": 3, "slots": 4},
+    # sustained arrivals instead of burst-at-t0: per-decision latency with a
+    # WARM prefix/grammar, the operating point between bursts. Not part of
+    # the default suite (run explicitly: --preset steady).
+    "steady": {"pods": 128, "nodes": 32, "shapes": 16, "rounds": 2,
+               "arrival_rate": 100.0},
 }
 
 
-async def run_burst(scheduler, cluster, pods, timeout_s: float) -> dict[str, float]:
-    """Add all pods at t0, wait until all bound; per-pod latency = bind - t0."""
+async def run_burst(
+    scheduler, cluster, pods, timeout_s: float, arrival_rate: float | None = None
+) -> dict[str, float]:
+    """Schedule pods and report per-pod latency (bind time - enqueue time).
+
+    arrival_rate=None: all pods enqueue at t0 (burst). Otherwise pods
+    arrive uniformly at `arrival_rate` pods/sec (sustained load)."""
     bind_times: dict[str, float] = {}
+    enqueue_times: dict[str, float] = {}
     orig_bind = cluster.bind_pod_to_node
 
     def timed_bind(pod_name, namespace, node_name):
@@ -155,12 +166,21 @@ async def run_burst(scheduler, cluster, pods, timeout_s: float) -> dict[str, flo
     cluster.bind_pod_to_node = timed_bind
     try:
         t0 = time.perf_counter()
-        for pod in pods:
+        for i, pod in enumerate(pods):
+            if arrival_rate:
+                target = t0 + i / arrival_rate
+                delay = target - time.perf_counter()
+                if delay > 0:
+                    await asyncio.sleep(delay)
+            enqueue_times[pod.name] = time.perf_counter()
             cluster.add_pod(pod)
         async with asyncio.timeout(timeout_s):
             while cluster.bind_count < len(pods):
                 await asyncio.sleep(0.005)
-        return {name: (t - t0) * 1000.0 for name, t in bind_times.items()}
+        return {
+            name: (t - enqueue_times[name]) * 1000.0
+            for name, t in bind_times.items()
+        }
     finally:
         cluster.bind_pod_to_node = orig_bind
 
@@ -233,7 +253,10 @@ async def bench_preset(args, backend=None) -> dict:
 
         pods = [_dc.replace(p, name=f"{round_id}-{p.name}") for p in pods]
         try:
-            latencies = await run_burst(scheduler, cluster, pods, timeout_s)
+            latencies = await run_burst(
+                scheduler, cluster, pods, timeout_s,
+                arrival_rate=getattr(args, "arrival_rate", None),
+            )
         finally:
             scheduler.stop()
             cluster.close()
@@ -509,6 +532,10 @@ def main() -> None:
     parser.add_argument("--max-new-tokens", type=int, default=None)
     parser.add_argument("--temperature", type=float, default=None)
     parser.add_argument("--rounds", type=int, default=None)
+    parser.add_argument(
+        "--arrival-rate", type=float, default=None,
+        help="pods/sec arrival pacing instead of burst-at-t0 (steady preset)",
+    )
     parser.add_argument("--quantize", choices=["int8"], default=None)
     parser.add_argument(
         "--preset", choices=sorted(PRESETS) + ["suite", "throughput"],
