@@ -148,7 +148,7 @@ PRESETS = {
 
 async def run_burst(
     scheduler, cluster, pods, timeout_s: float, arrival_rate: float | None = None
-) -> dict[str, float]:
+) -> tuple[dict[str, float], float]:
     """Schedule pods and report per-pod latency (bind time - enqueue time).
 
     arrival_rate=None: all pods enqueue at t0 (burst). Otherwise pods

@@ -243,7 +243,8 @@ def cmd_verify(args: argparse.Namespace, cfg: Config) -> int:
             # path needs no files (mirror kube_check's degrade).
             return "transformers not installed (ByteTokenizer available)"
         sample = "Node: node-1"
-        assert tok.decode(tok.encode(sample)) == sample
+        if tok.decode(tok.encode(sample)) != sample:
+            raise RuntimeError(f"tokenizer round-trip failed for {sample!r}")
         return f"{label}: vocab {tok.vocab_size}, pad {tok.pad_id}, eos {tok.eos_id}"
 
     if not args.fast:

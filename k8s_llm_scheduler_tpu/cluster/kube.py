@@ -73,8 +73,9 @@ def _pod_to_raw(pod) -> RawPod:
     required = getattr(
         node_aff, "required_during_scheduling_ignored_during_execution", None
     )
-    terms = [
-        [
+    terms = []
+    for term in getattr(required, "node_selector_terms", None) or []:
+        exprs = [
             {
                 "key": e.key or "",
                 "operator": e.operator or "In",
@@ -82,8 +83,22 @@ def _pod_to_raw(pod) -> RawPod:
             }
             for e in (term.match_expressions or [])
         ]
-        for term in (getattr(required, "node_selector_terms", None) or [])
-    ]
+        # matchFields terms (K8s supports only metadata.name here) are kept
+        # as field-tagged expressions so validation matches them against the
+        # node name rather than silently dropping the constraint.
+        exprs.extend(
+            {
+                "key": f.key or "",
+                "operator": f.operator or "In",
+                "values": list(f.values or []),
+                "field": True,
+            }
+            for f in (getattr(term, "match_fields", None) or [])
+        )
+        terms.append(exprs)
+    # NB: `if terms`, not `if any(terms)`: an all-empty term list must be
+    # KEPT — K8s treats an empty nodeSelectorTerm as match-nothing, and
+    # node_affinity_matches preserves that (empty term is falsy).
     if terms:
         affinity = {"node_affinity_terms": terms}
     return RawPod(

@@ -54,6 +54,11 @@ def _nodes_digest(nodes: Sequence[NodeMetrics]) -> bytes:
             f"|{node.name}|{node.cpu_usage_percent:.2f}|{node.memory_usage_percent:.2f}"
             f"|{int(node.is_ready)}".encode()
         )
+        # Labels and taints gate feasibility (selector/affinity/toleration),
+        # so a label or taint change within the TTL must miss the cache; the
+        # memo above keeps this per-snapshot, not per-pod.
+        h.update(f"|L{sorted(node.labels.items())!r}".encode())
+        h.update(f"|T{[sorted(t.items()) for t in node.taints]!r}".encode())
     digest = h.digest()
     with _NODES_DIGEST_LOCK:
         _NODES_DIGEST_MEMO[key] = (nodes, digest)

@@ -53,10 +53,15 @@ def tolerates_taints(pod: PodSpec, node: NodeMetrics) -> bool:
     return True
 
 
-def _affinity_expr_matches(expr: dict, labels: dict[str, str]) -> bool:
+def _affinity_expr_matches(
+    expr: dict, labels: dict[str, str], node_name: str = ""
+) -> bool:
     key = expr.get("key", "")
     op = expr.get("operator", "In")
     values = expr.get("values") or []
+    if expr.get("field"):
+        # matchFields expression: K8s only supports metadata.name here.
+        labels = {"metadata.name": node_name}
     present = key in labels
     val = labels.get(key)
     if op == "In":
@@ -92,7 +97,8 @@ def node_affinity_matches(pod: PodSpec, node: NodeMetrics) -> bool:
     if not terms:
         return True
     return any(
-        term and all(_affinity_expr_matches(e, node.labels) for e in term)
+        term
+        and all(_affinity_expr_matches(e, node.labels, node.name) for e in term)
         for term in terms
     )
 

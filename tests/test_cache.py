@@ -42,6 +42,19 @@ class TestCacheKey:
         k2 = decision_cache_key(pod, [make_node("a", cpu_pct=90)])
         assert k1 != k2
 
+    def test_node_labels_and_taints_in_key(self):
+        """Feasibility depends on labels/taints (selector, affinity,
+        tolerations), so changing either within the TTL must change the key."""
+        pod = make_pod()
+        base = decision_cache_key(pod, [make_node("a")])
+        labeled = decision_cache_key(pod, [make_node("a", labels={"zone": "z1"})])
+        tainted = decision_cache_key(
+            pod, [make_node("a", taints=({"key": "x", "effect": "NoSchedule"},))]
+        )
+        assert base != labeled
+        assert base != tainted
+        assert labeled != tainted
+
     def test_priority_in_key(self):
         nodes = [make_node("a")]
         assert decision_cache_key(make_pod(priority=0), nodes) != decision_cache_key(

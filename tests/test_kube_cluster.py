@@ -262,6 +262,43 @@ class TestPodConversion:
         raw = kube_mod._pod_to_raw(make_v1_pod())
         assert raw.affinity == {}
 
+    def test_match_fields_terms_preserved(self, kube_env):
+        """matchFields-only and mixed terms keep the field constraint as a
+        field-tagged expression instead of collapsing to match-nothing."""
+        kube_mod, _ = kube_env
+        affinity = _ns(
+            node_affinity=_ns(
+                required_during_scheduling_ignored_during_execution=_ns(
+                    node_selector_terms=[
+                        _ns(  # matchFields-only term
+                            match_expressions=None,
+                            match_fields=[_ns(
+                                key="metadata.name", operator="In",
+                                values=["node-a"],
+                            )],
+                        ),
+                        _ns(  # mixed term
+                            match_expressions=[
+                                _ns(key="zone", operator="In", values=["z1"]),
+                            ],
+                            match_fields=[_ns(
+                                key="metadata.name", operator="NotIn",
+                                values=["node-b"],
+                            )],
+                        ),
+                    ]
+                )
+            )
+        )
+        raw = kube_mod._pod_to_raw(make_v1_pod(affinity=affinity))
+        assert raw.affinity["node_affinity_terms"] == [
+            [{"key": "metadata.name", "operator": "In", "values": ["node-a"],
+              "field": True}],
+            [{"key": "zone", "operator": "In", "values": ["z1"]},
+             {"key": "metadata.name", "operator": "NotIn",
+              "values": ["node-b"], "field": True}],
+        ]
+
 
 class TestWatch:
     async def test_watch_filters_and_self_heals(self, kube_env):

@@ -117,6 +117,23 @@ class TestNodeAffinity:
         assert node_affinity_matches(pod, make_node(labels={"cores": "4"}))
         assert not node_affinity_matches(pod, make_node(labels={"cores": "16"}))
 
+    def test_match_fields_expression_matches_node_name(self):
+        """Field-tagged expressions (from matchFields) gate on metadata.name,
+        not labels — K8s's only supported matchFields key."""
+        from k8s_llm_scheduler_tpu.core.validation import node_affinity_matches
+        from conftest import make_node
+
+        pod = self._pod([[{
+            "key": "metadata.name", "operator": "In",
+            "values": ["node-a"], "field": True,
+        }]])
+        assert node_affinity_matches(pod, make_node("node-a"))
+        assert not node_affinity_matches(pod, make_node("node-b"))
+        # a metadata.name *label* must not satisfy a field expression
+        assert not node_affinity_matches(
+            pod, make_node("node-b", labels={"metadata.name": "node-a"})
+        )
+
     def test_terms_or_expressions_and(self):
         from k8s_llm_scheduler_tpu.core.validation import node_affinity_matches
         from conftest import make_node
