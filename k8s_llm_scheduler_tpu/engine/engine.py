@@ -166,6 +166,7 @@ def _decode_chunk_impl(
     n_steps: int,      # static
     constrained: bool,  # static
     paged_attn: str = "gather",  # static: "gather" | "pallas"
+    shmap=None,  # static ShardedAttnImpl | None (tp-sharded paged kernel)
 ):
     """`n_steps` decode iterations fused into one program. Emits the sampled
     token per step; finished/exhausted/idle slots emit pad_id and idle.
@@ -203,6 +204,7 @@ def _decode_chunk_impl(
             ck, cv, tail, prefix_k, prefix_v, prefix_len,
             page_tables=page_tables,
             own_impl="pallas" if paged_attn == "pallas" else "dense",
+            shmap=shmap,
         )
         key, sub = jax.random.split(key)
         if constrained:
@@ -458,6 +460,7 @@ class InferenceEngine:
         prefix_chunk: int = 2048,
         paged_attn: str = "gather",
         prefix_attn_impl: str | None = None,
+        mesh=None,  # jax.sharding.Mesh | None — set for multi-device serving
     ) -> None:
         self.cfg = cfg
         self.params = params
@@ -493,10 +496,32 @@ class InferenceEngine:
 
         # Per-instance shared-prefix attention impl (None = the module
         # default, "auto"): bound into the jitted programs as a closure
-        # constant. engine/local.build_local_backend passes "xla" for
-        # multi-device meshes — GSPMD cannot partition a pallas_call — so
-        # the choice is per-engine, never a process-global mutation.
+        # constant — per-engine, never a process-global mutation. On a
+        # multi-device mesh with a tp axis the str preference is upgraded
+        # to a ShardedAttnImpl: the Pallas kernels run per-shard under
+        # shard_map over the tp-sharded kv-head axis (GSPMD cannot
+        # partition a pallas_call), so the 70B tp=8 serving path keeps
+        # flash attention instead of falling back to XLA.
+        if prefix_attn_impl not in (None, "auto", "xla", "pallas"):
+            # A typo here would silently degrade to the einsum path —
+            # exactly the flash-kernel regression this knob exists to avoid.
+            raise ValueError(
+                f"unknown prefix attention impl {prefix_attn_impl!r} "
+                f"(expected 'auto', 'xla', or 'pallas')"
+            )
+        tp_size = mesh.shape.get("tp", 1) if mesh is not None else 1
+        if tp_size > 1:
+            from k8s_llm_scheduler_tpu.ops.attention import ShardedAttnImpl
+
+            prefix_attn_impl = ShardedAttnImpl(
+                mesh=mesh, axis="tp", kind=prefix_attn_impl or "auto"
+            )
         self.prefix_attn_impl = prefix_attn_impl
+        chunk_shmap = (
+            prefix_attn_impl
+            if tp_size > 1 and paged_attn == "pallas"
+            else None
+        )
 
         self._prefill = jax.jit(forward_prefill, static_argnums=(1,))
         # Prefix prefill needs KV only — skipping the LM head avoids a
@@ -511,7 +536,7 @@ class InferenceEngine:
             donate_argnums=(7, 8, 11, 12, 13, 14, 15, 16),
         )
         self._chunk = jax.jit(
-            _decode_chunk_impl,
+            functools.partial(_decode_chunk_impl, shmap=chunk_shmap),
             static_argnums=(1, 20, 21, 22),
             donate_argnums=(2, 3, 8, 9, 10, 11, 12),
         )

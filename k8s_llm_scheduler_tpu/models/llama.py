@@ -498,6 +498,8 @@ def forward_decode_buffered(
     prefix_len: jax.Array,  # scalar int32
     page_tables: jax.Array | None = None,  # [B, P] (own_impl="pallas" only)
     own_impl: str = "dense",  # static: "dense" pre-gathered | "pallas" kernel
+    shmap: Any = None,  # static ops.attention.ShardedAttnImpl | None —
+    # wraps the paged kernel in shard_map over the tp kv-head axis
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """One decode step against (prefix | own tokens | chunk buffer).
 
@@ -523,7 +525,16 @@ def forward_decode_buffered(
     if own_impl == "pallas":
         from k8s_llm_scheduler_tpu.ops.pallas_paged_attention import (
             paged_decode_attention_parts,
+            paged_decode_attention_parts_shmap,
         )
+
+        if shmap is not None:
+            def paged_parts(q, ko, vo, pt, lens):
+                return paged_decode_attention_parts_shmap(
+                    q, ko, vo, pt, lens, shmap.mesh, shmap.axis
+                )
+        else:
+            paged_parts = paged_decode_attention_parts
 
     x = params["embed"][tokens]  # [B, D]
     layer_ids = jnp.arange(cfg.n_layers)
@@ -553,9 +564,7 @@ def forward_decode_buffered(
 
         qg = (q.astype(jnp.float32) * hd**-0.5).reshape(B, cfg.n_kv_heads, q_per_kv, hd)
         if own_impl == "pallas":
-            own_part = paged_decode_attention_parts(
-                q, ko, vo, page_tables, own_lens
-            )
+            own_part = paged_parts(q, ko, vo, page_tables, own_lens)
         else:
             own_part = attend_part(qg, ko, vo, own_mask, "bkgh,blkh->bkgl")
         parts = [

@@ -11,18 +11,50 @@ Replaces the remote attention the reference rents from the HF-hosted 70B
 
 from __future__ import annotations
 
+import dataclasses
+
 import jax
 import jax.numpy as jnp
+from jax.sharding import Mesh
 
 NEG_INF = -1e30  # large-negative mask value; avoids NaN from -inf * 0
 
 # Default shared-prefix attention implementation: "auto" picks the Pallas
 # flash kernel (ops/pallas_prefix_attention.py) on TPU when the shapes meet
 # its tiling constraints, else the XLA einsum path. "xla" forces the einsum
-# path — the engine passes it per-instance for multi-device meshes (GSPMD
-# cannot partition a pallas_call without an explicit sharding rule);
-# "pallas" forces the kernel (interpret-mode on CPU — parity tests only).
+# path; "pallas" forces the kernel (interpret-mode on CPU — parity tests).
+# On a multi-device mesh the engine passes a ShardedAttnImpl instead of a
+# string: GSPMD cannot partition a pallas_call, so the kernel is wrapped in
+# shard_map over the tp-sharded kv-head axis (per-shard it is
+# embarrassingly parallel — no collectives).
 PREFIX_ATTN_IMPL = "auto"
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardedAttnImpl:
+    """Attention-impl choice for a tp-sharded mesh.
+
+    `kind` is the same auto/xla/pallas preference as the string form; the
+    mesh+axis let the dispatch wrap Pallas kernels in shard_map over the
+    kv-head axis instead of falling back to XLA (the round-2 behavior,
+    which cost the 70B tp=8 serving path both flash kernels)."""
+
+    mesh: Mesh
+    axis: str = "tp"
+    kind: str = "auto"
+
+
+def _resolve_impl(impl) -> tuple[str, Mesh | None, str | None, int]:
+    """Normalize str | ShardedAttnImpl | None -> (kind, mesh, axis, shards)."""
+    if impl is None:
+        impl = PREFIX_ATTN_IMPL
+    if isinstance(impl, ShardedAttnImpl):
+        shards = impl.mesh.shape.get(impl.axis, 1)
+        kind = impl.kind or PREFIX_ATTN_IMPL
+        if shards > 1:
+            return kind, impl.mesh, impl.axis, shards
+        return kind, None, None, 1
+    return impl, None, None, 1
 
 
 def set_prefix_attn_impl(impl: str) -> None:
@@ -41,24 +73,30 @@ def prefix_attend_parts(q, qg, prefix_k, prefix_v, prefix_len, impl=None):
     overrides the module default per call site (the engine plumbs its
     per-instance setting through; None falls back to PREFIX_ATTN_IMPL).
     """
-    impl = PREFIX_ATTN_IMPL if impl is None else impl
+    kind, mesh, axis, shards = _resolve_impl(impl)
     use_pallas = False
-    if impl == "pallas" or (impl == "auto" and jax.default_backend() == "tpu"):
+    if kind == "pallas" or (kind == "auto" and jax.default_backend() == "tpu"):
         from k8s_llm_scheduler_tpu.ops.pallas_prefix_attention import (
             prefix_attention_supported,
         )
 
         # "pallas" forces the kernel wherever the tiling supports it (incl.
         # interpret mode off-TPU — parity tests); unsupported shapes always
-        # take the einsum path.
+        # take the einsum path. On a sharded mesh the check runs on the
+        # PER-SHARD shapes (kv heads divided over the tp axis).
         use_pallas = prefix_attention_supported(
-            q.shape, prefix_k.shape[1], prefix_k.shape[0]
+            q.shape, prefix_k.shape[1], prefix_k.shape[0], shards=shards
         )
     if use_pallas:
         from k8s_llm_scheduler_tpu.ops.pallas_prefix_attention import (
             flash_prefix_attention_parts,
+            flash_prefix_attention_parts_shmap,
         )
 
+        if mesh is not None:
+            return flash_prefix_attention_parts_shmap(
+                q, prefix_k, prefix_v, prefix_len, mesh, axis
+            )
         return flash_prefix_attention_parts(q, prefix_k, prefix_v, prefix_len)
     Sp = prefix_k.shape[0]
     pre_mask = (jnp.arange(Sp) < prefix_len)[None, None, None, None, :]
@@ -71,19 +109,26 @@ def causal_chunk_attend_parts(q, qg, k_chunk, v_chunk, chunk_lens, impl=None):
     Same dispatch contract as prefix_attend_parts: `q` [B, S, n_heads, hd]
     post-RoPE for the kernel, `qg` the pre-scaled grouped layout for the
     einsum fallback."""
-    impl = PREFIX_ATTN_IMPL if impl is None else impl
+    kind, mesh, axis, shards = _resolve_impl(impl)
     use_pallas = False
-    if impl == "pallas" or (impl == "auto" and jax.default_backend() == "tpu"):
+    if kind == "pallas" or (kind == "auto" and jax.default_backend() == "tpu"):
         from k8s_llm_scheduler_tpu.ops.pallas_prefix_attention import (
             causal_attention_supported,
         )
 
-        use_pallas = causal_attention_supported(q.shape, k_chunk.shape[2])
+        use_pallas = causal_attention_supported(
+            q.shape, k_chunk.shape[2], shards=shards
+        )
     if use_pallas:
         from k8s_llm_scheduler_tpu.ops.pallas_prefix_attention import (
             flash_causal_attention_parts,
+            flash_causal_attention_parts_shmap,
         )
 
+        if mesh is not None:
+            return flash_causal_attention_parts_shmap(
+                q, k_chunk, v_chunk, chunk_lens, mesh, axis
+            )
         return flash_causal_attention_parts(q, k_chunk, v_chunk, chunk_lens)
     S = q.shape[1]
     pos = jnp.arange(S)

@@ -189,6 +189,38 @@ def paged_decode_attention_parts(
     return o, m[:, :, 0].reshape(B, n_kv, g), l[:, :, 0].reshape(B, n_kv, g)
 
 
+def paged_decode_attention_parts_shmap(
+    q, k_cache, v_cache, page_table, seq_lens, mesh, axis: str = "tp",
+    interpret=None,
+):
+    """paged_decode_attention_parts with kv heads sharded over `mesh[axis]`.
+
+    The paged KV cache shards its kv-head dim over tp
+    (parallel/sharding.kv_cache_spec); page tables and seq lens replicate.
+    Per shard the kernel is unchanged and collective-free, so shard_map is
+    a pure layout wrapper (check_vma=False: pallas_call has no varying-axis
+    rule)."""
+    P = jax.sharding.PartitionSpec
+    fn = functools.partial(paged_decode_attention_parts, interpret=interpret)
+    return jax.shard_map(
+        fn,
+        mesh=mesh,
+        in_specs=(
+            P(None, axis, None),        # q [B, n_heads, hd]
+            P(None, None, axis, None),  # caches [pages, ps, n_kv, hd]
+            P(None, None, axis, None),
+            P(None, None),              # page_table [B, max_pages]
+            P(None),                    # seq_lens [B]
+        ),
+        out_specs=(
+            P(None, axis, None, None),  # o [B, n_kv, g, hd]
+            P(None, axis, None),        # m [B, n_kv, g]
+            P(None, axis, None),
+        ),
+        check_vma=False,
+    )(q, k_cache, v_cache, page_table, seq_lens)
+
+
 def _paged_call(q, k_cache, v_cache, page_table, seq_lens, *, normalize, interpret):
     B, n_heads, head_dim = q.shape
     num_pages, page_size, n_kv, _ = k_cache.shape

@@ -112,10 +112,18 @@ def _prefix_kernel(
 
 
 def prefix_attention_supported(
-    q_shape: tuple[int, ...], n_kv: int, prefix_cap: int
+    q_shape: tuple[int, ...], n_kv: int, prefix_cap: int, shards: int = 1
 ) -> bool:
-    """Whether the kernel's tiling constraints hold for these static shapes."""
+    """Whether the kernel's tiling constraints hold for these static shapes.
+
+    `shards` > 1 checks the PER-SHARD shapes of a shard_map over the
+    kv-head axis (heads divided over tp; nq is unchanged since the GQA
+    group size survives the division)."""
     B, S, n_heads, hd = q_shape
+    if n_heads % shards or n_kv % shards:
+        return False
+    n_heads //= shards
+    n_kv //= shards
     if n_heads % n_kv:
         return False
     nq = B * (n_heads // n_kv) * S  # query rows per kv head
@@ -201,9 +209,13 @@ def _causal_kernel(
         l_ref[0, 0] = l_scr[:]
 
 
-def causal_attention_supported(q_shape: tuple[int, ...], n_kv: int) -> bool:
+def causal_attention_supported(
+    q_shape: tuple[int, ...], n_kv: int, shards: int = 1
+) -> bool:
     B, S, n_heads, hd = q_shape
-    if n_heads % n_kv:
+    if n_heads % shards or n_kv % shards:
+        return False
+    if (n_heads // shards) % (n_kv // shards):
         return False
     return (
         _largest_divisor(S, 1024, 8) is not None
@@ -366,3 +378,58 @@ def flash_prefix_attention_parts(
     m = m[:, :, 0].reshape(n_kv, B, g, S).transpose(1, 0, 2, 3)
     l = l[:, :, 0].reshape(n_kv, B, g, S).transpose(1, 0, 2, 3)
     return o, m, l
+
+
+# ------------------------------------------------ tp-sharded (shard_map)
+# GSPMD cannot partition a pallas_call, but both kernels are embarrassingly
+# parallel over the kv-head axis — exactly the axis Megatron tp shards
+# (parallel/sharding.py: wq/wk/wv column-parallel). Wrapping the kernel in
+# shard_map over that axis runs one per-shard kernel per device with zero
+# collectives; the flash partials come back kv-head-sharded, which is the
+# layout merge_attention_parts and the wo row-parallel matmul expect.
+# check_vma=False: pallas_call carries no varying-axis rule, and the wrap
+# is collective-free by construction.
+
+
+def flash_prefix_attention_parts_shmap(
+    q, prefix_k, prefix_v, prefix_len, mesh, axis: str = "tp", interpret=None
+):
+    """flash_prefix_attention_parts with heads sharded over `mesh[axis]`."""
+    P = jax.sharding.PartitionSpec
+    fn = functools.partial(flash_prefix_attention_parts, interpret=interpret)
+    return jax.shard_map(
+        fn,
+        mesh=mesh,
+        in_specs=(
+            P(None, None, axis, None),  # q [B, S, n_heads, hd]
+            P(None, axis, None),        # prefix_k [Sp, n_kv, hd]
+            P(None, axis, None),
+            P(),                        # prefix_len scalar
+        ),
+        out_specs=(
+            P(None, axis, None, None, None),  # o [B, n_kv, g, S, hd]
+            P(None, axis, None, None),        # m [B, n_kv, g, S]
+            P(None, axis, None, None),
+        ),
+        check_vma=False,
+    )(q, prefix_k, prefix_v, jnp.asarray(prefix_len, jnp.int32))
+
+
+def flash_causal_attention_parts_shmap(
+    q, k, v, lens, mesh, axis: str = "tp", interpret=None
+):
+    """flash_causal_attention_parts with heads sharded over `mesh[axis]`."""
+    P = jax.sharding.PartitionSpec
+    fn = functools.partial(flash_causal_attention_parts, interpret=interpret)
+    head_spec = P(None, None, axis, None)  # [B, S, heads, hd]
+    return jax.shard_map(
+        fn,
+        mesh=mesh,
+        in_specs=(head_spec, head_spec, head_spec, P(None)),
+        out_specs=(
+            P(None, axis, None, None, None),
+            P(None, axis, None, None),
+            P(None, axis, None, None),
+        ),
+        check_vma=False,
+    )(q, k, v, lens)

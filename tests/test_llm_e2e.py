@@ -142,8 +142,15 @@ class TestShardedBackend:
             max_slots=2, num_pages=64, page_size=64,
             prefill_buckets=(512, 1024, 2048, 4096),
             chunk_steps=8, temperature=0.0, max_new_tokens=160,
+            # Kernels ON under tp sharding: the engine must wrap them in
+            # shard_map (interpret mode on the CPU mesh), not fall back.
+            prefix_attn_impl="pallas",
         )
         try:
+            from k8s_llm_scheduler_tpu.ops.attention import ShardedAttnImpl
+
+            impl = backend.engine.prefix_attn_impl
+            assert isinstance(impl, ShardedAttnImpl) and impl.kind == "pallas"
             # params actually sharded over the mesh
             leaves = jax.tree_util.tree_leaves(backend.engine.params)
             assert any(
@@ -175,6 +182,50 @@ class TestShardedBackend:
         finally:
             backend.close()
             cluster.close()
+
+    def test_sharded_pallas_matches_xla_decisions(self):
+        """Same pods, same sharded mesh: shard-mapped Pallas kernels and the
+        XLA cascade produce identical greedy decisions."""
+        from k8s_llm_scheduler_tpu.cluster.interface import raw_pod_to_spec
+
+        cfg = LlamaConfig(
+            name="tp-parity", vocab_size=512, d_model=64, n_layers=2,
+            n_heads=4, n_kv_heads=2, d_ff=128, max_seq_len=4096,
+            rope_theta=10000.0, dtype=jnp.float32, tie_embeddings=True,
+        )
+        cluster = synthetic_cluster(3)
+        nodes = cluster.get_node_metrics()
+        pods = [raw_pod_to_spec(p) for p in pod_burst(2, distinct_shapes=2)]
+        decisions = {}
+        for impl in ("pallas", "xla"):
+            backend = build_local_backend(
+                cfg=cfg, mesh_axes={"tp": 2},
+                max_slots=2, num_pages=64, page_size=64,
+                prefill_buckets=(512, 1024, 2048, 4096),
+                chunk_steps=8, temperature=0.0, max_new_tokens=160,
+                prefix_attn_impl=impl,
+            )
+            try:
+                decisions[impl] = [
+                    backend.get_scheduling_decision(p, nodes).selected_node
+                    for p in pods
+                ]
+            finally:
+                backend.close()
+        assert decisions["pallas"] == decisions["xla"]
+
+    def test_serving_rejects_non_tp_axes(self):
+        """dp>1 serving meshes replicate weights without sharding the batch
+        — build_local_backend must reject them loudly."""
+        cfg = LlamaConfig(
+            name="tp-reject", vocab_size=512, d_model=64, n_layers=2,
+            n_heads=4, n_kv_heads=2, d_ff=128, max_seq_len=4096,
+            rope_theta=10000.0, dtype=jnp.float32, tie_embeddings=True,
+        )
+        with pytest.raises(ValueError, match="only a tp axis"):
+            build_local_backend(cfg=cfg, mesh_axes={"tp": 2, "dp": 2})
+        with pytest.raises(ValueError, match="only a tp axis"):
+            build_local_backend(cfg=cfg, mesh_axes={"dp": 2})
 
 
 class TestGroupSwitching:
