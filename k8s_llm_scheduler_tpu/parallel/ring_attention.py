@@ -24,19 +24,25 @@ from jax.sharding import Mesh, PartitionSpec as P
 from k8s_llm_scheduler_tpu.ops.attention import NEG_INF
 
 
-def _block_attn(q, k, v, q_pos, k_pos, scale):
+def _block_attn(q, k, v, q_pos, k_pos, scale, k_valid=None):
     """One (q-chunk x k-chunk) block: masked logits, local max/sum stats.
 
-    q: [B, Sq, n_kv, g, hd]; k/v: [B, Sk, n_kv, hd].
+    q: [B, Sq, n_kv, g, hd]; k/v: [B, Sk, n_kv, hd]; k_valid: [B, Sk] bool
+    per-row key validity (padding mask), None = all valid.
     Returns (num [B,Sq,n_kv,g,hd], den [B,Sq,n_kv,g], mx [B,Sq,n_kv,g]).
     """
     logits = jnp.einsum(
         "bqkgh,bskh->bqkgs", q.astype(jnp.float32) * scale, k.astype(jnp.float32)
     )
-    mask = q_pos[:, None] >= k_pos[None, :]  # causal by absolute position
-    logits = jnp.where(mask[None, :, None, None, :], logits, NEG_INF)
+    mask = (q_pos[:, None] >= k_pos[None, :])[None, :, None, None, :]  # causal
+    if k_valid is not None:
+        mask = mask & k_valid[:, None, None, None, :]
+    logits = jnp.where(mask, logits, NEG_INF)
     mx = jnp.max(logits, axis=-1)
     p = jnp.exp(logits - mx[..., None])
+    # exp(NEG_INF - NEG_INF) = 1 on fully-masked rows — zero them so a
+    # padded-out row reports den 0 (weight 0) instead of garbage mass.
+    p = jnp.where(mask, p, 0.0)
     den = jnp.sum(p, axis=-1)
     num = jnp.einsum("bqkgs,bskh->bqkgh", p, v.astype(jnp.float32))
     return num, den, mx
@@ -48,10 +54,17 @@ def ring_self_attention(
     v: jax.Array,
     axis_name: str,
     varying_axes: tuple[str, ...] | None = None,
+    seq_lens: jax.Array | None = None,  # [B] GLOBAL valid length per row
 ) -> jax.Array:
     """Causal ring attention over `axis_name`. Call inside shard_map with the
     sequence dim sharded over that axis. Chunks are assumed layed out in
-    order: device i holds positions [i*S_local, (i+1)*S_local)."""
+    order: device i holds positions [i*S_local, (i+1)*S_local).
+
+    `seq_lens` gives each row's global valid length: keys at absolute
+    positions >= seq_lens[b] are masked out of every block, so padded
+    batches attend only real tokens — matching unsharded masked attention
+    (padding-row queries attend the row's valid prefix, exactly like
+    ops.attention.causal_prefill_attention; loss masking drops them)."""
     B, S, n_heads, hd = q.shape
     n_kv = k.shape[2]
     g = n_heads // n_kv
@@ -81,7 +94,12 @@ def ring_self_attention(
         k_cur, v_cur, num, den, mx = carry
         src = (me - r) % n  # whose chunk we hold after r rotations
         k_pos = src * S + local_pos
-        b_num, b_den, b_mx = _block_attn(qg, k_cur, v_cur, q_pos, k_pos, scale)
+        k_valid = (
+            None if seq_lens is None else k_pos[None, :] < seq_lens[:, None]
+        )
+        b_num, b_den, b_mx = _block_attn(
+            qg, k_cur, v_cur, q_pos, k_pos, scale, k_valid
+        )
         new_mx = jnp.maximum(mx, b_mx)
         corr_old = jnp.exp(mx - new_mx)
         corr_new = jnp.exp(b_mx - new_mx)
@@ -94,8 +112,8 @@ def ring_self_attention(
     k_f, v_f, num, den, mx = jax.lax.fori_loop(
         0, n, step, (k, v, num0, den0, mx0)
     )
-    # Fully-masked rows (den==0 can't happen causally: position attends to
-    # itself) — still guard the division.
+    # den==0 only for a zero-length row (every key masked): the guard maps
+    # it to 0 output instead of dividing by zero.
     out = num / jnp.maximum(den, 1e-30)[..., None]
     return out.reshape(B, S, n_heads, hd).astype(q.dtype)
 
@@ -107,27 +125,29 @@ def make_ring_prefill_attention(
     S sharded over `sp_axis` (and optionally B over `batch_axis`), returns
     the attention output with the same sharding. Signature-compatible with
     ops.attention.causal_prefill_attention so it can be passed as
-    `attn_impl` to the model forward; `seq_lens` is accepted but sequences
-    must be full/unpadded (ring chunks have no per-chunk padding support)."""
+    `attn_impl` to the model forward. `seq_lens` (per-row global valid
+    length) masks padded key positions out of every ring block, so padded
+    batches match unsharded masked attention — the round-2 NaN-poison
+    guard is gone."""
 
     spec = P(batch_axis, sp_axis, None, None)
     varying = tuple(a for a in (sp_axis, batch_axis) if a)
 
-    def wrapped(q, k, v):
-        return ring_self_attention(q, k, v, sp_axis, varying_axes=varying)
+    def wrapped(q, k, v, lens):
+        return ring_self_attention(
+            q, k, v, sp_axis, varying_axes=varying, seq_lens=lens
+        )
 
     wrapped = functools.partial(
-        jax.shard_map, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(spec, spec, spec, P(batch_axis)),
+        out_specs=spec,
     )(wrapped)
 
     def attn(q, k, v, seq_lens=None):
-        if seq_lens is not None:
-            # Loud guard instead of silent corruption: ring chunks carry no
-            # per-chunk padding mask, so padded rows would attend pad K/V.
-            # A padded batch NaN-poisons the output (surfaces in the loss)
-            # rather than silently training on contaminated activations.
-            ok = jnp.all(seq_lens == q.shape[1])
-            q = jnp.where(ok, q, jnp.nan)
-        return wrapped(q, k, v)
+        if seq_lens is None:
+            seq_lens = jnp.full((q.shape[0],), q.shape[1], jnp.int32)
+        return wrapped(q, k, v, seq_lens.astype(jnp.int32))
 
     return attn

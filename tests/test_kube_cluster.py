@@ -36,9 +36,11 @@ class FakeCoreV1Api:
         self._state = state
 
     def list_node(self):
+        self._state["list_node_calls"] = self._state.get("list_node_calls", 0) + 1
         return _ns(items=self._state["nodes"])
 
     def list_pod_for_all_namespaces(self, **kw):
+        self._state["list_pods_calls"] = self._state.get("list_pods_calls", 0) + 1
         return _ns(items=self._state["pods"])
 
     def create_namespaced_binding(self, namespace, body, _preload_content=True):
@@ -298,6 +300,94 @@ class TestPodConversion:
              {"key": "metadata.name", "operator": "NotIn",
               "values": ["node-b"], "field": True}],
         ]
+
+
+class TestInformer:
+    """Watch-driven cluster-state cache: snapshots are O(1) reads while the
+    watch is live — one initial relist, then ZERO list calls (SURVEY §7,
+    replacing the reference's per-snapshot N+1, scheduler.py:144-147)."""
+
+    async def test_snapshots_cost_zero_list_calls_while_watch_live(self, kube_env):
+        kube_mod, state = kube_env
+        state["nodes"] = [make_node("node-a"), make_node("node-b")]
+        state["pods"] = [
+            make_v1_pod("p0", node_name="node-a", phase="Running")
+        ]
+        cluster = kube_mod.KubeCluster(watch_timeout_seconds=1)
+        metrics = cluster.get_node_metrics()  # initial full relist
+        assert state["list_node_calls"] == 1
+        assert state["list_pods_calls"] == 1
+        assert {n.name: n.pod_count for n in metrics} == {
+            "node-a": 1, "node-b": 0,
+        }
+
+        state["watch_scripts"] = [[
+            {"type": "ADDED",
+             "object": make_v1_pod("p1", node_name="node-b", phase="Running")},
+            {"type": "DELETED",
+             "object": make_v1_pod("p0", node_name="node-a", phase="Running")},
+            {"object": make_v1_pod("match-1")},  # pending -> yielded
+        ]]
+        stream = cluster.watch_pending_pods("ai-sched")
+        got = []
+        async with asyncio.timeout(30):
+            async for raw in stream:
+                got.append(raw.name)
+                break
+        assert got == ["match-1"]
+        # events preceding match-1 were folded into the informer in order
+        for _ in range(8):
+            metrics = cluster.get_node_metrics()
+        assert state["list_node_calls"] == 1, "snapshot relisted nodes"
+        assert state["list_pods_calls"] == 1, "snapshot relisted pods"
+        assert {n.name: n.pod_count for n in metrics} == {
+            "node-a": 0, "node-b": 1,
+        }
+        await stream.aclose()
+
+    async def test_watch_break_marks_informer_stale(self, kube_env):
+        kube_mod, state = kube_env
+        state["nodes"] = [make_node("node-a")]
+        state["pods"] = []
+        cluster = kube_mod.KubeCluster(watch_timeout_seconds=1)
+        cluster.get_node_metrics()
+        calls_before = state["list_pods_calls"]
+        state["watch_scripts"] = [RuntimeError("stream broke")]
+        stream = cluster.watch_pending_pods("ai-sched")
+        consume = asyncio.ensure_future(stream.__anext__())
+        try:
+            # a broken stream may have dropped events: snapshots must fall
+            # back to relisting until the watch recovers
+            async with asyncio.timeout(10):
+                while state["list_pods_calls"] == calls_before:
+                    cluster.get_node_metrics()
+                    await asyncio.sleep(0.02)
+        finally:
+            consume.cancel()
+            try:
+                await consume
+            except (asyncio.CancelledError, StopAsyncIteration):
+                pass
+            await stream.aclose()
+
+    def test_bind_optimistically_updates_counts(self, kube_env):
+        kube_mod, state = kube_env
+        state["nodes"] = [make_node("node-a")]
+        state["pods"] = []
+        cluster = kube_mod.KubeCluster()
+        cluster.get_node_metrics()
+        assert cluster.bind_pod_to_node("p9", "default", "node-a") is True
+        assert cluster._inf_counts["node-a"] == 1
+        assert cluster._inf_pod_node[("default", "p9")] == "node-a"
+
+    def test_informer_disabled_always_relists(self, kube_env):
+        kube_mod, state = kube_env
+        state["nodes"] = [make_node("node-a")]
+        state["pods"] = []
+        cluster = kube_mod.KubeCluster(informer=False)
+        cluster.get_node_metrics()
+        cluster.get_node_metrics()
+        assert state["list_node_calls"] == 2
 
 
 class TestWatch:

@@ -112,3 +112,39 @@ class TestRingAttention:
         out2 = make_ring_prefill_attention(make_mesh({"sp": 2}), "sp")(q, k, v)
         out4 = make_ring_prefill_attention(make_mesh({"sp": 4}), "sp")(q, k, v)
         np.testing.assert_allclose(np.asarray(out2), np.asarray(out4), atol=2e-4, rtol=1e-3)
+
+    def test_padded_batch_matches_full_attention(self):
+        """Ragged seq_lens: ring attention over sp=4 equals unsharded masked
+        attention on the valid region; padding-row queries come back 0
+        (replacing the round-2 NaN-poison guard)."""
+        B, S, H, KV, hd = 3, 64, 8, 4, 16
+        q = jax.random.normal(jax.random.PRNGKey(9), (B, S, H, hd), dtype=jnp.float32)
+        k = jax.random.normal(jax.random.PRNGKey(10), (B, S, KV, hd), dtype=jnp.float32)
+        v = jax.random.normal(jax.random.PRNGKey(11), (B, S, KV, hd), dtype=jnp.float32)
+        # lengths land mid-chunk (41), on a chunk boundary (32), and full
+        lens = jnp.array([41, 32, 64], dtype=jnp.int32)
+
+        ref = causal_prefill_attention(q, k, v, lens)
+        ring = make_ring_prefill_attention(make_mesh({"sp": 4}), "sp")
+        out = np.asarray(ring(q, k, v, seq_lens=lens))
+        assert not np.any(np.isnan(out))
+        # Whole output matches, padding-row queries included: both paths
+        # have them attend the row's valid prefix (downstream loss masking
+        # ignores those rows either way).
+        np.testing.assert_allclose(out, np.asarray(ref), atol=2e-4, rtol=1e-3)
+
+    def test_padded_batch_with_batch_axis(self):
+        """seq_lens shard correctly over a dp batch axis alongside sp."""
+        B, S, H, KV, hd = 2, 32, 4, 2, 8
+        q = jax.random.normal(jax.random.PRNGKey(12), (B, S, H, hd), dtype=jnp.float32)
+        k = jax.random.normal(jax.random.PRNGKey(13), (B, S, KV, hd), dtype=jnp.float32)
+        v = jax.random.normal(jax.random.PRNGKey(14), (B, S, KV, hd), dtype=jnp.float32)
+        lens = jnp.array([20, 32], dtype=jnp.int32)
+        ref = causal_prefill_attention(q, k, v, lens)
+        mesh = make_mesh({"dp": 2, "sp": 4})
+        ring = make_ring_prefill_attention(mesh, "sp", batch_axis="dp")
+        out = np.asarray(ring(q, k, v, seq_lens=lens))
+        for b, n in enumerate([20, 32]):
+            np.testing.assert_allclose(
+                out[b, :n], np.asarray(ref)[b, :n], atol=2e-4, rtol=1e-3
+            )
