@@ -139,8 +139,8 @@ PRESETS = {
     # standalone measures ~130ms).
     "longctx": {"pods": 16, "nodes": 256, "shapes": 4, "rounds": 3, "slots": 4},
     # sustained arrivals instead of burst-at-t0: per-decision latency with a
-    # WARM prefix/grammar, the operating point between bursts. Not part of
-    # the default suite (run explicitly: --preset steady).
+    # WARM prefix/grammar, the operating point between bursts. Runs in the
+    # default suite at 1 round (bounded); standalone runs get 2.
     "steady": {"pods": 128, "nodes": 32, "shapes": 16, "rounds": 2,
                "arrival_rate": 100.0},
 }
@@ -501,22 +501,37 @@ def run_suite(args) -> None:
         ns_long = _preset_ns("longctx")
         r_long = await bench_preset(ns_long)
         _emit(r_long)
-        return r_def, r_burst, r_long
 
-    r_def, r_burst, r_long = asyncio.run(suite())
+        # steady-state arrivals, bounded to ONE round in the suite so
+        # BENCH_r*.json tracks warm per-decision latency round over round
+        # without doubling suite wall time.
+        ns_steady = _preset_ns("steady")
+        ns_steady.rounds = 1
+        r_steady = await bench_preset(ns_steady)
+        _emit(r_steady)
+        return r_def, r_burst, r_long, r_steady
+
+    r_def, r_burst, r_long, r_steady = asyncio.run(suite())
 
     tp_bench = model_throughput("bench", None, args.peak_tflops)
     _emit(tp_bench)
     tp_1b = model_throughput("llama-3.2-1b-instruct", None, args.peak_tflops)
     _emit(tp_1b)
+    # int8 weight-only path, bench-size: tracks the quantized decode/prefill
+    # kernels every round (the 8B int8 run is a 20-30 min standalone:
+    # `--preset throughput --model llama-3.1-8b-instruct --quantize int8`).
+    tp_int8 = model_throughput("bench", "int8", args.peak_tflops)
+    _emit(tp_int8)
 
     r_def["extra"]["presets"] = {
         "burst1000": r_burst["extra"],
         "longctx": r_long["extra"],
+        "steady": r_steady["extra"],
     }
     r_def["extra"]["throughput"] = {
         "bench": tp_bench["extra"],
         "llama-3.2-1b": tp_1b["extra"],
+        "bench-int8": tp_int8["extra"],
     }
     r_def["extra"]["dispatch_rtt_ms"] = measure_dispatch_rtt_ms()
     _emit(r_def)

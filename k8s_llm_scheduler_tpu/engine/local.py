@@ -105,8 +105,12 @@ class LocalLLMBackend:
         request_timeout_s: float = 60.0,
         admit_wait_s: float = 0.002,
         group_switch_after_s: float = 0.25,
+        partial_hold_s: float = 0.03,
     ) -> None:
         self.engine = engine
+        # Max time a ragged wave tail may wait for stragglers while earlier
+        # waves are in flight (see _submit_waves.run_group).
+        self.partial_hold_s = partial_hold_s
         self.tokenizer = tokenizer or engine.tokenizer
         self.prompt_engine = PromptEngine()
         self.max_new_tokens = max_new_tokens
@@ -255,11 +259,14 @@ class LocalLLMBackend:
                 waves.append((handle, batch))
 
         def run_group(items: list[_WorkItem]) -> None:
-            """Full waves submit; a ragged tail holds while the pipeline is
-            busy. While a wave is executing (~150ms+), more of the burst's
-            leaders keep arriving — holding the partial until then turns
-            seven ragged waves into two full ones, and the held items lose
-            no time (the device is busy with the earlier wave anyway)."""
+            """Full waves submit; a ragged tail holds BRIEFLY while the
+            pipeline is busy. While a wave executes, more of the burst's
+            leaders keep arriving — holding the partial turns seven ragged
+            waves into two full ones. But the hold must be deadline-bounded:
+            waves pipeline on device, so once the tail has waited
+            ~hold_max_s it ships as-is — an unbounded hold parks the tail
+            for a FULL wave round trip (~230ms measured), pushing its
+            followers past every other pod in the burst."""
             batch: list[_WorkItem] = []
             for item in items:
                 batch.append(item)
@@ -267,7 +274,9 @@ class LocalLLMBackend:
                     submit(batch)
                     batch = []
             if batch:
-                if waves:
+                oldest = min(i.enqueued_at for i in batch)
+                held_s = time.perf_counter() - oldest
+                if waves and held_s < self.partial_hold_s:
                     rest.extend(batch)
                 else:
                     submit(batch)
@@ -398,6 +407,10 @@ class LocalLLMBackend:
                 try:
                     got = self._queue.get(timeout=0.002)
                 except queue.Empty:
+                    if pending:
+                        # held ragged tails re-check their hold deadline
+                        # even with no new arrivals (run_group)
+                        pending = self._submit_waves(pending, waves)
                     continue
                 if got is None:
                     self._stopped.set()

@@ -123,12 +123,49 @@ class DecisionClient:
             self.stats["fallback_decisions"] += 1
         return decision
 
-    async def get_scheduling_decision(
+    def fast_decision(
         self, pod: PodSpec, nodes: Sequence[NodeMetrics]
+    ) -> tuple[SchedulingDecision | None, "asyncio.Future | None"]:
+        """Synchronous fast path for the burst hot loop (sched/loop.py):
+
+        - (decision, None): cache hit, counted, ready to bind — no
+          coroutine needed;
+        - (None, future): a single-flight leader for this key is in flight;
+          the caller may park the pod on the future (follower fan-out) and
+          bind the whole batch when it resolves — count via
+          note_coalesced(n) at flush;
+        - (None, None): backend work needed — take the full async path.
+        """
+        if self.cache is None:
+            return None, None
+        key = decision_cache_key(pod, nodes)
+        cached = self.cache.get(pod, nodes, key=key)
+        if cached is not None:
+            self.stats["total_requests"] += 1
+            self.stats["cached_requests"] += 1
+            return dataclasses.replace(cached, source=DecisionSource.CACHE), None
+        return None, self._inflight.get(key)
+
+    def note_coalesced(self, n: int) -> None:
+        """Account a flushed follower batch (see fast_decision)."""
+        self.stats["total_requests"] += n
+        self.stats["coalesced_requests"] += n
+        self.stats["cached_requests"] += n
+
+    async def get_scheduling_decision(
+        self,
+        pod: PodSpec,
+        nodes: Sequence[NodeMetrics],
+        concurrency: "asyncio.Semaphore | None" = None,
     ) -> SchedulingDecision | None:
         """Decide a node for `pod`, or None when nothing can decide (the pod
         stays Pending and will be re-observed — correctness rests on the
-        cluster as source of truth, SURVEY §5 checkpoint note)."""
+        cluster as source of truth, SURVEY §5 checkpoint note).
+
+        `concurrency` bounds ONLY the backend-work path (_decide_uncached):
+        cache hits and single-flight follower waits never hold a slot — but
+        a follower that falls through after a failed leader does, so a
+        leader failure can't stampede an unbounded herd onto the backend."""
         self.stats["total_requests"] += 1
 
         key: str | None = None
@@ -160,7 +197,11 @@ class DecisionClient:
                 my_future = fut
 
         try:
-            decision = await self._decide_uncached(pod, nodes, cache_key=key)
+            if concurrency is not None:
+                async with concurrency:
+                    decision = await self._decide_uncached(pod, nodes, cache_key=key)
+            else:
+                decision = await self._decide_uncached(pod, nodes, cache_key=key)
         except BaseException:
             if my_future is not None:
                 if self._inflight.get(key) is my_future:

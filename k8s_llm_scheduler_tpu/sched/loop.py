@@ -21,6 +21,7 @@ TPU-first differences:
 from __future__ import annotations
 
 import asyncio
+import dataclasses
 import logging
 import time
 from collections.abc import Sequence
@@ -59,6 +60,8 @@ class Scheduler:
         self._snapshot: tuple[float, Sequence[NodeMetrics]] | None = None
         self._snapshot_lock = asyncio.Lock()
         self._tasks: set[asyncio.Task] = set()
+        # follower fan-out batches parked on in-flight leader futures
+        self._followers: dict[asyncio.Future, list] = {}
         self._stop_event = asyncio.Event()
         self.running = False
         # Per-phase wall time of the decision pipeline (SURVEY §5 tracing:
@@ -83,10 +86,13 @@ class Scheduler:
             self._snapshot = (time.monotonic(), metrics)
             return metrics
 
-    async def schedule_pod(self, raw: RawPod) -> bool:
+    async def schedule_pod(self, raw: RawPod, pod=None) -> bool:
         """One pod through the full pipeline (reference scheduler.py:690-729).
-        Returns True iff the pod was bound."""
-        pod = raw_pod_to_spec(raw)
+        Returns True iff the pod was bound. `pod` is the optional
+        already-converted PodSpec (the fast path computes it before falling
+        through; don't pay raw_pod_to_spec twice on the ingest hot path)."""
+        if pod is None:
+            pod = raw_pod_to_spec(raw)
         with self.phases.phase("snapshot"):
             nodes = await self._node_snapshot()
         if not nodes:
@@ -95,7 +101,15 @@ class Scheduler:
             return False
 
         with self.phases.phase("decide"):
-            decision = await self.client.get_scheduling_decision(pod, nodes)
+            # The semaphore is passed THROUGH: the client acquires it only
+            # around real backend work. Cache hits and single-flight
+            # follower waits never hold a slot (during a burst, followers
+            # parked on slots throttled the watch drain behind the wave
+            # round trip — measured ~2x p50 inflation), while a follower
+            # retrying after a failed leader is still bounded.
+            decision = await self.client.get_scheduling_decision(
+                pod, nodes, concurrency=self._sem
+            )
         if decision is None:
             self.stats["unschedulable"] += 1
             return False
@@ -139,12 +153,107 @@ class Scheduler:
         )
         return True
 
-    async def _spawn(self, raw: RawPod) -> None:
-        async with self._sem:
-            try:
-                await self.schedule_pod(raw)
-            except Exception:
-                logger.exception("unhandled error scheduling %s/%s", raw.namespace, raw.name)
+    async def _spawn(self, raw: RawPod, pod=None) -> None:
+        # No semaphore here: the client bounds only its backend work, so
+        # cache/coalesced decisions drain at host speed during a burst.
+        try:
+            await self.schedule_pod(raw, pod)
+        except Exception:
+            logger.exception("unhandled error scheduling %s/%s", raw.namespace, raw.name)
+
+    # ------------------------------------------------------- burst fast path
+    def _try_fast(self, raw: RawPod) -> tuple[bool, "PodSpec | None"]:
+        """Handle a watch event synchronously on the hot loop when no
+        backend work is needed. Returns (handled, pod_spec); an unhandled
+        pod's spec is passed to the full path so it isn't converted twice.
+
+        During a 1000-pod burst only ~#shapes decisions need the model;
+        everything else is a cache hit or a follower of an in-flight
+        single-flight leader. Spawning a task per such pod (round 2) made
+        the median pod's latency drain-bound: hundreds of live coroutines
+        contended with the engine's wave round trip. Here cache hits bind
+        inline and followers park on the leader's future in a LIST — one
+        callback flushes the whole batch when the leader resolves, so the
+        loop stays idle while the wave is in flight (the pod's latency is
+        then one wave round trip, not host scheduling).
+        """
+        if not getattr(self.binder, "bind_is_nonblocking", False):
+            return False, None  # blocking binders need the executor path
+        snap = self._snapshot
+        if snap is None or time.monotonic() - snap[0] >= self.snapshot_ttl_s:
+            return False, None  # no fresh snapshot: full path refreshes it
+        nodes = snap[1]
+        if not nodes:
+            return False, None
+        pod = raw_pod_to_spec(raw)
+        t0 = time.perf_counter()
+        decision, fut = self.client.fast_decision(pod, nodes)
+        if decision is not None:
+            # Record the decide phase only when the fast path handles the
+            # pod — an unhandled probe falls through to schedule_pod, which
+            # records its own decide (double counting otherwise).
+            self.phases.record("decide", time.perf_counter() - t0)
+            self.stats["cache_decisions"] += 1
+            self._bind_now(pod, decision)
+            return True, pod
+        if fut is not None:
+            batch = self._followers.get(fut)
+            if batch is None:
+                self._followers[fut] = batch = []
+                fut.add_done_callback(self._flush_followers)
+            batch.append((raw, pod, t0))
+            return True, pod
+        return False, pod
+
+    def _bind_now(self, pod, decision) -> None:
+        """Synchronous bind + bookkeeping (nonblocking binders only)."""
+        with self.phases.phase("bind"):
+            ok = self.binder.bind_pod_to_node(
+                pod.name, pod.namespace, decision.selected_node
+            )
+        if ok:
+            self.stats["total_scheduled"] += 1
+        else:
+            self.stats["failed_bindings"] += 1
+            logger.error(
+                "binding failed: %s/%s -> %s",
+                pod.namespace, pod.name, decision.selected_node,
+            )
+
+    def _flush_followers(self, fut: asyncio.Future) -> None:
+        """Leader resolved: bind its parked followers in one pass, or (on a
+        failed/fallback leader) degrade each to the full path."""
+        batch = self._followers.pop(fut, [])
+        if not batch:
+            return
+        leader = None
+        if not fut.cancelled():
+            leader = fut.result()  # single-flight futures never hold exceptions
+        if leader is not None:
+            self.client.note_coalesced(len(batch))
+            decision = dataclasses.replace(leader, source=DecisionSource.CACHE)
+            now = time.perf_counter()
+            for _raw, pod, parked_at in batch:
+                # Per-item isolation: one raising bind must not drop the
+                # rest of the batch (this runs in a future done-callback).
+                try:
+                    # follower decide duration = park -> leader resolution,
+                    # matching what the shield-await path used to measure
+                    self.phases.record("decide", now - parked_at)
+                    self.stats["cache_decisions"] += 1
+                    self._bind_now(pod, decision)
+                except Exception:
+                    self.stats["failed_bindings"] += 1
+                    logger.exception(
+                        "follower bind failed: %s/%s", pod.namespace, pod.name
+                    )
+        else:
+            # leader failed or fell back: each follower decides on the full
+            # path (which records its own decide phase)
+            for raw, pod, _t0 in batch:
+                task = asyncio.create_task(self._spawn(raw, pod))
+                self._tasks.add(task)
+                task.add_done_callback(self._tasks.discard)
 
     async def run(self) -> None:
         """Watch loop: stream pending pods, schedule each concurrently.
@@ -179,7 +288,21 @@ class Scheduler:
                             raw = next_task.result()
                         except StopAsyncIteration:
                             break
-                        task = asyncio.create_task(self._spawn(raw))
+                        pod = None
+                        try:
+                            handled, pod = self._try_fast(raw)
+                        except Exception:
+                            # Per-pod containment: a poison event must not
+                            # tear down the watch stream (the full path has
+                            # its own try/except in _spawn).
+                            handled = False
+                            logger.exception(
+                                "fast path failed for %s/%s",
+                                raw.namespace, raw.name,
+                            )
+                        if handled:
+                            continue
+                        task = asyncio.create_task(self._spawn(raw, pod))
                         self._tasks.add(task)
                         task.add_done_callback(self._tasks.discard)
                     break  # stream ended cleanly or stop requested

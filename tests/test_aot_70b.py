@@ -37,6 +37,10 @@ from k8s_llm_scheduler_tpu.parallel.sharding import (
     validate_specs_divisibility,
 )
 
+# Everything here jit-compiles models/kernels (seconds per test):
+# full-suite only, excluded from the fast tier (TESTING.md).
+pytestmark = pytest.mark.slow
+
 CFG = get_config("llama-3.3-70b-instruct")
 GB = 1e9
 

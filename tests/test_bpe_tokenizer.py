@@ -16,6 +16,10 @@ import jax
 import jax.numpy as jnp
 import pytest
 
+# Everything here jit-compiles models/kernels (seconds per test):
+# full-suite only, excluded from the fast tier (TESTING.md).
+pytestmark = pytest.mark.slow
+
 FIXTURE = str(
     Path(__file__).resolve().parent.parent
     / "k8s_llm_scheduler_tpu" / "assets" / "bpe4k"

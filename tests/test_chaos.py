@@ -20,6 +20,10 @@ from k8s_llm_scheduler_tpu.models.configs import LlamaConfig
 from k8s_llm_scheduler_tpu.sched.client import DecisionClient
 from k8s_llm_scheduler_tpu.types import DecisionSource
 
+# Everything here jit-compiles models/kernels (seconds per test):
+# full-suite only, excluded from the fast tier (TESTING.md).
+pytestmark = pytest.mark.slow
+
 
 def tiny_backend(**kw):
     cfg = LlamaConfig(

@@ -17,6 +17,10 @@ from k8s_llm_scheduler_tpu.models.configs import LlamaConfig
 from k8s_llm_scheduler_tpu.models.llama import init_params
 from k8s_llm_scheduler_tpu.utils.json_extract import parse_decision_json
 
+# Everything here jit-compiles models/kernels (seconds per test):
+# full-suite only, excluded from the fast tier (TESTING.md).
+pytestmark = pytest.mark.slow
+
 TOK = ByteTokenizer()
 
 ENGINE_CFG = LlamaConfig(

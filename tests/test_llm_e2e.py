@@ -26,6 +26,10 @@ from k8s_llm_scheduler_tpu.testing import (
 )
 from k8s_llm_scheduler_tpu.types import DecisionSource
 
+# Everything here jit-compiles models/kernels (seconds per test):
+# full-suite only, excluded from the fast tier (TESTING.md).
+pytestmark = pytest.mark.slow
+
 E2E_CFG = LlamaConfig(
     name="e2e-test", vocab_size=512, d_model=64, n_layers=2, n_heads=4,
     n_kv_heads=2, d_ff=128, max_seq_len=4096, rope_theta=10000.0,

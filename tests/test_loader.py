@@ -17,6 +17,10 @@ from k8s_llm_scheduler_tpu.models.loader import (
 )
 from k8s_llm_scheduler_tpu.parallel.mesh import make_mesh
 
+# Everything here jit-compiles models/kernels (seconds per test):
+# full-suite only, excluded from the fast tier (TESTING.md).
+pytestmark = pytest.mark.slow
+
 CFG = LlamaConfig(
     name="loader-test", vocab_size=256, d_model=64, n_layers=3, n_heads=4,
     n_kv_heads=2, d_ff=128, max_seq_len=512, rope_theta=10000.0,

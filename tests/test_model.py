@@ -18,6 +18,10 @@ from k8s_llm_scheduler_tpu.models.llama import (
     rope_inv_freq,
 )
 
+# Everything here jit-compiles models/kernels (seconds per test):
+# full-suite only, excluded from the fast tier (TESTING.md).
+pytestmark = pytest.mark.slow
+
 CFG = LlamaConfig(
     name="test", vocab_size=64, d_model=32, n_layers=2, n_heads=4, n_kv_heads=2,
     d_ff=64, max_seq_len=256, rope_theta=10000.0, dtype=jnp.float32,

@@ -17,6 +17,10 @@ from k8s_llm_scheduler_tpu.parallel.sharding import (
     validate_specs_divisibility,
 )
 
+# Everything here jit-compiles models/kernels (seconds per test):
+# full-suite only, excluded from the fast tier (TESTING.md).
+pytestmark = pytest.mark.slow
+
 CFG = LlamaConfig(
     name="par-test", vocab_size=64, d_model=64, n_layers=2, n_heads=8, n_kv_heads=4,
     d_ff=128, max_seq_len=256, rope_theta=10000.0, dtype=jnp.float32,

@@ -174,3 +174,97 @@ class TestStopWhileIdle:
         await asyncio.sleep(0.1)  # loop is idle, blocked on the stream
         scheduler.stop()  # no cluster.close() — stop alone must suffice
         await asyncio.wait_for(task, timeout=2)
+
+
+class TestBurstFastPath:
+    """The watch-loop fast path: cache hits bind inline, followers park on
+    the leader's future and flush as a batch (no per-pod task)."""
+
+    @pytest.mark.asyncio
+    async def test_followers_coalesce_onto_leader(self):
+        cluster = synthetic_cluster(3)
+        backend = StubBackend(latency_s=0.15)
+        scheduler = make_scheduler(cluster, backend, snapshot_ttl_s=60.0)
+        task = asyncio.create_task(scheduler.run())
+        try:
+            # leaders first: they take the full path and install the
+            # snapshot + in-flight futures the fast path needs
+            for pod in pod_burst(2, distinct_shapes=2):
+                cluster.add_pod(pod)
+            await asyncio.sleep(0.05)
+            followers = pod_burst(20, distinct_shapes=2)[2:]
+            for pod in followers:
+                cluster.add_pod(pod)
+            async with asyncio.timeout(20):
+                while cluster.bind_count < 20:
+                    await asyncio.sleep(0.01)
+        finally:
+            scheduler.stop()
+            cluster.close()
+            await asyncio.wait_for(task, timeout=5)
+        stats = scheduler.get_stats()
+        assert stats["total_scheduled"] == 20
+        assert backend.calls == 2, "followers must coalesce, not re-decide"
+        assert stats["client"]["coalesced_requests"] >= 16
+        assert stats["llm_decisions"] == 2
+        assert stats["cache_decisions"] == 18
+        # phase accounting covers fast-path pods exactly once each
+        assert stats["phases"]["decide"]["count"] == 20
+        assert stats["phases"]["bind"]["count"] == 20
+
+    @pytest.mark.asyncio
+    async def test_failed_leader_followers_degrade_bounded(self):
+        """Leader exhausts retries -> its future resolves None -> parked
+        followers re-decide on the FULL path (bounded by the semaphore),
+        and every pod still lands."""
+        cluster = synthetic_cluster(3)
+        backend = StubBackend(latency_s=0.1)
+        backend.fail_next = 3  # leader's 3 attempts all fail -> fallback
+        scheduler = make_scheduler(cluster, backend, snapshot_ttl_s=60.0)
+        task = asyncio.create_task(scheduler.run())
+        try:
+            pods = pod_burst(10, distinct_shapes=1)
+            cluster.add_pod(pods[0])
+            await asyncio.sleep(0.05)  # leader in flight
+            for pod in pods[1:]:
+                cluster.add_pod(pod)
+            async with asyncio.timeout(20):
+                while cluster.bind_count < 10:
+                    await asyncio.sleep(0.01)
+        finally:
+            scheduler.stop()
+            cluster.close()
+            await asyncio.wait_for(task, timeout=5)
+        stats = scheduler.get_stats()
+        assert stats["total_scheduled"] == 10
+        # leader fell back; followers recovered through the healthy backend
+        assert stats["fallback_decisions"] >= 1
+        assert stats["llm_decisions"] + stats["cache_decisions"] >= 9
+
+    @pytest.mark.asyncio
+    async def test_bind_failure_in_flush_is_isolated(self):
+        """One failing bind inside a follower flush batch must not drop the
+        rest of the batch."""
+        cluster = synthetic_cluster(3)
+        backend = StubBackend(latency_s=0.15)
+        scheduler = make_scheduler(cluster, backend, snapshot_ttl_s=60.0)
+        task = asyncio.create_task(scheduler.run())
+        try:
+            pods = pod_burst(10, distinct_shapes=1)
+            cluster.add_pod(pods[0])
+            await asyncio.sleep(0.05)
+            # fail the leader's own bind + one follower's bind
+            cluster.fail_next_bindings = 2
+            for pod in pods[1:]:
+                cluster.add_pod(pod)
+            async with asyncio.timeout(20):
+                while cluster.bind_count < 8:
+                    await asyncio.sleep(0.01)
+            await asyncio.sleep(0.1)  # let any stragglers finish
+        finally:
+            scheduler.stop()
+            cluster.close()
+            await asyncio.wait_for(task, timeout=5)
+        stats = scheduler.get_stats()
+        assert stats["failed_bindings"] == 2
+        assert stats["total_scheduled"] == 8

@@ -9,6 +9,10 @@ from k8s_llm_scheduler_tpu.models.configs import LlamaConfig
 from k8s_llm_scheduler_tpu.parallel.mesh import make_mesh
 from k8s_llm_scheduler_tpu.train.train_step import causal_lm_loss, make_train_step
 
+# Everything here jit-compiles models/kernels (seconds per test):
+# full-suite only, excluded from the fast tier (TESTING.md).
+pytestmark = pytest.mark.slow
+
 CFG = LlamaConfig(
     name="train-test", vocab_size=64, d_model=64, n_layers=2, n_heads=4,
     n_kv_heads=4, d_ff=128, max_seq_len=512, rope_theta=10000.0,
