@@ -337,7 +337,12 @@ def _synthetic_text(seed: int, n_tokens: int) -> str:
     return f"[seed {seed}]" + bytes(body).decode("ascii")
 
 
-def model_throughput(model: str, quantize: str | None, peak_override: float | None) -> dict:
+def model_throughput(
+    model: str,
+    quantize: str | None,
+    peak_override: float | None,
+    slots: int = 16,
+) -> dict:
     """Engine-level microbench: prefill tok/s, pipelined decision-wave decode
     tok/s + decisions/s, and MFU against the chip's peak bf16 FLOP/s.
 
@@ -367,7 +372,7 @@ def model_throughput(model: str, quantize: str | None, peak_override: float | No
     prefill_n = 4000
     eng = InferenceEngine(
         params, cfg, tok,
-        num_pages=64, page_size=128, max_slots=16, max_pages_per_seq=16,
+        num_pages=64, page_size=128, max_slots=slots, max_pages_per_seq=16,
         prefill_buckets=(512, 4096), chunk_steps=8, prefix_chunk=2048,
         temperature=0.0,
     )
@@ -404,7 +409,7 @@ def model_throughput(model: str, quantize: str | None, peak_override: float | No
     names = [f"bench-node-{i:03d}" for i in range(32)]
     eng.set_grammar(build_decision_dfa(tok, names, max_reason_tokens=60))
     suffixes = [
-        tok.encode(_synthetic_text(100 + i, 250)) for i in range(16)
+        tok.encode(_synthetic_text(100 + i, 250)) for i in range(slots)
     ]
     eng.decide_wave(suffixes, max_new_tokens=72)  # compile + warm
     n_waves = 6
@@ -429,6 +434,7 @@ def model_throughput(model: str, quantize: str | None, peak_override: float | No
         "extra": {
             "model": model,
             "quantize": quantize,
+            "slots": slots,
             "params_m": round(param_count(cfg) / 1e6, 1),
             "device_kind": device_kind,
             "prefill_tok_per_s": round(prefill_tps, 1),
@@ -494,21 +500,21 @@ def run_suite(args) -> None:
             early = {**r_def, "extra": {**r_def["extra"], "partial": True}}
             _emit(early)
             r_burst = await bench_preset(ns_burst, backend)
+            _emit(r_burst)
+            # steady-state arrivals, bounded to ONE round and run on the
+            # SAME backend (identical engine geometry -> no re-jit), so
+            # BENCH_r*.json tracks warm per-decision latency round over
+            # round without inflating suite wall time.
+            ns_steady = _preset_ns("steady")
+            ns_steady.rounds = 1
+            r_steady = await bench_preset(ns_steady, backend)
         finally:
             backend.close()
-        _emit(r_burst)
+        _emit(r_steady)
 
         ns_long = _preset_ns("longctx")
         r_long = await bench_preset(ns_long)
         _emit(r_long)
-
-        # steady-state arrivals, bounded to ONE round in the suite so
-        # BENCH_r*.json tracks warm per-decision latency round over round
-        # without doubling suite wall time.
-        ns_steady = _preset_ns("steady")
-        ns_steady.rounds = 1
-        r_steady = await bench_preset(ns_steady)
-        _emit(r_steady)
         return r_def, r_burst, r_long, r_steady
 
     r_def, r_burst, r_long, r_steady = asyncio.run(suite())
@@ -591,7 +597,8 @@ def main() -> None:
         return
     if args.preset == "throughput":
         result = model_throughput(
-            args.model or DEFAULTS["model"], args.quantize, args.peak_tflops
+            args.model or DEFAULTS["model"], args.quantize, args.peak_tflops,
+            slots=args.slots or 16,
         )
         _emit(result)
         return

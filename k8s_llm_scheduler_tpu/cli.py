@@ -146,7 +146,37 @@ async def _run_scheduler(cfg: Config, cluster, demo_pods: bool = False) -> int:
     return 0
 
 
+def _maybe_init_distributed(cfg: Config) -> bool:
+    """Initialize multi-host JAX when configured. Returns True when this
+    process should run the cluster-facing control plane (always True
+    single-process; process 0 only otherwise)."""
+    if not cfg.get("distributed.enabled"):
+        return True
+    from k8s_llm_scheduler_tpu.parallel.distributed import (
+        init_distributed,
+        is_coordinator,
+    )
+
+    init_distributed(
+        cfg.get("distributed.coordinator"),
+        cfg.get("distributed.num_processes"),
+        cfg.get("distributed.process_id"),
+    )
+    return is_coordinator()
+
+
 def cmd_run(args: argparse.Namespace, cfg: Config) -> int:
+    if not _maybe_init_distributed(cfg):
+        # Worker hosts serve their own model replica in the replicated-
+        # control-plane design (SCALING.md "Multi-host"); the k8s watch/
+        # bind loop belongs to the coordinator alone. Until the replicated
+        # serving loop lands, workers exit loudly instead of double-binding.
+        print(
+            "distributed worker process: control plane runs on process 0 "
+            "only (see SCALING.md 'Multi-host')",
+            file=sys.stderr,
+        )
+        return 3
     if args.fake_cluster:
         from k8s_llm_scheduler_tpu.testing import synthetic_cluster
 
@@ -263,6 +293,9 @@ def cmd_train(args: argparse.Namespace, cfg: Config) -> int:
     from k8s_llm_scheduler_tpu.models.configs import get_config
     from k8s_llm_scheduler_tpu.train.distill import train_and_save
 
+    # Training is SPMD: every process enters the same step (dp/fsdp axes
+    # may span hosts via parallel/distributed.multihost_mesh).
+    _maybe_init_distributed(cfg)
     model_cfg = get_config(args.model)
     loss = train_and_save(
         model_cfg,

@@ -85,6 +85,16 @@ DEFAULTS: dict[str, Any] = {
         "timeout": 60,  # config.yaml:42
         "half_open_max_calls": 1,
     },
+    # Multi-host JAX (parallel/distributed.py). On TPU pods the launcher
+    # auto-detects coordinator/count/id (leave them null); set them
+    # explicitly for manual/CPU launches. The control plane (watch/bind)
+    # runs only on process 0 — see SCALING.md "Multi-host".
+    "distributed": {
+        "enabled": False,
+        "coordinator": None,  # e.g. "10.0.0.2:8476"
+        "num_processes": None,
+        "process_id": None,
+    },
 }
 
 # Env var name -> dotted config path (reference scheduler.py:56-60 names kept).

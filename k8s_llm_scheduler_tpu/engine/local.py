@@ -463,6 +463,7 @@ def build_local_backend(
     devices: Sequence[Any] | None = None,
     request_timeout_s: float = 60.0,
     group_switch_after_s: float = 0.25,
+    partial_hold_s: float = 0.03,
 ) -> LocalLLMBackend:
     """Construct the full local stack: params (from an HF safetensors or
     orbax checkpoint when checkpoint_path is set, random-init otherwise —
@@ -563,4 +564,5 @@ def build_local_backend(
         engine, tokenizer, max_new_tokens=max_new_tokens, constrained=constrained,
         request_timeout_s=request_timeout_s,
         group_switch_after_s=group_switch_after_s,
+        partial_hold_s=partial_hold_s,
     )

@@ -1,0 +1,119 @@
+"""Multi-host (DCN) scaffolding: process initialization and hybrid meshes.
+
+SURVEY §2.3's DP row names "batch axis replicated or sharded over DCN for
+multi-host" as the TPU equivalent of the parallelism the reference rents
+from HuggingFace's hosted deployment (reference scheduler.py:425-433,
+config.yaml:9). This module provides the pieces:
+
+- `init_distributed`: `jax.distributed.initialize` behind a flag — after
+  it, `jax.devices()` spans every host and GSPMD collectives cross DCN.
+- `multihost_mesh`: a mesh whose DCN axes (dp/fsdp — low-traffic
+  collectives: one grad all-reduce per step) span processes while ICI axes
+  (tp/sp — per-layer collectives) stay inside one host, so high-traffic
+  collectives never leave the chip interconnect. This is the standard
+  hybrid layout (cf. jax mesh_utils.create_hybrid_device_mesh); built by
+  hand here so it works on any backend, including the virtual-CPU
+  multi-process dryrun (tools/dryrun_multihost.py).
+- `is_coordinator`: process-0 gate for cluster-facing side effects. The
+  control plane (watch/bind) runs ONLY on the coordinator; worker hosts
+  participate in collectives (training) or serve their own replica
+  (serving — weights replicated across hosts over DCN, tp within host; see
+  SCALING.md "Multi-host").
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+from collections.abc import Mapping
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+logger = logging.getLogger(__name__)
+
+_INITIALIZED = False
+
+
+def init_distributed(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> bool:
+    """Initialize multi-host JAX (idempotent; no-op for single-process).
+
+    On TPU pods with the standard launcher the three arguments are
+    auto-detected (pass None); on CPU/manual launch they are required.
+    Returns True iff running multi-process after the call.
+    """
+    global _INITIALIZED
+    if num_processes is not None and num_processes <= 1:
+        return False
+    if not _INITIALIZED:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+        _INITIALIZED = True
+        logger.info(
+            "distributed: process %d/%d, %d global devices",
+            jax.process_index(), jax.process_count(), jax.device_count(),
+        )
+    return jax.process_count() > 1
+
+
+def is_coordinator() -> bool:
+    """True on the process that owns cluster-facing side effects
+    (watch/bind); always True single-process."""
+    return jax.process_index() == 0
+
+
+def multihost_mesh(
+    dcn_axes: Mapping[str, int],
+    ici_axes: Mapping[str, int],
+) -> Mesh:
+    """Mesh with `dcn_axes` spanning processes and `ici_axes` within each.
+
+    Axis order is (dcn..., ici...), so DCN axes are outermost — exactly the
+    layout where per-layer tp/sp collectives ride ICI neighbors and only
+    the once-per-step dp/fsdp reductions cross hosts.
+
+    The product of dcn_axes must equal the process count; the product of
+    ici_axes must fit each process's local device count (extra local
+    devices are left out of the mesh).
+    """
+    # Size-1 axes are KEPT (like parallel/mesh.make_mesh): specs written
+    # for the multi-host shape keep working on a scale-down mesh.
+    dcn_axes = {k: int(v) for k, v in dcn_axes.items()}
+    ici_axes = {k: int(v) for k, v in ici_axes.items()}
+    overlap = set(dcn_axes) & set(ici_axes)
+    if overlap:
+        raise ValueError(f"axes {overlap} appear in both dcn and ici")
+    dcn_size = math.prod(dcn_axes.values()) if dcn_axes else 1
+    ici_size = math.prod(ici_axes.values())
+    procs = sorted({d.process_index for d in jax.devices()})
+    if dcn_size != len(procs):
+        raise ValueError(
+            f"dcn axes {dict(dcn_axes)} need {dcn_size} processes, "
+            f"have {len(procs)}"
+        )
+    rows = []
+    for p in procs:
+        local = sorted(
+            (d for d in jax.devices() if d.process_index == p),
+            key=lambda d: d.id,
+        )
+        if len(local) < ici_size:
+            raise ValueError(
+                f"ici axes {dict(ici_axes)} need {ici_size} devices per "
+                f"process; process {p} has {len(local)}"
+            )
+        rows.append(local[:ici_size])
+    arr = np.array(rows)  # [n_procs, ici_size]
+    if dcn_axes:
+        arr = arr.reshape(*dcn_axes.values(), *ici_axes.values())
+    else:
+        arr = arr[0].reshape(*ici_axes.values())
+    return Mesh(arr, tuple(dcn_axes) + tuple(ici_axes))

@@ -57,6 +57,9 @@ class Scheduler:
         self.error_backoff_s = error_backoff_s
         self.snapshot_ttl_s = snapshot_ttl_s
         self._sem = asyncio.Semaphore(max_concurrency)
+        # Blocking (executor) binds get their own bound so they can't
+        # monopolize the shared to_thread pool (snapshot runs there too).
+        self._bind_sem = asyncio.Semaphore(min(32, max_concurrency))
         self._snapshot: tuple[float, Sequence[NodeMetrics]] | None = None
         self._snapshot_lock = asyncio.Lock()
         self._tasks: set[asyncio.Task] = set()
@@ -121,27 +124,25 @@ class Scheduler:
         else:
             self.stats["llm_decisions"] += 1
 
-        with self.phases.phase("bind"):
-            if getattr(self.binder, "bind_is_nonblocking", False):
-                # In-memory binders (FakeCluster) finish in microseconds; the
-                # executor round trip would cost more than the bind and its
-                # queue serializes a 1000-pod drain.
-                ok = self.binder.bind_pod_to_node(
-                    pod.name, pod.namespace, decision.selected_node
-                )
-            else:
-                ok = await asyncio.to_thread(
-                    self.binder.bind_pod_to_node,
-                    pod.name, pod.namespace, decision.selected_node,
-                )
+        if getattr(self.binder, "bind_is_nonblocking", False):
+            # In-memory binders (FakeCluster) finish in microseconds; the
+            # executor round trip would cost more than the bind and its
+            # queue serializes a 1000-pod drain.
+            ok = self._bind_now(pod, decision)
+        else:
+            # Blocking binders go through the shared to_thread executor;
+            # bound separately from the decide semaphore so an unbounded
+            # flood of cache-hit binds can't saturate the executor and
+            # starve _node_snapshot's to_thread behind it.
+            async with self._bind_sem:
+                with self.phases.phase("bind"):
+                    ok = await asyncio.to_thread(
+                        self.binder.bind_pod_to_node,
+                        pod.name, pod.namespace, decision.selected_node,
+                    )
+            self._note_bind(ok, pod, decision)
         if not ok:
-            self.stats["failed_bindings"] += 1
-            logger.error(
-                "binding failed: %s/%s -> %s", pod.namespace, pod.name, decision.selected_node
-            )
             return False
-
-        self.stats["total_scheduled"] += 1
         logger.info(
             "scheduled %s/%s -> %s (%s, conf=%.2f, %.1fms)",
             pod.namespace,
@@ -194,7 +195,18 @@ class Scheduler:
             # records its own decide (double counting otherwise).
             self.phases.record("decide", time.perf_counter() - t0)
             self.stats["cache_decisions"] += 1
-            self._bind_now(pod, decision)
+            try:
+                self._bind_now(pod, decision)
+            except Exception:
+                # Contained HERE, pod counts as handled: re-running it
+                # through the full path would double-count the decide/cache
+                # stats just recorded (and could double-bind). A raising
+                # binder is accounted like a failed bind; the pod stays
+                # Pending and the watch re-observes it.
+                self.stats["failed_bindings"] += 1
+                logger.exception(
+                    "fast-path bind raised: %s/%s", pod.namespace, pod.name
+                )
             return True, pod
         if fut is not None:
             batch = self._followers.get(fut)
@@ -205,12 +217,18 @@ class Scheduler:
             return True, pod
         return False, pod
 
-    def _bind_now(self, pod, decision) -> None:
+    def _bind_now(self, pod, decision) -> bool:
         """Synchronous bind + bookkeeping (nonblocking binders only)."""
         with self.phases.phase("bind"):
             ok = self.binder.bind_pod_to_node(
                 pod.name, pod.namespace, decision.selected_node
             )
+        self._note_bind(ok, pod, decision)
+        return ok
+
+    def _note_bind(self, ok: bool, pod, decision) -> None:
+        """The ONE place bind outcomes are accounted (fast path, full path,
+        follower flush all converge here)."""
         if ok:
             self.stats["total_scheduled"] += 1
         else:

@@ -143,7 +143,19 @@ def train_and_save(
     from k8s_llm_scheduler_tpu.train.train_step import make_train_step
 
     tokenizer = ByteTokenizer(vocab_size=max(512, cfg.vocab_size))
-    mesh = mesh_from_config(mesh_axes)
+    if jax.process_count() > 1:
+        # Multi-host: dp/fsdp span processes (DCN), tp/sp stay within one
+        # host (ICI) — mesh_from_config's flat device slice is process-
+        # location-blind and would scatter tp across hosts.
+        from k8s_llm_scheduler_tpu.parallel.distributed import multihost_mesh
+
+        axes = dict(mesh_axes or {})
+        mesh = multihost_mesh(
+            {k: v for k, v in axes.items() if k in ("dp", "fsdp")},
+            {k: v for k, v in axes.items() if k in ("tp", "sp")} or {"tp": 1},
+        )
+    else:
+        mesh = mesh_from_config(mesh_axes)
     init_fn, step_fn = make_train_step(cfg, mesh)
     state = init_fn(jax.random.PRNGKey(seed))
     batches = make_batches(tokenizer, batch_size, seq_len, seed=seed)
@@ -155,6 +167,9 @@ def train_and_save(
         if step % log_every == 0 or step == steps:
             loss = float(loss_arr)
             logger.info("step %d/%d loss %.4f", step, steps, loss)
-    save_checkpoint(out_dir, state.params)
-    logger.info("checkpoint saved to %s", out_dir)
+    if jax.process_index() == 0:
+        # coordinator-only side effect; worker hosts hold the same
+        # (replicated-spec) state and must not race the directory write
+        save_checkpoint(out_dir, state.params)
+        logger.info("checkpoint saved to %s", out_dir)
     return loss

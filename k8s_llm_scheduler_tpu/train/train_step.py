@@ -104,6 +104,43 @@ def make_train_step(
         return TrainState(params, opt_state, jnp.zeros((), jnp.int32))
 
     def place_batch(tokens, seq_lens):
+        """Place a GLOBAL batch (same arrays on every process) onto the
+        mesh. Multi-host: each process contributes its dp-slice of the
+        batch via make_array_from_process_local_data — rows map to
+        processes in dp-axis order, which is process order under
+        parallel/distributed.multihost_mesh (dp outermost)."""
+        if jax.process_count() > 1:
+            import numpy as _np
+
+            tokens = _np.asarray(tokens)
+            seq_lens = _np.asarray(seq_lens)
+            b = len(tokens)
+
+            def local_rows(sharding):
+                # Rows THIS process holds, derived from the sharding itself
+                # (not assumed): replicated batch -> all rows on every
+                # process; dp over processes -> that process's slice; works
+                # for any dcn layout multihost_mesh produces.
+                idx_map = sharding.addressable_devices_indices_map((b,))
+                rows = sorted({
+                    r
+                    for (rs, *_rest) in [
+                        idx if isinstance(idx, tuple) else (idx,)
+                        for idx in idx_map.values()
+                    ]
+                    for r in range(rs.start or 0, b if rs.stop is None else rs.stop)
+                })
+                return rows
+
+            rows = local_rows(lens_sharding)
+            return (
+                jax.make_array_from_process_local_data(
+                    data_sharding, tokens[rows]
+                ),
+                jax.make_array_from_process_local_data(
+                    lens_sharding, seq_lens[rows]
+                ),
+            )
         return (
             jax.device_put(tokens, data_sharding),
             jax.device_put(seq_lens, lens_sharding),
