@@ -12,10 +12,13 @@ north-star target is p50 < 200 ms on a burst, zero external API calls —
 vs_baseline here is target_ms / measured_p50 (>1.0 beats the target).
 
 Default run (`python bench.py`) executes the SUITE: every BASELINE preset
-(default, burst1000, longctx) plus model-throughput microbenches (prefill
-tok/s, decode tok/s, MFU) for the bench-size model and a 1B-scale model.
-One JSON line per preset is printed as it completes; the LAST line is the
-headline default-preset result with the whole suite folded into `extra`.
+(default, burst1000, steady, longctx) on the bench-size model, the default
+and burst1000 presets again on the BASELINE 1B model (with cold-leader /
+warm-cache p50s split out), and model-throughput microbenches (prefill
+tok/s, decode tok/s, MFU). One JSON line per result is printed as it
+completes; the second-to-last line is the full suite object, and the LAST
+line is a COMPACT headline — the 1B default-preset p50 — small enough that
+tail-capture always parses it.
 
 Usage:
     python bench.py                          # full suite
@@ -206,9 +209,11 @@ def build_backend(args):
     num_pages = max(64, min(1024, int(1e9 // page_bytes)))
     return build_local_backend(
         cfg=cfg,
-        # the committed BPE fixture: preset benches measure real-tokenizer
-        # prompt lengths, not byte-inflated ones
-        tokenizer_path=BPE_FIXTURE if args.model == "bench" else None,
+        # the committed BPE fixture for EVERY preset model: benches measure
+        # real-tokenizer prompt lengths, not byte-inflated ones (the engine
+        # accepts a tokenizer smaller than the model's padded vocab, so
+        # checkpoint-shaped 1B/8B configs run with the fixture too)
+        tokenizer_path=BPE_FIXTURE,
         max_slots=args.slots,
         num_pages=num_pages,
         page_size=page_size,
@@ -250,6 +255,19 @@ async def bench_preset(args, backend=None) -> dict:
             scheduler_name=SCHEDULER_NAME, snapshot_ttl_s=300.0,
             max_concurrency=256,
         )
+        # Tag every bound pod with its decision source so per-pod latencies
+        # split into cold (LLM leader — paid a real wave round trip) and
+        # warm (cache hit or single-flight follower). All bind paths
+        # converge on _note_bind, so the wrap sees every pod exactly once.
+        sources: dict[str, str] = {}
+        orig_note = scheduler._note_bind
+
+        def tagging_note(ok, pod, decision):
+            if ok:
+                sources[pod.name] = decision.source.value
+            orig_note(ok, pod, decision)
+
+        scheduler._note_bind = tagging_note
         task = asyncio.create_task(scheduler.run())
         pods = pod_burst(n_pods, distinct_shapes=args.shapes)
         # distinct names per round so bind bookkeeping stays unambiguous
@@ -265,7 +283,7 @@ async def bench_preset(args, backend=None) -> dict:
             scheduler.stop()
             cluster.close()
             await asyncio.wait_for(task, timeout=30)
-        return latencies, wall_s, scheduler.get_stats()
+        return latencies, wall_s, scheduler.get_stats(), sources
 
     # Warmup at FULL burst size: compiles every program geometry the measured
     # rounds hit (prefix bucket for this node count, this grammar's wave
@@ -285,20 +303,42 @@ async def bench_preset(args, backend=None) -> dict:
     # a single burst round measures the weather as much as the code.
     rounds = []
     for r in range(args.rounds):
-        latencies, wall_s, stats = await one_round(
+        latencies, wall_s, stats, sources = await one_round(
             args.pods, round_id=f"{args.preset}-{r + 1}", timeout_s=600.0
         )
         values = sorted(latencies.values())
         p50 = statistics.median(values)
         p99 = values[min(len(values) - 1, int(len(values) * 0.99))]
-        rounds.append((p50, p99, args.pods / wall_s, stats))
+        # Cold = LLM-sourced decisions (the leaders, each paying a real
+        # model wave); warm = cache hits + coalesced followers. Every round
+        # starts with a FRESH decision cache, so cold-p50 is the honest
+        # uncached per-shape latency at this model size.
+        cold = sorted(
+            lat for name, lat in latencies.items()
+            if sources.get(name) == "llm"
+        )
+        warm = sorted(
+            lat for name, lat in latencies.items()
+            if sources.get(name) == "cache"
+        )
+        split = {
+            "p50_cold_ms": round(statistics.median(cold), 2) if cold else None,
+            "p50_warm_ms": round(statistics.median(warm), 2) if warm else None,
+            "n_cold": len(cold),
+            "n_warm": len(warm),
+        }
+        rounds.append((p50, p99, args.pods / wall_s, stats, split))
     if profile_cm is not None:
         profile_cm.__exit__(None, None, None)
     if own_backend:
         backend.close()
 
     rounds.sort(key=lambda t: t[0])
-    p50, p99, pods_per_sec, stats = rounds[len(rounds) // 2]
+    # Lower-median: for odd round counts this is the true median; for even
+    # counts it reports the lower middle rather than systematically picking
+    # the worse round (tunnel weather makes the upper middle a weather
+    # sample as often as a code sample).
+    p50, p99, pods_per_sec, stats, split = rounds[(len(rounds) - 1) // 2]
     decide = stats["phases"]["decide"]
     return {
         "metric": "p50_decision_latency_ms",
@@ -307,6 +347,7 @@ async def bench_preset(args, backend=None) -> dict:
         "vs_baseline": round(TARGET_P50_MS / p50, 3),
         "extra": {
             "p99_ms": round(p99, 2),
+            **split,
             "pods": args.pods,
             "nodes": args.nodes,
             "shapes": args.shapes,
@@ -479,8 +520,12 @@ DEFAULTS = {
 }
 
 
-def _preset_ns(preset: str, base: argparse.Namespace | None = None) -> argparse.Namespace:
-    ns = argparse.Namespace(**{**DEFAULTS, **PRESETS[preset]})
+def _preset_ns(
+    preset: str,
+    base: argparse.Namespace | None = None,
+    **overrides,
+) -> argparse.Namespace:
+    ns = argparse.Namespace(**{**DEFAULTS, **PRESETS[preset], **overrides})
     ns.preset = preset
     ns.quantize = getattr(base, "quantize", None) if base else None
     ns.profile_dir = None
@@ -491,23 +536,29 @@ def _emit(line: dict) -> None:
     print(json.dumps(line), flush=True)
 
 
+BASELINE_MODEL = "llama-3.2-1b-instruct"
+
+
 def run_suite(args) -> None:
     async def suite():
         # default + burst1000 share the model/slots -> ONE backend, one set
         # of compiled programs (a rebuilt engine re-jits everything).
         ns_def = _preset_ns("default")
         ns_burst = _preset_ns("burst1000")
+        def emit_partial(r: dict) -> None:
+            # Emit every result as soon as it lands: if a driver timeout
+            # kills the suite midway, the last complete line is still a
+            # real metric. EVERY per-preset line is marked partial (on a
+            # COPY — the suite object must not inherit the mark) so
+            # metric-filtering consumers keep only the final headline.
+            _emit({**r, "extra": {**r["extra"], "partial": True}})
+
         backend = build_backend(ns_def)
         try:
             r_def = await bench_preset(ns_def, backend)
-            # Emit the headline early AND (enriched) last: if a driver
-            # timeout kills the suite midway, the last complete line is
-            # still a real headline metric. The early copy is marked
-            # partial so metric-filtering consumers can dedupe.
-            early = {**r_def, "extra": {**r_def["extra"], "partial": True}}
-            _emit(early)
+            emit_partial(r_def)
             r_burst = await bench_preset(ns_burst, backend)
-            _emit(r_burst)
+            emit_partial(r_burst)
             # steady-state arrivals, bounded to ONE round and run on the
             # SAME backend (identical engine geometry -> no re-jit), so
             # BENCH_r*.json tracks warm per-decision latency round over
@@ -517,37 +568,126 @@ def run_suite(args) -> None:
             r_steady = await bench_preset(ns_steady, backend)
         finally:
             backend.close()
-        _emit(r_steady)
+        emit_partial(r_steady)
 
         ns_long = _preset_ns("longctx")
         r_long = await bench_preset(ns_long)
-        _emit(r_long)
-        return r_def, r_burst, r_long, r_steady
+        emit_partial(r_long)
 
-    r_def, r_burst, r_long, r_steady = asyncio.run(suite())
+        # BASELINE-model pass (VERDICT r03 #2): the recorded preset p50s
+        # must exist at a REAL model size, not just the 18M bench model.
+        # One shared 1B backend, default + burst1000, with the cold/warm
+        # split reported per preset. 3 rounds each: a true median against
+        # tunnel weather (the measured rounds are seconds; the warmup
+        # compile dominates this block's wall time either way).
+        ns1_def = _preset_ns("default", model=BASELINE_MODEL, rounds=3)
+        ns1_burst = _preset_ns("burst1000", model=BASELINE_MODEL, rounds=3)
+        r1_def = r1_burst = None
+        try:
+            backend_1b = build_backend(ns1_def)
+            try:
+                r1_def = await bench_preset(ns1_def, backend_1b)
+                emit_partial(r1_def)
+                r1_burst = await bench_preset(ns1_burst, backend_1b)
+                emit_partial(r1_burst)
+            finally:
+                backend_1b.close()
+        except Exception:
+            # The bench-model headline must survive a 1B failure (OOM,
+            # compile timeout): record the traceback on stderr, keep going.
+            import traceback
+
+            traceback.print_exc()
+        return r_def, r_burst, r_long, r_steady, r1_def, r1_burst
+
+    r_def, r_burst, r_long, r_steady, r1_def, r1_burst = asyncio.run(suite())
 
     tp_bench = model_throughput("bench", None, args.peak_tflops)
     _emit(tp_bench)
-    tp_1b = model_throughput("llama-3.2-1b-instruct", None, args.peak_tflops)
-    _emit(tp_1b)
+    try:
+        tp_1b = model_throughput(BASELINE_MODEL, None, args.peak_tflops)
+        _emit(tp_1b)
+    except Exception:
+        # Same protection as the 1B preset block: a 1B-scale failure must
+        # not cost the round its suite_results + headline lines.
+        import traceback
+
+        traceback.print_exc()
+        tp_1b = None
     # int8 weight-only path, bench-size: tracks the quantized decode/prefill
     # kernels every round (the 8B int8 run is a 20-30 min standalone:
     # `--preset throughput --model llama-3.1-8b-instruct --quantize int8`).
     tp_int8 = model_throughput("bench", "int8", args.peak_tflops)
     _emit(tp_int8)
 
-    r_def["extra"]["presets"] = {
-        "burst1000": r_burst["extra"],
-        "longctx": r_long["extra"],
-        "steady": r_steady["extra"],
+    dispatch_rtt = measure_dispatch_rtt_ms()
+
+    # The FULL suite object goes on its own (fat) line, second to last —
+    # the driver's tail capture truncated r03's final line when everything
+    # was folded into it and the round's headline was lost (VERDICT r03 #1).
+    suite_line = {
+        "metric": "suite_results",
+        "value": (r1_def or r_def)["value"],
+        "unit": "ms",
+        "extra": {
+            "presets": {
+                "default": r_def["extra"],
+                "burst1000": r_burst["extra"],
+                "longctx": r_long["extra"],
+                "steady": r_steady["extra"],
+                "default@1b": r1_def["extra"] if r1_def else None,
+                "burst1000@1b": r1_burst["extra"] if r1_burst else None,
+            },
+            "throughput": {
+                "bench": tp_bench["extra"],
+                "llama-3.2-1b": tp_1b["extra"] if tp_1b else None,
+                "bench-int8": tp_int8["extra"],
+            },
+            "dispatch_rtt_ms": dispatch_rtt,
+        },
     }
-    r_def["extra"]["throughput"] = {
-        "bench": tp_bench["extra"],
-        "llama-3.2-1b": tp_1b["extra"],
-        "bench-int8": tp_int8["extra"],
+    _emit(suite_line)
+
+    # LAST line: compact headline only — the BASELINE-model default-preset
+    # p50 with its cold/warm split plus a one-level summary of the other
+    # presets. Small enough that the driver's tail always parses it.
+    def _mini(r):
+        e = r["extra"]
+        return {
+            "p50_ms": r["value"],
+            "p50_cold_ms": e.get("p50_cold_ms"),
+            "p50_warm_ms": e.get("p50_warm_ms"),
+        }
+
+    top = r1_def or r_def
+    headline = {
+        "metric": "p50_decision_latency_ms",
+        "value": top["value"],
+        "unit": "ms",
+        "vs_baseline": top["vs_baseline"],
+        "extra": {
+            "model": BASELINE_MODEL if r1_def else "bench",
+            "preset": "default",
+            "p50_cold_ms": top["extra"].get("p50_cold_ms"),
+            "p50_warm_ms": top["extra"].get("p50_warm_ms"),
+            "n_cold": top["extra"].get("n_cold"),
+            "n_warm": top["extra"].get("n_warm"),
+            "burst1000@1b": _mini(r1_burst) if r1_burst else None,
+            "default@bench": _mini(r_def),
+            "burst1000@bench": _mini(r_burst),
+            "longctx_p50_ms": r_long["value"],
+            "steady_p99_ms": r_steady["extra"]["p99_ms"],
+            "decisions_per_s_1b": (
+                tp_1b["extra"]["decisions_per_s"] if tp_1b else None
+            ),
+            "mfu_prefill_1b": (
+                tp_1b["extra"].get("mfu_prefill") if tp_1b else None
+            ),
+            "dispatch_rtt_ms": dispatch_rtt,
+            "baseline_note": "reference publishes no numbers; target p50<200ms (BASELINE.md)",
+        },
     }
-    r_def["extra"]["dispatch_rtt_ms"] = measure_dispatch_rtt_ms()
-    _emit(r_def)
+    _emit(headline)
 
 
 def main() -> None:

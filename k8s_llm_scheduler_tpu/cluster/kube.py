@@ -23,6 +23,16 @@ behavior parity points:
   cache read (zero API calls) between periodic full-relist reconciliations
   — at 256 nodes / 10k pods the full relist per snapshot TTL was the next
   scaling wall after the reference's N+1 (SURVEY §7).
+- resourceVersion continuation: when a watch stream reaches its server-side
+  timeout it RESUMES from the last observed resourceVersion (consuming
+  bookmark events to keep that version fresh) instead of restarting from
+  scratch — no event gap, no forced relist, matching real client-go
+  reflector behavior. A 410 Gone (version expired server-side) falls back
+  to one fresh-start watch plus a single reconciling relist.
+- node watch: node-level changes (NotReady, taints, labels, add/remove)
+  stream into the informer the same way pod placements do, so a node going
+  NotReady is reflected in snapshots in event time rather than waiting out
+  relist_interval_s.
 """
 
 from __future__ import annotations
@@ -159,9 +169,19 @@ class KubeCluster:
         # Placement deltas since the last relist: a relist's API responses
         # race the watch reader, so deltas folded while the list calls were
         # in flight are REPLAYED over the listed snapshot (events observed
-        # during a list win — standard reflector behavior).
-        self._inf_journal: list[tuple[tuple[str, str], str | None]] = []
+        # during a list win — standard reflector behavior). Entries carry a
+        # monotonically increasing sequence number so the replay cut point
+        # survives the runaway-guard front truncation (list indices would
+        # shift under it and replay the wrong slice).
+        self._inf_seq = 0
+        self._inf_journal: list[tuple[int, tuple[str, str], str | None]] = []
         self._inf_last_relist = 0.0
+        # True only once the watch stream has PROVEN healthy (first event /
+        # bookmark observed, or a clean server-side timeout with rv
+        # continuation — a stream that connects but silently stalls before
+        # any event never flips this). Written under _inf_lock. A stream
+        # that stalls AFTER events is still bounded by relist_interval_s:
+        # freshness requires a relist within that window regardless.
         self._inf_watch_live = False
 
     @staticmethod
@@ -239,28 +259,37 @@ class KubeCluster:
         """Full reconciliation: ONE list-nodes + ONE list-pods call (never
         one call per node — the reference's N+1). Deltas journaled by the
         watch/bind paths while the list calls were in flight are replayed
-        over the listed snapshot so concurrent events are not lost."""
+        over the listed snapshot so concurrent events are not lost. The
+        replay cut point is a sequence number, not a list index, so the
+        journal's runaway-guard truncation can never shift it."""
         with self._inf_lock:
-            j0 = len(self._inf_journal)
+            seq0 = self._inf_seq
         nodes = self._v1.list_node().items
         pods = self._v1.list_pod_for_all_namespaces().items
         counts: dict[str, int] = {}
         pod_node: dict[tuple[str, str], str] = {}
         for pod in pods:
             node_name = pod.spec.node_name
-            if node_name:
+            # Skip terminal pods, matching _informer_observe: a completed
+            # Job pod holds no scheduling capacity, and counting it only in
+            # relists made pod_count flap every reconciliation (the
+            # synthesized usage percent and the decision-cache digest with
+            # it). Deliberate divergence from the reference, which counts
+            # every placed pod (scheduler.py:144-147).
+            phase = getattr(getattr(pod, "status", None), "phase", None) or ""
+            if node_name and phase not in ("Succeeded", "Failed"):
                 counts[node_name] = counts.get(node_name, 0) + 1
                 meta = getattr(pod, "metadata", None)
                 if meta is not None:
                     pod_node[(meta.namespace, meta.name)] = node_name
         parsed = [self._parse_node(n) for n in nodes]
         with self._inf_lock:
-            replay = self._inf_journal[j0:]
+            replay = [e for e in self._inf_journal if e[0] > seq0]
             self._inf_nodes = parsed
             self._inf_counts = counts
             self._inf_pod_node = pod_node
             self._inf_journal = []
-            for key, node in replay:
+            for _seq, key, node in replay:
                 self._place_pod_locked(key, node)
             self._inf_last_relist = time.monotonic()
             return self._metrics_from_cache_locked()
@@ -282,9 +311,38 @@ class KubeCluster:
             self._inf_pod_node[key] = node
             self._inf_counts[node] = self._inf_counts.get(node, 0) + 1
         if journal:
-            self._inf_journal.append((key, node))
+            self._inf_seq += 1
+            self._inf_journal.append((self._inf_seq, key, node))
             if len(self._inf_journal) > 100_000:  # relist-gap runaway guard
                 del self._inf_journal[:50_000]
+
+    def _informer_observe_node(self, etype: str, node) -> None:
+        """Fold one node watch event into the cached node facts. Upserts by
+        name (ADDED/MODIFIED), drops on DELETED. No-op until the first
+        relist establishes a baseline list. Node events racing a relist's
+        in-flight list call can be overwritten by the (older) list result;
+        the next event or relist reconciles — node facts have no journal
+        because the damage window is one relist_interval_s at worst and
+        node mutations are orders of magnitude rarer than pod churn."""
+        try:
+            name = node.metadata.name
+        except AttributeError:
+            return
+        with self._inf_lock:
+            if self._inf_nodes is None:
+                return
+            if etype == "DELETED":
+                self._inf_nodes = [
+                    r for r in self._inf_nodes if r["name"] != name
+                ]
+                return
+            rec = self._parse_node(node)
+            for i, old in enumerate(self._inf_nodes):
+                if old["name"] == name:
+                    self._inf_nodes[i] = rec
+                    break
+            else:
+                self._inf_nodes.append(rec)
 
     def _informer_observe(self, etype: str, pod) -> None:
         """Fold one watch event into the pod->node placement map. Keyed by
@@ -304,57 +362,196 @@ class KubeCluster:
         with self._inf_lock:
             self._place_pod_locked(key, None if gone else node, journal=True)
 
+    def _mark_stale_locked_free(self) -> None:
+        """A broken stream may have dropped events: mark the informer stale
+        so the next snapshot relists."""
+        with self._inf_lock:
+            self._inf_watch_live = False
+            self._inf_last_relist = 0.0
+
+    def _mark_live(self) -> None:
+        with self._inf_lock:
+            self._inf_watch_live = True
+
+    @staticmethod
+    def _event_rv(obj) -> str | None:
+        return getattr(getattr(obj, "metadata", None), "resource_version", None)
+
+    def _stream_kwargs(self, rv: str | None) -> dict:
+        """Watch kwargs: rv=None is a fresh start (the server replays the
+        current state as synthetic ADDED events — how pre-existing pending
+        pods are picked up); a concrete rv RESUMES exactly after the last
+        observed event. Bookmarks keep the rv current through quiet spells
+        so a resume after the server-side timeout never lands on an
+        expired version."""
+        kwargs = {
+            "timeout_seconds": self._watch_timeout,
+            "allow_watch_bookmarks": True,
+        }
+        if rv is not None:
+            kwargs["resource_version"] = rv
+        return kwargs
+
+    class _WatchExpired(Exception):
+        """410 Gone delivered as an in-stream ERROR event."""
+
+    @classmethod
+    def _check_error_event(cls, etype: str, obj) -> None:
+        if etype == "ERROR":
+            code = getattr(obj, "code", None)
+            if code is None and isinstance(obj, dict):
+                code = obj.get("code")
+            if code == 410:
+                raise cls._WatchExpired()
+            raise RuntimeError(f"watch ERROR event: {obj!r}")
+
+    @staticmethod
+    def _is_gone(exc: Exception) -> bool:
+        return getattr(exc, "status", None) == 410
+
+    def _watch_cycle(
+        self, list_fn, rv: str | None, stopping, on_event, on_alive=None
+    ) -> tuple[str | None, bool, str]:
+        """ONE watch stream to completion — the rv/bookmark/410 state
+        machine shared by the pod and node readers. `on_event(etype, obj)`
+        fires per non-bookmark event; `on_alive()` once at the stream's
+        first event (bookmarks included — a bookmark proves the stream
+        healthy on quiet clusters). Returns (rv, saw_event, outcome) with
+        outcome 'clean' (server-side timeout or stop; resume from rv),
+        'expired' (410: caller must fresh-start), or 'error' (unknown
+        failure: caller backs off and may mark state stale)."""
+        saw_event = False
+        try:
+            w = k8s_watch.Watch()
+            for event in w.stream(list_fn, **self._stream_kwargs(rv)):
+                if stopping():
+                    break
+                etype = event.get("type", "")
+                obj = event["object"]
+                self._check_error_event(etype, obj)
+                new_rv = self._event_rv(obj)
+                if new_rv is not None:
+                    rv = new_rv
+                if not saw_event:
+                    saw_event = True
+                    if on_alive is not None:
+                        on_alive()
+                if etype != "BOOKMARK":
+                    on_event(etype, obj)
+            return rv, saw_event, "clean"
+        except self._WatchExpired:
+            return None, saw_event, "expired"
+        except Exception as exc:
+            if self._is_gone(exc):
+                return None, saw_event, "expired"
+            logger.warning(
+                "%s watch stream error, re-watching: %s",
+                getattr(list_fn, "__name__", "watch"), exc,
+            )
+            return rv, saw_event, "error"
+
     async def watch_pending_pods(self, scheduler_name: str) -> AsyncIterator[RawPod]:
         """Watch stream bridged thread->asyncio so the loop stays responsive.
 
+        Each generator starts its first stream FRESH (rv unset — the server
+        replays current state, so pending pods that predate this watch are
+        observed), then RESUMES from the last seen resourceVersion across
+        the server-side timeouts — no event gap, so the informer stays
+        fresh and snapshots keep costing zero API calls across arbitrarily
+        many timeout cycles. 410 Gone (version expired) degrades to one
+        fresh start plus a single reconciling relist. When the informer is
+        enabled a second reader watches NODES the same way, folding
+        NotReady/taint/label/add/remove changes into the cache in event
+        time.
+
         Cleanup contract: abandoning/aclosing the generator stops the reader
-        thread (its stop event is per-watch, so the cluster object can be
+        threads (their stop event is per-watch, so the cluster object can be
         watched again), and the bounded queue + timeout-polling get mean no
         thread is ever parked forever on an abandoned watch.
         """
         sync_queue: queue_mod.Queue[RawPod | None] = queue_mod.Queue(maxsize=1024)
         stop = threading.Event()
 
+        def stopping() -> bool:
+            return stop.is_set() or self._stop.is_set()
+
+        def on_pod_event(etype: str, obj) -> None:
+            # Feed the informer from the SAME stream the scheduler already
+            # pays for: every event updates pod->node placements, so
+            # snapshots between relists cost zero API calls.
+            self._informer_observe(etype, obj)
+            raw = _pod_to_raw(obj)
+            if raw.needs_scheduling and raw.scheduler_name == scheduler_name:
+                while not stopping():
+                    try:
+                        sync_queue.put(raw, timeout=0.5)
+                        break
+                    except queue_mod.Full:
+                        continue
+
         def reader() -> None:
-            while not (stop.is_set() or self._stop.is_set()):
-                try:
-                    w = k8s_watch.Watch()
-                    self._inf_watch_live = True
-                    for event in w.stream(
-                        self._v1.list_pod_for_all_namespaces,
-                        timeout_seconds=self._watch_timeout,
-                    ):
-                        if stop.is_set() or self._stop.is_set():
-                            break
-                        # Feed the informer from the SAME stream the
-                        # scheduler already pays for: every event updates
-                        # pod->node placements, so snapshots between
-                        # relists cost zero API calls.
-                        self._informer_observe(
-                            event.get("type", ""), event["object"]
-                        )
-                        raw = _pod_to_raw(event["object"])
-                        if raw.needs_scheduling and raw.scheduler_name == scheduler_name:
-                            while not (stop.is_set() or self._stop.is_set()):
-                                try:
-                                    sync_queue.put(raw, timeout=0.5)
-                                    break
-                                except queue_mod.Full:
-                                    continue
-                except Exception as exc:
-                    # Self-heal: log + brief sleep + re-watch (scheduler.py:683-685)
-                    # A broken stream may have dropped placement events:
-                    # mark the informer stale so the next snapshot relists.
-                    self._inf_watch_live = False
-                    with self._inf_lock:
-                        self._inf_last_relist = 0.0
-                    logger.warning("watch stream error, re-watching: %s", exc)
+            rv: str | None = None
+            while not stopping():
+                was_fresh = rv is None
+                rv, saw_event, outcome = self._watch_cycle(
+                    self._v1.list_pod_for_all_namespaces, rv, stopping,
+                    on_pod_event, on_alive=self._mark_live,
+                )
+                if outcome == "clean":
+                    # Clean server-side timeout. With a concrete rv the
+                    # next stream resumes gaplessly; rv=None means the next
+                    # stream is a fresh state replay — either way the
+                    # stream proved healthy end to end.
+                    self._mark_live()
+                    if not saw_event:
+                        # empty stream: yield briefly so a server that
+                        # closes streams immediately can't hot-loop us
+                        stop.wait(0.02)
+                elif outcome == "expired":
+                    # An EXPIRED rv is not a server-health signal: restart
+                    # fresh IMMEDIATELY (client-go relist-and-rewatch), so
+                    # the stale window costs one reconciling relist, not a
+                    # backoff's worth of them. But a 410 against an
+                    # ALREADY-fresh start means the server itself is sick —
+                    # that gets the self-heal backoff, or we'd hot-loop the
+                    # API at unbounded rate.
+                    logger.warning(
+                        "watch resourceVersion expired (410); fresh start + relist"
+                    )
+                    self._mark_stale_locked_free()
+                    if was_fresh:
+                        stop.wait(5.0)
+                else:
+                    # Self-heal: brief sleep + re-watch (reference
+                    # scheduler.py:683-685); events may have been dropped.
+                    self._mark_stale_locked_free()
                     stop.wait(5.0)
-            self._inf_watch_live = False
+            with self._inf_lock:
+                self._inf_watch_live = False
             try:
                 sync_queue.put_nowait(None)
             except queue_mod.Full:
                 pass
+
+        def node_reader() -> None:
+            """Node facts ride their own watch; same rv/bookmark/410
+            discipline via _watch_cycle. Errors here never force relists —
+            the pod watch owns informer freshness; stale node facts
+            self-bound at one relist_interval_s."""
+            rv: str | None = None
+            while not stopping():
+                was_fresh = rv is None
+                rv, saw_event, outcome = self._watch_cycle(
+                    self._v1.list_node, rv, stopping,
+                    self._informer_observe_node,
+                )
+                if outcome == "clean":
+                    if not saw_event:
+                        stop.wait(0.02)
+                elif outcome == "expired" and not was_fresh:
+                    pass  # expired rv: immediate fresh-start re-watch
+                else:  # unknown error, or 410 against a fresh start
+                    stop.wait(5.0)
 
         def poll_get() -> RawPod | None:
             """Blocking get with a timeout loop so the executor thread can
@@ -368,6 +565,11 @@ class KubeCluster:
 
         thread = threading.Thread(target=reader, daemon=True, name="k8s-watch")
         thread.start()
+        if self._informer:
+            node_thread = threading.Thread(
+                target=node_reader, daemon=True, name="k8s-node-watch"
+            )
+            node_thread.start()
         loop = asyncio.get_running_loop()
         try:
             while True:

@@ -83,11 +83,19 @@ def _pick(masked, rng, temperature):
     return jnp.where(temperature > 0, sampled, greedy).astype(jnp.int32)
 
 
-def _sample_unconstrained(logits, pad_id, rng, temperature):
+def _sample_unconstrained(logits, pad_id, rng, temperature, vocab_limit=None):
     """Full-vocab sampling with only pad excluded (pad is the idle-slot
-    emission sentinel — see set_grammar)."""
+    emission sentinel — see set_grammar). `vocab_limit` (a static int, set
+    when the tokenizer's vocab is smaller than the model's padded vocab)
+    additionally masks ids the tokenizer cannot decode — a checkpoint-shaped
+    128k-vocab model served with a small domain tokenizer must never emit
+    an id past the tokenizer's table."""
     V = logits.shape[-1]
-    masked = jnp.where(jnp.arange(V)[None, :] == pad_id, NEG_INF, logits)
+    ids = jnp.arange(V)[None, :]
+    bad = ids == pad_id
+    if vocab_limit is not None and vocab_limit < V:
+        bad = bad | (ids >= vocab_limit)
+    masked = jnp.where(bad, NEG_INF, logits)
     return _pick(masked, rng, temperature)
 
 
@@ -124,6 +132,7 @@ def _admit_impl(
     rng, temperature,
     constrained: bool,  # static
     prefix_impl: str | None = None,  # static
+    vocab_limit: int | None = None,  # static — see _sample_unconstrained
 ):
     """Batched admission: suffix prefill + KV scatter + first-token sample,
     one device program. Rows scatter into their slot's state; padding rows
@@ -139,7 +148,9 @@ def _admit_impl(
             last_logits, sp_tokens[start_vec], sp_next[start_vec], rng, temperature
         )
     else:
-        first_new = _sample_unconstrained(last_logits, pad_id, rng, temperature)
+        first_new = _sample_unconstrained(
+            last_logits, pad_id, rng, temperature, vocab_limit
+        )
         st_new = start_vec
     finished = (first_new == eos_id) | (st_new == done_state)
     real = suffix_lens > 0  # padding rows must never activate the trash row
@@ -167,6 +178,7 @@ def _decode_chunk_impl(
     constrained: bool,  # static
     paged_attn: str = "gather",  # static: "gather" | "pallas"
     shmap=None,  # static ShardedAttnImpl | None (tp-sharded paged kernel)
+    vocab_limit: int | None = None,  # static — see _sample_unconstrained
 ):
     """`n_steps` decode iterations fused into one program. Emits the sampled
     token per step; finished/exhausted/idle slots emit pad_id and idle.
@@ -212,7 +224,9 @@ def _decode_chunk_impl(
                 logits, sp_tokens[st], sp_next[st], sub, temperature
             )
         else:
-            nxt = _sample_unconstrained(logits, pad_id, sub, temperature)
+            nxt = _sample_unconstrained(
+                logits, pad_id, sub, temperature, vocab_limit
+            )
             new_st = st
         emitted = jnp.where(act_eff, nxt, pad_id)
         new_st = jnp.where(act_eff, new_st, st)
@@ -262,6 +276,7 @@ def _wave_impl(
     cap: int,      # static — generated-KV capacity, >= max(max_new)
     constrained: bool,  # static
     prefix_impl: str | None = None,  # static
+    vocab_limit: int | None = None,  # static — see _sample_unconstrained
 ):
     """One whole decision wave in ONE device program, with
     GRAMMAR-ACCELERATED BLOCK DECODING.
@@ -318,7 +333,9 @@ def _wave_impl(
                 logits, sp_tokens[st], sp_next[st], sub, temperature
             )
         else:
-            t0 = _sample_unconstrained(logits, pad_id, sub, temperature)
+            t0 = _sample_unconstrained(
+                logits, pad_id, sub, temperature, vocab_limit
+            )
             s_t0 = st
         emit0 = act & (emitted < max_new)
         s_cur = jnp.where(emit0, s_t0, st)
@@ -465,11 +482,22 @@ class InferenceEngine:
         self.cfg = cfg
         self.params = params
         self.tokenizer = tokenizer or ByteTokenizer()
-        if self.tokenizer.vocab_size != cfg.vocab_size:
+        if self.tokenizer.vocab_size > cfg.vocab_size:
             raise ValueError(
-                f"tokenizer vocab {self.tokenizer.vocab_size} != model vocab "
-                f"{cfg.vocab_size} — grammar masks and logits would misalign"
+                f"tokenizer vocab {self.tokenizer.vocab_size} > model vocab "
+                f"{cfg.vocab_size} — the tokenizer would emit ids past the "
+                f"embedding table"
             )
+        # Tokenizer smaller than the model's (padded) vocab is fine —
+        # checkpoint-shaped 128k-vocab configs served with a small domain
+        # tokenizer (e.g. the committed 4k-BPE fixture). Grammar tables are
+        # built from the tokenizer so constrained ids are always in range;
+        # unconstrained sampling masks the undecodable tail via this limit.
+        self._vocab_limit: int | None = (
+            self.tokenizer.vocab_size
+            if self.tokenizer.vocab_size < cfg.vocab_size
+            else None
+        )
         self.kv = PagedKVCache(
             cfg,
             num_pages=num_pages,
@@ -531,17 +559,29 @@ class InferenceEngine:
             static_argnums=(1,),
         )
         self._admit = jax.jit(
-            functools.partial(_admit_impl, prefix_impl=prefix_attn_impl),
+            functools.partial(
+                _admit_impl,
+                prefix_impl=prefix_attn_impl,
+                vocab_limit=self._vocab_limit,
+            ),
             static_argnums=(1, 26),
             donate_argnums=(7, 8, 11, 12, 13, 14, 15, 16),
         )
         self._chunk = jax.jit(
-            functools.partial(_decode_chunk_impl, shmap=chunk_shmap),
+            functools.partial(
+                _decode_chunk_impl,
+                shmap=chunk_shmap,
+                vocab_limit=self._vocab_limit,
+            ),
             static_argnums=(1, 20, 21, 22),
             donate_argnums=(2, 3, 8, 9, 10, 11, 12),
         )
         self._wave = jax.jit(
-            functools.partial(_wave_impl, prefix_impl=prefix_attn_impl),
+            functools.partial(
+                _wave_impl,
+                prefix_impl=prefix_attn_impl,
+                vocab_limit=self._vocab_limit,
+            ),
             static_argnums=(1, 18, 19, 20, 21),
         )
         # Chunked long-prefix prefill reuses the dense cascade directly.

@@ -490,6 +490,50 @@ class TestSparseGrammar:
             assert obj["selected_node"] in names
             assert 0.0 <= obj["confidence"] <= 1.0
 
+    def test_tokenizer_smaller_than_model_vocab(self):
+        """A checkpoint-shaped (padded-vocab) model served with a smaller
+        domain tokenizer: the engine must accept it, constrained decoding
+        stays valid, and unconstrained sampling must never emit an id past
+        the tokenizer's table (bench.py runs the 1B config with the
+        committed 1280-token BPE fixture through exactly this path)."""
+        small_tok = ByteTokenizer()  # vocab 512
+        cfg = LlamaConfig(
+            name="padded-vocab", vocab_size=1024, d_model=64, n_layers=2,
+            n_heads=4, n_kv_heads=2, d_ff=128, max_seq_len=1024,
+            rope_theta=10000.0, dtype=jnp.float32, tie_embeddings=True,
+        )
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        eng = InferenceEngine(
+            params, cfg, small_tok, num_pages=32, page_size=64, max_slots=2,
+            max_pages_per_seq=8, prefill_buckets=(128, 256), chunk_steps=4,
+            temperature=0.0,
+        )
+        # unconstrained: every emitted id must be decodable
+        fin = eng.generate(small_tok.encode("hello"), max_new_tokens=24)
+        assert all(t < small_tok.vocab_size for t in fin.token_ids)
+        wave = eng.decide_wave([small_tok.encode("hi")], max_new_tokens=16)
+        assert all(t < small_tok.vocab_size for t in wave[0].token_ids)
+        # constrained: decision grammar built from the tokenizer still works
+        names = ["node-0", "node-1"]
+        eng.set_grammar(build_decision_dfa(small_tok, names, max_reason_tokens=5))
+        fins = eng.decide_wave(
+            [small_tok.chat_prompt("sys", "pick")], max_new_tokens=120
+        )
+        obj = json.loads(fins[0].text)
+        assert obj["selected_node"] in names
+
+    def test_tokenizer_larger_than_model_vocab_rejected(self):
+        big_tok = ByteTokenizer(vocab_size=2048)
+        cfg = LlamaConfig(
+            name="small-model-vocab", vocab_size=512, d_model=64, n_layers=2,
+            n_heads=4, n_kv_heads=2, d_ff=128, max_seq_len=1024,
+            rope_theta=10000.0, dtype=jnp.float32, tie_embeddings=True,
+        )
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        with pytest.raises(ValueError, match="embedding table"):
+            InferenceEngine(params, cfg, big_tok, num_pages=8, page_size=64,
+                            max_slots=2, max_pages_per_seq=4)
+
     def test_backend_keeps_constraint_for_large_vocab(self):
         from k8s_llm_scheduler_tpu.engine.local import LocalLLMBackend
 
