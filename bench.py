@@ -224,6 +224,9 @@ def build_backend(args):
         temperature=args.temperature,
         max_new_tokens=args.max_new_tokens,
         quantize=getattr(args, "quantize", None),
+        # repo-local persistent compile cache: the bench re-runs every
+        # round; geometries compiled in ANY earlier run load in ~100ms
+        compile_cache_dir=str(Path(__file__).resolve().parent / ".xla_cache"),
     )
 
 
@@ -290,6 +293,14 @@ async def bench_preset(args, backend=None) -> dict:
     # n_iters bucket) AND absorbs the first-full-round host-side overhead
     # (round-1 p50 ran ~40 ms hotter when warmup used fewer pods).
     await one_round(args.pods, round_id=f"{args.preset}-w", timeout_s=600.0)
+    # Wait out the engine's sibling-geometry prewarm (the idle worker
+    # compiles the OTHER wave row bucket at every bucket the warmup hit):
+    # a straggler-timing ragged wave in a measured round must never pay a
+    # cold jit (r03 longctx recorded a 5.1s mid-round stall from exactly
+    # that). Engine-owner discipline: we only poll the read-only backlog.
+    async with asyncio.timeout(600):
+        while backend.engine.wave_prewarm_backlog() > 0:
+            await asyncio.sleep(0.05)
 
     profile_cm = None
     if getattr(args, "profile_dir", None):

@@ -61,6 +61,7 @@ def _build_stack(cfg: Config, cluster) -> Any:
             quantize=cfg.get("llm.quantization"),
             request_timeout_s=float(cfg.get("llm.timeout")),
             group_switch_after_s=float(cfg.get("llm.group_switch_after_s")),
+            compile_cache_dir=cfg.get("llm.compile_cache_dir"),
         )
 
     cache = (

@@ -60,6 +60,9 @@ DEFAULTS: dict[str, Any] = {
         # fairness bound for (prefix, grammar) group switches under load
         # (engine/local.py _submit_waves)
         "group_switch_after_s": 0.25,
+        # persistent XLA compile cache dir ("auto" = ~/.cache/...; null
+        # disables) — utils/compile_cache.py
+        "compile_cache_dir": "auto",
     },
     "cache": {
         "enabled": True,

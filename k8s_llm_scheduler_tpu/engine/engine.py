@@ -598,6 +598,18 @@ class InferenceEngine:
         # cheap next to a model call's fixed cost of reading the weights.
         self.wave_block = 24
         self._grammar_wave_iters: int | None = None
+        # Wave-geometry bookkeeping for prewarming: every submit_wave
+        # records its compiled variant key and the (bucket, max_new) shape
+        # it served, so prewarm_wave_siblings can compile the row-bucket
+        # variants a straggler-timing ragged wave would otherwise hit cold
+        # mid-burst (a measured 5.1s jit stall class).
+        self._wave_compiled: set[tuple] = set()
+        self._wave_shapes_seen: set[tuple[int, int]] = set()
+        # Geometries whose prewarm dispatch raised: excluded from the
+        # backlog so a persistent failure can't wedge callers polling
+        # wave_prewarm_backlog()==0 (a real wave still compiles the
+        # variant on demand if it is ever actually needed).
+        self._wave_prewarm_failed: set[tuple] = set()
 
         # Grammar tables (sparse, vocab-independent; content swaps without
         # recompiling for a same-K grammar — see SparseDFATables).
@@ -1036,6 +1048,123 @@ class InferenceEngine:
         return [r.req_id for r in reqs]
 
     # ---------------------------------------------------------------- wave
+    def _wave_geometry(
+        self, n_prompts: int, max_new_tokens: int
+    ) -> tuple[int, int, int]:
+        """(R, n_iters, F) for a wave of `n_prompts`.
+
+        TWO row buckets: half width and full width. Wave compute scales
+        with R (every padding row still runs masked through the model), so
+        a burst whose leaders fit the half bucket — the common case — pays
+        half the prefill/decode; exactly two buckets bounds the
+        compiled-variant count. With a grammar, block decoding needs only
+        wave_iterations(dfa) model calls (forced runs are free); without
+        one, every token is a choice (F=1, one per iteration). n_iters is
+        bucketed to multiples of 4 to bound compile variants further."""
+        half = self.max_slots // 2
+        R = half if 0 < n_prompts <= half else self.max_slots
+        if self._constrained and self._grammar_wave_iters is not None:
+            F = self.wave_block
+            n_iters = min(self._grammar_wave_iters, max_new_tokens)
+        else:
+            F = 1
+            n_iters = max_new_tokens
+        n_iters = max(4, -(-n_iters // 4) * 4)
+        return R, n_iters, F
+
+    def _wave_key(
+        self, R: int, bucket: int, n_iters: int, F: int, max_new: int
+    ) -> tuple:
+        """Identity of one compiled _wave variant: everything that changes
+        the traced program's shapes/statics. Prefix buffer length and
+        grammar table shapes are included — a same-R wave against a longer
+        prefix or a wider DFA bucket is a different executable."""
+        prefix = self._prefix or self._get_empty_prefix()
+        return (
+            R, bucket, n_iters, F, max_new,
+            prefix.k.shape[1], self._sp_tokens.shape, self._constrained,
+        )
+
+    def wave_prewarm_backlog(self) -> int:
+        """Number of sibling wave geometries not yet compiled (read-only;
+        safe to poll from other threads)."""
+        return len(self._missing_wave_siblings())
+
+    def _missing_wave_siblings(self) -> list[tuple[int, int, int]]:
+        """(n_prompts, bucket, max_new) probes for wave variants adjacent
+        to ones already used: BOTH row buckets at every seen (suffix
+        bucket, budget). A burst normally runs full-R waves, then one
+        straggler forms a half-R ragged tail — that variant must not
+        compile mid-burst."""
+        out = []
+        for bucket, max_new in self._wave_shapes_seen:
+            for n_prompts in (1, self.max_slots):
+                R, n_iters, F = self._wave_geometry(n_prompts, max_new)
+                key = self._wave_key(R, bucket, n_iters, F, max_new)
+                if (
+                    key not in self._wave_compiled
+                    and key not in self._wave_prewarm_failed
+                ):
+                    out.append((n_prompts, bucket, max_new))
+        return out
+
+    def prewarm_wave_siblings(self, limit: int | None = None) -> int:
+        """Compile up to `limit` missing sibling wave geometries by
+        dispatching one dummy wave each (row 0 holds a single real token;
+        the rest are padding — with a grammar the while-loop early-exits
+        after one short decision, so the device cost is a fraction of a
+        real wave; the jit compile is the point). Engine-owner thread
+        only, like every dispatch path. Results are discarded; the dummy
+        wave shares nothing with slot state."""
+        done = 0
+        for n_prompts, bucket, max_new in self._missing_wave_siblings():
+            if limit is not None and done >= limit:
+                break
+            R, n_iters, F = self._wave_geometry(n_prompts, max_new)
+            prefix = self._prefix or self._get_empty_prefix()
+            self._prefix = prefix
+            pad = self.tokenizer.pad_id
+            tokens = np.full((R, bucket), pad, dtype=np.int32)
+            tokens[0, 0] = self.tokenizer.eos_id
+            suffix_lens = np.zeros(R, dtype=np.int32)
+            suffix_lens[0] = 1
+            max_new_vec = np.zeros(R, dtype=np.int32)
+            max_new_vec[0] = max_new
+            self._rng, sub = jax.random.split(self._rng)
+            key = self._wave_key(R, bucket, n_iters, F, max_new)
+            try:
+                self._wave(
+                    self.params, self.cfg,
+                    jnp.asarray(tokens), jnp.asarray(suffix_lens),
+                    prefix.k, prefix.v, jnp.int32(prefix.length),
+                    jnp.asarray(max_new_vec),
+                    self._sp_tokens, self._sp_next, self._forced,
+                    self._forced_next, self._done_state,
+                    jnp.int32(self.tokenizer.eos_id), jnp.int32(pad),
+                    jnp.int32(self._dfa_start),
+                    sub, jnp.float32(self.temperature),
+                    n_iters, F, max_new, self._constrained,
+                )
+            except Exception:
+                # Record and move on: the backlog must drain even when a
+                # dispatch fails (a wedged backlog would stall callers
+                # waiting on wave_prewarm_backlog()==0 forever), and the
+                # variant still compiles on demand if ever truly needed.
+                self._wave_prewarm_failed.add(key)
+                self.stats["wave_prewarm_failures"] = (
+                    self.stats.get("wave_prewarm_failures", 0) + 1
+                )
+                logger.exception(
+                    "wave prewarm dispatch failed for geometry %s", key
+                )
+                continue
+            self._wave_compiled.add(key)
+            self.stats["wave_prewarms"] = (
+                self.stats.get("wave_prewarms", 0) + 1
+            )
+            done += 1
+        return done
+
     def submit_wave(
         self, prompts: list[list[int]], max_new_tokens: int = 200
     ) -> WaveHandle:
@@ -1067,29 +1196,12 @@ class InferenceEngine:
         self._prefix = prefix
 
         bucket = self._bucket_for(max(len(p) for p in prompts))
-        # TWO row buckets: half width and full width. Wave compute scales
-        # with R (every padding row still runs masked through the model), so
-        # a burst whose leaders fit the half bucket — the common case —
-        # pays half the prefill/decode. Exactly two buckets bounds the
-        # compiled-variant count; a full-size warmup burst exercises both
-        # (stragglers form narrow waves), and a cold bucket mid-burst costs
-        # one jit (~5s) once per geometry, amortized by the median-of-rounds
-        # bench and by steady-state serving.
-        half = self.max_slots // 2
-        R = half if 0 < len(prompts) <= half else self.max_slots
+        R, n_iters, F = self._wave_geometry(len(prompts), max_new_tokens)
+        self._wave_shapes_seen.add((bucket, max_new_tokens))
+        self._wave_compiled.add(
+            self._wave_key(R, bucket, n_iters, F, max_new_tokens)
+        )
         pad = self.tokenizer.pad_id
-        # Wave geometry: with a grammar, block decoding needs only
-        # wave_iterations(dfa) model calls (forced runs are free); without
-        # one, every token is a choice (F=1, one per iteration). n_iters is
-        # bucketed to multiples of 4 to bound compile variants.
-        if self._constrained and self._grammar_wave_iters is not None:
-            F = self.wave_block
-            n_iters = min(self._grammar_wave_iters, max_new_tokens)
-        else:
-            F = 1
-            n_iters = max_new_tokens
-        n_iters = max(4, -(-n_iters // 4) * 4)
-
         tokens = np.full((R, bucket), pad, dtype=np.int32)
         suffix_lens = np.zeros(R, dtype=np.int32)
         max_new = np.zeros(R, dtype=np.int32)

@@ -349,11 +349,28 @@ class LocalLLMBackend:
         except queue.Empty:
             pass
 
+    def _try_prewarm(self) -> bool:
+        """Compile ONE missing sibling wave geometry while the engine is
+        idle (engine.prewarm_wave_siblings). The jit compile blocks this
+        thread for seconds — which is exactly why it runs here, at a moment
+        with no pending work, instead of mid-burst when a straggler-timing
+        ragged wave would otherwise hit it cold. Requests arriving during
+        the compile queue up and are served right after (bounded, once per
+        geometry, vs. unbounded mid-burst stall risk)."""
+        try:
+            return self.engine.prewarm_wave_siblings(limit=1) > 0
+        except Exception:
+            logger.exception("wave prewarm failed")
+            return False
+
     def _run_worker(self) -> None:
         pending: list[_WorkItem] = []
         waves: deque[tuple[Any, list[_WorkItem]]] = deque()
         while not self._stopped.is_set():
-            self._drain_queue(pending, block=not pending and not waves)
+            block = not pending and not waves
+            if block and self._try_prewarm():
+                block = False  # re-check the queue without parking
+            self._drain_queue(pending, block=block)
             if self._stopped.is_set() or (not pending and not waves):
                 continue
             # Nothing below may kill the engine-owner thread — a dead worker
@@ -464,13 +481,23 @@ def build_local_backend(
     request_timeout_s: float = 60.0,
     group_switch_after_s: float = 0.25,
     partial_hold_s: float = 0.03,
+    compile_cache_dir: str | None = "auto",
 ) -> LocalLLMBackend:
     """Construct the full local stack: params (from an HF safetensors or
     orbax checkpoint when checkpoint_path is set, random-init otherwise —
     models/loader.py), mesh sharding, engine, backend.
 
     `devices` overrides the mesh's device pool (default: jax.devices()) —
-    used by the driver dryrun to target the virtual CPU mesh explicitly."""
+    used by the driver dryrun to target the virtual CPU mesh explicitly.
+    `compile_cache_dir` points JAX's persistent compilation cache at a
+    durable directory ("auto" = ~/.cache/k8s-llm-scheduler-tpu/xla; None
+    disables) so engine program geometries compiled by ANY previous process
+    load in ~100ms instead of re-jitting (utils/compile_cache.py)."""
+    from k8s_llm_scheduler_tpu.utils.compile_cache import (
+        enable_persistent_compile_cache,
+    )
+
+    enable_persistent_compile_cache(compile_cache_dir)
     cfg = cfg or get_config(model)
     mesh = mesh_from_config(mesh_axes, devices=devices)
     multi = mesh.devices.size > 1

@@ -1,0 +1,61 @@
+"""Persistent XLA compilation cache.
+
+Every wave/admit/chunk geometry the engine dispatches is a separate XLA
+program; a cold one costs seconds of jit at 1B+ scale (a 5.1s mid-burst
+stall was measured when a straggler-timing ragged wave hit an uncompiled
+row bucket). JAX's persistent compilation cache serializes compiled
+executables to disk keyed by HLO hash, so a geometry any PREVIOUS process
+compiled loads in ~100ms instead of recompiling. Verified effective on the
+TPU backend (2.1s cold -> 0.5s warm across processes).
+
+Complements, not replaces, the engine's sibling-geometry prewarm
+(engine/engine.py prewarm_wave_siblings): the cache kills cross-process
+recompiles; the prewarm kills first-ever compiles at a moment nothing is
+waiting on them.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+logger = logging.getLogger(__name__)
+
+_enabled_path: str | None = None
+
+
+def enable_persistent_compile_cache(path: str | None = "auto") -> str | None:
+    """Idempotently point JAX's compilation cache at a durable directory.
+
+    path="auto" resolves to ~/.cache/k8s-llm-scheduler-tpu/xla; None/""
+    disables (no-op). Returns the effective path (or None). Safe to call
+    before or after jax initialization, from any entry point — first
+    caller wins (the cache dir is process-global in jax).
+    """
+    global _enabled_path
+    if not path:
+        return None
+    if path == "auto":
+        path = os.path.join(
+            os.path.expanduser("~"), ".cache", "k8s-llm-scheduler-tpu", "xla"
+        )
+    if _enabled_path is not None:
+        return _enabled_path  # process-global; first caller wins
+    import jax
+
+    if jax.default_backend() == "cpu":
+        # CPU programs compile in ms (nothing to save) and XLA:CPU's AOT
+        # loader logs a page of machine-feature-mismatch warnings per cache
+        # hit — the cache only earns its keep on accelerator backends.
+        return None
+    try:
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        # Default threshold (1s) skips trivial programs; engine geometries
+        # at bench scale compile in 2-40s and all qualify.
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception as exc:  # unwritable dir, exotic backend
+        logger.warning("persistent compile cache disabled: %s", exc)
+        return None
+    _enabled_path = path
+    return path

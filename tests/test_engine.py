@@ -560,6 +560,83 @@ class TestSparseGrammar:
             backend.close()
 
 
+class TestWavePrewarm:
+    """Sibling wave geometries compile ahead of use, never mid-burst."""
+
+    def _engine(self):
+        params = init_params(jax.random.PRNGKey(0), ENGINE_CFG)
+        return InferenceEngine(
+            params, ENGINE_CFG, TOK,
+            num_pages=32, page_size=64, max_slots=4, max_pages_per_seq=8,
+            prefill_buckets=(128, 256), chunk_steps=4, temperature=0.0,
+        )
+
+    def test_backlog_and_prewarm(self):
+        eng = self._engine()
+        prompts = [TOK.encode(f"prompt {i}") for i in range(4)]  # full R
+        eng.decide_wave(prompts, max_new_tokens=16)
+        # the half-R sibling at this (bucket, budget) is not yet compiled
+        assert eng.wave_prewarm_backlog() == 1
+        assert eng.prewarm_wave_siblings() == 1
+        assert eng.wave_prewarm_backlog() == 0
+        # a real half-R wave now reuses the prewarmed variant
+        before = eng.stats.get("wave_prewarms", 0)
+        eng.decide_wave(prompts[:1], max_new_tokens=16)
+        assert eng.wave_prewarm_backlog() == 0
+        assert eng.stats.get("wave_prewarms", 0) == before
+
+    def test_failed_prewarm_does_not_wedge_backlog(self):
+        """A raising prewarm dispatch must drain from the backlog (callers
+        poll wave_prewarm_backlog()==0 with a timeout; a wedged entry
+        would stall them), while a real wave still works."""
+        eng = self._engine()
+        prompts = [TOK.encode(f"p{i}") for i in range(4)]
+        eng.decide_wave(prompts, max_new_tokens=16)
+        assert eng.wave_prewarm_backlog() == 1
+        real_wave = eng._wave
+
+        def boom(*a, **k):
+            raise RuntimeError("transient compile failure")
+
+        eng._wave = boom
+        assert eng.prewarm_wave_siblings() == 0
+        assert eng.wave_prewarm_backlog() == 0  # failed, not pending
+        assert eng.stats.get("wave_prewarm_failures", 0) == 1
+        eng._wave = real_wave
+        # the geometry still compiles on demand for a real wave
+        fins = eng.decide_wave(prompts[:1], max_new_tokens=16)
+        assert fins[0].token_ids
+
+    def test_group_switch_invalidates_keys(self):
+        eng = self._engine()
+        eng.decide_wave([TOK.encode("a")], max_new_tokens=8)
+        eng.prewarm_wave_siblings()
+        assert eng.wave_prewarm_backlog() == 0
+        # a longer prefix bucket is a different executable set
+        eng.set_prefix(TOK.encode("x" * 300))
+        assert eng.wave_prewarm_backlog() > 0
+
+    def test_backend_idle_prewarm(self):
+        """The worker compiles sibling geometries on its own while idle."""
+        import time as _time
+
+        from k8s_llm_scheduler_tpu.engine.local import LocalLLMBackend
+        from conftest import make_node, make_pod
+
+        eng = self._engine()
+        backend = LocalLLMBackend(eng, TOK, max_new_tokens=90)
+        try:
+            nodes = [make_node("node-x"), make_node("node-y")]
+            backend.get_scheduling_decision(make_pod(), nodes)
+            deadline = _time.monotonic() + 60
+            while eng.wave_prewarm_backlog() > 0:
+                assert _time.monotonic() < deadline, "idle prewarm never ran"
+                _time.sleep(0.05)
+            assert eng.stats.get("wave_prewarms", 0) >= 1
+        finally:
+            backend.close()
+
+
 class TestIncrementalPrefix:
     """LCP-seeded chunked prefill == fresh full prefill, exactly."""
 
