@@ -33,6 +33,30 @@ BANNER = r"""
 """
 
 
+def _backend_kwargs(cfg: Config, **overrides) -> dict:
+    """The ONE cfg -> build_local_backend kwargs mapping (cli run/demo and
+    cli complete must not drift: a cfg key honored by one and silently
+    ignored by the other is a support trap)."""
+    kwargs = dict(
+        model=cfg.get("llm.model", "tiny"),
+        mesh_axes=cfg.get("llm.mesh", None),
+        temperature=cfg.get("llm.temperature"),
+        max_slots=cfg.get("llm.max_batch"),
+        page_size=cfg.get("llm.page_size"),
+        prefill_buckets=tuple(cfg.get("llm.prefill_buckets")),
+        max_new_tokens=cfg.get("llm.max_tokens"),
+        constrained=cfg.get("llm.constrained_json"),
+        checkpoint_path=cfg.get("llm.checkpoint_path"),
+        tokenizer_path=cfg.get("llm.tokenizer_path"),
+        quantize=cfg.get("llm.quantization"),
+        request_timeout_s=float(cfg.get("llm.timeout")),
+        group_switch_after_s=float(cfg.get("llm.group_switch_after_s")),
+        compile_cache_dir=cfg.get("llm.compile_cache_dir"),
+    )
+    kwargs.update(overrides)
+    return kwargs
+
+
 def _build_stack(cfg: Config, cluster) -> Any:
     from k8s_llm_scheduler_tpu.core.breaker import CircuitBreaker
     from k8s_llm_scheduler_tpu.core.cache import DecisionCache
@@ -47,22 +71,7 @@ def _build_stack(cfg: Config, cluster) -> Any:
     else:
         from k8s_llm_scheduler_tpu.engine.local import build_local_backend
 
-        backend = build_local_backend(
-            model=cfg.get("llm.model", "tiny"),
-            mesh_axes=cfg.get("llm.mesh", None),
-            temperature=cfg.get("llm.temperature"),
-            max_slots=cfg.get("llm.max_batch"),
-            page_size=cfg.get("llm.page_size"),
-            prefill_buckets=tuple(cfg.get("llm.prefill_buckets")),
-            max_new_tokens=cfg.get("llm.max_tokens"),
-            constrained=cfg.get("llm.constrained_json"),
-            checkpoint_path=cfg.get("llm.checkpoint_path"),
-            tokenizer_path=cfg.get("llm.tokenizer_path"),
-            quantize=cfg.get("llm.quantization"),
-            request_timeout_s=float(cfg.get("llm.timeout")),
-            group_switch_after_s=float(cfg.get("llm.group_switch_after_s")),
-            compile_cache_dir=cfg.get("llm.compile_cache_dir"),
-        )
+        backend = build_local_backend(**_backend_kwargs(cfg))
 
     cache = (
         DecisionCache(
@@ -317,6 +326,74 @@ def cmd_bench(args: argparse.Namespace, cfg: Config) -> int:
     return subprocess.call(cmd)
 
 
+def cmd_complete(args: argparse.Namespace, cfg: Config) -> int:
+    """Free-form generation through the PAGED continuous-batching path —
+    the general-completion capability the reference gets from its remote
+    chat_completion endpoint (reference scheduler.py:425-433), minus the
+    network. Decision serving never uses this path (waves are strictly
+    faster for bounded grammar decisions — engine/engine.py module doc);
+    this command is its product surface: unbounded budgets, no grammar,
+    long prompts via the chunked prefix path.
+
+    The engine is SIZED FROM THE REQUEST: the prompt is read and encoded
+    first, the page table is sized for (suffix + budget), and a prompt
+    beyond the largest prefill bucket is installed as a chunked dense
+    prefix (set_prefix) with only its tail going through bucketed suffix
+    prefill — the same long-context machinery the 256-node cluster prompt
+    uses."""
+    from k8s_llm_scheduler_tpu.engine.local import build_local_backend
+    from k8s_llm_scheduler_tpu.engine.tokenizer import (
+        ByteTokenizer,
+        HFTokenizerAdapter,
+    )
+
+    tokenizer_path = cfg.get("llm.tokenizer_path")
+    tok = (
+        HFTokenizerAdapter(tokenizer_path)
+        if tokenizer_path
+        else ByteTokenizer()
+    )
+    prompt = args.prompt if args.prompt is not None else sys.stdin.read()
+    ids = (
+        tok.chat_prompt("You are a helpful assistant.", prompt)
+        if args.chat
+        else tok.encode(prompt)
+    )
+    if not ids:
+        print("empty prompt", file=sys.stderr)
+        return 2
+
+    page_size = int(cfg.get("llm.page_size"))
+    buckets = tuple(cfg.get("llm.prefill_buckets"))
+    # Long prompts: everything but a tail rides the chunked dense-prefix
+    # path; the tail (and the decode budget) is what the page table must
+    # hold per sequence.
+    tail = min(len(ids), max(1, buckets[0]))
+    pages_needed = -(-(tail + args.max_new_tokens + 1) // page_size) + 1
+    overrides = dict(
+        model=args.model or cfg.get("llm.model", "tiny"),
+        max_new_tokens=args.max_new_tokens,
+        max_pages_per_seq=pages_needed,
+        num_pages=max(512, pages_needed + 8),
+        constrained=False,
+    )
+    if args.temperature is not None:
+        overrides["temperature"] = args.temperature
+    backend = build_local_backend(**_backend_kwargs(cfg, **overrides))
+    try:
+        engine = backend.engine
+        if len(ids) > tail:
+            engine.set_prefix(ids[:-tail])
+        fin = engine.generate(ids[-tail:], max_new_tokens=args.max_new_tokens)
+        print(fin.text)
+        logger.info(
+            "completed %d tokens in %.1f ms", len(fin.token_ids), fin.latency_ms
+        )
+        return 0
+    finally:
+        backend.close()
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(prog="k8s_llm_scheduler_tpu")
     parser.add_argument("--config", default=None, help="path to config.yaml")
@@ -348,6 +425,21 @@ def main(argv: list[str] | None = None) -> int:
              "small configs; pass llm.model sizes deliberately)",
     )
 
+    p_complete = sub.add_parser(
+        "complete",
+        help="free-form text completion (paged continuous-batching path)",
+    )
+    p_complete.add_argument(
+        "--prompt", default=None, help="prompt text (default: stdin)"
+    )
+    p_complete.add_argument("--model", default=None, help="config name")
+    p_complete.add_argument("--max-new-tokens", type=int, default=200)
+    p_complete.add_argument("--temperature", type=float, default=None)
+    p_complete.add_argument(
+        "--chat", action="store_true",
+        help="wrap the prompt in the chat template",
+    )
+
     args = parser.parse_args(argv)
     cfg = load_config(yaml_path=args.config)
     setup_logging(
@@ -361,6 +453,7 @@ def main(argv: list[str] | None = None) -> int:
         "verify": cmd_verify,
         "bench": cmd_bench,
         "train": cmd_train,
+        "complete": cmd_complete,
     }
     return handlers[args.command](args, cfg)
 

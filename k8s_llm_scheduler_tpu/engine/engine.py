@@ -36,6 +36,18 @@ small-model latency; only syncs are expensive, enqueues pipeline):
   streams them through the Pallas kernel (paged_attn="pallas"). Requests
   join/leave between chunks; shapes never depend on how many are in
   flight.
+
+  WHY TWO DECODE PATHS: decision serving uses waves EXCLUSIVELY —
+  decisions are short, grammar-bounded, and arrive in prefix-sharing
+  bursts, so one fused program with no paged-cache traffic beats chunked
+  decode on every axis that matters there (dispatch count, HBM traffic,
+  tail latency). The paged path is the GENERAL-COMPLETION engine: budgets
+  beyond a wave's fused cap, no grammar, requests joining/leaving
+  mid-flight, chunk-granular harvesting — the capability the reference
+  exposes via its remote chat endpoint (reference scheduler.py:425-433).
+  Its product surface is `generate()` / `cli complete`; it also serves as
+  the fallback for workloads whose emission budget or batch dynamics
+  don't fit a wave.
 - **Device-resident decode state**: current token / position / active /
   DFA state / remaining-budget live on device between dispatches; the
   budget makes max_new_tokens a device-side guarantee.

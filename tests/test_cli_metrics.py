@@ -96,6 +96,42 @@ class TestCLI:
         stats = json.loads(out[out.index("{"):])
         assert stats["total_scheduled"] == 3
 
+    def test_complete_generates_text(self, capsys):
+        """`cli complete` drives the PAGED continuous-batching path end to
+        end — the general-completion product surface (the decision flow
+        never touches it; engine/engine.py module doc explains the
+        split)."""
+        from k8s_llm_scheduler_tpu.cli import main
+
+        rc = main([
+            "complete", "--model", "tiny", "--prompt", "hello world",
+            "--max-new-tokens", "12", "--temperature", "0.0",
+        ])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert out.strip()  # emitted some text
+
+    def test_complete_long_prompt_and_budget(self, capsys, tmp_path):
+        """Prompts past the largest prefill bucket ride the chunked
+        dense-prefix path, and the page table is sized from the actual
+        budget — no OutOfPages / bucket-overflow crashes (the command
+        advertises unbounded budgets)."""
+        from k8s_llm_scheduler_tpu.cli import main
+
+        cfg_file = tmp_path / "config.yaml"
+        # tiny buckets force the long-prompt path cheaply
+        cfg_file.write_text(
+            "llm:\n  prefill_buckets: [64, 128]\n  page_size: 64\n"
+        )
+        rc = main([
+            "--config", str(cfg_file),
+            "complete", "--model", "tiny", "--prompt", "x" * 400,
+            "--max-new-tokens", "300", "--temperature", "0.0",
+        ])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert out.strip()
+
     def test_run_without_kubernetes_errors_cleanly(self, capsys, tmp_path):
         from k8s_llm_scheduler_tpu.cli import main
         from k8s_llm_scheduler_tpu.cluster.kube import KubeCluster
