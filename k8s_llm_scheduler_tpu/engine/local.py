@@ -106,8 +106,15 @@ class LocalLLMBackend:
         admit_wait_s: float = 0.002,
         group_switch_after_s: float = 0.25,
         partial_hold_s: float = 0.03,
+        prewarm_idle_delay_s: float = 0.5,
     ) -> None:
         self.engine = engine
+        # Idle grace before a sibling-geometry prewarm compile may start:
+        # a jit blocks the worker for seconds, so it must not fire the
+        # instant the queue empties — a burst's next round often arrives
+        # within ms (measured: a prewarm starting between bench rounds
+        # delayed the next round's waves 9s behind its compile).
+        self.prewarm_idle_delay_s = prewarm_idle_delay_s
         # Max time a ragged wave tail may wait for stragglers while earlier
         # waves are in flight (see _submit_waves.run_group).
         self.partial_hold_s = partial_hold_s
@@ -126,6 +133,16 @@ class LocalLLMBackend:
         self._queue: queue.Queue[_WorkItem | None] = queue.Queue()
         self._dfa_cache: dict[tuple[str, ...], Any] = {}
         self._current_group: tuple | None = None
+        # EMA of per-wave device service time, used to DEADLINE the
+        # is_ready() straggler-poll in _worker_tick: on the tunneled TPU
+        # backend is_ready() reports when the whole enqueued chain drains,
+        # not when this wave's result landed (measured: wave 1 "ready" at
+        # 886ms vs true completion 469ms with 3 waves in flight), so
+        # trusting it defers every leader by the full pipeline depth. A
+        # blocking harvest returns at true completion; the EMA tells us
+        # when polling stops being useful.
+        self._wave_ema_s = 0.5
+        self._last_harvest_t = 0.0
         self._worker = threading.Thread(
             target=self._run_worker, daemon=True, name="llm-engine"
         )
@@ -333,10 +350,17 @@ class LocalLLMBackend:
         run_group(switch_items)
         return rest
 
-    def _drain_queue(self, pending: list[_WorkItem], block: bool) -> None:
-        """Move queued items into `pending`; a None sentinel sets _stopped."""
+    def _drain_queue(
+        self,
+        pending: list[_WorkItem],
+        block: bool,
+        block_timeout: float | None = None,
+    ) -> None:
+        """Move queued items into `pending`; a None sentinel sets _stopped.
+        `block_timeout` bounds only the FIRST (blocking) get — None waits
+        indefinitely."""
         try:
-            timeout = None if block else 0.0
+            timeout = block_timeout if block else 0.0
             while True:
                 item = (
                     self._queue.get(timeout=timeout) if block else self._queue.get_nowait()
@@ -352,24 +376,43 @@ class LocalLLMBackend:
     def _try_prewarm(self) -> bool:
         """Compile ONE missing sibling wave geometry while the engine is
         idle (engine.prewarm_wave_siblings). The jit compile blocks this
-        thread for seconds — which is exactly why it runs here, at a moment
-        with no pending work, instead of mid-burst when a straggler-timing
-        ragged wave would otherwise hit it cold. Requests arriving during
-        the compile queue up and are served right after (bounded, once per
-        geometry, vs. unbounded mid-burst stall risk)."""
+        thread for seconds — which is exactly why it runs here, after a
+        genuine idle grace period, instead of mid-burst when a
+        straggler-timing ragged wave would otherwise hit it cold. Requests
+        arriving during the compile queue up and are served right after
+        (bounded, once per geometry, vs. unbounded mid-burst stall
+        risk)."""
         try:
             return self.engine.prewarm_wave_siblings(limit=1) > 0
         except Exception:
             logger.exception("wave prewarm failed")
             return False
 
+    def _prewarm_backlog(self) -> int:
+        try:
+            return self.engine.wave_prewarm_backlog()
+        except AttributeError:  # stub engines
+            return 0
+
     def _run_worker(self) -> None:
         pending: list[_WorkItem] = []
         waves: deque[tuple[Any, list[_WorkItem]]] = deque()
         while not self._stopped.is_set():
             block = not pending and not waves
-            if block and self._try_prewarm():
-                block = False  # re-check the queue without parking
+            if block and self._prewarm_backlog() > 0:
+                # Idle with compiles owed: park only for the grace period;
+                # if still idle after it, compile ONE sibling geometry,
+                # then re-check the queue. Arriving work always wins over
+                # starting a prewarm.
+                self._drain_queue(
+                    pending, block=True,
+                    block_timeout=self.prewarm_idle_delay_s,
+                )
+                if self._stopped.is_set():
+                    break
+                if not pending:
+                    self._try_prewarm()
+                continue
             self._drain_queue(pending, block=block)
             if self._stopped.is_set() or (not pending and not waves):
                 continue
@@ -415,12 +458,27 @@ class LocalLLMBackend:
         if waves:
             handle, items = waves[0]
             # While the oldest wave executes, keep feeding the pipeline:
-            # stragglers arriving now become the NEXT wave, overlapping with
-            # this one on device instead of waiting behind a blocking sync.
-            # The wait blocks on the queue (2ms granularity for the
-            # is_ready re-check) rather than busy-polling, so an idle wait
-            # costs no CPU and a straggler wakes the worker immediately.
-            while not handle.is_ready() and not self._stopped.is_set():
+            # stragglers arriving now become the NEXT wave, overlapping
+            # with this one on device instead of waiting behind a blocking
+            # sync. The wait blocks on the queue (2ms granularity for the
+            # is_ready re-check) rather than busy-polling. The poll is
+            # DEADLINE-BOUNDED by the wave-service EMA: is_ready() on the
+            # tunneled backend only flips when the whole enqueued chain
+            # drains, so past the point where this wave should be done we
+            # stop polling and harvest BLOCKINGLY — device_get returns at
+            # the wave's true completion, which is what its leaders (and
+            # all their parked followers) are waiting on. The 0.5 factor
+            # biases the deadline LOW on purpose: an early blocking
+            # harvest returns at (and therefore MEASURES) the true
+            # completion time, keeping the EMA accurate — a high deadline
+            # would record its own lateness into the EMA and never
+            # converge back down.
+            deadline = handle.submitted_at + 0.5 * self._wave_ema_s
+            while (
+                not handle.is_ready()
+                and not self._stopped.is_set()
+                and time.perf_counter() < deadline
+            ):
                 try:
                     got = self._queue.get(timeout=0.002)
                 except queue.Empty:
@@ -443,6 +501,22 @@ class LocalLLMBackend:
                 for item in items:
                     item.fail(BackendError(str(exc)))
             else:
+                now = time.perf_counter()
+                # Marginal service time of THIS wave: from when the device
+                # could have started it (its submit, or the previous
+                # wave's completion) to its completion. Feeds the poll
+                # deadline above. ASYMMETRIC update: fast down, slow and
+                # CAPPED up — a cold-compile wave (5-30s) must not poison
+                # the estimate, or the deadline balloons and the poll
+                # degenerates back to waiting out the chain drain.
+                service = max(now - max(handle.submitted_at, self._last_harvest_t), 0.02)
+                self._last_harvest_t = now
+                if service < self._wave_ema_s:
+                    self._wave_ema_s = 0.5 * self._wave_ema_s + 0.5 * service
+                else:
+                    self._wave_ema_s = 0.9 * self._wave_ema_s + 0.1 * min(
+                        service, 2.0
+                    )
                 for fin, item in zip(fins, items):
                     item.resolve(fin.text)
         return pending

@@ -39,6 +39,7 @@ DECISION = json.dumps(
 class FakeHandle:
     def __init__(self, ready_at):
         self.ready_at = ready_at
+        self.submitted_at = time.perf_counter()
 
     def is_ready(self):
         return time.perf_counter() >= self.ready_at
@@ -70,12 +71,62 @@ class FakeEngine:
         return h
 
     def harvest_wave(self, h):
-        while not h.is_ready():
+        # Models the real engine: a blocking harvest (device_get) returns
+        # at the wave's TRUE completion regardless of what is_ready()
+        # claims (the tunneled backend's is_ready lies late).
+        while time.perf_counter() < h.ready_at:
             time.sleep(0.002)
         return [SimpleNamespace(text=DECISION) for _ in range(h.n)]
 
     def get_stats(self):
         return {}
+
+    def prewarm_wave_siblings(self, limit=None):
+        return 0  # idle prewarm: nothing to compile in a stub engine
+
+
+class LyingHandle(FakeHandle):
+    """A handle whose is_ready NEVER fires — the tunneled-backend failure
+    mode where readiness tracks chain-drain, not this wave's completion."""
+
+    def is_ready(self):
+        return False
+
+
+class TestHarvestDeadline:
+    def test_lying_is_ready_still_resolves_at_wave_completion(self):
+        """With is_ready never returning True, the worker must stop
+        polling at the EMA deadline and harvest blockingly — decisions
+        resolve around true wave completion instead of hanging behind the
+        pipeline (measured on the tunneled chip: wave-1 'ready' at 886ms
+        vs true completion 469ms with 3 waves in flight)."""
+        eng = FakeEngine(wave_s=0.3)
+
+        orig_submit = eng.submit_wave
+
+        def lying_submit(prompts, max_new_tokens):
+            h = orig_submit(prompts, max_new_tokens)
+            lying = LyingHandle(h.ready_at)
+            lying.n = h.n
+            return lying
+
+        eng.submit_wave = lying_submit
+        backend = LocalLLMBackend(
+            eng, tokenizer=ByteTokenizer(), max_new_tokens=160,
+            partial_hold_s=0.01, admit_wait_s=0.001,
+        )
+        try:
+            nodes = make_nodes()
+            t0 = time.perf_counter()
+            decision = backend.get_scheduling_decision(make_pod(0), nodes)
+            took = time.perf_counter() - t0
+            assert decision.selected_node == "node-1"
+            # ema starts at 0.5 -> deadline 0.25s, wave completes at 0.3s:
+            # resolution ~0.3s, nowhere near the 60s request timeout the
+            # old unbounded poll would have risked on a lying backend
+            assert took < 1.5, f"decision took {took:.2f}s"
+        finally:
+            backend.close()
 
 
 class TestPartialHoldDeadline:
