@@ -472,8 +472,16 @@ class LocalLLMBackend:
             # harvest returns at (and therefore MEASURES) the true
             # completion time, keeping the EMA accurate — a high deadline
             # would record its own lateness into the EMA and never
-            # converge back down.
-            deadline = handle.submitted_at + 0.5 * self._wave_ema_s
+            # converge back down (the stable band is ema in [true, 2x
+            # true], so the poll window is 0.5-1.0x the true service).
+            # Anchored to when the device could have STARTED this wave
+            # (its submit, or the previous harvest) — anchoring to submit
+            # alone would pre-expire the deadline for every wave behind
+            # the first and degenerate the pipeline to serial harvests.
+            deadline = (
+                max(handle.submitted_at, self._last_harvest_t)
+                + 0.5 * self._wave_ema_s
+            )
             while (
                 not handle.is_ready()
                 and not self._stopped.is_set()
@@ -514,8 +522,13 @@ class LocalLLMBackend:
                 if service < self._wave_ema_s:
                     self._wave_ema_s = 0.5 * self._wave_ema_s + 0.5 * service
                 else:
+                    # Up-cap is RELATIVE (4x current estimate): the EMA can
+                    # grow geometrically to reach any steady service level
+                    # (multi-second waves at 8B+ scale) within a few waves,
+                    # while a single 30s cold-compile outlier still moves
+                    # it by at most ~30%.
                     self._wave_ema_s = 0.9 * self._wave_ema_s + 0.1 * min(
-                        service, 2.0
+                        service, 4.0 * self._wave_ema_s
                     )
                 for fin, item in zip(fins, items):
                     item.resolve(fin.text)
@@ -555,6 +568,7 @@ def build_local_backend(
     request_timeout_s: float = 60.0,
     group_switch_after_s: float = 0.25,
     partial_hold_s: float = 0.03,
+    prewarm_idle_delay_s: float = 0.5,
     compile_cache_dir: str | None = "auto",
 ) -> LocalLLMBackend:
     """Construct the full local stack: params (from an HF safetensors or
@@ -666,4 +680,5 @@ def build_local_backend(
         request_timeout_s=request_timeout_s,
         group_switch_after_s=group_switch_after_s,
         partial_hold_s=partial_hold_s,
+        prewarm_idle_delay_s=prewarm_idle_delay_s,
     )
