@@ -72,6 +72,10 @@ def _build_stack(cfg: Config, cluster) -> Any:
         from k8s_llm_scheduler_tpu.engine.local import build_local_backend
 
         backend = build_local_backend(**_backend_kwargs(cfg))
+    # Coordinator fan-out across worker replicas, when configured
+    # (distributed.replica_addrs; sched/replica.py). Sits below the cache/
+    # single-flight stack so only leader decisions cross hosts.
+    backend = _maybe_fanout(backend, cfg)
 
     cache = (
         DecisionCache(
@@ -177,16 +181,11 @@ def _maybe_init_distributed(cfg: Config) -> bool:
 
 def cmd_run(args: argparse.Namespace, cfg: Config) -> int:
     if not _maybe_init_distributed(cfg):
-        # Worker hosts serve their own model replica in the replicated-
-        # control-plane design (SCALING.md "Multi-host"); the k8s watch/
-        # bind loop belongs to the coordinator alone. Until the replicated
-        # serving loop lands, workers exit loudly instead of double-binding.
-        print(
-            "distributed worker process: control plane runs on process 0 "
-            "only (see SCALING.md 'Multi-host')",
-            file=sys.stderr,
-        )
-        return 3
+        # Worker host: no control plane (watch/bind belongs to the
+        # coordinator alone) — serve THIS host's model replica over the
+        # decision-RPC transport until terminated (SCALING.md
+        # "Multi-host"; sched/replica.py).
+        return _run_worker_replica(cfg)
     if args.fake_cluster:
         from k8s_llm_scheduler_tpu.testing import synthetic_cluster
 
@@ -205,6 +204,58 @@ def cmd_run(args: argparse.Namespace, cfg: Config) -> int:
             watch_timeout_seconds=cfg.get("scheduler.watch_interval")
         )
     return asyncio.run(_run_scheduler(cfg, cluster, demo_pods=False))
+
+
+def _run_worker_replica(cfg: Config) -> int:
+    """Worker-process serving loop: build the local backend (weights for
+    THIS host's replica; tp within the host) and answer decision RPCs from
+    the coordinator until the process is terminated."""
+    import threading
+
+    from k8s_llm_scheduler_tpu.sched.replica import ReplicaServer
+
+    if cfg.get("llm.backend") == "stub":
+        # control-plane testing without weights: workers honor the stub
+        # setting exactly like the coordinator's _build_stack does
+        from k8s_llm_scheduler_tpu.engine.backend import StubBackend
+
+        backend = StubBackend()
+    else:
+        from k8s_llm_scheduler_tpu.engine.local import build_local_backend
+
+        backend = build_local_backend(**_backend_kwargs(cfg))
+    port = int(cfg.get("distributed.replica_port"))
+    server = ReplicaServer(backend, port=port)
+    print(f"replica worker serving decisions on :{server.port}", flush=True)
+    try:
+        threading.Event().wait()  # serve until terminated
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.close()
+        backend.close()
+    return 0
+
+
+def _maybe_fanout(backend, cfg: Config):
+    """Wrap the coordinator's backend in a FanoutBackend when worker
+    replica addresses are configured."""
+    addrs = cfg.get("distributed.replica_addrs") or []
+    if not addrs:
+        return backend
+    from k8s_llm_scheduler_tpu.sched.replica import FanoutBackend, ReplicaClient
+
+    replicas = [backend]
+    for addr in addrs:
+        host, _, port = str(addr).rpartition(":")
+        replicas.append(
+            ReplicaClient(
+                host or "localhost", int(port),
+                request_timeout_s=float(cfg.get("llm.timeout")),
+            )
+        )
+    logger.info("fanning decisions out over %d replicas", len(replicas))
+    return FanoutBackend(replicas)
 
 
 def cmd_demo(args: argparse.Namespace, cfg: Config) -> int:
@@ -316,6 +367,52 @@ def cmd_train(args: argparse.Namespace, cfg: Config) -> int:
         mesh_axes=cfg.get("llm.mesh"),
     )
     print(f"final loss {loss:.4f}; checkpoint at {args.out}")
+    if args.eval:
+        import jax
+
+        if jax.process_index() != 0:
+            # Multi-host SPMD training: the serving-stack eval is a
+            # single-process affair (worker processes must not each build
+            # a backend over a mesh that spans hosts, nor print duplicate
+            # reports).
+            return 0
+        from k8s_llm_scheduler_tpu.train.eval import evaluate_checkpoint
+
+        report = evaluate_checkpoint(
+            args.model, args.out, n_cases=args.eval_cases,
+            backend_kwargs=_eval_backend_kwargs(cfg),
+        )
+        print(json.dumps(report))
+    return 0
+
+
+def _eval_backend_kwargs(cfg: Config) -> dict:
+    """The cfg mapping for eval backends, minus multi-host mesh axes (the
+    eval is per-process; a dcn-spanning llm.mesh would reference
+    non-addressable devices)."""
+    import jax
+
+    kwargs = _backend_kwargs(cfg)
+    if jax.process_count() > 1:
+        kwargs["mesh_axes"] = None
+    return kwargs
+
+
+def cmd_eval(args: argparse.Namespace, cfg: Config) -> int:
+    """Decision-quality report card (train/eval.py): teacher agreement on
+    held-out clusters + placement load-spread vs the fallback scorer and a
+    random placer — the criteria the reference only PROMPTS for
+    (reference scheduler.py:196-214), measured."""
+    from k8s_llm_scheduler_tpu.train.eval import evaluate_checkpoint
+
+    report = evaluate_checkpoint(
+        args.model or cfg.get("llm.model", "tiny"),
+        args.checkpoint,
+        n_cases=args.cases,
+        placement_pods=args.placement_pods,
+        backend_kwargs=_eval_backend_kwargs(cfg),
+    )
+    print(json.dumps(report))
     return 0
 
 
@@ -425,6 +522,25 @@ def main(argv: list[str] | None = None) -> int:
              "small configs; pass llm.model sizes deliberately)",
     )
 
+    p_train.add_argument(
+        "--eval", action="store_true",
+        help="after training, report teacher agreement + placement quality "
+             "for the saved checkpoint",
+    )
+    p_train.add_argument("--eval-cases", type=int, default=64)
+
+    p_eval = sub.add_parser(
+        "eval",
+        help="decision-quality report: teacher agreement + placement spread",
+    )
+    p_eval.add_argument(
+        "--checkpoint", default=None,
+        help="orbax/safetensors checkpoint dir (default: random-init floor)",
+    )
+    p_eval.add_argument("--model", default=None, help="config name")
+    p_eval.add_argument("--cases", type=int, default=64)
+    p_eval.add_argument("--placement-pods", type=int, default=32)
+
     p_complete = sub.add_parser(
         "complete",
         help="free-form text completion (paged continuous-batching path)",
@@ -453,6 +569,7 @@ def main(argv: list[str] | None = None) -> int:
         "verify": cmd_verify,
         "bench": cmd_bench,
         "train": cmd_train,
+        "eval": cmd_eval,
         "complete": cmd_complete,
     }
     return handlers[args.command](args, cfg)

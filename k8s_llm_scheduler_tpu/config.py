@@ -97,6 +97,12 @@ DEFAULTS: dict[str, Any] = {
         "coordinator": None,  # e.g. "10.0.0.2:8476"
         "num_processes": None,
         "process_id": None,
+        # Cross-host decision serving (sched/replica.py): worker processes
+        # serve their replica backend on replica_port; the coordinator
+        # fans leader decisions out over replica_addrs ("host:port", one
+        # per worker). Empty addrs = coordinator serves alone.
+        "replica_port": 9901,
+        "replica_addrs": [],
     },
 }
 

@@ -453,6 +453,9 @@ class WaveHandle:
     max_new_tokens: int
     req_ids: list[int]
     submitted_at: float = dataclasses.field(default_factory=time.perf_counter)
+    # True when this wave's geometry compiled at dispatch: its wall time is
+    # jit + execution, and service-time estimators must skip it.
+    cold_compile: bool = False
 
     def is_ready(self) -> bool:
         """True once the device result landed (harvest won't block)."""
@@ -1210,9 +1213,9 @@ class InferenceEngine:
         bucket = self._bucket_for(max(len(p) for p in prompts))
         R, n_iters, F = self._wave_geometry(len(prompts), max_new_tokens)
         self._wave_shapes_seen.add((bucket, max_new_tokens))
-        self._wave_compiled.add(
-            self._wave_key(R, bucket, n_iters, F, max_new_tokens)
-        )
+        geo_key = self._wave_key(R, bucket, n_iters, F, max_new_tokens)
+        cold_compile = geo_key not in self._wave_compiled
+        self._wave_compiled.add(geo_key)
         pad = self.tokenizer.pad_id
         tokens = np.full((R, bucket), pad, dtype=np.int32)
         suffix_lens = np.zeros(R, dtype=np.int32)
@@ -1255,6 +1258,7 @@ class InferenceEngine:
             n=len(prompts),
             max_new_tokens=max_new_tokens,
             req_ids=req_ids,
+            cold_compile=cold_compile,
         )
 
     def harvest_wave(self, handle: WaveHandle) -> list[Finished]:

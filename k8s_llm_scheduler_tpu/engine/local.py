@@ -513,23 +513,23 @@ class LocalLLMBackend:
                 # Marginal service time of THIS wave: from when the device
                 # could have started it (its submit, or the previous
                 # wave's completion) to its completion. Feeds the poll
-                # deadline above. ASYMMETRIC update: fast down, slow and
-                # CAPPED up — a cold-compile wave (5-30s) must not poison
-                # the estimate, or the deadline balloons and the poll
-                # degenerates back to waiting out the chain drain.
+                # deadline above. Waves whose geometry jit-compiled at
+                # dispatch are EXCLUDED — their wall time is compile +
+                # execution and would poison the estimate (a poisoned-high
+                # EMA delays every subsequent harvest past true
+                # completion until it decays). The remaining update is
+                # asymmetric: fast down; up capped RELATIVE (4x) so
+                # multi-second waves at 8B+ scale still converge in a few
+                # steps while any residual outlier moves it at most ~30%.
                 service = max(now - max(handle.submitted_at, self._last_harvest_t), 0.02)
                 self._last_harvest_t = now
-                if service < self._wave_ema_s:
-                    self._wave_ema_s = 0.5 * self._wave_ema_s + 0.5 * service
-                else:
-                    # Up-cap is RELATIVE (4x current estimate): the EMA can
-                    # grow geometrically to reach any steady service level
-                    # (multi-second waves at 8B+ scale) within a few waves,
-                    # while a single 30s cold-compile outlier still moves
-                    # it by at most ~30%.
-                    self._wave_ema_s = 0.9 * self._wave_ema_s + 0.1 * min(
-                        service, 4.0 * self._wave_ema_s
-                    )
+                if not getattr(handle, "cold_compile", False):
+                    if service < self._wave_ema_s:
+                        self._wave_ema_s = 0.5 * self._wave_ema_s + 0.5 * service
+                    else:
+                        self._wave_ema_s = 0.9 * self._wave_ema_s + 0.1 * min(
+                            service, 4.0 * self._wave_ema_s
+                        )
                 for fin, item in zip(fins, items):
                     item.resolve(fin.text)
         return pending

@@ -1,0 +1,405 @@
+"""Cross-host decision serving: replica workers + coordinator fan-out.
+
+SCALING.md's multi-host serving layout is replica-per-host (weights
+replicated over hosts, tp within each host's ICI domain) — but through
+round 3 only the coordinator actually SERVED: workers had a backend and no
+way to receive work. This module is the missing transport:
+
+- `ReplicaServer`: runs on a worker host next to its LocalLLMBackend;
+  accepts length-delimited JSON requests over TCP and answers each with
+  the backend's SchedulingDecision. Connections are handled on threads and
+  requests WITHIN a connection are executed concurrently — the worker's
+  engine sees the same concurrency a local DecisionClient would produce,
+  so its wave batching still coalesces a burst's leaders.
+- `ReplicaClient`: a MULTIPLEXING client (one socket, id-tagged frames, a
+  reader thread resolving per-request futures). Concurrent coordinator
+  requests interleave on the wire instead of serializing, which is what
+  keeps the remote engine's waves full.
+- `FanoutBackend`: the coordinator-side DecisionBackend that round-robins
+  decisions across [local backend, replica clients...]. It sits BELOW
+  DecisionClient, so the cache / single-flight / breaker / fallback stack
+  is untouched: only leader decisions (cache misses) ever reach a replica.
+
+The control plane stays coordinator-only (watch/bind; parallel/
+distributed.is_coordinator) — what fans out is pure model compute, the
+part that scales with replica count. K8s traffic does not multiply.
+
+Transport is dependency-free (socket + json + threading): 4-byte
+big-endian length prefix, UTF-8 JSON payload. Request:
+{"id": n, "pod": {...}, "nodes": [...]}; response: {"id": n,
+"decision": {...}} | {"id": n, "error": str, "kind":
+"infeasible"|"backend"}.
+
+Validated end to end (two real processes, decisions on both) by
+tools/dryrun_multihost.py; protocol/fan-out unit tests in
+tests/test_replica.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import json
+import logging
+import socket
+import struct
+import threading
+from collections.abc import Sequence
+from concurrent.futures import Future
+from concurrent.futures import TimeoutError as FuturesTimeout
+from typing import Any
+
+from k8s_llm_scheduler_tpu.engine.backend import (
+    BackendError,
+    DecisionBackend,
+    NoFeasibleNodeError,
+)
+from k8s_llm_scheduler_tpu.types import (
+    DecisionSource,
+    NodeMetrics,
+    PodSpec,
+    SchedulingDecision,
+)
+
+logger = logging.getLogger(__name__)
+
+_LEN = struct.Struct(">I")
+MAX_FRAME = 64 << 20  # sanity bound; a 10k-pod snapshot is ~3 MB of JSON
+
+
+# ------------------------------------------------------------------ frames
+def _send_frame(sock: socket.socket, obj: dict) -> None:
+    payload = json.dumps(obj).encode("utf-8")
+    sock.sendall(_LEN.pack(len(payload)) + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes | None:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def _recv_frame(sock: socket.socket) -> dict | None:
+    header = _recv_exact(sock, _LEN.size)
+    if header is None:
+        return None
+    (length,) = _LEN.unpack(header)
+    if length > MAX_FRAME:
+        raise BackendError(f"replica frame of {length} bytes exceeds bound")
+    payload = _recv_exact(sock, length)
+    if payload is None:
+        return None
+    return json.loads(payload.decode("utf-8"))
+
+
+# ------------------------------------------------------------- serialization
+def pod_to_wire(pod: PodSpec) -> dict:
+    return dataclasses.asdict(pod)
+
+
+def pod_from_wire(d: dict) -> PodSpec:
+    d = dict(d)
+    d["tolerations"] = tuple(d.get("tolerations") or ())
+    return PodSpec(**d)
+
+
+def node_to_wire(node: NodeMetrics) -> dict:
+    return dataclasses.asdict(node)
+
+
+def node_from_wire(d: dict) -> NodeMetrics:
+    d = dict(d)
+    d["taints"] = tuple(d.get("taints") or ())
+    return NodeMetrics(**d)
+
+
+def decision_to_wire(dec: SchedulingDecision) -> dict:
+    d = dataclasses.asdict(dec)
+    d["source"] = dec.source.value
+    return d
+
+
+def decision_from_wire(d: dict) -> SchedulingDecision:
+    d = dict(d)
+    d["source"] = DecisionSource(d["source"])
+    return SchedulingDecision(**d)
+
+
+# ------------------------------------------------------------------- server
+class ReplicaServer:
+    """Serve a DecisionBackend over TCP on a worker host.
+
+    One accept thread; one reader thread per connection; one worker thread
+    per in-flight request (requests within a connection run CONCURRENTLY —
+    the engine's wave batching depends on seeing the burst's leaders
+    together, and the engine-owner thread in LocalLLMBackend already
+    serializes device access safely).
+    """
+
+    def __init__(self, backend: DecisionBackend, host: str = "0.0.0.0",
+                 port: int = 9901) -> None:
+        self.backend = backend
+        self._sock = socket.create_server((host, port))
+        self.port = self._sock.getsockname()[1]  # resolved (port=0 allowed)
+        self._stop = threading.Event()
+        self.served = 0
+        self._served_lock = threading.Lock()
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True, name="replica-accept"
+        )
+        self._accept_thread.start()
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, addr = self._sock.accept()
+            except OSError:
+                return  # socket closed by stop()
+            logger.info("replica: accepted connection from %s:%s", *addr[:2])
+            threading.Thread(
+                target=self._serve_conn, args=(conn, addr), daemon=True,
+                name=f"replica-conn-{addr[1]}",
+            ).start()
+
+    def _serve_conn(self, conn: socket.socket, addr) -> None:
+        send_lock = threading.Lock()
+        try:
+            while not self._stop.is_set():
+                req = _recv_frame(conn)
+                if req is None:
+                    return
+                threading.Thread(
+                    target=self._serve_one, args=(conn, send_lock, req),
+                    daemon=True,
+                ).start()
+        except (OSError, ValueError, json.JSONDecodeError) as exc:
+            logger.warning("replica connection %s dropped: %s", addr, exc)
+        finally:
+            conn.close()
+
+    def _serve_one(self, conn, send_lock, req: dict) -> None:
+        rid = req.get("id")
+        try:
+            pod = pod_from_wire(req["pod"])
+            nodes = [node_from_wire(n) for n in req["nodes"]]
+            decision = self.backend.get_scheduling_decision(pod, nodes)
+            resp = {"id": rid, "decision": decision_to_wire(decision)}
+            with self._served_lock:
+                self.served += 1
+        except NoFeasibleNodeError as exc:
+            resp = {"id": rid, "error": str(exc), "kind": "infeasible"}
+        except Exception as exc:
+            resp = {"id": rid, "error": str(exc), "kind": "backend"}
+        try:
+            with send_lock:
+                _send_frame(conn, resp)
+        except OSError:
+            pass  # client gone; nothing to deliver to
+
+    def close(self) -> None:
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        self._accept_thread.join(timeout=5)
+
+
+# ------------------------------------------------------------------- client
+class ReplicaClient:
+    """Multiplexing client for one remote replica.
+
+    Thread-safe: any number of coordinator threads may call
+    get_scheduling_decision concurrently; frames interleave on one socket
+    and a reader thread resolves the per-id futures. A dead connection
+    fails all in-flight requests with BackendError (the DecisionClient
+    stack above retries / falls back / trips the breaker exactly as it
+    would for a local backend fault)."""
+
+    def __init__(self, host: str, port: int, connect_timeout_s: float = 10.0,
+                 request_timeout_s: float = 60.0) -> None:
+        self.addr = f"{host}:{port}"
+        self.request_timeout_s = request_timeout_s
+        self._sock = socket.create_connection((host, port), connect_timeout_s)
+        # create_connection leaves its timeout ON THE SOCKET: the reader
+        # would then die on any response slower than connect_timeout_s
+        # (e.g. a first decision paying a jit compile). Per-request
+        # deadlines are enforced at fut.result(request_timeout_s); the
+        # socket itself blocks indefinitely.
+        self._sock.settimeout(None)
+        self._send_lock = threading.Lock()
+        self._pending: dict[int, Future] = {}
+        self._pending_lock = threading.Lock()
+        self._ids = itertools.count()
+        self._closed = False
+        self._reader = threading.Thread(
+            target=self._read_loop, daemon=True, name=f"replica-client-{port}"
+        )
+        self._reader.start()
+
+    def _read_loop(self) -> None:
+        try:
+            while True:
+                resp = _recv_frame(self._sock)
+                if resp is None:
+                    break
+                with self._pending_lock:
+                    fut = self._pending.pop(resp.get("id"), None)
+                if fut is not None and not fut.done():
+                    fut.set_result(resp)
+        except Exception as exc:  # OSError, desync, MAX_FRAME BackendError…
+            # ANY reader death must fall through to the in-flight-failure
+            # sweep below — a narrower catch once let a BackendError from
+            # the frame-size check skip it, leaving callers to block out
+            # their full request timeout with no error ever surfaced.
+            if not self._closed:
+                logger.warning("replica client %s reader died: %r", self.addr, exc)
+        # connection is gone: fail everything in flight
+        with self._pending_lock:
+            pending, self._pending = self._pending, {}
+        for fut in pending.values():
+            if not fut.done():
+                fut.set_exception(
+                    BackendError(f"replica {self.addr} connection lost")
+                )
+
+    def _submit(self, pod: PodSpec, nodes: Sequence[NodeMetrics]) -> tuple[int, Future]:
+        rid = next(self._ids)
+        fut: Future = Future()
+        with self._pending_lock:
+            if self._closed:
+                raise BackendError(f"replica {self.addr} client closed")
+            self._pending[rid] = fut
+        try:
+            with self._send_lock:
+                _send_frame(self._sock, {
+                    "id": rid,
+                    "pod": pod_to_wire(pod),
+                    "nodes": [node_to_wire(n) for n in nodes],
+                })
+        except OSError as exc:
+            with self._pending_lock:
+                self._pending.pop(rid, None)
+            raise BackendError(f"replica {self.addr} send failed: {exc}") from exc
+        return rid, fut
+
+    def _resolve(self, resp: dict) -> SchedulingDecision:
+        if "decision" in resp:
+            return decision_from_wire(resp["decision"])
+        if resp.get("kind") == "infeasible":
+            raise NoFeasibleNodeError(resp.get("error", ""))
+        raise BackendError(
+            f"replica {self.addr}: {resp.get('error', 'unknown failure')}"
+        )
+
+    def _drop(self, rid: int) -> None:
+        with self._pending_lock:
+            self._pending.pop(rid, None)
+
+    def get_scheduling_decision(
+        self, pod: PodSpec, nodes: Sequence[NodeMetrics]
+    ) -> SchedulingDecision:
+        rid, fut = self._submit(pod, nodes)
+        try:
+            resp = fut.result(timeout=self.request_timeout_s)
+        except FuturesTimeout as exc:
+            # drop the pending entry (it would otherwise leak for the
+            # connection's lifetime) and surface the module's documented
+            # failure type
+            self._drop(rid)
+            raise BackendError(
+                f"replica {self.addr} timed out after {self.request_timeout_s}s"
+            ) from exc
+        return self._resolve(resp)
+
+    async def get_scheduling_decision_async(
+        self, pod: PodSpec, nodes: Sequence[NodeMetrics]
+    ) -> SchedulingDecision:
+        """Natively-async variant (DecisionClient prefers it): awaits the
+        wire future without holding a worker thread, so a burst's leaders
+        fan out to replicas without being capped by the to_thread pool."""
+        import asyncio
+
+        rid, fut = self._submit(pod, nodes)
+        try:
+            resp = await asyncio.wait_for(
+                asyncio.wrap_future(fut), timeout=self.request_timeout_s
+            )
+        except (TimeoutError, asyncio.TimeoutError) as exc:
+            self._drop(rid)
+            raise BackendError(
+                f"replica {self.addr} timed out after {self.request_timeout_s}s"
+            ) from exc
+        return self._resolve(resp)
+
+    def close(self) -> None:
+        with self._pending_lock:
+            self._closed = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        self._reader.join(timeout=5)
+
+
+# ------------------------------------------------------------------ fan-out
+class FanoutBackend:
+    """Round-robin decisions across [local backend, remote replicas...].
+
+    Sits at the DecisionBackend seam, below cache/single-flight: only
+    leader decisions reach it, so replica count multiplies exactly the
+    model compute. Round-robin (not load-based) is deliberate: within one
+    burst every replica re-prefills the same snapshot prefix once and then
+    serves its share of leaders — the shared-prefix economics hold on
+    every replica independently. A replica failure surfaces as the
+    BackendError the retry/breaker/fallback stack already handles; the
+    stats record per-replica routing for observability."""
+
+    def __init__(self, replicas: Sequence[Any]) -> None:
+        if not replicas:
+            raise ValueError("FanoutBackend needs at least one replica")
+        self.replicas = list(replicas)
+        self._rr = itertools.count()
+        self.routed = [0] * len(self.replicas)
+
+    def get_scheduling_decision(
+        self, pod: PodSpec, nodes: Sequence[NodeMetrics]
+    ) -> SchedulingDecision:
+        i = next(self._rr) % len(self.replicas)
+        self.routed[i] += 1
+        return self.replicas[i].get_scheduling_decision(pod, nodes)
+
+    async def get_scheduling_decision_async(
+        self, pod: PodSpec, nodes: Sequence[NodeMetrics]
+    ) -> SchedulingDecision:
+        """Async routing: without this, wrapping a backend in FanoutBackend
+        would hide the replicas' native async paths from DecisionClient and
+        throttle every leader through the default to_thread pool (~32
+        threads) — the exact bottleneck the async path exists to avoid."""
+        import asyncio
+
+        i = next(self._rr) % len(self.replicas)
+        self.routed[i] += 1
+        replica = self.replicas[i]
+        fn = getattr(replica, "get_scheduling_decision_async", None)
+        if fn is not None:
+            return await fn(pod, nodes)
+        return await asyncio.to_thread(
+            replica.get_scheduling_decision, pod, nodes
+        )
+
+    def get_stats(self) -> dict:
+        stats: dict[str, Any] = {"fanout_routed": list(self.routed)}
+        local = self.replicas[0]
+        if hasattr(local, "get_stats"):
+            stats.update(local.get_stats())
+        return stats
+
+    def close(self) -> None:
+        for r in self.replicas:
+            if hasattr(r, "close"):
+                r.close()

@@ -27,26 +27,17 @@ from k8s_llm_scheduler_tpu.engine.tokenizer import Tokenizer
 logger = logging.getLogger(__name__)
 
 
-def teacher_pairs(
-    tokenizer: Tokenizer,
-    n_nodes: int = 5,
-    seed: int = 0,
-) -> Iterator[list[int]]:
-    """Endless (prompt + decision) token sequences from the heuristic
-    teacher over randomized synthetic clusters.
-
-    Each sample is the full chat prompt (system + cluster state + pod)
-    followed by the teacher's decision JSON and EOS — exactly the
-    sequence the serving path decodes, so the causal-LM loss teaches the
-    decision distribution in place.
-    """
+def random_cases(n_nodes: int = 5, seed: int = 0):
+    """Endless randomized (pod, nodes) scheduling cases — THE training
+    distribution. train/eval.py draws its held-out cases from this same
+    generator at a disjoint seed, so agreement measured there stays
+    on-distribution by construction when this is tuned."""
     import dataclasses
 
     from k8s_llm_scheduler_tpu.cluster.interface import raw_pod_to_spec
     from k8s_llm_scheduler_tpu.testing import pod_burst, synthetic_cluster
 
     rng = np.random.default_rng(seed)
-    pe = PromptEngine()
     while True:
         cluster = synthetic_cluster(int(rng.integers(2, n_nodes + 1)))
         base_nodes = cluster.get_node_metrics()
@@ -62,33 +53,56 @@ def teacher_pairs(
             )
             for n in base_nodes
         ]
-        pods = [raw_pod_to_spec(p) for p in pod_burst(4, distinct_shapes=4)]
-        pods = [
-            dataclasses.replace(
-                p,
-                cpu_request=round(float(rng.uniform(0.05, 2.0)), 3),
-                memory_request=round(float(rng.uniform(0.064, 2.0)), 3),
+        for raw in pod_burst(4, distinct_shapes=4):
+            pod = raw_pod_to_spec(raw)
+            yield (
+                dataclasses.replace(
+                    pod,
+                    cpu_request=round(float(rng.uniform(0.05, 2.0)), 3),
+                    memory_request=round(float(rng.uniform(0.064, 2.0)), 3),
+                ),
+                nodes,
             )
-            for p in pods
-        ]
-        for pod in pods:
-            decision = fallback_decision(
-                nodes, reason="teacher", strategy="resource_balanced", pod=pod
-            )
-            if decision is None:
-                continue
-            cluster_part, pod_part = pe.split_prompt(pod, nodes)
-            prompt = tokenizer.chat_prompt(
-                pe.system_prompt, cluster_part + pod_part
-            )
-            answer = json.dumps(
-                {
-                    "selected_node": decision.selected_node,
-                    "confidence": round(decision.confidence, 2),
-                    "reasoning": "resource balanced",
-                }
-            )
-            yield prompt + tokenizer.encode(answer) + [tokenizer.eos_id]
+
+
+def teacher_pairs(
+    tokenizer: Tokenizer,
+    n_nodes: int = 5,
+    seed: int = 0,
+) -> Iterator[tuple[list[int], int]]:
+    """Endless (prompt + decision tokens, answer_start) samples from the
+    heuristic teacher over randomized synthetic clusters.
+
+    Each sample is the full chat prompt (system + cluster state + pod)
+    followed by the teacher's decision JSON and EOS — exactly the
+    sequence the serving path decodes. `answer_start` is the index of the
+    first decision token: the loss masks to the answer span
+    (train_step.causal_lm_loss loss_start), because a ~60-token answer
+    behind a ~1.5k-token prompt otherwise contributes ~4% of the gradient
+    and the decision head stays near uniform for hundreds of steps.
+    """
+    pe = PromptEngine()
+    for pod, nodes in random_cases(n_nodes=n_nodes, seed=seed):
+        decision = fallback_decision(
+            nodes, reason="teacher", strategy="resource_balanced", pod=pod
+        )
+        if decision is None:
+            continue
+        cluster_part, pod_part = pe.split_prompt(pod, nodes)
+        prompt = tokenizer.chat_prompt(
+            pe.system_prompt, cluster_part + pod_part
+        )
+        answer = json.dumps(
+            {
+                "selected_node": decision.selected_node,
+                "confidence": round(decision.confidence, 2),
+                "reasoning": "resource balanced",
+            }
+        )
+        yield (
+            prompt + tokenizer.encode(answer) + [tokenizer.eos_id],
+            len(prompt),
+        )
 
 
 def make_batches(
@@ -97,21 +111,25 @@ def make_batches(
     seq_len: int,
     n_nodes: int = 5,
     seed: int = 0,
-) -> Iterator[tuple[np.ndarray, np.ndarray]]:
-    """Batched, padded (tokens, seq_lens) for the train step."""
+) -> Iterator[tuple[np.ndarray, np.ndarray, np.ndarray]]:
+    """Batched, padded (tokens, seq_lens, answer_starts) for the train
+    step (answer_starts feeds the loss mask)."""
     pairs = teacher_pairs(tokenizer, n_nodes=n_nodes, seed=seed)
     pad = tokenizer.pad_id
     warned = False
     while True:
         tokens = np.full((batch_size, seq_len), pad, dtype=np.int32)
         lens = np.zeros(batch_size, dtype=np.int32)
+        starts = np.zeros(batch_size, dtype=np.int32)
         for b in range(batch_size):
-            ids = next(pairs)
+            ids, ans_start = next(pairs)
             if len(ids) > seq_len:
                 # Truncate from the LEFT: the decision JSON lives at the
                 # tail, and a distillation batch that drops the answer
                 # trains on prompt text only (silently learning nothing).
+                cut = len(ids) - seq_len
                 ids = ids[-seq_len:]
+                ans_start = max(0, ans_start - cut)
                 if not warned:
                     logger.warning(
                         "teacher pairs exceed seq_len=%d; truncating prompt "
@@ -120,7 +138,8 @@ def make_batches(
                     warned = True
             tokens[b, : len(ids)] = ids
             lens[b] = len(ids)
-        yield tokens, lens
+            starts[b] = ans_start
+        yield tokens, lens, starts
 
 
 def train_and_save(
@@ -132,10 +151,15 @@ def train_and_save(
     mesh_axes: dict[str, int] | None = None,
     log_every: int = 5,
     seed: int = 0,
+    lr: float = 3e-4,
 ) -> float:
-    """Run `steps` of causal-LM fine-tuning on teacher pairs and save an
-    orbax checkpoint servable via checkpoint_path. Returns the final loss."""
+    """Run `steps` of answer-masked fine-tuning on teacher pairs and save
+    an orbax checkpoint servable via checkpoint_path. Returns the final
+    loss. `lr` defaults suit bootstrap distillation of the small configs
+    from random init (the 1e-5 fine-tune default under-trained them by
+    orders of magnitude)."""
     import jax
+    import optax
 
     from k8s_llm_scheduler_tpu.engine.tokenizer import ByteTokenizer
     from k8s_llm_scheduler_tpu.models.loader import save_checkpoint
@@ -156,14 +180,16 @@ def train_and_save(
         )
     else:
         mesh = mesh_from_config(mesh_axes)
-    init_fn, step_fn = make_train_step(cfg, mesh)
+    init_fn, step_fn = make_train_step(
+        cfg, mesh, optimizer=optax.adamw(lr)
+    )
     state = init_fn(jax.random.PRNGKey(seed))
     batches = make_batches(tokenizer, batch_size, seq_len, seed=seed)
     loss = float("nan")
     for step in range(1, steps + 1):
-        tokens, lens = next(batches)
-        tokens, lens = step_fn.place_batch(tokens, lens)
-        state, loss_arr = step_fn(state, tokens, lens)
+        tokens, lens, starts = next(batches)
+        tokens, lens, starts = step_fn.place_batch(tokens, lens, starts)
+        state, loss_arr = step_fn(state, tokens, lens, starts)
         if step % log_every == 0 or step == steps:
             loss = float(loss_arr)
             logger.info("step %d/%d loss %.4f", step, steps, loss)

@@ -41,12 +41,31 @@ class TrainState(NamedTuple):
     step: jax.Array
 
 
-def causal_lm_loss(logits: jax.Array, tokens: jax.Array, seq_lens: jax.Array) -> jax.Array:
-    """Mean next-token cross entropy over valid (non-pad) positions."""
+def causal_lm_loss(
+    logits: jax.Array,
+    tokens: jax.Array,
+    seq_lens: jax.Array,
+    loss_start: jax.Array | None = None,
+) -> jax.Array:
+    """Mean next-token cross entropy over valid (non-pad) positions.
+
+    `loss_start` ([B] int32) restricts the loss to targets at index >=
+    loss_start — the distillation path passes the answer offset so the
+    gradient teaches the DECISION distribution rather than drowning it
+    25:1 in prompt-modeling (a 1.5k-token cluster prompt carries a
+    ~60-token answer; full-sequence loss left the decision head near
+    uniform after hundreds of steps). None keeps the plain-LM behavior
+    (pretraining-style callers: pipeline stages, dryrun)."""
     targets = tokens[:, 1:]
     logits = logits[:, :-1]
     S = targets.shape[1]
-    mask = (jnp.arange(S)[None, :] < (seq_lens[:, None] - 1)).astype(jnp.float32)
+    pos = jnp.arange(S)[None, :]
+    mask = pos < (seq_lens[:, None] - 1)
+    if loss_start is not None:
+        # target index j predicts token j+1, so answer tokens start
+        # contributing at j = loss_start - 1
+        mask = mask & (pos >= jnp.maximum(loss_start[:, None] - 1, 0))
+    mask = mask.astype(jnp.float32)
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
@@ -85,14 +104,16 @@ def make_train_step(
     data_sharding = NamedSharding(mesh, P(dp, sp))
     lens_sharding = NamedSharding(mesh, P(dp))
 
-    def loss_fn(params, tokens, seq_lens):
+    def loss_fn(params, tokens, seq_lens, loss_start):
         logits, _, _ = forward_prefill(params, cfg, tokens, seq_lens, attn_impl)
-        return causal_lm_loss(logits, tokens, seq_lens)
+        return causal_lm_loss(logits, tokens, seq_lens, loss_start)
 
     @jax.jit
-    def step_fn(state: TrainState, tokens, seq_lens):
+    def step_fn(state: TrainState, tokens, seq_lens, loss_start=None):
         tokens = jax.lax.with_sharding_constraint(tokens, data_sharding)
-        loss, grads = jax.value_and_grad(loss_fn)(state.params, tokens, seq_lens)
+        loss, grads = jax.value_and_grad(loss_fn)(
+            state.params, tokens, seq_lens, loss_start
+        )
         updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
         return TrainState(params, opt_state, state.step + 1), loss
@@ -103,37 +124,37 @@ def make_train_step(
         opt_state = jax.jit(optimizer.init)(params)  # moments inherit shardings
         return TrainState(params, opt_state, jnp.zeros((), jnp.int32))
 
-    def place_batch(tokens, seq_lens):
+    def local_rows(sharding, b):
+        # Rows THIS process holds, derived from the sharding itself
+        # (not assumed): replicated batch -> all rows on every
+        # process; dp over processes -> that process's slice; works
+        # for any dcn layout multihost_mesh produces.
+        idx_map = sharding.addressable_devices_indices_map((b,))
+        return sorted({
+            r
+            for (rs, *_rest) in [
+                idx if isinstance(idx, tuple) else (idx,)
+                for idx in idx_map.values()
+            ]
+            for r in range(rs.start or 0, b if rs.stop is None else rs.stop)
+        })
+
+    def place_batch(tokens, seq_lens, loss_start=None):
         """Place a GLOBAL batch (same arrays on every process) onto the
         mesh. Multi-host: each process contributes its dp-slice of the
         batch via make_array_from_process_local_data — rows map to
         processes in dp-axis order, which is process order under
-        parallel/distributed.multihost_mesh (dp outermost)."""
+        parallel/distributed.multihost_mesh (dp outermost). With
+        `loss_start` ([B], the distillation answer offsets) a 3-tuple is
+        returned, the extra array placed like seq_lens."""
         if jax.process_count() > 1:
             import numpy as _np
 
             tokens = _np.asarray(tokens)
             seq_lens = _np.asarray(seq_lens)
             b = len(tokens)
-
-            def local_rows(sharding):
-                # Rows THIS process holds, derived from the sharding itself
-                # (not assumed): replicated batch -> all rows on every
-                # process; dp over processes -> that process's slice; works
-                # for any dcn layout multihost_mesh produces.
-                idx_map = sharding.addressable_devices_indices_map((b,))
-                rows = sorted({
-                    r
-                    for (rs, *_rest) in [
-                        idx if isinstance(idx, tuple) else (idx,)
-                        for idx in idx_map.values()
-                    ]
-                    for r in range(rs.start or 0, b if rs.stop is None else rs.stop)
-                })
-                return rows
-
-            rows = local_rows(lens_sharding)
-            return (
+            rows = local_rows(lens_sharding, b)
+            placed = (
                 jax.make_array_from_process_local_data(
                     data_sharding, tokens[rows]
                 ),
@@ -141,10 +162,18 @@ def make_train_step(
                     lens_sharding, seq_lens[rows]
                 ),
             )
-        return (
+            if loss_start is not None:
+                placed = (*placed, jax.make_array_from_process_local_data(
+                    lens_sharding, _np.asarray(loss_start)[rows]
+                ))
+            return placed
+        placed = (
             jax.device_put(tokens, data_sharding),
             jax.device_put(seq_lens, lens_sharding),
         )
+        if loss_start is not None:
+            placed = (*placed, jax.device_put(loss_start, lens_sharding))
+        return placed
 
     step_fn.place_batch = place_batch  # type: ignore[attr-defined]
     return init_fn, step_fn

@@ -1,0 +1,204 @@
+"""Cross-host decision serving (sched/replica.py): wire protocol,
+multiplexing client, fan-out routing, failure propagation — all over real
+localhost sockets with the stub backend (no model weights)."""
+
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import pytest
+
+from k8s_llm_scheduler_tpu.engine.backend import (
+    BackendError,
+    NoFeasibleNodeError,
+    StubBackend,
+)
+from k8s_llm_scheduler_tpu.sched.replica import (
+    FanoutBackend,
+    ReplicaClient,
+    ReplicaServer,
+    decision_from_wire,
+    decision_to_wire,
+)
+from k8s_llm_scheduler_tpu.types import DecisionSource, NodeMetrics, PodSpec
+
+
+def make_nodes(n=3):
+    return [
+        NodeMetrics(
+            name=f"node-{i}", cpu_usage_percent=10.0 * (i + 1),
+            memory_usage_percent=10.0 * (i + 1), available_cpu_cores=8.0,
+            available_memory_gb=32.0, pod_count=i, max_pods=110,
+            labels={"zone": "z1"}, taints=(),
+            conditions={"Ready": "True"},
+        )
+        for i in range(n)
+    ]
+
+
+def make_pod(i=0):
+    return PodSpec(
+        name=f"p{i}", namespace="default", cpu_request=0.1,
+        memory_request=0.125, node_selector={}, tolerations=(
+            {"key": "gpu", "operator": "Exists", "value": "", "effect": ""},
+        ),
+        priority=3,
+    )
+
+
+@pytest.fixture
+def server():
+    srv = ReplicaServer(StubBackend(), host="127.0.0.1", port=0)
+    yield srv
+    srv.close()
+
+
+class TestWire:
+    def test_decision_roundtrip(self):
+        from k8s_llm_scheduler_tpu.types import SchedulingDecision
+
+        d = SchedulingDecision(
+            selected_node="node-2", confidence=0.87, reasoning="because",
+            source=DecisionSource.LLM, latency_ms=12.5,
+        )
+        assert decision_from_wire(decision_to_wire(d)) == d
+
+
+class TestClientServer:
+    def test_remote_decision_matches_local(self, server):
+        client = ReplicaClient("127.0.0.1", server.port)
+        try:
+            local = StubBackend()
+            pod, nodes = make_pod(), make_nodes()
+            remote_d = client.get_scheduling_decision(pod, nodes)
+            local_d = local.get_scheduling_decision(pod, nodes)
+            assert remote_d.selected_node == local_d.selected_node
+            assert remote_d.source is DecisionSource.LLM
+            assert server.served == 1
+        finally:
+            client.close()
+
+    def test_concurrent_requests_multiplex(self, server):
+        client = ReplicaClient("127.0.0.1", server.port)
+        try:
+            nodes = make_nodes()
+            with ThreadPoolExecutor(8) as pool:
+                futs = [
+                    pool.submit(client.get_scheduling_decision, make_pod(i), nodes)
+                    for i in range(16)
+                ]
+                decisions = [f.result(timeout=30) for f in futs]
+            assert len(decisions) == 16
+            assert server.served == 16
+        finally:
+            client.close()
+
+    def test_infeasible_propagates_as_infeasible(self, server):
+        client = ReplicaClient("127.0.0.1", server.port)
+        try:
+            pod = PodSpec(
+                name="huge", namespace="default", cpu_request=999.0,
+                memory_request=999.0,
+            )
+            with pytest.raises(NoFeasibleNodeError):
+                client.get_scheduling_decision(pod, make_nodes())
+        finally:
+            client.close()
+
+    def test_backend_error_propagates(self):
+        stub = StubBackend()
+        stub.fail_next = 1
+        srv = ReplicaServer(stub, host="127.0.0.1", port=0)
+        client = ReplicaClient("127.0.0.1", srv.port)
+        try:
+            with pytest.raises(BackendError):
+                client.get_scheduling_decision(make_pod(), make_nodes())
+            # next call succeeds — the connection survives a backend error
+            d = client.get_scheduling_decision(make_pod(), make_nodes())
+            assert d.selected_node.startswith("node-")
+        finally:
+            client.close()
+            srv.close()
+
+    def test_link_drop_fails_inflight_requests(self):
+        import socket as socket_mod
+
+        stub = StubBackend(latency_s=0.5)
+        srv = ReplicaServer(stub, host="127.0.0.1", port=0)
+        client = ReplicaClient("127.0.0.1", srv.port)
+        try:
+            with ThreadPoolExecutor(2) as pool:
+                fut = pool.submit(
+                    client.get_scheduling_decision, make_pod(), make_nodes()
+                )
+                time.sleep(0.1)
+                # simulate the link dropping mid-request (shutdown, not
+                # close: close from another thread does not interrupt a
+                # blocked recv)
+                client._sock.shutdown(socket_mod.SHUT_RDWR)
+                with pytest.raises(BackendError):
+                    fut.result(timeout=10)
+        finally:
+            client.close()
+            srv.close()
+
+
+class TestAsyncPath:
+    async def test_async_decision_and_fanout(self, server):
+        """The natively-async client path resolves without a worker
+        thread, and FanoutBackend exposes it (hiding it would throttle
+        leaders through the to_thread pool)."""
+        client = ReplicaClient("127.0.0.1", server.port)
+        local = StubBackend()
+        fan = FanoutBackend([local, client])
+        try:
+            import asyncio
+
+            nodes = make_nodes()
+            decisions = await asyncio.gather(*[
+                fan.get_scheduling_decision_async(make_pod(i), nodes)
+                for i in range(8)
+            ])
+            assert len(decisions) == 8
+            assert fan.routed == [4, 4]
+            assert server.served == 4
+        finally:
+            client.close()
+
+    def test_timeout_raises_backend_error_and_drops_pending(self):
+        stub = StubBackend(latency_s=1.0)
+        srv = ReplicaServer(stub, host="127.0.0.1", port=0)
+        client = ReplicaClient(
+            "127.0.0.1", srv.port, request_timeout_s=0.15
+        )
+        try:
+            with pytest.raises(BackendError, match="timed out"):
+                client.get_scheduling_decision(make_pod(), make_nodes())
+            # the pending-table entry must not leak for the connection's
+            # lifetime
+            assert client._pending == {}
+        finally:
+            client.close()
+            srv.close()
+
+
+class TestFanout:
+    def test_round_robin_over_local_and_remote(self, server):
+        client = ReplicaClient("127.0.0.1", server.port)
+        local = StubBackend()
+        fan = FanoutBackend([local, client])
+        try:
+            nodes = make_nodes()
+            for i in range(6):
+                d = fan.get_scheduling_decision(make_pod(i), nodes)
+                assert d.selected_node.startswith("node-")
+            assert fan.routed == [3, 3]
+            assert local.calls == 3
+            assert server.served == 3
+            assert fan.get_stats()["fanout_routed"] == [3, 3]
+        finally:
+            client.close()
+
+    def test_empty_replicas_rejected(self):
+        with pytest.raises(ValueError):
+            FanoutBackend([])

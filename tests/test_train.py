@@ -44,6 +44,20 @@ class TestLoss:
         np.testing.assert_allclose(float(short1), float(short2), rtol=1e-6)
         assert abs(float(full) - float(short1)) > 1e-6
 
+    def test_loss_start_masks_prompt_span(self):
+        """With loss_start, corrupting logits BEFORE the answer span must
+        not change the loss (the prompt no longer contributes gradient)."""
+        logits = jax.random.normal(jax.random.PRNGKey(2), (1, 16, CFG.vocab_size))
+        tokens, _ = batch(1, 16)
+        lens = jnp.array([16])
+        start = jnp.array([10])
+        masked = causal_lm_loss(logits, tokens, lens, start)
+        corrupted = logits.at[:, :8].set(999.0)  # prompt-only corruption
+        masked2 = causal_lm_loss(corrupted, tokens, lens, start)
+        np.testing.assert_allclose(float(masked), float(masked2), rtol=1e-6)
+        # and it differs from the unmasked loss
+        assert abs(float(masked) - float(causal_lm_loss(logits, tokens, lens))) > 1e-6
+
 
 class TestTrainStep:
     def test_loss_decreases_single_device(self):
@@ -192,13 +206,17 @@ class TestDistill:
         tok = ByteTokenizer()
         it = teacher_pairs(tok, n_nodes=3, seed=0)
         for _ in range(3):
-            ids = next(it)
+            ids, ans_start = next(it)
             assert ids[-1] == tok.eos_id
+            assert 0 < ans_start < len(ids)
             text = tok.decode(ids)
-            # the decision JSON tail must parse and name a real node
+            # the decision JSON tail must parse and name a real node —
+            # and the answer span must be exactly the JSON + EOS
             tail = text[text.rindex("{"):]
             obj = _json.loads(tail)
             assert obj["selected_node"].startswith("node-")
+            answer = tok.decode(ids[ans_start:-1])
+            assert _json.loads(answer)["selected_node"] == obj["selected_node"]
 
     def test_train_and_save_then_serve(self, tmp_path):
         from k8s_llm_scheduler_tpu.engine.local import build_local_backend

@@ -32,6 +32,9 @@ REPO = Path(__file__).resolve().parent.parent
 
 
 def child(process_id: int, port: int) -> None:
+    import logging
+
+    logging.basicConfig(level=logging.INFO)
     import jax
 
     jax.config.update("jax_platforms", "cpu")
@@ -138,14 +141,79 @@ def child(process_id: int, port: int) -> None:
             )
         else:
             print(f"worker {process_id}: replica decision computed, no bind")
+
+        # ---- 4. CROSS-HOST decision serving (sched/replica.py) ---------
+        # The worker serves its replica over the decision-RPC transport;
+        # the coordinator fans a burst of leaders out round-robin across
+        # [its own backend, the worker's] — decisions EXECUTE on both
+        # processes (the round-3 gap: workers had weights but no way to
+        # receive work).
+        import dataclasses as _dc
+
+        from jax.experimental import multihost_utils
+
+        from k8s_llm_scheduler_tpu.sched.replica import (
+            FanoutBackend,
+            ReplicaClient,
+            ReplicaServer,
+        )
+
+        # The worker binds an OS-assigned port and publishes it through a
+        # collective (a pre-agreed port races the Gloo/app ephemeral
+        # binds in this multi-process harness; on real pods the same
+        # allgather pattern removes any need for port coordination).
+        # NOTE: no collective may be OUTSTANDING while the worker serves —
+        # a pending barrier blocks the worker's device execution, so the
+        # remote decision can never run (measured as a deadlock ->
+        # coordinator timeout). The port allgather completes before
+        # serving starts; completion is signaled through the replica
+        # protocol itself (served-count poll), not a barrier.
+        import time as _time
+
+        server = None
+        if not is_coordinator():
+            server = ReplicaServer(backend, host="127.0.0.1", port=0)
+        ports = multihost_utils.process_allgather(
+            np.int32(server.port if server else 0)
+        )
+        if not is_coordinator():
+            deadline = _time.monotonic() + 300
+            while server.served < 2 and _time.monotonic() < deadline:
+                _time.sleep(0.05)
+            server.close()
+            assert server.served >= 2, f"worker served {server.served}"
+            print(
+                f"dryrun OK (cross-host serving): worker {process_id} "
+                f"served {server.served} decisions via replica RPC"
+            )
+        else:
+            client = ReplicaClient("127.0.0.1", int(ports[1]))
+            fan = FanoutBackend([backend, client])
+            try:
+                for i in range(4):
+                    pod_i = _dc.replace(pod, name=f"mh-pod-{i}",
+                                        cpu_request=0.1 + 0.01 * i)
+                    d = fan.get_scheduling_decision(pod_i, nodes)
+                    assert d.selected_node in {n.name for n in nodes}
+                assert fan.routed == [2, 2], fan.routed
+                print(
+                    "dryrun OK (cross-host serving): coordinator fanned "
+                    f"4 decisions {fan.routed} over [local, worker]"
+                )
+            finally:
+                client.close()
     finally:
         backend.close()
 
 
-def _attempt() -> tuple[int, list[str]]:
+def _free_port() -> int:
     with socket.socket() as s:
         s.bind(("localhost", 0))
-        port = s.getsockname()[1]
+        return s.getsockname()[1]
+
+
+def _attempt() -> tuple[int, list[str]]:
+    port = _free_port()
     env = dict(
         os.environ,
         JAX_PLATFORMS="cpu",
@@ -177,6 +245,9 @@ def parent() -> int:
     if rc == 0:
         assert "multihost train" in outs[0] and "coordinator-only bind" in outs[0]
         assert "no bind" in outs[1]
+        # cross-host serving: decisions executed on BOTH processes
+        assert "coordinator fanned 4 decisions [2, 2]" in outs[0], outs[0][-500:]
+        assert "served 2 decisions via replica RPC" in outs[1], outs[1][-500:]
         print("dryrun_multihost: ALL OK")
     return rc
 
