@@ -203,6 +203,13 @@ class ReplicaServer:
     def close(self) -> None:
         self._stop.set()
         try:
+            # shutdown BEFORE close: close alone does not wake a thread
+            # blocked in accept(), so the join below would eat its full
+            # timeout (measured 5s per server teardown)
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass  # not connected / already closed — fine
+        try:
             self._sock.close()
         except OSError:
             pass
@@ -339,6 +346,12 @@ class ReplicaClient:
     def close(self) -> None:
         with self._pending_lock:
             self._closed = True
+        try:
+            # shutdown wakes the reader blocked in recv (close alone does
+            # not — it parked the join below for its full timeout)
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
         try:
             self._sock.close()
         except OSError:
