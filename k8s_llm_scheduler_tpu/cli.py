@@ -246,11 +246,24 @@ def _maybe_fanout(backend, cfg: Config):
     from k8s_llm_scheduler_tpu.sched.replica import FanoutBackend, ReplicaClient
 
     replicas = [backend]
+    default_port = int(cfg.get("distributed.replica_port"))
     for addr in addrs:
-        host, _, port = str(addr).rpartition(":")
+        text = str(addr)
+        host, sep, port_s = text.rpartition(":")
+        if sep:
+            try:
+                port = int(port_s)
+            except ValueError:
+                raise ValueError(
+                    f"distributed.replica_addrs entry {text!r}: port "
+                    f"{port_s!r} is not an integer (expected 'host:port' "
+                    f"or bare 'host')"
+                ) from None
+        else:
+            host, port = text, default_port  # bare host: default port
         replicas.append(
             ReplicaClient(
-                host or "localhost", int(port),
+                host or "localhost", port,
                 request_timeout_s=float(cfg.get("llm.timeout")),
             )
         )
@@ -464,8 +477,11 @@ def cmd_complete(args: argparse.Namespace, cfg: Config) -> int:
     buckets = tuple(cfg.get("llm.prefill_buckets"))
     # Long prompts: everything but a tail rides the chunked dense-prefix
     # path; the tail (and the decode budget) is what the page table must
-    # hold per sequence.
-    tail = min(len(ids), max(1, buckets[0]))
+    # hold per sequence. Split at the LARGEST bucket — only prompts beyond
+    # it need the long-context machinery; everything shorter is one
+    # ordinary bucketed suffix prefill (splitting at the smallest bucket
+    # forced set_prefix's chunked path on nearly every completion).
+    tail = min(len(ids), max(1, buckets[-1]))
     pages_needed = -(-(tail + args.max_new_tokens + 1) // page_size) + 1
     overrides = dict(
         model=args.model or cfg.get("llm.model", "tiny"),

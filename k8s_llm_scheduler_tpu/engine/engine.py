@@ -456,6 +456,10 @@ class WaveHandle:
     # True when this wave's geometry compiled at dispatch: its wall time is
     # jit + execution, and service-time estimators must skip it.
     cold_compile: bool = False
+    # Compiled-variant identity (engine._wave_key) — service-time
+    # estimators key on it so a 50ms half-R decision wave and a 2s
+    # full-R longctx wave don't share one estimate.
+    geo_key: tuple | None = None
 
     def is_ready(self) -> bool:
         """True once the device result landed (harvest won't block)."""
@@ -1112,7 +1116,10 @@ class InferenceEngine:
         straggler forms a half-R ragged tail — that variant must not
         compile mid-burst."""
         out = []
-        for bucket, max_new in self._wave_shapes_seen:
+        # list(): submit_wave (engine-owner thread) mutates the set while
+        # bench/monitors poll the backlog from other threads — iterating
+        # the live set would intermittently raise RuntimeError
+        for bucket, max_new in list(self._wave_shapes_seen):
             for n_prompts in (1, self.max_slots):
                 R, n_iters, F = self._wave_geometry(n_prompts, max_new)
                 key = self._wave_key(R, bucket, n_iters, F, max_new)
@@ -1215,7 +1222,6 @@ class InferenceEngine:
         self._wave_shapes_seen.add((bucket, max_new_tokens))
         geo_key = self._wave_key(R, bucket, n_iters, F, max_new_tokens)
         cold_compile = geo_key not in self._wave_compiled
-        self._wave_compiled.add(geo_key)
         pad = self.tokenizer.pad_id
         tokens = np.full((R, bucket), pad, dtype=np.int32)
         suffix_lens = np.zeros(R, dtype=np.int32)
@@ -1238,6 +1244,11 @@ class InferenceEngine:
             sub, jnp.float32(self.temperature),
             n_iters, F, max_new_tokens, self._constrained,
         )
+        # Recorded only AFTER a successful dispatch: a failed first
+        # dispatch must leave the geometry cold (or the retry's compile
+        # would be mislabeled warm and poison the service-time EMA, and
+        # the prewarm path would skip a geometry that never compiled).
+        self._wave_compiled.add(geo_key)
         # Start the D2H transfer right behind the program so harvest finds
         # the results already on host (a blocking device_get is its own
         # round trip on a tunneled backend).
@@ -1259,6 +1270,7 @@ class InferenceEngine:
             max_new_tokens=max_new_tokens,
             req_ids=req_ids,
             cold_compile=cold_compile,
+            geo_key=geo_key,
         )
 
     def harvest_wave(self, handle: WaveHandle) -> list[Finished]:

@@ -140,8 +140,13 @@ class LocalLLMBackend:
         # 886ms vs true completion 469ms with 3 waves in flight), so
         # trusting it defers every leader by the full pipeline depth. A
         # blocking harvest returns at true completion; the EMA tells us
-        # when polling stops being useful.
-        self._wave_ema_s = 0.5
+        # when polling stops being useful. Keyed PER GEOMETRY
+        # (WaveHandle.geo_key): a 50ms half-R decision wave and a 2s
+        # full-R longctx wave alternating in one workload must not share
+        # an estimate — the fast-down update would chronically
+        # under-deadline the long one and serialize its pipeline.
+        self._wave_ema: dict[tuple | None, float] = {}
+        self._wave_ema_default = 0.5
         self._last_harvest_t = 0.0
         self._worker = threading.Thread(
             target=self._run_worker, daemon=True, name="llm-engine"
@@ -478,9 +483,10 @@ class LocalLLMBackend:
             # (its submit, or the previous harvest) — anchoring to submit
             # alone would pre-expire the deadline for every wave behind
             # the first and degenerate the pipeline to serial harvests.
+            geo = getattr(handle, "geo_key", None)
+            ema = self._wave_ema.get(geo, self._wave_ema_default)
             deadline = (
-                max(handle.submitted_at, self._last_harvest_t)
-                + 0.5 * self._wave_ema_s
+                max(handle.submitted_at, self._last_harvest_t) + 0.5 * ema
             )
             while (
                 not handle.is_ready()
@@ -524,12 +530,12 @@ class LocalLLMBackend:
                 service = max(now - max(handle.submitted_at, self._last_harvest_t), 0.02)
                 self._last_harvest_t = now
                 if not getattr(handle, "cold_compile", False):
-                    if service < self._wave_ema_s:
-                        self._wave_ema_s = 0.5 * self._wave_ema_s + 0.5 * service
+                    ema = self._wave_ema.get(geo, self._wave_ema_default)
+                    if service < ema:
+                        ema = 0.5 * ema + 0.5 * service
                     else:
-                        self._wave_ema_s = 0.9 * self._wave_ema_s + 0.1 * min(
-                            service, 4.0 * self._wave_ema_s
-                        )
+                        ema = 0.9 * ema + 0.1 * min(service, 4.0 * ema)
+                    self._wave_ema[geo] = ema
                 for fin, item in zip(fins, items):
                     item.resolve(fin.text)
         return pending

@@ -176,7 +176,10 @@ class ReplicaServer:
                     target=self._serve_one, args=(conn, send_lock, req),
                     daemon=True,
                 ).start()
-        except (OSError, ValueError, json.JSONDecodeError) as exc:
+        except Exception as exc:
+            # broad on purpose: _recv_frame's frame-size guard raises
+            # BackendError, and ANY reader failure must take the logged
+            # drop path, not kill the thread via excepthook
             logger.warning("replica connection %s dropped: %s", addr, exc)
         finally:
             conn.close()
@@ -225,33 +228,74 @@ class ReplicaClient:
     and a reader thread resolves the per-id futures. A dead connection
     fails all in-flight requests with BackendError (the DecisionClient
     stack above retries / falls back / trips the breaker exactly as it
-    would for a local backend fault)."""
+    would for a local backend fault).
+
+    Connection lifecycle: LAZY and SELF-HEALING. The first submit dials;
+    a dead/never-up replica surfaces as a fast BackendError per decision
+    (absorbed by retry/fallback upstream — the coordinator must not crash
+    because a worker is still loading weights), and every later submit
+    re-dials, so a restarted worker heals without restarting the
+    coordinator."""
 
     def __init__(self, host: str, port: int, connect_timeout_s: float = 10.0,
                  request_timeout_s: float = 60.0) -> None:
         self.addr = f"{host}:{port}"
+        self._host, self._port = host, port
+        self.connect_timeout_s = connect_timeout_s
         self.request_timeout_s = request_timeout_s
-        self._sock = socket.create_connection((host, port), connect_timeout_s)
-        # create_connection leaves its timeout ON THE SOCKET: the reader
-        # would then die on any response slower than connect_timeout_s
-        # (e.g. a first decision paying a jit compile). Per-request
-        # deadlines are enforced at fut.result(request_timeout_s); the
-        # socket itself blocks indefinitely.
-        self._sock.settimeout(None)
+        self._sock: socket.socket | None = None
+        self._reader: threading.Thread | None = None
+        self._conn_lock = threading.Lock()
         self._send_lock = threading.Lock()
         self._pending: dict[int, Future] = {}
         self._pending_lock = threading.Lock()
         self._ids = itertools.count()
         self._closed = False
-        self._reader = threading.Thread(
-            target=self._read_loop, daemon=True, name=f"replica-client-{port}"
-        )
-        self._reader.start()
 
-    def _read_loop(self) -> None:
+    def _ensure_connected(self) -> socket.socket:
+        """Dial (or re-dial) the replica. Serialized so concurrent submits
+        after a drop produce one reconnect, not a stampede."""
+        with self._conn_lock:
+            if self._closed:
+                raise BackendError(f"replica {self.addr} client closed")
+            if self._sock is not None and (
+                self._reader is not None and self._reader.is_alive()
+            ):
+                return self._sock
+            # previous socket (if any) is dead: drop it and re-dial
+            if self._sock is not None:
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+                self._sock = None
+            try:
+                sock = socket.create_connection(
+                    (self._host, self._port), self.connect_timeout_s
+                )
+            except OSError as exc:
+                raise BackendError(
+                    f"replica {self.addr} unreachable: {exc}"
+                ) from exc
+            # create_connection leaves its timeout ON THE SOCKET: the
+            # reader would then die on any response slower than
+            # connect_timeout_s (e.g. a first decision paying a jit
+            # compile). Per-request deadlines are enforced at
+            # fut.result(request_timeout_s); the socket itself blocks
+            # indefinitely.
+            sock.settimeout(None)
+            self._sock = sock
+            self._reader = threading.Thread(
+                target=self._read_loop, args=(sock,), daemon=True,
+                name=f"replica-client-{self._port}",
+            )
+            self._reader.start()
+            return sock
+
+    def _read_loop(self, sock: socket.socket) -> None:
         try:
             while True:
-                resp = _recv_frame(self._sock)
+                resp = _recv_frame(sock)
                 if resp is None:
                     break
                 with self._pending_lock:
@@ -265,7 +309,8 @@ class ReplicaClient:
             # their full request timeout with no error ever surfaced.
             if not self._closed:
                 logger.warning("replica client %s reader died: %r", self.addr, exc)
-        # connection is gone: fail everything in flight
+        # connection is gone: fail everything in flight (the next submit
+        # re-dials via _ensure_connected)
         with self._pending_lock:
             pending, self._pending = self._pending, {}
         for fut in pending.values():
@@ -275,6 +320,7 @@ class ReplicaClient:
                 )
 
     def _submit(self, pod: PodSpec, nodes: Sequence[NodeMetrics]) -> tuple[int, Future]:
+        sock = self._ensure_connected()
         rid = next(self._ids)
         fut: Future = Future()
         with self._pending_lock:
@@ -283,7 +329,7 @@ class ReplicaClient:
             self._pending[rid] = fut
         try:
             with self._send_lock:
-                _send_frame(self._sock, {
+                _send_frame(sock, {
                     "id": rid,
                     "pod": pod_to_wire(pod),
                     "nodes": [node_to_wire(n) for n in nodes],
@@ -346,17 +392,22 @@ class ReplicaClient:
     def close(self) -> None:
         with self._pending_lock:
             self._closed = True
-        try:
-            # shutdown wakes the reader blocked in recv (close alone does
-            # not — it parked the join below for its full timeout)
-            self._sock.shutdown(socket.SHUT_RDWR)
-        except OSError:
-            pass
-        try:
-            self._sock.close()
-        except OSError:
-            pass
-        self._reader.join(timeout=5)
+        with self._conn_lock:
+            sock, reader = self._sock, self._reader
+            self._sock = None
+        if sock is not None:
+            try:
+                # shutdown wakes the reader blocked in recv (close alone
+                # does not — it parked the join below for its full timeout)
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                sock.close()
+            except OSError:
+                pass
+        if reader is not None:
+            reader.join(timeout=5)
 
 
 # ------------------------------------------------------------------ fan-out

@@ -143,6 +143,67 @@ class TestClientServer:
             srv.close()
 
 
+class TestConnectionLifecycle:
+    def test_unreachable_replica_fails_fast_then_heals(self):
+        """Constructing a client to a not-yet-up worker must not raise
+        (the coordinator starts before workers finish loading weights);
+        decisions fail fast as BackendError until the worker appears,
+        then succeed without any reconnect ceremony."""
+        import socket as socket_mod
+
+        with socket_mod.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        client = ReplicaClient("127.0.0.1", port, connect_timeout_s=0.5)
+        try:
+            with pytest.raises(BackendError, match="unreachable"):
+                client.get_scheduling_decision(make_pod(), make_nodes())
+            srv = ReplicaServer(StubBackend(), host="127.0.0.1", port=port)
+            try:
+                d = client.get_scheduling_decision(make_pod(), make_nodes())
+                assert d.selected_node.startswith("node-")
+            finally:
+                srv.close()
+        finally:
+            client.close()
+
+    def test_reconnects_after_worker_restart(self):
+        """A worker restart must not permanently disable its replica slot:
+        the in-flight request fails, and later submits re-dial the fresh
+        server."""
+        srv1 = ReplicaServer(StubBackend(), host="127.0.0.1", port=0)
+        port = srv1.port
+        client = ReplicaClient("127.0.0.1", port)
+        try:
+            assert client.get_scheduling_decision(
+                make_pod(), make_nodes()
+            ).selected_node.startswith("node-")
+            srv1.close()  # worker dies
+            time.sleep(0.1)
+            # restart on the same port
+            srv2 = ReplicaServer(StubBackend(), host="127.0.0.1", port=port)
+            try:
+                deadline = time.monotonic() + 10
+                last = None
+                while time.monotonic() < deadline:
+                    try:
+                        d = client.get_scheduling_decision(
+                            make_pod(), make_nodes()
+                        )
+                        break
+                    except BackendError as exc:
+                        last = exc
+                        time.sleep(0.05)
+                else:
+                    pytest.fail(f"never healed: {last}")
+                assert d.selected_node.startswith("node-")
+                assert srv2.served >= 1
+            finally:
+                srv2.close()
+        finally:
+            client.close()
+
+
 class TestAsyncPath:
     async def test_async_decision_and_fanout(self, server):
         """The natively-async client path resolves without a worker
@@ -272,6 +333,32 @@ class TestFanoutSchedulerE2E:
         local = StubBackend()
         fan = FanoutBackend([local, client])
         cluster = synthetic_cluster(4)
+        # Witness that the failure path executed: count every BackendError
+        # the remote replica surfaces. (The reconnect-capable client can
+        # fully recover within the retry budget, leaving no trace in the
+        # aggregate client stats — failed_requests counts only
+        # retry-EXHAUSTED calls.)
+        remote_errors: list[BackendError] = []
+        orig_async = client.get_scheduling_decision_async
+
+        async def counting_async(pod, nodes):
+            try:
+                return await orig_async(pod, nodes)
+            except BackendError as exc:
+                remote_errors.append(exc)
+                raise
+
+        client.get_scheduling_decision_async = counting_async
+        orig_sync = client.get_scheduling_decision
+
+        def counting_sync(pod, nodes):
+            try:
+                return orig_sync(pod, nodes)
+            except BackendError as exc:
+                remote_errors.append(exc)
+                raise
+
+        client.get_scheduling_decision = counting_sync
         try:
             killed_with_inflight = asyncio.Event()
 
@@ -291,7 +378,8 @@ class TestFanoutSchedulerE2E:
             assert killed_with_inflight.is_set()
             # EVERY pod got placed: the in-flight remote leaders surfaced
             # as BackendError and the retry (other replica via
-            # round-robin) or fallback stack absorbed them
+            # round-robin, or the reconnected remote) or fallback stack
+            # absorbed them
             assert stats["total_scheduled"] == 24
             assert (
                 stats["llm_decisions"]
@@ -299,13 +387,8 @@ class TestFanoutSchedulerE2E:
                 + stats["fallback_decisions"]
                 == 24
             )
-            # the failure path genuinely ran: the client recorded failed
-            # backend attempts and/or fallbacks beyond the happy path
-            c = stats["client"]
-            assert (
-                c.get("failed_requests", 0) > 0
-                or stats["fallback_decisions"] > 0
-            ), c
+            # the failure path genuinely ran
+            assert remote_errors, "kill produced no BackendError"
         finally:
             cluster.close()
             client.close()
