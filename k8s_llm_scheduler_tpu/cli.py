@@ -249,6 +249,17 @@ def _maybe_fanout(backend, cfg: Config):
     default_port = int(cfg.get("distributed.replica_port"))
     for addr in addrs:
         text = str(addr)
+        if text.isdigit():
+            # bare port (pre-round-4 configs used '9901' for
+            # localhost:9901 — keep that meaning rather than dialing a
+            # hostname made of digits)
+            replicas.append(
+                ReplicaClient(
+                    "localhost", int(text),
+                    request_timeout_s=float(cfg.get("llm.timeout")),
+                )
+            )
+            continue
         host, sep, port_s = text.rpartition(":")
         if sep:
             try:

@@ -148,6 +148,11 @@ class ReplicaServer:
         self._stop = threading.Event()
         self.served = 0
         self._served_lock = threading.Lock()
+        # live per-connection sockets: close() must shut these down too —
+        # closing only the listener left connection threads serving
+        # requests after "shutdown" (a stopped worker kept answering)
+        self._conns: set[socket.socket] = set()
+        self._conns_lock = threading.Lock()
         self._accept_thread = threading.Thread(
             target=self._accept_loop, daemon=True, name="replica-accept"
         )
@@ -167,6 +172,11 @@ class ReplicaServer:
 
     def _serve_conn(self, conn: socket.socket, addr) -> None:
         send_lock = threading.Lock()
+        with self._conns_lock:
+            if self._stop.is_set():
+                conn.close()
+                return
+            self._conns.add(conn)
         try:
             while not self._stop.is_set():
                 req = _recv_frame(conn)
@@ -180,8 +190,11 @@ class ReplicaServer:
             # broad on purpose: _recv_frame's frame-size guard raises
             # BackendError, and ANY reader failure must take the logged
             # drop path, not kill the thread via excepthook
-            logger.warning("replica connection %s dropped: %s", addr, exc)
+            if not self._stop.is_set():
+                logger.warning("replica connection %s dropped: %s", addr, exc)
         finally:
+            with self._conns_lock:
+                self._conns.discard(conn)
             conn.close()
 
     def _serve_one(self, conn, send_lock, req: dict) -> None:
@@ -216,6 +229,16 @@ class ReplicaServer:
             self._sock.close()
         except OSError:
             pass
+        # kill live connections too: a closed server must stop SERVING,
+        # not just stop accepting (their blocked recvs need the shutdown
+        # wake-up just like the listener's accept)
+        with self._conns_lock:
+            conns = list(self._conns)
+        for conn in conns:
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
         self._accept_thread.join(timeout=5)
 
 
@@ -252,7 +275,7 @@ class ReplicaClient:
         self._ids = itertools.count()
         self._closed = False
 
-    def _ensure_connected(self) -> socket.socket:
+    def _ensure_connected(self) -> tuple[socket.socket, threading.Thread]:
         """Dial (or re-dial) the replica. Serialized so concurrent submits
         after a drop produce one reconnect, not a stampede."""
         with self._conn_lock:
@@ -261,7 +284,7 @@ class ReplicaClient:
             if self._sock is not None and (
                 self._reader is not None and self._reader.is_alive()
             ):
-                return self._sock
+                return self._sock, self._reader
             # previous socket (if any) is dead: drop it and re-dial
             if self._sock is not None:
                 try:
@@ -282,15 +305,40 @@ class ReplicaClient:
             # connect_timeout_s (e.g. a first decision paying a jit
             # compile). Per-request deadlines are enforced at
             # fut.result(request_timeout_s); the socket itself blocks
-            # indefinitely.
+            # indefinitely — with TCP KEEPALIVE on, so a HALF-OPEN peer
+            # (host preempted without FIN/RST) eventually kills the
+            # reader and the next submit re-dials instead of the reader
+            # blocking in recv forever.
             sock.settimeout(None)
+            try:
+                sock.setsockopt(socket.SOL_SOCKET, socket.SO_KEEPALIVE, 1)
+                if hasattr(socket, "TCP_KEEPIDLE"):
+                    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_KEEPIDLE, 30)
+                    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_KEEPINTVL, 10)
+                    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_KEEPCNT, 3)
+            except OSError:
+                pass  # keepalive is best-effort hardening
             self._sock = sock
-            self._reader = threading.Thread(
+            reader = threading.Thread(
                 target=self._read_loop, args=(sock,), daemon=True,
                 name=f"replica-client-{self._port}",
             )
-            self._reader.start()
-            return sock
+            self._reader = reader
+            reader.start()
+            return sock, reader
+
+    def _mark_suspect(self) -> None:
+        """A request timed out: the connection may be half-open (peer gone
+        without FIN/RST — keepalive takes ~minutes). Shut the socket so the
+        reader dies, in-flight futures fail fast, and the next submit
+        re-dials; if the replica was merely slow, the re-dial is cheap."""
+        with self._conn_lock:
+            sock = self._sock
+        if sock is not None:
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
 
     def _read_loop(self, sock: socket.socket) -> None:
         try:
@@ -320,7 +368,7 @@ class ReplicaClient:
                 )
 
     def _submit(self, pod: PodSpec, nodes: Sequence[NodeMetrics]) -> tuple[int, Future]:
-        sock = self._ensure_connected()
+        sock, reader = self._ensure_connected()
         rid = next(self._ids)
         fut: Future = Future()
         with self._pending_lock:
@@ -338,6 +386,18 @@ class ReplicaClient:
             with self._pending_lock:
                 self._pending.pop(rid, None)
             raise BackendError(f"replica {self.addr} send failed: {exc}") from exc
+        if not reader.is_alive():
+            # TOCTOU guard: the reader may have died (and run its
+            # fail-everything sweep) BETWEEN the liveness check and our
+            # future registration — a first write after FIN can land in
+            # the send buffer without EPIPE, leaving this future orphaned
+            # with nobody to resolve it. Fail it ourselves.
+            with self._pending_lock:
+                self._pending.pop(rid, None)
+            if not fut.done():
+                fut.set_exception(
+                    BackendError(f"replica {self.addr} connection lost")
+                )
         return rid, fut
 
     def _resolve(self, resp: dict) -> SchedulingDecision:
@@ -361,9 +421,11 @@ class ReplicaClient:
             resp = fut.result(timeout=self.request_timeout_s)
         except FuturesTimeout as exc:
             # drop the pending entry (it would otherwise leak for the
-            # connection's lifetime) and surface the module's documented
-            # failure type
+            # connection's lifetime), mark the connection suspect (a
+            # half-open peer would otherwise stall EVERY later request by
+            # the full timeout), and surface the documented failure type
             self._drop(rid)
+            self._mark_suspect()
             raise BackendError(
                 f"replica {self.addr} timed out after {self.request_timeout_s}s"
             ) from exc
@@ -384,6 +446,7 @@ class ReplicaClient:
             )
         except (TimeoutError, asyncio.TimeoutError) as exc:
             self._drop(rid)
+            self._mark_suspect()
             raise BackendError(
                 f"replica {self.addr} timed out after {self.request_timeout_s}s"
             ) from exc
