@@ -177,9 +177,13 @@ async def run_burst(
                     await asyncio.sleep(delay)
             enqueue_times[pod.name] = time.perf_counter()
             cluster.add_pod(pod)
-        async with asyncio.timeout(timeout_s):
+        async def _drain() -> None:
             while cluster.bind_count < len(pods):
                 await asyncio.sleep(0.005)
+
+        # wait_for, not asyncio.timeout: the latter is 3.11+ and the
+        # package floor is >=3.10
+        await asyncio.wait_for(_drain(), timeout=timeout_s)
         latencies = {
             name: (t - enqueue_times[name]) * 1000.0
             for name, t in bind_times.items()
@@ -298,9 +302,11 @@ async def bench_preset(args, backend=None) -> dict:
     # a straggler-timing ragged wave in a measured round must never pay a
     # cold jit (r03 longctx recorded a 5.1s mid-round stall from exactly
     # that). Engine-owner discipline: we only poll the read-only backlog.
-    async with asyncio.timeout(600):
+    async def _drain_prewarm() -> None:
         while backend.engine.wave_prewarm_backlog() > 0:
             await asyncio.sleep(0.05)
+
+    await asyncio.wait_for(_drain_prewarm(), timeout=600)
 
     profile_cm = None
     if getattr(args, "profile_dir", None):

@@ -53,6 +53,14 @@ def _backend_kwargs(cfg: Config, **overrides) -> dict:
         group_switch_after_s=float(cfg.get("llm.group_switch_after_s")),
         compile_cache_dir=cfg.get("llm.compile_cache_dir"),
     )
+    if cfg.get("distributed.enabled"):
+        # Multi-host: after jax.distributed.initialize, jax.devices() is
+        # GLOBAL — a per-host replica mesh built from it would shard params
+        # over non-addressable devices (and hang at startup). Each process'
+        # backend must span only the devices it owns.
+        import jax
+
+        kwargs["devices"] = jax.local_devices()
     kwargs.update(overrides)
     return kwargs
 
@@ -206,10 +214,18 @@ def cmd_run(args: argparse.Namespace, cfg: Config) -> int:
     return asyncio.run(_run_scheduler(cfg, cluster, demo_pods=False))
 
 
-def _run_worker_replica(cfg: Config) -> int:
+def _run_worker_replica(
+    cfg: Config, stop_event: Any | None = None, ready: Any | None = None
+) -> int:
     """Worker-process serving loop: build the local backend (weights for
-    THIS host's replica; tp within the host) and answer decision RPCs from
-    the coordinator until the process is terminated."""
+    THIS host's replica; tp within the host, over THIS process' local
+    devices — `_backend_kwargs` injects `devices=jax.local_devices()` when
+    distributed.enabled) and answer decision RPCs from the coordinator
+    until the process is terminated.
+
+    `stop_event`/`ready` exist for tests (tests/test_multihost.py drives
+    this exact path with a tp=2 mesh): production workers pass neither and
+    serve until killed."""
     import threading
 
     from k8s_llm_scheduler_tpu.sched.replica import ReplicaServer
@@ -225,10 +241,18 @@ def _run_worker_replica(cfg: Config) -> int:
 
         backend = build_local_backend(**_backend_kwargs(cfg))
     port = int(cfg.get("distributed.replica_port"))
-    server = ReplicaServer(backend, port=port)
+    server = ReplicaServer(
+        backend,
+        host=str(cfg.get("distributed.replica_bind_host")),
+        port=port,
+        max_inflight=int(cfg.get("distributed.replica_max_inflight")),
+    )
     print(f"replica worker serving decisions on :{server.port}", flush=True)
+    if ready is not None:
+        ready.port = server.port
+        ready.set()
     try:
-        threading.Event().wait()  # serve until terminated
+        (stop_event or threading.Event()).wait()  # serve until terminated
     except KeyboardInterrupt:
         pass
     finally:
@@ -260,18 +284,51 @@ def _maybe_fanout(backend, cfg: Config):
                 )
             )
             continue
-        host, sep, port_s = text.rpartition(":")
-        if sep:
-            try:
-                port = int(port_s)
-            except ValueError:
+        if text.startswith("["):
+            # bracketed IPv6: '[::1]:9901' or '[::1]' (default port)
+            bracket_end = text.find("]")
+            if bracket_end < 0:
                 raise ValueError(
-                    f"distributed.replica_addrs entry {text!r}: port "
-                    f"{port_s!r} is not an integer (expected 'host:port' "
-                    f"or bare 'host')"
-                ) from None
+                    f"distributed.replica_addrs entry {text!r}: unterminated "
+                    f"'[' (expected '[v6-addr]:port')"
+                )
+            host = text[1:bracket_end]
+            rest = text[bracket_end + 1 :]
+            if rest.startswith(":"):
+                try:
+                    port = int(rest[1:])
+                except ValueError:
+                    raise ValueError(
+                        f"distributed.replica_addrs entry {text!r}: port "
+                        f"{rest[1:]!r} is not an integer"
+                    ) from None
+            elif rest:
+                raise ValueError(
+                    f"distributed.replica_addrs entry {text!r}: trailing "
+                    f"{rest!r} after ']' (expected '[v6-addr]:port')"
+                )
+            else:
+                port = default_port
+        elif text.count(":") > 1:
+            # bare IPv6 literal: rpartition(':') would misparse '::1' as
+            # host ':' port 1 — demand brackets instead of guessing
+            raise ValueError(
+                f"distributed.replica_addrs entry {text!r} looks like a bare "
+                f"IPv6 literal; write it bracketed ('[{text}]:port')"
+            )
         else:
-            host, port = text, default_port  # bare host: default port
+            host, sep, port_s = text.rpartition(":")
+            if sep:
+                try:
+                    port = int(port_s)
+                except ValueError:
+                    raise ValueError(
+                        f"distributed.replica_addrs entry {text!r}: port "
+                        f"{port_s!r} is not an integer (expected 'host:port' "
+                        f"or bare 'host')"
+                    ) from None
+            else:
+                host, port = text, default_port  # bare host: default port
         replicas.append(
             ReplicaClient(
                 host or "localhost", port,

@@ -103,6 +103,13 @@ DEFAULTS: dict[str, Any] = {
         # per worker). Empty addrs = coordinator serves alone.
         "replica_port": 9901,
         "replica_addrs": [],
+        # Replica RPC is unauthenticated (trusted-network protocol):
+        # default bind is loopback; multi-host deployments set this to the
+        # worker's pod/host IP (or "0.0.0.0" on a trusted network).
+        "replica_bind_host": "localhost",
+        # Bound on concurrently-executing requests per worker (a remote
+        # peer must not be able to spawn unbounded threads).
+        "replica_max_inflight": 64,
     },
 }
 

@@ -133,16 +133,37 @@ def decision_from_wire(d: dict) -> SchedulingDecision:
 class ReplicaServer:
     """Serve a DecisionBackend over TCP on a worker host.
 
-    One accept thread; one reader thread per connection; one worker thread
-    per in-flight request (requests within a connection run CONCURRENTLY —
+    One accept thread; one reader thread per connection; requests within a
+    connection run CONCURRENTLY on a bounded executor (`max_inflight`) —
     the engine's wave batching depends on seeing the burst's leaders
     together, and the engine-owner thread in LocalLLMBackend already
-    serializes device access safely).
+    serializes device access safely, but an unbounded thread-per-request
+    design let any client spawn unbounded threads.
+
+    Trust model: the protocol is unauthenticated JSON-RPC that drives model
+    compute — it must only be reachable from the coordinator. The default
+    bind is loopback; multi-host deployments set
+    `distributed.replica_bind_host` to the worker's pod/host IP (or
+    explicitly to "0.0.0.0" on a trusted network).
     """
 
-    def __init__(self, backend: DecisionBackend, host: str = "0.0.0.0",
-                 port: int = 9901) -> None:
+    def __init__(self, backend: DecisionBackend, host: str = "localhost",
+                 port: int = 9901, max_inflight: int = 64,
+                 max_connections: int = 16) -> None:
+        from concurrent.futures import ThreadPoolExecutor
+
         self.backend = backend
+        self.max_inflight = max_inflight
+        self.max_connections = max_connections
+        self._pool = ThreadPoolExecutor(
+            max_workers=max_inflight, thread_name_prefix="replica-req"
+        )
+        # in-flight = queued + executing: the executor's own queue is
+        # unbounded, so admission is gated here — excess requests get an
+        # immediate "overloaded" error response instead of queueing
+        # unbounded memory
+        self._inflight = 0
+        self._inflight_lock = threading.Lock()
         self._sock = socket.create_server((host, port))
         self.port = self._sock.getsockname()[1]  # resolved (port=0 allowed)
         self._stop = threading.Event()
@@ -173,7 +194,10 @@ class ReplicaServer:
     def _serve_conn(self, conn: socket.socket, addr) -> None:
         send_lock = threading.Lock()
         with self._conns_lock:
-            if self._stop.is_set():
+            if self._stop.is_set() or len(self._conns) >= self.max_connections:
+                # connection cap: each live connection holds a reader
+                # thread; without this bound any reachable peer could
+                # spawn unbounded threads by dialing in a loop
                 conn.close()
                 return
             self._conns.add(conn)
@@ -182,10 +206,31 @@ class ReplicaServer:
                 req = _recv_frame(conn)
                 if req is None:
                     return
-                threading.Thread(
-                    target=self._serve_one, args=(conn, send_lock, req),
-                    daemon=True,
-                ).start()
+                with self._inflight_lock:
+                    admitted = self._inflight < self.max_inflight
+                    if admitted:
+                        self._inflight += 1
+                if not admitted:
+                    # fail fast instead of queueing unbounded: the
+                    # coordinator's retry/fallback stack absorbs this
+                    # exactly like any other backend error
+                    try:
+                        with send_lock:
+                            _send_frame(conn, {
+                                "id": req.get("id"),
+                                "error": f"replica overloaded "
+                                         f"(>{self.max_inflight} in flight)",
+                                "kind": "backend",
+                            })
+                    except OSError:
+                        return
+                    continue
+                try:
+                    self._pool.submit(self._serve_one, conn, send_lock, req)
+                except RuntimeError:
+                    with self._inflight_lock:
+                        self._inflight -= 1
+                    return  # pool shut down by close()
         except Exception as exc:
             # broad on purpose: _recv_frame's frame-size guard raises
             # BackendError, and ANY reader failure must take the logged
@@ -210,6 +255,9 @@ class ReplicaServer:
             resp = {"id": rid, "error": str(exc), "kind": "infeasible"}
         except Exception as exc:
             resp = {"id": rid, "error": str(exc), "kind": "backend"}
+        finally:
+            with self._inflight_lock:
+                self._inflight -= 1
         try:
             with send_lock:
                 _send_frame(conn, resp)
@@ -240,6 +288,7 @@ class ReplicaServer:
             except OSError:
                 pass
         self._accept_thread.join(timeout=5)
+        self._pool.shutdown(wait=False, cancel_futures=True)
 
 
 # ------------------------------------------------------------------- client
@@ -327,18 +376,23 @@ class ReplicaClient:
             reader.start()
             return sock, reader
 
-    def _mark_suspect(self) -> None:
+    def _mark_suspect(self, sock: socket.socket) -> None:
         """A request timed out: the connection may be half-open (peer gone
         without FIN/RST — keepalive takes ~minutes). Shut the socket so the
         reader dies, in-flight futures fail fast, and the next submit
-        re-dials; if the replica was merely slow, the re-dial is cheap."""
+        re-dials; if the replica was merely slow, the re-dial is cheap.
+
+        `sock` is the connection the timed-out request was SUBMITTED on:
+        if another thread already re-dialed (self._sock replaced), shutting
+        down the current socket would spuriously kill a healthy connection
+        and every request in flight on it."""
         with self._conn_lock:
-            sock = self._sock
-        if sock is not None:
-            try:
-                sock.shutdown(socket.SHUT_RDWR)
-            except OSError:
-                pass
+            if sock is not self._sock:
+                return  # stale connection already replaced; nothing to kill
+        try:
+            sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
 
     def _read_loop(self, sock: socket.socket) -> None:
         try:
@@ -367,7 +421,9 @@ class ReplicaClient:
                     BackendError(f"replica {self.addr} connection lost")
                 )
 
-    def _submit(self, pod: PodSpec, nodes: Sequence[NodeMetrics]) -> tuple[int, Future]:
+    def _submit(
+        self, pod: PodSpec, nodes: Sequence[NodeMetrics]
+    ) -> tuple[int, Future, socket.socket]:
         sock, reader = self._ensure_connected()
         rid = next(self._ids)
         fut: Future = Future()
@@ -398,7 +454,7 @@ class ReplicaClient:
                 fut.set_exception(
                     BackendError(f"replica {self.addr} connection lost")
                 )
-        return rid, fut
+        return rid, fut, sock
 
     def _resolve(self, resp: dict) -> SchedulingDecision:
         if "decision" in resp:
@@ -416,7 +472,7 @@ class ReplicaClient:
     def get_scheduling_decision(
         self, pod: PodSpec, nodes: Sequence[NodeMetrics]
     ) -> SchedulingDecision:
-        rid, fut = self._submit(pod, nodes)
+        rid, fut, sock = self._submit(pod, nodes)
         try:
             resp = fut.result(timeout=self.request_timeout_s)
         except FuturesTimeout as exc:
@@ -425,7 +481,7 @@ class ReplicaClient:
             # half-open peer would otherwise stall EVERY later request by
             # the full timeout), and surface the documented failure type
             self._drop(rid)
-            self._mark_suspect()
+            self._mark_suspect(sock)
             raise BackendError(
                 f"replica {self.addr} timed out after {self.request_timeout_s}s"
             ) from exc
@@ -439,14 +495,14 @@ class ReplicaClient:
         fan out to replicas without being capped by the to_thread pool."""
         import asyncio
 
-        rid, fut = self._submit(pod, nodes)
+        rid, fut, sock = self._submit(pod, nodes)
         try:
             resp = await asyncio.wait_for(
                 asyncio.wrap_future(fut), timeout=self.request_timeout_s
             )
         except (TimeoutError, asyncio.TimeoutError) as exc:
             self._drop(rid)
-            self._mark_suspect()
+            self._mark_suspect(sock)
             raise BackendError(
                 f"replica {self.addr} timed out after {self.request_timeout_s}s"
             ) from exc

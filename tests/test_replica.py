@@ -120,6 +120,55 @@ class TestClientServer:
             client.close()
             srv.close()
 
+    def test_overload_fails_fast_not_queues(self):
+        """Requests beyond max_inflight get an immediate 'overloaded'
+        backend error instead of queueing unbounded (advisor r4: a peer
+        must not grow server memory/threads without bound)."""
+        stub = StubBackend(latency_s=0.4)
+        srv = ReplicaServer(stub, host="127.0.0.1", port=0, max_inflight=1)
+        client = ReplicaClient("127.0.0.1", srv.port)
+        try:
+            nodes = make_nodes()
+            with ThreadPoolExecutor(4) as pool:
+                futs = [
+                    pool.submit(client.get_scheduling_decision, make_pod(i), nodes)
+                    for i in range(4)
+                ]
+                results = []
+                for f in futs:
+                    try:
+                        results.append(("ok", f.result(timeout=30)))
+                    except BackendError as exc:
+                        results.append(("err", str(exc)))
+            oks = [r for r in results if r[0] == "ok"]
+            errs = [r for r in results if r[0] == "err"]
+            assert oks, results  # at least the admitted request completes
+            assert errs and all("overloaded" in e for _, e in errs), results
+        finally:
+            client.close()
+            srv.close()
+
+    def test_connection_cap_rejects_excess_dials(self):
+        """Beyond max_connections, new connections are closed at accept —
+        each live connection costs a reader thread, so the cap bounds what
+        a dial-in-a-loop peer can allocate."""
+        srv = ReplicaServer(StubBackend(), host="127.0.0.1", port=0,
+                            max_connections=1)
+        c1 = ReplicaClient("127.0.0.1", srv.port)
+        c2 = ReplicaClient("127.0.0.1", srv.port, request_timeout_s=2)
+        try:
+            d = c1.get_scheduling_decision(make_pod(), make_nodes())
+            assert d.selected_node.startswith("node-")
+            with pytest.raises(BackendError):
+                c2.get_scheduling_decision(make_pod(), make_nodes())
+            # first connection unaffected by the rejected dial
+            d = c1.get_scheduling_decision(make_pod(1), make_nodes())
+            assert d.selected_node.startswith("node-")
+        finally:
+            c1.close()
+            c2.close()
+            srv.close()
+
     def test_link_drop_fails_inflight_requests(self):
         import socket as socket_mod
 
