@@ -48,6 +48,8 @@ def _backend_kwargs(cfg: Config, **overrides) -> dict:
         constrained=cfg.get("llm.constrained_json"),
         checkpoint_path=cfg.get("llm.checkpoint_path"),
         tokenizer_path=cfg.get("llm.tokenizer_path"),
+        tokenizer_name=cfg.get("llm.tokenizer", "byte"),
+        decode_matmul=cfg.get("llm.decode_matmul", "dense"),
         quantize=cfg.get("llm.quantization"),
         request_timeout_s=float(cfg.get("llm.timeout")),
         group_switch_after_s=float(cfg.get("llm.group_switch_after_s")),
@@ -201,16 +203,19 @@ def cmd_run(args: argparse.Namespace, cfg: Config) -> int:
     else:
         from k8s_llm_scheduler_tpu.cluster.kube import KubeCluster
 
-        if not KubeCluster.available():
+        try:
+            cluster = KubeCluster(
+                watch_timeout_seconds=cfg.get("scheduler.watch_interval")
+            )
+        except Exception as exc:
+            # a driver is always importable (in-tree httpapi fallback);
+            # a missing/unreachable kubeconfig surfaces here
             print(
-                "kubernetes client not installed; use --fake-cluster for the "
-                "in-memory cluster",
+                f"cannot reach a Kubernetes cluster ({exc}); use "
+                f"--fake-cluster for the in-memory cluster",
                 file=sys.stderr,
             )
             return 2
-        cluster = KubeCluster(
-            watch_timeout_seconds=cfg.get("scheduler.watch_interval")
-        )
     return asyncio.run(_run_scheduler(cfg, cluster, demo_pods=False))
 
 
@@ -381,12 +386,24 @@ def cmd_verify(args: argparse.Namespace, cfg: Config) -> int:
         check("model forward (TINY)", engine_smoke)
 
     def kube_check():
+        import os
+
         from k8s_llm_scheduler_tpu.cluster.kube import KubeCluster
 
-        if not KubeCluster.available():
-            return "kubernetes client not installed (fake cluster available)"
+        configured = (
+            os.environ.get("KUBERNETES_SERVICE_HOST")
+            or os.environ.get("KUBECONFIG")
+            or os.path.exists(os.path.expanduser("~/.kube/config"))
+        )
+        if not configured:
+            # a driver is always importable (in-tree httpapi fallback);
+            # only call out to a cluster when one is actually configured
+            return (
+                f"no kubeconfig found (driver {KubeCluster.driver()}; "
+                f"fake cluster available)"
+            )
         nodes = KubeCluster().get_node_metrics()
-        return f"{len(nodes)} nodes visible"
+        return f"{len(nodes)} nodes visible ({KubeCluster.driver()} driver)"
 
     check("cluster access", kube_check)
 
@@ -446,6 +463,13 @@ def cmd_train(args: argparse.Namespace, cfg: Config) -> int:
         batch_size=args.batch_size,
         seq_len=args.seq_len,
         mesh_axes=cfg.get("llm.mesh"),
+        lr=args.lr,
+        tokenizer_name=cfg.get("llm.tokenizer", "byte"),
+        name_weight=args.name_weight,
+        probe_every=args.probe_every,
+        lr_schedule=args.lr_schedule,
+        easy_frac=args.easy_frac,
+        save_every=args.save_every,
     )
     print(f"final loss {loss:.4f}; checkpoint at {args.out}")
     if args.eval:
@@ -606,6 +630,28 @@ def main(argv: list[str] | None = None) -> int:
              "small configs; pass llm.model sizes deliberately)",
     )
 
+    p_train.add_argument("--lr", type=float, default=3e-4)
+    p_train.add_argument(
+        "--lr-schedule", default="constant", choices=("constant", "cosine"),
+    )
+    p_train.add_argument(
+        "--name-weight", type=float, default=8.0,
+        help="loss upweight on the selected_node value tokens (the one "
+             "decision-bearing span of the answer)",
+    )
+    p_train.add_argument(
+        "--probe-every", type=int, default=0,
+        help="log greedy held-out teacher agreement every N steps (0=off)",
+    )
+    p_train.add_argument(
+        "--save-every", type=int, default=0,
+        help="snapshot the checkpoint every N steps (0=only at the end)",
+    )
+    p_train.add_argument(
+        "--easy-frac", type=float, default=0.0,
+        help="fraction of curriculum (wide-margin) cases mixed into the "
+             "teacher stream (train-only; eval never draws from it)",
+    )
     p_train.add_argument(
         "--eval", action="store_true",
         help="after training, report teacher agreement + placement quality "

@@ -57,10 +57,33 @@ try:  # pragma: no cover - exercised only with a real cluster
     from kubernetes.client.rest import ApiException
 
     _KUBERNETES_AVAILABLE = True
-except ImportError:  # pragma: no cover
-    k8s_client = k8s_config = k8s_watch = None
-    ApiException = Exception
-    _KUBERNETES_AVAILABLE = False
+    _KUBERNETES_DRIVER = "official"
+except ImportError:
+    # In-tree stdlib REST transport (cluster/httpapi.py): same attribute
+    # surface, same wire paths — the scheduler runs against a real API
+    # server with zero external dependencies. Wire-level tested against
+    # cluster/wire_fake.py in tests/test_kube_wire.py.
+    from k8s_llm_scheduler_tpu.cluster import httpapi as _httpapi
+
+    class _HttpApiClientModule:
+        CoreV1Api = _httpapi.CoreV1Api
+        V1Binding = _httpapi.V1Binding
+        V1ObjectMeta = _httpapi.V1ObjectMeta
+        V1ObjectReference = _httpapi.V1ObjectReference
+
+    class _HttpApiConfigModule:
+        load_incluster_config = staticmethod(_httpapi.load_incluster_config)
+        load_kube_config = staticmethod(_httpapi.load_kube_config)
+
+    class _HttpApiWatchModule:
+        Watch = _httpapi.Watch
+
+    k8s_client = _HttpApiClientModule
+    k8s_config = _HttpApiConfigModule
+    k8s_watch = _HttpApiWatchModule
+    ApiException = _httpapi.ApiException
+    _KUBERNETES_AVAILABLE = True
+    _KUBERNETES_DRIVER = "httpapi"
 
 
 def _pod_to_raw(pod) -> RawPod:
@@ -145,10 +168,6 @@ class KubeCluster:
         informer: bool = True,
         relist_interval_s: float = 30.0,
     ) -> None:
-        if not _KUBERNETES_AVAILABLE:
-            raise RuntimeError(
-                "kubernetes package not installed; use cluster.fake.FakeCluster"
-            )
         try:
             k8s_config.load_incluster_config()
         except Exception:
@@ -186,7 +205,15 @@ class KubeCluster:
 
     @staticmethod
     def available() -> bool:
+        """Always True since the in-tree httpapi fallback (a driver is
+        always importable; reaching a cluster is decided at construction).
+        Kept for API stability; see driver() for which client is active."""
         return _KUBERNETES_AVAILABLE
+
+    @staticmethod
+    def driver() -> str:
+        """'official' (kubernetes package) or 'httpapi' (in-tree REST)."""
+        return _KUBERNETES_DRIVER
 
     # ----------------------------------------------------------- ClusterState
     def get_node_metrics(self) -> Sequence[NodeMetrics]:

@@ -57,6 +57,14 @@ DEFAULTS: dict[str, Any] = {
         "checkpoint_path": None,
         "quantization": None,  # None | "int8" (weight-only, models/quant.py)
         "tokenizer_path": None,
+        # builtin tokenizer when no tokenizer_path is set: "byte"
+        # (hermetic default) or "numeric" (byte + single-token integers —
+        # the distillation-grade vocab; engine/tokenizer.py)
+        "tokenizer": "byte",
+        # block-decode matmul impl: "dense" (XLA einsums) or "ragged"
+        # (ops/ragged_matmul.py — skips DFA-decided F-width padding;
+        # single-device only, tp meshes fall back to dense)
+        "decode_matmul": "dense",
         # fairness bound for (prefix, grammar) group switches under load
         # (engine/local.py _submit_waves)
         "group_switch_after_s": 0.25,

@@ -289,6 +289,7 @@ def _wave_impl(
     constrained: bool,  # static
     prefix_impl: str | None = None,  # static
     vocab_limit: int | None = None,  # static — see _sample_unconstrained
+    ragged_decode: bool = False,  # static — ragged-M decode matmuls
 ):
     """One whole decision wave in ONE device program, with
     GRAMMAR-ACCELERATED BLOCK DECODING.
@@ -381,6 +382,7 @@ def _wave_impl(
             params, cfg, blk_tok, blk_valid, blk_len, positions,
             k_sfx, v_sfx, suffix_lens, gk, gv, emitted,
             prefix_k, prefix_v, prefix_len, prefix_impl=prefix_impl,
+            ragged=ragged_decode,
         )
         carry = (
             gk, gv, s_cur, alive, emitted + blk_len,
@@ -496,6 +498,7 @@ class InferenceEngine:
         prefix_chunk: int = 2048,
         paged_attn: str = "gather",
         prefix_attn_impl: str | None = None,
+        decode_matmul: str = "dense",  # "dense" | "ragged" (single device)
         mesh=None,  # jax.sharding.Mesh | None — set for multi-device serving
     ) -> None:
         self.cfg = cfg
@@ -564,6 +567,20 @@ class InferenceEngine:
                 mesh=mesh, axis="tp", kind=prefix_attn_impl or "auto"
             )
         self.prefix_attn_impl = prefix_attn_impl
+        if decode_matmul not in ("dense", "ragged"):
+            raise ValueError(
+                f"unknown decode_matmul {decode_matmul!r} "
+                f"(expected 'dense' or 'ragged')"
+            )
+        if decode_matmul == "ragged" and tp_size > 1:
+            # GSPMD cannot partition the pallas_call; the dense einsum
+            # path partitions fine, so multi-device serving keeps it
+            logger.info(
+                "decode_matmul='ragged' is single-device; tp=%d mesh "
+                "falls back to the dense decode path", tp_size,
+            )
+            decode_matmul = "dense"
+        self.decode_matmul = decode_matmul
         chunk_shmap = (
             prefix_attn_impl
             if tp_size > 1 and paged_attn == "pallas"
@@ -600,6 +617,7 @@ class InferenceEngine:
                 _wave_impl,
                 prefix_impl=prefix_attn_impl,
                 vocab_limit=self._vocab_limit,
+                ragged_decode=(decode_matmul == "ragged"),
             ),
             static_argnums=(1, 18, 19, 20, 21),
         )

@@ -564,12 +564,14 @@ def build_local_backend(
     prefix_chunk: int = 2048,
     paged_attn: str = "gather",
     prefix_attn_impl: str | None = None,
+    decode_matmul: str = "dense",
     quantize: str | None = None,
     max_new_tokens: int = 200,
     constrained: bool = True,
     rng_seed: int = 0,
     checkpoint_path: str | None = None,
     tokenizer_path: str | None = None,
+    tokenizer_name: str = "byte",
     devices: Sequence[Any] | None = None,
     request_timeout_s: float = 60.0,
     group_switch_after_s: float = 0.25,
@@ -593,6 +595,21 @@ def build_local_backend(
 
     enable_persistent_compile_cache(compile_cache_dir)
     cfg = cfg or get_config(model)
+    builtin_tokenizer = None
+    if tokenizer_path is None and not (
+        checkpoint_path
+        and tokenizer_name == "byte"
+        and (Path(checkpoint_path) / "tokenizer.json").exists()
+    ):
+        # Builtin tokenizer: the shared rule in engine/tokenizer.py may
+        # WIDEN cfg.vocab_size (numeric NUM rows live above the byte
+        # base) — this must happen before params are built; train/
+        # distill.py calls the same helper, so checkpoints round-trip.
+        from k8s_llm_scheduler_tpu.engine.tokenizer import (
+            build_builtin_tokenizer,
+        )
+
+        builtin_tokenizer, cfg = build_builtin_tokenizer(tokenizer_name, cfg)
     mesh = mesh_from_config(mesh_axes, devices=devices)
     multi = mesh.devices.size > 1
     # Serving shards over tp only: params are tp-sharded (Megatron specs)
@@ -649,17 +666,15 @@ def build_local_backend(
         params = init_params_int8_host(rng_seed, cfg)
     else:
         params = init_params(jax.random.PRNGKey(rng_seed), cfg)
-    if tokenizer_path is None and checkpoint_path:
-        if (Path(checkpoint_path) / "tokenizer.json").exists():
-            tokenizer_path = checkpoint_path
-    if tokenizer_path:
+    if builtin_tokenizer is not None:
+        tokenizer = builtin_tokenizer
+    else:
+        # a HF tokenizer dir was given, or the checkpoint ships its own
+        # (auto-adopted only when no builtin was explicitly selected — a
+        # numeric-distilled checkpoint must keep the vocab it trained on)
         from k8s_llm_scheduler_tpu.engine.tokenizer import HFTokenizerAdapter
 
-        tokenizer = HFTokenizerAdapter(tokenizer_path)
-    else:
-        # Vocab-padded byte tokenizer: checkpoint-shaped configs (128k
-        # vocab) run hermetically without a tokenizer file.
-        tokenizer = ByteTokenizer(vocab_size=max(512, cfg.vocab_size))
+        tokenizer = HFTokenizerAdapter(tokenizer_path or checkpoint_path)
     if max_pages_per_seq is None:
         # Own pages hold only the per-pod suffix + generated tokens (the
         # shared cluster-state prefix lives in the dense prefix buffer), so
@@ -679,6 +694,7 @@ def build_local_backend(
         # over the kv-head axis (ops/pallas_prefix_attention.py shmap
         # wrappers), so the sharded serving path keeps flash attention.
         prefix_attn_impl=prefix_attn_impl,
+        decode_matmul=decode_matmul,
         mesh=mesh if multi else None,
     )
     return LocalLLMBackend(

@@ -95,6 +95,100 @@ class ByteTokenizer:
         return prefix, suffix
 
 
+class NumericTokenizer(ByteTokenizer):
+    """ByteTokenizer + single tokens for integers 0-999.
+
+    The decision task is numeric RANKING: the model must compare
+    utilization percentages across node blocks and name the argmax. Byte-
+    level digits make that a multi-token arithmetic puzzle — round-4
+    distillation drove answer CE to 0.018 while top-1 agreement stayed at
+    chance (EVAL.md finding 4). Rendering each integer as ONE token turns
+    magnitude comparison into an ordering over ~1000 embeddings, which a
+    small transformer learns directly (VERDICT r4 next-step 1, route b:
+    "a tokenizer that renders metrics as single comparable tokens").
+
+    Encoding rules (deterministic, lossless):
+    - maximal digit runs of 1-3 chars with no leading zero (or exactly
+      "0") become NUM tokens: "47" -> NUM_47, "3" -> NUM_3;
+    - runs with leading zeros ("007") or length > 3 fall back to bytes,
+      keeping decode(encode(x)) == x for arbitrary text;
+    - everything else is byte-level, ids identical to ByteTokenizer, so
+      the chat template, specials, and DFA machinery carry over.
+
+    Vocab: 512 (byte base + specials) + 1000 integers = 1512, padded to
+    1536 (12 x 128 MXU lanes). Model configs must be built with
+    vocab_size >= 1536 to serve it (build_local_backend widens the config
+    automatically when this tokenizer is selected).
+    """
+
+    NUM_BASE = 512
+    NUM_COUNT = 1000
+    VOCAB = 1536  # 512 + 1000, padded to a multiple of 128
+
+    def __init__(self, vocab_size: int = VOCAB) -> None:
+        if vocab_size < self.VOCAB:
+            raise ValueError(
+                f"NumericTokenizer needs vocab_size >= {self.VOCAB}"
+            )
+        super().__init__(vocab_size=vocab_size)
+
+    def encode(self, text: str) -> list[int]:
+        import re
+
+        out: list[int] = []
+        for part in re.split(r"(\d+)", text):
+            if not part:
+                continue
+            if part.isdigit():
+                if len(part) <= 3 and (part == "0" or part[0] != "0"):
+                    out.append(self.NUM_BASE + int(part))
+                else:
+                    out.extend(b + 1 for b in part.encode("utf-8"))
+            else:
+                out.extend(b + 1 for b in part.encode("utf-8"))
+        return out
+
+    def decode(self, ids: Sequence[int]) -> str:
+        parts: list[str] = []
+        byte_run = bytearray()
+        for i in ids:
+            if 1 <= i <= 256:
+                byte_run.append(i - 1)
+                continue
+            if byte_run:
+                parts.append(byte_run.decode("utf-8", errors="replace"))
+                byte_run = bytearray()
+            if self.NUM_BASE <= i < self.NUM_BASE + self.NUM_COUNT:
+                parts.append(str(i - self.NUM_BASE))
+        if byte_run:
+            parts.append(byte_run.decode("utf-8", errors="replace"))
+        return "".join(parts)
+
+
+def build_builtin_tokenizer(name: str, cfg):
+    """(tokenizer, possibly-widened model cfg) for a builtin tokenizer.
+
+    THE single vocab rule: training (train/distill.py) and serving
+    (engine/local.build_local_backend) both call this, so a checkpoint
+    trained with a builtin tokenizer restores into the serving stack
+    shape-for-shape — the embedding width is decided here and only here.
+    """
+    import dataclasses
+
+    if name == "numeric":
+        if cfg.vocab_size < NumericTokenizer.VOCAB:
+            cfg = dataclasses.replace(cfg, vocab_size=NumericTokenizer.VOCAB)
+        return NumericTokenizer(vocab_size=cfg.vocab_size), cfg
+    if name == "byte":
+        if cfg.vocab_size < 512:
+            cfg = dataclasses.replace(cfg, vocab_size=512)
+        return ByteTokenizer(vocab_size=cfg.vocab_size), cfg
+    raise ValueError(
+        f"unknown tokenizer {name!r} (builtin: 'byte', 'numeric'; use "
+        f"tokenizer_path for a HF tokenizer dir)"
+    )
+
+
 class HFTokenizerAdapter:
     """Local-files-only wrapper over a HuggingFace fast tokenizer.
 

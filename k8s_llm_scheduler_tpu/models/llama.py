@@ -403,6 +403,7 @@ def forward_block_decode(
     prefix_v_all: jax.Array,
     prefix_len: jax.Array,  # scalar int32
     prefix_impl: str | None = None,  # static
+    ragged: bool = False,  # static: ragged-M Pallas matmuls (single device)
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """One grammar-accelerated decode iteration: an F-wide mini-prefill.
 
@@ -414,6 +415,19 @@ def forward_block_decode(
     model call instead of one per character. Invalid block slots write their
     K/V to the buffer's trash slot (index cap).
 
+    `ragged=True` removes the F-width padding from every projection/MLP
+    matmul (SCALING.md wave roofline: 62% of decode compute at the
+    250-token point): valid tokens are compacted to the front of the
+    flattened [R*F] axis once per iteration (argsort shared by all
+    layers), the residual stream stays compacted through the scan, and
+    matmuls run in ops/ragged_matmul with the valid-token count scalar-
+    prefetched so FLOPs scale with real tokens. Attention and K/V
+    bookkeeping stay in the [R, F] layout (they are the small term and
+    are row-structured); q/k/v scatter back through the inverse
+    permutation. Dead compacted rows carry garbage — every consumer
+    masks by blk_valid / trash-slot dest, exactly as the dense path
+    already requires.
+
     Returns (logits [R, V] f32 at each row's LAST VALID block position,
     gen_k, gen_v).
     """
@@ -424,6 +438,21 @@ def forward_block_decode(
 
     x = params["embed"][blk_tok]  # [R, F, D]
     Ss = k_sfx.shape[2]
+
+    if ragged:
+        from k8s_llm_scheduler_tpu.ops.ragged_matmul import ragged_matmul
+
+        flat_valid = blk_valid.reshape(R * F)
+        perm = jnp.argsort(jnp.logical_not(flat_valid), stable=True)
+        inv_perm = jnp.argsort(perm)
+        total = jnp.sum(blk_len)
+        # last valid token of row r in compacted order (rows with len 0
+        # clamp to 0 — their logits are never consumed, same contract as
+        # the dense path's max(len-1, 0))
+        last_c = jnp.maximum(jnp.cumsum(blk_len) - 1, 0)
+
+        def _rdense(h, w):
+            return ragged_matmul(h, w, total)
 
     sfx_mask = (jnp.arange(Ss)[None, :] < suffix_lens[:, None])[
         :, None, None, None, :
@@ -437,6 +466,56 @@ def forward_block_decode(
     # K/V scatter destinations: valid token j -> tail + j, invalid -> trash.
     dest = jnp.where(blk_valid, tail[:, None] + j[None, :], cap1 - 1)  # [R, F]
     row = jnp.arange(R)[:, None]
+
+    if ragged:
+        xc = x.reshape(R * F, -1)[perm]  # valid tokens first
+
+        def body_ragged(carry, xs):
+            xc, gk, gv = carry
+            lp, pk, pv, ks, vs, idx = xs
+            h = rms_norm(xc, lp["attn_norm"], cfg.rms_eps)
+            q = _rdense(h, lp["wq"])[inv_perm].reshape(
+                R, F, cfg.n_heads, hd
+            )
+            k = _rdense(h, lp["wk"])[inv_perm].reshape(
+                R, F, cfg.n_kv_heads, hd
+            )
+            v = _rdense(h, lp["wv"])[inv_perm].reshape(
+                R, F, cfg.n_kv_heads, hd
+            )
+            q = apply_rope(q, positions, inv_freq)
+            k = apply_rope(k, positions, inv_freq)
+            qg = (q.astype(jnp.float32) * hd**-0.5).reshape(
+                R, F, cfg.n_kv_heads, cfg.q_per_kv, hd
+            )
+            parts = [
+                prefix_attend_parts(q, qg, pk, pv, prefix_len, impl=prefix_impl),
+                attend_part(qg, ks, vs, sfx_mask, "bqkgh,bskh->bkgqs"),
+                attend_part(qg, gk[idx], gv[idx], gen_mask, "bqkgh,bskh->bkgqs"),
+                attend_part(qg, k, v, blk_mask, "bqkgh,bskh->bkgqs"),
+            ]
+            attn = merge_attention_parts(parts)
+            attn = jnp.moveaxis(attn, 3, 1).reshape(R * F, cfg.n_heads * hd)
+            attn_c = attn[perm].astype(xc.dtype)
+            xc = xc + _rdense(attn_c, lp["wo"])
+            h2 = rms_norm(xc, lp["mlp_norm"], cfg.rms_eps)
+            gate = _rdense(h2, lp["w_gate"])
+            up = _rdense(h2, lp["w_up"])
+            fused = jax.nn.silu(gate.astype(jnp.float32)).astype(xc.dtype) * up
+            xc = xc + _rdense(fused, lp["w_down"])
+            gk = gk.at[idx, row, dest].set(k.astype(gk.dtype))
+            gv = gv.at[idx, row, dest].set(v.astype(gv.dtype))
+            return (xc, gk, gv), None
+
+        (xc, gen_k, gen_v), _ = jax.lax.scan(
+            body_ragged,
+            (xc, gen_k, gen_v),
+            (
+                params["layers"], prefix_k_all, prefix_v_all,
+                k_sfx, v_sfx, jnp.arange(cfg.n_layers),
+            ),
+        )
+        return _logits(params, cfg, xc[last_c]), gen_k, gen_v
 
     def body(carry, xs):
         x, gk, gv = carry

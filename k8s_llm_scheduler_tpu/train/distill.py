@@ -65,13 +65,62 @@ def random_cases(n_nodes: int = 5, seed: int = 0):
             )
 
 
+ANSWER_PREFIX = '{"selected_node": "'
+
+
+def easy_cases(n_nodes: int = 3, seed: int = 1):
+    """Curriculum stream: small clusters where ONE node dominates the
+    teacher score by a wide margin (low usage + low pod count vs loaded
+    peers). Pure scaffolding for the number-ordering circuit — the
+    held-out eval never draws from here (train/eval.py uses
+    random_cases exclusively), so mixing these in cannot inflate the
+    reported agreement."""
+    import dataclasses
+
+    from k8s_llm_scheduler_tpu.cluster.interface import raw_pod_to_spec
+    from k8s_llm_scheduler_tpu.testing import pod_burst, synthetic_cluster
+
+    rng = np.random.default_rng(seed)
+    while True:
+        k = int(rng.integers(2, n_nodes + 1))
+        cluster = synthetic_cluster(k)
+        base_nodes = cluster.get_node_metrics()
+        cluster.close()
+        winner = int(rng.integers(0, k))
+        nodes = []
+        for i, n in enumerate(base_nodes):
+            if i == winner:
+                lo, hi, pods_hi = 5, 25, 10
+            else:
+                lo, hi, pods_hi = 60, 95, 55
+            nodes.append(
+                dataclasses.replace(
+                    n,
+                    cpu_usage_percent=float(rng.uniform(lo, hi)),
+                    memory_usage_percent=float(rng.uniform(lo, hi)),
+                    pod_count=int(rng.integers(0, pods_hi)),
+                )
+            )
+        for raw in pod_burst(2, distinct_shapes=2):
+            pod = raw_pod_to_spec(raw)
+            yield (
+                dataclasses.replace(
+                    pod,
+                    cpu_request=round(float(rng.uniform(0.05, 2.0)), 3),
+                    memory_request=round(float(rng.uniform(0.064, 2.0)), 3),
+                ),
+                nodes,
+            )
+
+
 def teacher_pairs(
     tokenizer: Tokenizer,
     n_nodes: int = 5,
     seed: int = 0,
-) -> Iterator[tuple[list[int], int]]:
-    """Endless (prompt + decision tokens, answer_start) samples from the
-    heuristic teacher over randomized synthetic clusters.
+    easy_frac: float = 0.0,
+) -> Iterator[tuple[list[int], int, tuple[int, int]]]:
+    """Endless (prompt + decision tokens, answer_start, name_span) samples
+    from the heuristic teacher over randomized synthetic clusters.
 
     Each sample is the full chat prompt (system + cluster state + pod)
     followed by the teacher's decision JSON and EOS — exactly the
@@ -80,9 +129,23 @@ def teacher_pairs(
     (train_step.causal_lm_loss loss_start), because a ~60-token answer
     behind a ~1.5k-token prompt otherwise contributes ~4% of the gradient
     and the decision head stays near uniform for hundreds of steps.
-    """
+    `name_span` is the (start, end) token range of the selected_node
+    VALUE inside the answer — the only informative tokens of the whole
+    sequence; make_batches upweights them (EVAL.md finding 4)."""
     pe = PromptEngine()
-    for pod, nodes in random_cases(n_nodes=n_nodes, seed=seed):
+    prefix_ids = tokenizer.encode(ANSWER_PREFIX)
+
+    def mixed_cases():
+        hard = random_cases(n_nodes=n_nodes, seed=seed)
+        if not easy_frac:
+            yield from hard
+            return
+        easy = easy_cases(seed=seed + 1)
+        rng = np.random.default_rng(seed + 2)
+        while True:
+            yield next(easy if rng.random() < easy_frac else hard)
+
+    for pod, nodes in mixed_cases():
         decision = fallback_decision(
             nodes, reason="teacher", strategy="resource_balanced", pod=pod
         )
@@ -99,9 +162,12 @@ def teacher_pairs(
                 "reasoning": "resource balanced",
             }
         )
+        name_len = len(tokenizer.encode(decision.selected_node))
+        name_start = len(prompt) + len(prefix_ids)
         yield (
             prompt + tokenizer.encode(answer) + [tokenizer.eos_id],
             len(prompt),
+            (name_start, name_start + name_len),
         )
 
 
@@ -111,18 +177,26 @@ def make_batches(
     seq_len: int,
     n_nodes: int = 5,
     seed: int = 0,
-) -> Iterator[tuple[np.ndarray, np.ndarray, np.ndarray]]:
-    """Batched, padded (tokens, seq_lens, answer_starts) for the train
-    step (answer_starts feeds the loss mask)."""
-    pairs = teacher_pairs(tokenizer, n_nodes=n_nodes, seed=seed)
+    name_weight: float = 8.0,
+    easy_frac: float = 0.0,
+) -> Iterator[tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]]:
+    """Batched, padded (tokens, seq_lens, answer_starts, loss_weights) for
+    the train step (answer_starts feeds the loss mask; loss_weights
+    upweight the FINAL selected_node value token by `name_weight` — the
+    corpus' names share a 'node-' prefix, so the last token is the one
+    decision-bearing choice of a ~70-token mostly-deterministic answer)."""
+    pairs = teacher_pairs(
+        tokenizer, n_nodes=n_nodes, seed=seed, easy_frac=easy_frac
+    )
     pad = tokenizer.pad_id
     warned = False
     while True:
         tokens = np.full((batch_size, seq_len), pad, dtype=np.int32)
         lens = np.zeros(batch_size, dtype=np.int32)
         starts = np.zeros(batch_size, dtype=np.int32)
+        weights = np.ones((batch_size, seq_len), dtype=np.float32)
         for b in range(batch_size):
-            ids, ans_start = next(pairs)
+            ids, ans_start, (ns, ne) = next(pairs)
             if len(ids) > seq_len:
                 # Truncate from the LEFT: the decision JSON lives at the
                 # tail, and a distillation batch that drops the answer
@@ -130,6 +204,7 @@ def make_batches(
                 cut = len(ids) - seq_len
                 ids = ids[-seq_len:]
                 ans_start = max(0, ans_start - cut)
+                ns, ne = max(0, ns - cut), max(0, ne - cut)
                 if not warned:
                     logger.warning(
                         "teacher pairs exceed seq_len=%d; truncating prompt "
@@ -139,7 +214,146 @@ def make_batches(
             tokens[b, : len(ids)] = ids
             lens[b] = len(ids)
             starts[b] = ans_start
-        yield tokens, lens, starts
+            if ne > ns:
+                weights[b, ne - 1] = name_weight
+        yield tokens, lens, starts, weights
+
+
+def numeric_embedding_init(params, tokenizer) -> None:
+    """Seed the NUM token embeddings with a smooth magnitude code.
+
+    Random-init embeddings force the model to DISCOVER the ordering of
+    1000 independent vectors from task reward alone; writing multi-scale
+    sinusoid features of v=k/999 into the first few dims (the standard
+    numeracy-embedding trick — cf. positional encodings) hands it a
+    comparable representation on day one. Only the first 8 dims of the
+    1000 NUM rows are touched; training remains free to reshape them.
+    In-place on the host-side param tree before device placement."""
+    import numpy as np_mod
+
+    from k8s_llm_scheduler_tpu.engine.tokenizer import NumericTokenizer
+
+    if not isinstance(tokenizer, NumericTokenizer):
+        return
+    import jax
+
+    orig = params["embed"]
+    embed = np_mod.asarray(orig, dtype=np_mod.float32)
+    k = np_mod.arange(NumericTokenizer.NUM_COUNT, dtype=np_mod.float32)
+    v = k / float(NumericTokenizer.NUM_COUNT - 1)
+    feats = []
+    for freq in (1.0, 2.0, 4.0, 8.0):
+        feats.append(np_mod.sin(np_mod.pi * v * freq))
+        feats.append(np_mod.cos(np_mod.pi * v * freq))
+    block = np_mod.stack(feats, axis=1) * 0.08  # match init scale ~1/sqrt(d)
+    rows = slice(
+        NumericTokenizer.NUM_BASE,
+        NumericTokenizer.NUM_BASE + NumericTokenizer.NUM_COUNT,
+    )
+    embed[rows, : block.shape[1]] = block
+    new = embed.astype(orig.dtype)  # ml_dtypes handles bf16 in numpy
+    if hasattr(orig, "sharding"):
+        new = jax.device_put(new, orig.sharding)
+    params["embed"] = new
+
+
+def build_tokenizer(name: str, cfg):
+    """(tokenizer, possibly-widened cfg) — delegates to THE shared rule
+    (engine/tokenizer.build_builtin_tokenizer) so checkpoints trained
+    here restore into build_local_backend shape-for-shape."""
+    from k8s_llm_scheduler_tpu.engine.tokenizer import build_builtin_tokenizer
+
+    return build_builtin_tokenizer(name, cfg)
+
+
+def make_agreement_probe(
+    cfg,
+    tokenizer: Tokenizer,
+    n_cases: int = 64,
+    n_nodes: int = 5,
+    seed: int = 30_011,
+    seq_len: int = 2048,
+):
+    """Build `probe(params) -> agreement` — greedy-serving-equivalent
+    teacher agreement, cheap enough to run every few hundred train steps.
+
+    Exactness: the decision grammar forces every token of the answer
+    except the node-name choice (engine/constrained.py builds a trie over
+    feasible names; for the corpus' `node-K` names the names share the
+    'node-' prefix and diverge only at the final K token). Greedy
+    constrained decoding therefore equals: forward the prompt +
+    '{"selected_node": "node-' and argmax the final-position logits over
+    the feasible nodes' last name tokens. One batched prefill scores the
+    whole probe set — no engine, no waves.
+
+    The probe seed is disjoint from BOTH the training stream and
+    train/eval.py's held-out seed (10_007): train-time model selection
+    never sees the final report card's cases."""
+    import jax
+    import jax.numpy as jnp
+
+    from k8s_llm_scheduler_tpu.core.validation import feasible_nodes
+    from k8s_llm_scheduler_tpu.models.llama import forward_prefill
+
+    pe = PromptEngine()
+    prefix_ids = tokenizer.encode(ANSWER_PREFIX)
+    cases = random_cases(n_nodes=n_nodes, seed=seed)
+    rows, row_meta = [], []
+    while len(rows) < n_cases:
+        pod, nodes = next(cases)
+        decision = fallback_decision(
+            nodes, reason="teacher", strategy="resource_balanced", pod=pod
+        )
+        if decision is None:
+            continue
+        cand = feasible_nodes(pod, nodes)
+        name_toks = [tokenizer.encode(n.name) for n in cand]
+        shared, diverge = name_toks[0][:-1], [t[-1] for t in name_toks]
+        if any(t[:-1] != shared for t in name_toks) or len(set(diverge)) != len(
+            diverge
+        ):
+            # names that don't share a single-token divergence point would
+            # need full per-name scoring; this corpus never produces them
+            continue
+        cluster_part, pod_part = pe.split_prompt(pod, nodes)
+        ids = (
+            tokenizer.chat_prompt(pe.system_prompt, cluster_part + pod_part)
+            + prefix_ids
+            + shared
+        )
+        if len(ids) > seq_len:
+            ids = ids[-seq_len:]
+        target = next(
+            i for i, n in enumerate(cand) if n.name == decision.selected_node
+        )
+        rows.append(ids)
+        row_meta.append((diverge, target))
+    max_k = max(len(d) for d, _ in row_meta)
+    tokens = np.full((n_cases, seq_len), tokenizer.pad_id, dtype=np.int32)
+    lens = np.zeros(n_cases, dtype=np.int32)
+    cand_toks = np.full((n_cases, max_k), -1, dtype=np.int32)
+    targets = np.zeros(n_cases, dtype=np.int32)
+    for i, (ids, (diverge, target)) in enumerate(zip(rows, row_meta)):
+        tokens[i, : len(ids)] = ids
+        lens[i] = len(ids)
+        cand_toks[i, : len(diverge)] = diverge
+        targets[i] = target
+
+    @jax.jit
+    def _predict(params, tokens, lens, cand_toks):
+        logits, _, _ = forward_prefill(params, cfg, tokens, lens)
+        last = logits[jnp.arange(tokens.shape[0]), lens - 1]  # [N, V]
+        cand_logits = jnp.take_along_axis(
+            last, jnp.maximum(cand_toks, 0), axis=1
+        )
+        cand_logits = jnp.where(cand_toks >= 0, cand_logits, -jnp.inf)
+        return jnp.argmax(cand_logits, axis=1)
+
+    def probe(params) -> float:
+        pred = np.asarray(_predict(params, tokens, lens, cand_toks))
+        return float((pred == targets).mean())
+
+    return probe
 
 
 def train_and_save(
@@ -152,21 +366,32 @@ def train_and_save(
     log_every: int = 5,
     seed: int = 0,
     lr: float = 3e-4,
+    tokenizer_name: str = "byte",
+    name_weight: float = 8.0,
+    probe_every: int = 0,
+    lr_schedule: str = "constant",
+    easy_frac: float = 0.0,
+    numeric_init: bool = True,
+    save_every: int = 0,
 ) -> float:
     """Run `steps` of answer-masked fine-tuning on teacher pairs and save
     an orbax checkpoint servable via checkpoint_path. Returns the final
     loss. `lr` defaults suit bootstrap distillation of the small configs
     from random init (the 1e-5 fine-tune default under-trained them by
-    orders of magnitude)."""
+    orders of magnitude).
+
+    `tokenizer_name="numeric"` trains with the single-token-integer vocab
+    (serve the result with llm.tokenizer: numeric). `probe_every=N` logs
+    greedy held-out teacher agreement every N steps (make_agreement_probe).
+    `lr_schedule="cosine"` adds linear warmup (5%) + cosine decay."""
     import jax
     import optax
 
-    from k8s_llm_scheduler_tpu.engine.tokenizer import ByteTokenizer
     from k8s_llm_scheduler_tpu.models.loader import save_checkpoint
     from k8s_llm_scheduler_tpu.parallel.mesh import mesh_from_config
     from k8s_llm_scheduler_tpu.train.train_step import make_train_step
 
-    tokenizer = ByteTokenizer(vocab_size=max(512, cfg.vocab_size))
+    tokenizer, cfg = build_tokenizer(tokenizer_name, cfg)
     if jax.process_count() > 1:
         # Multi-host: dp/fsdp span processes (DCN), tp/sp stay within one
         # host (ICI) — mesh_from_config's flat device slice is process-
@@ -180,19 +405,58 @@ def train_and_save(
         )
     else:
         mesh = mesh_from_config(mesh_axes)
-    init_fn, step_fn = make_train_step(
-        cfg, mesh, optimizer=optax.adamw(lr)
-    )
+    if lr_schedule == "cosine":
+        warmup = max(1, min(steps // 10, 500))
+        sched = optax.warmup_cosine_decay_schedule(
+            init_value=0.0, peak_value=lr,
+            warmup_steps=warmup,
+            decay_steps=max(steps, warmup + 1), end_value=lr * 0.05,
+        )
+        optimizer = optax.adamw(sched)
+    else:
+        optimizer = optax.adamw(lr)
+    init_fn, step_fn = make_train_step(cfg, mesh, optimizer=optimizer)
     state = init_fn(jax.random.PRNGKey(seed))
-    batches = make_batches(tokenizer, batch_size, seq_len, seed=seed)
+    if numeric_init and jax.process_count() == 1:
+        # magnitude-aware NUM embedding seed (no-op for byte tokenizer);
+        # multi-host skips it — re-placing one leaf of a dcn-sharded tree
+        # is not worth the complexity for a warm-start heuristic
+        numeric_embedding_init(state.params, tokenizer)
+    batches = make_batches(
+        tokenizer, batch_size, seq_len, seed=seed, name_weight=name_weight,
+        easy_frac=easy_frac,
+    )
+    probe = (
+        make_agreement_probe(cfg, tokenizer, seq_len=seq_len)
+        if probe_every
+        else None
+    )
     loss = float("nan")
     for step in range(1, steps + 1):
-        tokens, lens, starts = next(batches)
-        tokens, lens, starts = step_fn.place_batch(tokens, lens, starts)
-        state, loss_arr = step_fn(state, tokens, lens, starts)
+        tokens, lens, starts, weights = next(batches)
+        tokens, lens, starts, weights = step_fn.place_batch(
+            tokens, lens, starts, weights
+        )
+        state, loss_arr = step_fn(state, tokens, lens, starts, weights)
         if step % log_every == 0 or step == steps:
             loss = float(loss_arr)
             logger.info("step %d/%d loss %.4f", step, steps, loss)
+        if probe is not None and (step % probe_every == 0 or step == steps):
+            logger.info(
+                "step %d/%d held-out greedy agreement %.1f%%",
+                step, steps, 100.0 * probe(state.params),
+            )
+        if (
+            save_every
+            and step % save_every == 0
+            and step != steps
+            and jax.process_index() == 0
+        ):
+            # periodic snapshot: a multi-hour run over a flaky transport
+            # must not lose everything to one hung RPC
+            save_checkpoint(out_dir, state.params)
+            logger.info("step %d/%d checkpoint snapshot -> %s",
+                        step, steps, out_dir)
     if jax.process_index() == 0:
         # coordinator-only side effect; worker hosts hold the same
         # (replicated-spec) state and must not race the directory write

@@ -46,6 +46,7 @@ def causal_lm_loss(
     tokens: jax.Array,
     seq_lens: jax.Array,
     loss_start: jax.Array | None = None,
+    loss_weights: jax.Array | None = None,
 ) -> jax.Array:
     """Mean next-token cross entropy over valid (non-pad) positions.
 
@@ -55,7 +56,16 @@ def causal_lm_loss(
     25:1 in prompt-modeling (a 1.5k-token cluster prompt carries a
     ~60-token answer; full-sequence loss left the decision head near
     uniform after hundreds of steps). None keeps the plain-LM behavior
-    (pretraining-style callers: pipeline stages, dryrun)."""
+    (pretraining-style callers: pipeline stages, dryrun).
+
+    `loss_weights` ([B, S] float32, aligned with `tokens`: weight of
+    PREDICTING token j) further re-weights positions inside the masked
+    span. The distillation path upweights the selected_node value tokens:
+    ~69 of ~70 answer tokens are deterministic JSON format, so the ONE
+    informative token otherwise carries ~1.4% of the answer gradient
+    (EVAL.md finding 4 — answer CE reached 0.018 at chance agreement).
+    The weighted mean normalizes by the weight sum, so upweighting the
+    name does not change the loss scale."""
     targets = tokens[:, 1:]
     logits = logits[:, :-1]
     S = targets.shape[1]
@@ -66,6 +76,8 @@ def causal_lm_loss(
         # contributing at j = loss_start - 1
         mask = mask & (pos >= jnp.maximum(loss_start[:, None] - 1, 0))
     mask = mask.astype(jnp.float32)
+    if loss_weights is not None:
+        mask = mask * loss_weights[:, 1:]
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
@@ -104,15 +116,16 @@ def make_train_step(
     data_sharding = NamedSharding(mesh, P(dp, sp))
     lens_sharding = NamedSharding(mesh, P(dp))
 
-    def loss_fn(params, tokens, seq_lens, loss_start):
+    def loss_fn(params, tokens, seq_lens, loss_start, loss_weights):
         logits, _, _ = forward_prefill(params, cfg, tokens, seq_lens, attn_impl)
-        return causal_lm_loss(logits, tokens, seq_lens, loss_start)
+        return causal_lm_loss(logits, tokens, seq_lens, loss_start, loss_weights)
 
     @jax.jit
-    def step_fn(state: TrainState, tokens, seq_lens, loss_start=None):
+    def step_fn(state: TrainState, tokens, seq_lens, loss_start=None,
+                loss_weights=None):
         tokens = jax.lax.with_sharding_constraint(tokens, data_sharding)
         loss, grads = jax.value_and_grad(loss_fn)(
-            state.params, tokens, seq_lens, loss_start
+            state.params, tokens, seq_lens, loss_start, loss_weights
         )
         updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
@@ -139,14 +152,15 @@ def make_train_step(
             for r in range(rs.start or 0, b if rs.stop is None else rs.stop)
         })
 
-    def place_batch(tokens, seq_lens, loss_start=None):
+    def place_batch(tokens, seq_lens, loss_start=None, loss_weights=None):
         """Place a GLOBAL batch (same arrays on every process) onto the
         mesh. Multi-host: each process contributes its dp-slice of the
         batch via make_array_from_process_local_data — rows map to
         processes in dp-axis order, which is process order under
-        parallel/distributed.multihost_mesh (dp outermost). With
-        `loss_start` ([B], the distillation answer offsets) a 3-tuple is
-        returned, the extra array placed like seq_lens."""
+        parallel/distributed.multihost_mesh (dp outermost). `loss_start`
+        ([B], the distillation answer offsets) is placed like seq_lens;
+        `loss_weights` ([B, S], per-token loss weights) like tokens; the
+        returned tuple grows accordingly."""
         if jax.process_count() > 1:
             import numpy as _np
 
@@ -166,6 +180,10 @@ def make_train_step(
                 placed = (*placed, jax.make_array_from_process_local_data(
                     lens_sharding, _np.asarray(loss_start)[rows]
                 ))
+            if loss_weights is not None:
+                placed = (*placed, jax.make_array_from_process_local_data(
+                    data_sharding, _np.asarray(loss_weights)[rows]
+                ))
             return placed
         placed = (
             jax.device_put(tokens, data_sharding),
@@ -173,6 +191,8 @@ def make_train_step(
         )
         if loss_start is not None:
             placed = (*placed, jax.device_put(loss_start, lens_sharding))
+        if loss_weights is not None:
+            placed = (*placed, jax.device_put(loss_weights, data_sharding))
         return placed
 
     step_fn.place_batch = place_batch  # type: ignore[attr-defined]

@@ -132,12 +132,18 @@ class TestCLI:
         assert rc == 0
         assert out.strip()
 
-    def test_run_without_kubernetes_errors_cleanly(self, capsys, tmp_path):
+    def test_run_without_cluster_config_errors_cleanly(
+        self, capsys, tmp_path, monkeypatch
+    ):
+        """No kubeconfig anywhere -> `run` must point at --fake-cluster,
+        not traceback (covers both the official client and the in-tree
+        httpapi driver, whose availability no longer depends on an
+        installed package)."""
         from k8s_llm_scheduler_tpu.cli import main
-        from k8s_llm_scheduler_tpu.cluster.kube import KubeCluster
 
-        if KubeCluster.available():
-            pytest.skip("kubernetes client installed")
+        monkeypatch.delenv("KUBECONFIG", raising=False)
+        monkeypatch.delenv("KUBERNETES_SERVICE_HOST", raising=False)
+        monkeypatch.setenv("HOME", str(tmp_path))  # no ~/.kube/config
         cfg_file = tmp_path / "config.yaml"
         cfg_file.write_text("llm:\n  backend: stub\n")
         rc = main(["--config", str(cfg_file), "run"])

@@ -206,7 +206,7 @@ class TestDistill:
         tok = ByteTokenizer()
         it = teacher_pairs(tok, n_nodes=3, seed=0)
         for _ in range(3):
-            ids, ans_start = next(it)
+            ids, ans_start, (ns, ne) = next(it)
             assert ids[-1] == tok.eos_id
             assert 0 < ans_start < len(ids)
             text = tok.decode(ids)
@@ -217,6 +217,8 @@ class TestDistill:
             assert obj["selected_node"].startswith("node-")
             answer = tok.decode(ids[ans_start:-1])
             assert _json.loads(answer)["selected_node"] == obj["selected_node"]
+            # the name span decodes to exactly the selected node's name
+            assert tok.decode(ids[ns:ne]) == obj["selected_node"]
 
     def test_train_and_save_then_serve(self, tmp_path):
         from k8s_llm_scheduler_tpu.engine.local import build_local_backend

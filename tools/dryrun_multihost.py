@@ -170,6 +170,16 @@ def child(process_id: int, port: int) -> None:
         # protocol itself (served-count poll), not a barrier.
         import time as _time
 
+        # Coordinator-done sentinel: a FILE, not a collective — a barrier
+        # would park the worker's device execution while it must still
+        # serve decisions (measured deadlock; see module notes). Keyed on
+        # the shared coordinator port, same host by construction. Stale
+        # sentinels from a crashed earlier run are cleared BEFORE the port
+        # allgather: both processes leave that barrier together, and a
+        # worker polling a stale file would close before serving.
+        done_path = Path(f"/tmp/dryrun_mh_done_{port}")
+        if is_coordinator():
+            done_path.unlink(missing_ok=True)
         server = None
         if not is_coordinator():
             server = ReplicaServer(backend, host="127.0.0.1", port=0)
@@ -177,11 +187,17 @@ def child(process_id: int, port: int) -> None:
             np.int32(server.port if server else 0)
         )
         if not is_coordinator():
+            # >= 1: health-aware fanout probes the remote replica at least
+            # once; the split beyond that depends on observed latencies.
+            # Closing only after the coordinator's done-sentinel guarantees
+            # no in-flight decision races the shutdown.
             deadline = _time.monotonic() + 300
-            while server.served < 2 and _time.monotonic() < deadline:
+            while (
+                not done_path.exists() and _time.monotonic() < deadline
+            ):
                 _time.sleep(0.05)
             server.close()
-            assert server.served >= 2, f"worker served {server.served}"
+            assert server.served >= 1, f"worker served {server.served}"
             print(
                 f"dryrun OK (cross-host serving): worker {process_id} "
                 f"served {server.served} decisions via replica RPC"
@@ -195,13 +211,17 @@ def child(process_id: int, port: int) -> None:
                                         cpu_request=0.1 + 0.01 * i)
                     d = fan.get_scheduling_decision(pod_i, nodes)
                     assert d.selected_node in {n.name for n in nodes}
-                assert fan.routed == [2, 2], fan.routed
+                # health-aware dispatch: exact split depends on observed
+                # latencies; the cross-host proof is that BOTH processes
+                # executed decisions
+                assert all(n > 0 for n in fan.routed), fan.routed
                 print(
                     "dryrun OK (cross-host serving): coordinator fanned "
-                    f"4 decisions {fan.routed} over [local, worker]"
+                    f"4 decisions routed={fan.routed} over [local, worker]"
                 )
             finally:
                 client.close()
+                done_path.touch()
     finally:
         backend.close()
 
@@ -246,8 +266,8 @@ def parent() -> int:
         assert "multihost train" in outs[0] and "coordinator-only bind" in outs[0]
         assert "no bind" in outs[1]
         # cross-host serving: decisions executed on BOTH processes
-        assert "coordinator fanned 4 decisions [2, 2]" in outs[0], outs[0][-500:]
-        assert "served 2 decisions via replica RPC" in outs[1], outs[1][-500:]
+        assert "coordinator fanned 4 decisions routed=" in outs[0], outs[0][-500:]
+        assert "decisions via replica RPC" in outs[1], outs[1][-500:]
         print("dryrun_multihost: ALL OK")
     return rc
 
