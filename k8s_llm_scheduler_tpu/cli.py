@@ -50,6 +50,7 @@ def _backend_kwargs(cfg: Config, **overrides) -> dict:
         tokenizer_path=cfg.get("llm.tokenizer_path"),
         tokenizer_name=cfg.get("llm.tokenizer", "byte"),
         decode_matmul=cfg.get("llm.decode_matmul", "dense"),
+        answer_style=cfg.get("llm.answer_style", "direct"),
         quantize=cfg.get("llm.quantization"),
         request_timeout_s=float(cfg.get("llm.timeout")),
         group_switch_after_s=float(cfg.get("llm.group_switch_after_s")),
@@ -470,6 +471,8 @@ def cmd_train(args: argparse.Namespace, cfg: Config) -> int:
         lr_schedule=args.lr_schedule,
         easy_frac=args.easy_frac,
         save_every=args.save_every,
+        resume=args.resume,
+        answer_style=cfg.get("llm.answer_style", "direct"),
     )
     print(f"final loss {loss:.4f}; checkpoint at {args.out}")
     if args.eval:
@@ -516,8 +519,20 @@ def cmd_eval(args: argparse.Namespace, cfg: Config) -> int:
         n_cases=args.cases,
         placement_pods=args.placement_pods,
         backend_kwargs=_eval_backend_kwargs(cfg),
+        scenarios=args.scenarios,
+        scenario_cases_n=args.scenario_cases,
     )
     print(json.dumps(report))
+    if args.scenarios and report.get("scenarios"):
+        # human-readable table after the JSON line
+        print(f"{'scenario':<18}{'agree%':>8}{'chance%':>9}{'valid%':>8}{'n':>5}",
+              file=sys.stderr)
+        for kind, row in report["scenarios"].items():
+            print(
+                f"{kind:<18}{row['agreement_pct']:>8}{row['chance_pct']:>9}"
+                f"{row['valid_pct']:>8}{row['n_cases']:>5}",
+                file=sys.stderr,
+            )
     return 0
 
 
@@ -648,6 +663,10 @@ def main(argv: list[str] | None = None) -> int:
         help="snapshot the checkpoint every N steps (0=only at the end)",
     )
     p_train.add_argument(
+        "--resume", action="store_true",
+        help="resume params from --out's latest snapshot if present",
+    )
+    p_train.add_argument(
         "--easy-frac", type=float, default=0.0,
         help="fraction of curriculum (wide-margin) cases mixed into the "
              "teacher stream (train-only; eval never draws from it)",
@@ -670,6 +689,12 @@ def main(argv: list[str] | None = None) -> int:
     p_eval.add_argument("--model", default=None, help="config name")
     p_eval.add_argument("--cases", type=int, default=64)
     p_eval.add_argument("--placement-pods", type=int, default=32)
+    p_eval.add_argument(
+        "--scenarios", action="store_true",
+        help="add the per-scenario-class agreement table (heterogeneous "
+             "capacities, taints, selectors, affinity)",
+    )
+    p_eval.add_argument("--scenario-cases", type=int, default=32)
 
     p_complete = sub.add_parser(
         "complete",

@@ -192,14 +192,34 @@ def load_incluster_config() -> None:
 def load_kube_config(path: str | None = None) -> None:
     """Minimal kubeconfig: current-context -> cluster server + user token.
 
-    Client-certificate auth is not implemented (this transport covers
-    token / insecure clusters); the official client remains the preferred
-    driver when installed (cluster/kube.py import order)."""
+    KUBECONFIG may be a colon-separated path list (kubectl semantics); the
+    first existing file that resolves to a cluster server wins — a
+    simplification of kubectl's full merge that covers the common multi-
+    file setup. Client-certificate auth is not implemented (this transport
+    covers token / insecure clusters); the official client remains the
+    preferred driver when installed (cluster/kube.py import order)."""
     import yaml
 
-    path = path or os.environ.get(
+    raw = path or os.environ.get(
         "KUBECONFIG", os.path.expanduser("~/.kube/config")
     )
+    candidates = [p for p in str(raw).split(os.pathsep) if p]
+    existing = [p for p in candidates if os.path.exists(p)]
+    if not existing:
+        raise FileNotFoundError(
+            f"no kubeconfig found at {raw!r}"
+        )
+    last_err: Exception | None = None
+    for p in existing:
+        try:
+            _load_one_kubeconfig(p, yaml)
+            return
+        except Exception as exc:  # try the next file in the list
+            last_err = exc
+    raise last_err  # every existing file failed to resolve
+
+
+def _load_one_kubeconfig(path: str, yaml) -> None:
     with open(path, encoding="utf-8") as fh:
         doc = yaml.safe_load(fh) or {}
     current = doc.get("current-context")

@@ -65,6 +65,10 @@ DEFAULTS: dict[str, Any] = {
         # (ops/ragged_matmul.py — skips DFA-decided F-width padding;
         # single-device only, tp meshes fall back to dense)
         "decode_matmul": "dense",
+        # decision JSON field order: "direct" (reference order) or "cot"
+        # (reasoning before the constrained node choice — the parsed
+        # object is identical; engine/constrained.py)
+        "answer_style": "direct",
         # fairness bound for (prefix, grammar) group switches under load
         # (engine/local.py _submit_waves)
         "group_switch_after_s": 0.25,

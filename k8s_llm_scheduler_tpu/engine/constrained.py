@@ -99,15 +99,30 @@ def build_decision_dfa(
     tokenizer: Tokenizer,
     node_names: list[str],
     max_reason_tokens: int = 120,
+    style: str = "direct",
 ) -> DecisionDFA:
     """Compile the decision grammar for this set of allowed node names.
 
     Token-level trie — works for any tokenizer whose encode() is prefix-
     consistent over the name strings (byte-level trivially is; BPE names are
     encoded whole so each name is one fixed token path).
+
+    `style` fixes the FIELD ORDER of the emitted object (the parsed JSON
+    is identical either way — key order is semantically irrelevant):
+
+    - "direct": {"selected_node": ..., "confidence": ..., "reasoning": ...}
+      — the reference's serialization order (scheduler.py:208-212).
+    - "cot":    {"reasoning": ..., "selected_node": ..., "confidence": ...}
+      — chain-of-thought-before-choice: the model emits its free-text
+      rationale (e.g. per-node scores, EVAL.md) BEFORE the constrained
+      node choice, so the choice token can attend to the model's own
+      serialized comparison instead of computing a global argmax in one
+      step. Distillation selects this with train --answer-style cot.
     """
     if not node_names:
         raise ValueError("constrained decoding needs at least one allowed node name")
+    if style not in ("direct", "cot"):
+        raise ValueError(f"unknown decision style {style!r}")
     for name in node_names:
         # Names embed RAW inside the JSON string the grammar forces; a
         # quote/backslash/control char would make every decision unparseable
@@ -120,82 +135,107 @@ def build_decision_dfa(
                 "cannot appear in the decision grammar"
             )
     b = _Builder(tokenizer.vocab_size)
+    quote = tokenizer.encode('"')[0]
 
     start = b.new_state()
     done = b.new_state()
 
-    # {"selected_node": "
-    s = b.chain(start, tokenizer.encode('{"selected_node": "'))
+    def wire_name_trie(src: int) -> int:
+        """Trie over node names from `src`; leaves converge (via the
+        closing quote) on the returned post-name state."""
+        post_name = b.new_state()
+        trie: dict[tuple[int, ...], int] = {(): src}
+        for name in node_names:
+            toks = tokenizer.encode(name)
+            prefix: tuple[int, ...] = ()
+            for tok in toks:
+                nxt_prefix = prefix + (tok,)
+                if nxt_prefix not in trie:
+                    trie[nxt_prefix] = b.new_state()
+                    b.edge(trie[prefix], tok, trie[nxt_prefix])
+                elif tok not in b.edges[trie[prefix]]:
+                    b.edge(trie[prefix], tok, trie[nxt_prefix])
+                prefix = nxt_prefix
+            b.edge(trie[prefix], quote, post_name)
+        return post_name
 
-    # trie over node names; all leaves converge on the post-name state
-    post_name = b.new_state()
-    trie: dict[tuple[int, ...], int] = {(): s}
-    for name in node_names:
-        toks = tokenizer.encode(name)
-        prefix: tuple[int, ...] = ()
-        for i, tok in enumerate(toks):
-            nxt_prefix = prefix + (tok,)
-            if nxt_prefix not in trie:
-                trie[nxt_prefix] = b.new_state()
-                b.edge(trie[prefix], tok, trie[nxt_prefix])
-            elif tok not in b.edges[trie[prefix]]:
-                b.edge(trie[prefix], tok, trie[nxt_prefix])
-            prefix = nxt_prefix
-        # closing quote after a complete name
-        quote = tokenizer.encode('"')[0]
-        b.edge(trie[prefix], quote, post_name)
+    def wire_confidence(src: int) -> list[int]:
+        """0.d | 0.dd | 1.0 from `src`; returns the terminal states (the
+        caller wires the field separator/closer edges from them)."""
+        digits = {d: tokenizer.encode(str(d))[0] for d in range(10)}
+        dot = tokenizer.encode(".")[0]
+        zero_state = b.new_state()
+        b.edge(src, digits[0], zero_state)
+        zero_dot = b.new_state()
+        b.edge(zero_state, dot, zero_dot)
+        first_dec = b.new_state()
+        for d in range(10):
+            b.edge(zero_dot, digits[d], first_dec)
+        second_dec = b.new_state()
+        for d in range(10):
+            b.edge(first_dec, digits[d], second_dec)
+        one_state = b.new_state()
+        b.edge(src, digits[1], one_state)
+        one_dot = b.new_state()
+        b.edge(one_state, dot, one_dot)
+        one_zero = b.new_state()
+        b.edge(one_dot, digits[0], one_zero)
+        return [first_dec, second_dec, one_zero]
 
-    # , "confidence":<space>
-    s = b.chain(post_name, tokenizer.encode(', "confidence": '))
+    def wire_reasoning(src: int) -> int:
+        """Free text (printable, non-quote/backslash) from `src`, bounded
+        at max_reason_tokens; returns the state after the closing quote.
+        NumericTokenizer note: digit runs in generated reasoning arrive as
+        NUM tokens, so allow those alongside the single-char prints."""
+        printable = [
+            tokenizer.encode(chr(c))[0]
+            for c in range(32, 127)
+            if chr(c) not in ('"', "\\")
+        ]
+        num_base = getattr(tokenizer, "NUM_BASE", None)
+        if num_base is not None:
+            # integers 0-200 only: covers scores/percentages (the CoT
+            # vocabulary) while keeping the state out-degree inside the
+            # sparse-table K buckets (full NUM_COUNT would exceed 1024)
+            printable = sorted(
+                set(printable) | set(range(num_base, num_base + 201))
+            )
+        states = [src] + [b.new_state() for _ in range(max_reason_tokens)]
+        close_q = b.new_state()
+        for i, st in enumerate(states):
+            b.edge(st, quote, close_q)
+            if i < max_reason_tokens:
+                for tok in printable:
+                    b.edge(st, tok, states[i + 1])
+        return close_q
 
-    digits = {d: tokenizer.encode(str(d))[0] for d in range(10)}
-    dot = tokenizer.encode(".")[0]
-    # 0.d or 0.dd  |  1.0
-    zero_state = b.new_state()
-    b.edge(s, digits[0], zero_state)
-    zero_dot = b.new_state()
-    b.edge(zero_state, dot, zero_dot)
-    first_dec = b.new_state()
-    for d in range(10):
-        b.edge(zero_dot, digits[d], first_dec)
-    comma = tokenizer.encode(",")[0]
-    # first decimal can end (comma handled below) or take a second decimal
-    second_dec = b.new_state()
-    for d in range(10):
-        b.edge(first_dec, digits[d], second_dec)
-    one_state = b.new_state()
-    b.edge(s, digits[1], one_state)
-    one_dot = b.new_state()
-    b.edge(one_state, dot, one_dot)
-    one_zero = b.new_state()
-    b.edge(one_dot, digits[0], one_zero)
-
-    # after the number: , "reasoning": "
-    reason_open = tokenizer.encode(' "reasoning": "')
-    after_num_chain_src = b.new_state()
-    reason_start = b.chain(after_num_chain_src, reason_open)
-    for st in (first_dec, second_dec, one_zero):
-        b.edge(st, comma, after_num_chain_src)
-
-    # reasoning: printable non-quote bytes, bounded length, then "}<EOS>
-    quote = tokenizer.encode('"')[0]
-    close_tokens = tokenizer.encode('}')
-    printable = [
-        tokenizer.encode(chr(c))[0]
-        for c in range(32, 127)
-        if chr(c) not in ('"', "\\")
-    ]
-    reason_states = [reason_start] + [b.new_state() for _ in range(max_reason_tokens)]
-    # closing path: " -> } -> EOS -> done
-    close_q = b.new_state()
-    close_b = b.chain(close_q, close_tokens)
-    b.edge(close_b, tokenizer.eos_id, done)
-    for i, st in enumerate(reason_states):
-        b.edge(st, quote, close_q)
-        if i < max_reason_tokens:
-            for tok in printable:
-                b.edge(st, tok, reason_states[i + 1])
-    # at the cap, only the quote is allowed (handled: last state has only quote)
+    if style == "direct":
+        # {"selected_node": "<name>", "confidence": 0.x, "reasoning": "…"}
+        s = b.chain(start, tokenizer.encode('{"selected_node": "'))
+        post_name = wire_name_trie(s)
+        s = b.chain(post_name, tokenizer.encode(', "confidence": '))
+        conf_ends = wire_confidence(s)
+        comma = tokenizer.encode(",")[0]
+        after_num = b.new_state()
+        for st in conf_ends:
+            b.edge(st, comma, after_num)
+        reason_start = b.chain(after_num, tokenizer.encode(' "reasoning": "'))
+        close_q = wire_reasoning(reason_start)
+        close_b = b.chain(close_q, tokenizer.encode('}'))
+        b.edge(close_b, tokenizer.eos_id, done)
+    else:
+        # {"reasoning": "…", "selected_node": "<name>", "confidence": 0.x}
+        s = b.chain(start, tokenizer.encode('{"reasoning": "'))
+        close_q = wire_reasoning(s)
+        s = b.chain(close_q, tokenizer.encode(', "selected_node": "'))
+        post_name = wire_name_trie(s)
+        s = b.chain(post_name, tokenizer.encode(', "confidence": '))
+        conf_ends = wire_confidence(s)
+        brace = tokenizer.encode('}')[0]
+        close_b = b.new_state()
+        for st in conf_ends:
+            b.edge(st, brace, close_b)
+        b.edge(close_b, tokenizer.eos_id, done)
 
     # done state: self-loop on pad so finished slots stay well-defined
     b.edge(done, tokenizer.pad_id, done)

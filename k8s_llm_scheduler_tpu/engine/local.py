@@ -107,8 +107,13 @@ class LocalLLMBackend:
         group_switch_after_s: float = 0.25,
         partial_hold_s: float = 0.03,
         prewarm_idle_delay_s: float = 0.5,
+        answer_style: str = "direct",
     ) -> None:
         self.engine = engine
+        # Decision JSON field order: "direct" (reference serialization) or
+        # "cot" (reasoning emitted BEFORE the constrained node choice —
+        # engine/constrained.py). The parsed object is identical.
+        self.answer_style = answer_style
         # Idle grace before a sibling-geometry prewarm compile may start:
         # a jit blocks the worker for seconds, so it must not fire the
         # instant the queue empties — a burst's next round often arrives
@@ -241,7 +246,8 @@ class LocalLLMBackend:
                     f"need >= {62 + longest_name}"
                 )
             self._dfa_cache[key] = build_decision_dfa(
-                self.tokenizer, list(key), max_reason_tokens=min(budget, 120)
+                self.tokenizer, list(key), max_reason_tokens=min(budget, 120),
+                style=self.answer_style,
             )
         return self._dfa_cache[key]
 
@@ -578,6 +584,7 @@ def build_local_backend(
     partial_hold_s: float = 0.03,
     prewarm_idle_delay_s: float = 0.5,
     compile_cache_dir: str | None = "auto",
+    answer_style: str = "direct",
 ) -> LocalLLMBackend:
     """Construct the full local stack: params (from an HF safetensors or
     orbax checkpoint when checkpoint_path is set, random-init otherwise —
@@ -703,4 +710,5 @@ def build_local_backend(
         group_switch_after_s=group_switch_after_s,
         partial_hold_s=partial_hold_s,
         prewarm_idle_delay_s=prewarm_idle_delay_s,
+        answer_style=answer_style,
     )

@@ -41,6 +41,7 @@ import dataclasses
 import itertools
 import json
 import logging
+import math
 import socket
 import struct
 import threading
@@ -535,13 +536,17 @@ class _ReplicaHealth:
     """Per-replica dispatch state: in-flight count, latency EMA, failure
     cooldown. Mutated under the owning FanoutBackend's lock."""
 
-    __slots__ = ("inflight", "ema_s", "failures", "cooldown_until")
+    __slots__ = ("inflight", "ema_s", "failures", "cooldown_until", "probing")
 
     def __init__(self) -> None:
         self.inflight = 0
         self.ema_s = 0.0  # 0 = no sample yet (treated as fast/unknown)
         self.failures = 0
         self.cooldown_until = 0.0
+        # set when the current request is a starvation probe: its sample
+        # REPLACES the (stale) EMA instead of blending — the whole point
+        # of the probe is re-measurement
+        self.probing = False
 
 
 class FanoutBackend:
@@ -566,6 +571,26 @@ class FanoutBackend:
     COOLDOWN_BASE_S = 0.5
     COOLDOWN_CAP_S = 30.0
     EMA_ALPHA = 0.2
+    # A replica not routed to for PROBE_IDLE_S gets one probe request: the
+    # EMA only updates on routed requests, so without re-probing one
+    # transient slow sample (cold compile, GC pause) would starve a
+    # healthy replica forever. Two gates bound the probe cost:
+    # - TIME (idle >= PROBE_IDLE_S): pick-counted probes at burst rates
+    #   would re-route a slow replica's full latency into the burst every
+    #   N decisions (~30% capacity at 400/s measured);
+    # - COUNT (>= PROBE_EVERY_PICKS dispatches since the last probe):
+    #   under SPARSE traffic (inter-arrival > PROBE_IDLE_S) the time gate
+    #   alone would make every request a probe, degenerating dispatch to
+    #   alternation — the count gate caps probes at 1/PROBE_EVERY_PICKS
+    #   of traffic regardless of rate.
+    PROBE_IDLE_S = 5.0
+    PROBE_EVERY_PICKS = 8
+    # Replicas slower than SLOW_EXCLUDE_RATIO x the fastest EMA receive
+    # no cost-picked traffic at all (probes only): decisions are latency-
+    # sensitive, and inflight pressure on the fast replicas would
+    # otherwise leak band-tied picks onto a 10x replica exactly at burst
+    # peaks — where its full latency lands on the burst's tail.
+    SLOW_EXCLUDE_RATIO = 4.0
 
     def __init__(self, replicas: Sequence[Any]) -> None:
         if not replicas:
@@ -575,6 +600,9 @@ class FanoutBackend:
         self._health = [_ReplicaHealth() for _ in self.replicas]
         self._lock = threading.Lock()
         self._rr = itertools.count()  # tiebreak rotation among equals
+        self._last_routed_t = [time.monotonic()] * len(self.replicas)
+        self._picks_total = 0
+        self._last_probe_pick = 0
 
     # ------------------------------------------------------------- dispatch
     def _pick(self) -> int:
@@ -591,19 +619,55 @@ class FanoutBackend:
             ]
             if not candidates:
                 candidates = list(range(len(self.replicas)))
+            # starvation probe: a candidate idle past PROBE_IDLE_S gets
+            # this request so its EMA can recover — at most one probe per
+            # PROBE_EVERY_PICKS dispatches (see class comment)
+            self._picks_total += 1
+            starved = [
+                i for i in candidates
+                if now - self._last_routed_t[i] >= self.PROBE_IDLE_S
+            ]
+            if starved and (
+                self._picks_total - self._last_probe_pick
+                >= self.PROBE_EVERY_PICKS
+            ):
+                i = min(starved, key=lambda j: self._last_routed_t[j])
+                self._last_probe_pick = self._picks_total
+                self._last_routed_t[i] = now
+                self._health[i].inflight += 1
+                self._health[i].probing = True
+                self.routed[i] += 1
+                return i
+
+            # slow exclusion: drop way-slower replicas from the cost pick
+            # (probes above keep their EMAs fresh so they can rejoin)
+            min_ema = min(
+                (h.ema_s for h in self._health if h.ema_s), default=0.0
+            )
+            if min_ema:
+                fast_enough = [
+                    i for i in candidates
+                    if not self._health[i].ema_s
+                    or self._health[i].ema_s
+                    <= self.SLOW_EXCLUDE_RATIO * min_ema
+                ]
+                if fast_enough:
+                    candidates = fast_enough
 
             def cost(i: int) -> tuple:
                 h = self._health[i]
                 # unknown latency ranks as the fastest observed (optimistic
-                # first sample); +rotation index breaks exact ties so equal
-                # replicas still share work evenly
-                ema = h.ema_s or min(
-                    (x.ema_s for x in self._health if x.ema_s), default=0.0
-                )
-                return ((h.inflight + 1) * (ema or 1e-6),
-                        (i + rotate) % len(self.replicas))
+                # first sample). The load score is BANDED (~25% classes):
+                # µs-level EMA noise between equal replicas must not make
+                # one a permanent winner under sequential traffic — within
+                # a band the rotation index shares work evenly.
+                ema = h.ema_s or min_ema
+                score = (h.inflight + 1) * (ema or 1e-6)
+                band = int(math.log(score) / math.log(1.25))
+                return (band, (i + rotate) % len(self.replicas))
 
             i = min(candidates, key=cost)
+            self._last_routed_t[i] = now
             self._health[i].inflight += 1
             self.routed[i] += 1
             return i
@@ -624,10 +688,11 @@ class FanoutBackend:
                 h.cooldown_until = 0.0
                 if elapsed_s is not None:
                     h.ema_s = (
-                        elapsed_s if h.ema_s == 0.0
+                        elapsed_s if (h.ema_s == 0.0 or h.probing)
                         else (1 - self.EMA_ALPHA) * h.ema_s
                         + self.EMA_ALPHA * elapsed_s
                     )
+            h.probing = False
 
     def get_scheduling_decision(
         self, pod: PodSpec, nodes: Sequence[NodeMetrics]

@@ -68,6 +68,22 @@ def random_cases(n_nodes: int = 5, seed: int = 0):
 ANSWER_PREFIX = '{"selected_node": "'
 
 
+def teacher_cot(pod, nodes) -> str:
+    """The teacher's serialized comparison: per-feasible-node resource-
+    balanced scores (integers — single NUM tokens under the numeric
+    tokenizer) and the argmax, in prompt order. Used as the reasoning
+    field in answer_style='cot' training pairs: the model learns to EMIT
+    this computation before the constrained node choice, turning a
+    one-shot global argmax into a stepwise comparison it can attend to."""
+    from k8s_llm_scheduler_tpu.core.fallback import score_resource_balanced
+    from k8s_llm_scheduler_tpu.core.validation import feasible_nodes
+
+    cand = feasible_nodes(pod, nodes)
+    parts = [f"{n.name}={score_resource_balanced(n):.0f}" for n in cand]
+    best = max(cand, key=score_resource_balanced)
+    return " ".join(parts) + f" best={best.name}"
+
+
 def easy_cases(n_nodes: int = 3, seed: int = 1):
     """Curriculum stream: small clusters where ONE node dominates the
     teacher score by a wide margin (low usage + low pod count vs loaded
@@ -118,22 +134,25 @@ def teacher_pairs(
     n_nodes: int = 5,
     seed: int = 0,
     easy_frac: float = 0.0,
-) -> Iterator[tuple[list[int], int, tuple[int, int]]]:
-    """Endless (prompt + decision tokens, answer_start, name_span) samples
-    from the heuristic teacher over randomized synthetic clusters.
+    answer_style: str = "direct",
+) -> Iterator[tuple[list[int], int, tuple[int, int], tuple[int, int]]]:
+    """Endless (prompt + decision tokens, answer_start, name_span,
+    cot_span) samples from the heuristic teacher over randomized synthetic
+    clusters.
 
     Each sample is the full chat prompt (system + cluster state + pod)
     followed by the teacher's decision JSON and EOS — exactly the
-    sequence the serving path decodes. `answer_start` is the index of the
-    first decision token: the loss masks to the answer span
-    (train_step.causal_lm_loss loss_start), because a ~60-token answer
-    behind a ~1.5k-token prompt otherwise contributes ~4% of the gradient
-    and the decision head stays near uniform for hundreds of steps.
-    `name_span` is the (start, end) token range of the selected_node
-    VALUE inside the answer — the only informative tokens of the whole
-    sequence; make_batches upweights them (EVAL.md finding 4)."""
+    sequence the serving path decodes with the same answer_style.
+    `answer_start` is the index of the first decision token: the loss
+    masks to the answer span (train_step.causal_lm_loss loss_start),
+    because a ~60-token answer behind a ~1.5k-token prompt otherwise
+    contributes ~4% of the gradient and the decision head stays near
+    uniform for hundreds of steps. `name_span` is the (start, end) token
+    range of the selected_node VALUE — the decision-bearing tokens
+    (EVAL.md finding 4); `cot_span` is the reasoning VALUE's range when
+    answer_style='cot' (the teacher's serialized per-node scores,
+    teacher_cot), else (0, 0). make_batches upweights both."""
     pe = PromptEngine()
-    prefix_ids = tokenizer.encode(ANSWER_PREFIX)
 
     def mixed_cases():
         hard = random_cases(n_nodes=n_nodes, seed=seed)
@@ -155,19 +174,35 @@ def teacher_pairs(
         prompt = tokenizer.chat_prompt(
             pe.system_prompt, cluster_part + pod_part
         )
-        answer = json.dumps(
-            {
-                "selected_node": decision.selected_node,
-                "confidence": round(decision.confidence, 2),
-                "reasoning": "resource balanced",
-            }
-        )
+        if answer_style == "cot":
+            cot = teacher_cot(pod, nodes)
+            answer = json.dumps(
+                {
+                    "reasoning": cot,
+                    "selected_node": decision.selected_node,
+                    "confidence": round(decision.confidence, 2),
+                }
+            )
+            cot_start = len(prompt) + len(tokenizer.encode('{"reasoning": "'))
+            cot_span = (cot_start, cot_start + len(tokenizer.encode(cot)))
+            name_prefix = f'{{"reasoning": "{cot}", "selected_node": "'
+        else:
+            answer = json.dumps(
+                {
+                    "selected_node": decision.selected_node,
+                    "confidence": round(decision.confidence, 2),
+                    "reasoning": "resource balanced",
+                }
+            )
+            cot_span = (0, 0)
+            name_prefix = ANSWER_PREFIX
         name_len = len(tokenizer.encode(decision.selected_node))
-        name_start = len(prompt) + len(prefix_ids)
+        name_start = len(prompt) + len(tokenizer.encode(name_prefix))
         yield (
             prompt + tokenizer.encode(answer) + [tokenizer.eos_id],
             len(prompt),
             (name_start, name_start + name_len),
+            cot_span,
         )
 
 
@@ -179,14 +214,18 @@ def make_batches(
     seed: int = 0,
     name_weight: float = 8.0,
     easy_frac: float = 0.0,
+    answer_style: str = "direct",
+    cot_weight: float = 4.0,
 ) -> Iterator[tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]]:
     """Batched, padded (tokens, seq_lens, answer_starts, loss_weights) for
     the train step (answer_starts feeds the loss mask; loss_weights
     upweight the FINAL selected_node value token by `name_weight` — the
     corpus' names share a 'node-' prefix, so the last token is the one
-    decision-bearing choice of a ~70-token mostly-deterministic answer)."""
+    decision-bearing choice of a ~70-token mostly-deterministic answer —
+    and, for answer_style='cot', the reasoning scores by `cot_weight`)."""
     pairs = teacher_pairs(
-        tokenizer, n_nodes=n_nodes, seed=seed, easy_frac=easy_frac
+        tokenizer, n_nodes=n_nodes, seed=seed, easy_frac=easy_frac,
+        answer_style=answer_style,
     )
     pad = tokenizer.pad_id
     warned = False
@@ -196,7 +235,7 @@ def make_batches(
         starts = np.zeros(batch_size, dtype=np.int32)
         weights = np.ones((batch_size, seq_len), dtype=np.float32)
         for b in range(batch_size):
-            ids, ans_start, (ns, ne) = next(pairs)
+            ids, ans_start, (ns, ne), (cs, ce) = next(pairs)
             if len(ids) > seq_len:
                 # Truncate from the LEFT: the decision JSON lives at the
                 # tail, and a distillation batch that drops the answer
@@ -205,6 +244,7 @@ def make_batches(
                 ids = ids[-seq_len:]
                 ans_start = max(0, ans_start - cut)
                 ns, ne = max(0, ns - cut), max(0, ne - cut)
+                cs, ce = max(0, cs - cut), max(0, ce - cut)
                 if not warned:
                     logger.warning(
                         "teacher pairs exceed seq_len=%d; truncating prompt "
@@ -214,6 +254,8 @@ def make_batches(
             tokens[b, : len(ids)] = ids
             lens[b] = len(ids)
             starts[b] = ans_start
+            if ce > cs:
+                weights[b, cs:ce] = cot_weight
             if ne > ns:
                 weights[b, ne - 1] = name_weight
         yield tokens, lens, starts, weights
@@ -257,15 +299,6 @@ def numeric_embedding_init(params, tokenizer) -> None:
     params["embed"] = new
 
 
-def build_tokenizer(name: str, cfg):
-    """(tokenizer, possibly-widened cfg) — delegates to THE shared rule
-    (engine/tokenizer.build_builtin_tokenizer) so checkpoints trained
-    here restore into build_local_backend shape-for-shape."""
-    from k8s_llm_scheduler_tpu.engine.tokenizer import build_builtin_tokenizer
-
-    return build_builtin_tokenizer(name, cfg)
-
-
 def make_agreement_probe(
     cfg,
     tokenizer: Tokenizer,
@@ -273,6 +306,7 @@ def make_agreement_probe(
     n_nodes: int = 5,
     seed: int = 30_011,
     seq_len: int = 2048,
+    answer_style: str = "direct",
 ):
     """Build `probe(params) -> agreement` — greedy-serving-equivalent
     teacher agreement, cheap enough to run every few hundred train steps.
@@ -288,7 +322,16 @@ def make_agreement_probe(
 
     The probe seed is disjoint from BOTH the training stream and
     train/eval.py's held-out seed (10_007): train-time model selection
-    never sees the final report card's cases."""
+    never sees the final report card's cases.
+
+    answer_style='cot' probes the ARGMAX MOMENT teacher-forced: the
+    prefix is the teacher's per-node scores up to ' best=node-' and the
+    probed token is the argmax digit — i.e. "given correct scores in
+    context, does the model pick their max?". (Probing the constrained
+    selected_node field instead would be trivial: the teacher cot ends
+    'best=node-K', so that token is a copy.) Serving additionally needs
+    the model to GENERATE its scores; the honest end-to-end number comes
+    from `cli eval`."""
     import jax
     import jax.numpy as jnp
 
@@ -296,7 +339,6 @@ def make_agreement_probe(
     from k8s_llm_scheduler_tpu.models.llama import forward_prefill
 
     pe = PromptEngine()
-    prefix_ids = tokenizer.encode(ANSWER_PREFIX)
     cases = random_cases(n_nodes=n_nodes, seed=seed)
     rows, row_meta = [], []
     while len(rows) < n_cases:
@@ -316,9 +358,17 @@ def make_agreement_probe(
             # need full per-name scoring; this corpus never produces them
             continue
         cluster_part, pod_part = pe.split_prompt(pod, nodes)
+        if answer_style == "cot":
+            cot = teacher_cot(pod, nodes)
+            # up to 'best=' EXCLUSIVE of the final 'node-' — the shared
+            # name-prefix tokens are appended below with `shared`, and the
+            # probed token is the argmax digit over the in-context scores
+            prefix_str = '{"reasoning": "' + cot[: cot.rfind("node-")]
+        else:
+            prefix_str = ANSWER_PREFIX
         ids = (
             tokenizer.chat_prompt(pe.system_prompt, cluster_part + pod_part)
-            + prefix_ids
+            + tokenizer.encode(prefix_str)
             + shared
         )
         if len(ids) > seq_len:
@@ -373,6 +423,8 @@ def train_and_save(
     easy_frac: float = 0.0,
     numeric_init: bool = True,
     save_every: int = 0,
+    resume: bool = False,
+    answer_style: str = "direct",
 ) -> float:
     """Run `steps` of answer-masked fine-tuning on teacher pairs and save
     an orbax checkpoint servable via checkpoint_path. Returns the final
@@ -391,7 +443,11 @@ def train_and_save(
     from k8s_llm_scheduler_tpu.parallel.mesh import mesh_from_config
     from k8s_llm_scheduler_tpu.train.train_step import make_train_step
 
-    tokenizer, cfg = build_tokenizer(tokenizer_name, cfg)
+    # THE shared vocab rule (engine/tokenizer.py): serving applies the
+    # same widening, so checkpoints restore shape-for-shape
+    from k8s_llm_scheduler_tpu.engine.tokenizer import build_builtin_tokenizer
+
+    tokenizer, cfg = build_builtin_tokenizer(tokenizer_name, cfg)
     if jax.process_count() > 1:
         # Multi-host: dp/fsdp span processes (DCN), tp/sp stay within one
         # host (ICI) — mesh_from_config's flat device slice is process-
@@ -417,17 +473,43 @@ def train_and_save(
         optimizer = optax.adamw(lr)
     init_fn, step_fn = make_train_step(cfg, mesh, optimizer=optimizer)
     state = init_fn(jax.random.PRNGKey(seed))
-    if numeric_init and jax.process_count() == 1:
+    resumed = False
+    if resume:
+        import os
+
+        from k8s_llm_scheduler_tpu.models.loader import restore_checkpoint
+
+        if os.path.isdir(out_dir):
+            # Resume PARAMS from the latest snapshot (a multi-hour run
+            # over a flaky transport must survive a restart). Optimizer
+            # moments restart fresh — with warmup in the schedule that
+            # costs a brief re-adaptation, not the banked steps. Restore
+            # DIRECT-TO-SHARD onto the training mesh with the same
+            # tp/fsdp axes make_train_step shards with — a meshless
+            # restore would mix single-device params into a mesh-sharded
+            # opt_state.
+            params = restore_checkpoint(
+                out_dir, cfg,
+                mesh if mesh.devices.size > 1 else None,
+                tp="tp" if mesh.shape.get("tp", 1) > 1 else None,
+                fsdp="fsdp" if mesh.shape.get("fsdp", 1) > 1 else None,
+            )
+            state = state._replace(params=params)
+            resumed = True
+            logger.info("resumed params from %s", out_dir)
+    if not resumed and numeric_init and jax.process_count() == 1:
         # magnitude-aware NUM embedding seed (no-op for byte tokenizer);
         # multi-host skips it — re-placing one leaf of a dcn-sharded tree
         # is not worth the complexity for a warm-start heuristic
         numeric_embedding_init(state.params, tokenizer)
     batches = make_batches(
         tokenizer, batch_size, seq_len, seed=seed, name_weight=name_weight,
-        easy_frac=easy_frac,
+        easy_frac=easy_frac, answer_style=answer_style,
     )
     probe = (
-        make_agreement_probe(cfg, tokenizer, seq_len=seq_len)
+        make_agreement_probe(
+            cfg, tokenizer, seq_len=seq_len, answer_style=answer_style
+        )
         if probe_every
         else None
     )
@@ -443,8 +525,10 @@ def train_and_save(
             logger.info("step %d/%d loss %.4f", step, steps, loss)
         if probe is not None and (step % probe_every == 0 or step == steps):
             logger.info(
-                "step %d/%d held-out greedy agreement %.1f%%",
-                step, steps, 100.0 * probe(state.params),
+                "step %d/%d held-out greedy agreement%s %.1f%%",
+                step, steps,
+                " (teacher-forced CoT)" if answer_style == "cot" else "",
+                100.0 * probe(state.params),
             )
         if (
             save_every

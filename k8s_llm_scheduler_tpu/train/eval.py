@@ -61,6 +61,143 @@ def held_out_cases(
         yield next(cases)
 
 
+SCENARIO_CLASSES = (
+    "uniform", "hetero-capacity", "tainted", "selector", "affinity"
+)
+
+
+def scenario_cases(
+    kind: str,
+    n_nodes: int = 5,
+    seed: int = 40_009,
+) -> Iterator[tuple[PodSpec, list[NodeMetrics]]]:
+    """Held-out cases per scenario class (VERDICT r4 weak #5: the eval
+    previously drew only from the training generator's 5-uniform-node
+    distribution — agreement numbers never saw the constraint dimensions
+    core/validation.py exists for).
+
+    - uniform:         the training distribution (train/distill.random_cases)
+    - hetero-capacity: node sizes/max_pods drawn from distinct SKUs
+    - tainted:         some nodes carry NoSchedule taints; pods may tolerate
+    - selector:        tiered node labels; pods may pin a tier
+    - affinity:        required node-affinity terms over zone labels
+
+    Cases where the teacher abstains (no feasible node) are yielded too —
+    eval_agreement skips them, exactly as it does for the uniform stream.
+    """
+    if kind == "uniform":
+        from k8s_llm_scheduler_tpu.train.distill import random_cases
+
+        yield from random_cases(n_nodes=n_nodes, seed=seed)
+        return
+    if kind not in SCENARIO_CLASSES:
+        raise ValueError(
+            f"unknown scenario {kind!r} (known: {SCENARIO_CLASSES})"
+        )
+    rng = np.random.default_rng(seed)
+    skus = [(4.0, 16.0, 30), (8.0, 32.0, 60), (16.0, 64.0, 110),
+            (64.0, 256.0, 250)]
+    case_idx = 0
+    while True:
+        k = int(rng.integers(2, n_nodes + 1))
+        nodes = []
+        for i in range(k):
+            if kind == "hetero-capacity":
+                cpu_cap, mem_cap, max_pods = skus[int(rng.integers(len(skus)))]
+            else:
+                cpu_cap, mem_cap, max_pods = 16.0, 64.0, 110
+            labels = {"zone": f"z{i % 3}", "tier": ("db" if i % 2 else "web")}
+            taints: tuple = ()
+            if kind == "tainted" and rng.random() < 0.5:
+                taints = (
+                    {"key": "dedicated", "value": "gpu",
+                     "effect": "NoSchedule"},
+                )
+            nodes.append(
+                NodeMetrics(
+                    name=f"node-{i}",
+                    cpu_usage_percent=float(rng.uniform(5, 95)),
+                    memory_usage_percent=float(rng.uniform(5, 95)),
+                    available_cpu_cores=cpu_cap,
+                    available_memory_gb=mem_cap,
+                    pod_count=int(rng.integers(0, max_pods // 2)),
+                    max_pods=max_pods,
+                    labels=labels,
+                    taints=taints,
+                    conditions={"Ready": "True"},
+                )
+            )
+        selector = {}
+        tolerations: tuple = ()
+        affinity: dict = {}
+        if kind == "selector" and rng.random() < 0.7:
+            selector = {"tier": "db" if rng.random() < 0.5 else "web"}
+        if kind == "tainted" and rng.random() < 0.6:
+            tolerations = (
+                {"key": "dedicated", "operator": "Equal", "value": "gpu",
+                 "effect": "NoSchedule"},
+            )
+        if kind == "affinity" and rng.random() < 0.8:
+            zones = [f"z{z}" for z in rng.choice(3, size=2, replace=False)]
+            affinity = {
+                "node_affinity_terms": [
+                    [{"key": "zone", "operator": "In", "values": zones}]
+                ]
+            }
+        yield (
+            PodSpec(
+                name=f"{kind}-pod-{case_idx}",
+                namespace="default",
+                cpu_request=round(float(rng.uniform(0.05, 6.0)), 3),
+                memory_request=round(float(rng.uniform(0.064, 24.0)), 3),
+                node_selector=selector,
+                tolerations=tolerations,
+                affinity_rules=affinity,
+                priority=int(rng.integers(0, 5)),
+            ),
+            nodes,
+        )
+        case_idx += 1
+
+
+def eval_agreement_by_scenario(
+    decide: DecideFn,
+    n_cases: int = 32,
+    n_nodes: int = 5,
+    seed: int = 40_009,
+    classes: Sequence[str] = SCENARIO_CLASSES,
+) -> dict[str, dict]:
+    """Per-scenario-class agreement report — the distribution-shift table
+    (VERDICT r4 item 6). Each class gets its own case stream at the same
+    seed so the table is reproducible."""
+    out = {}
+    for kind in classes:
+        cases = scenario_cases(kind, n_nodes=n_nodes, seed=seed)
+        agree = total = valid = 0
+        chance_sum = 0.0
+        attempts = 0
+        while total < n_cases and attempts < n_cases * 8:
+            attempts += 1
+            pod, nodes = next(cases)
+            target = teacher_decide(pod, nodes)
+            if target is None:
+                continue
+            total += 1
+            chance_sum += 1.0 / max(1, len(feasible_nodes(pod, nodes)))
+            got = decide(pod, nodes)
+            if got is not None and got in {n.name for n in nodes}:
+                valid += 1
+                if got == target:
+                    agree += 1
+        out[kind] = {
+            "n_cases": total,
+            "agreement_pct": round(100.0 * agree / max(1, total), 1),
+            "valid_pct": round(100.0 * valid / max(1, total), 1),
+            "chance_pct": round(100.0 * chance_sum / max(1, total), 1),
+        }
+    return out
+
+
 def teacher_decide(pod: PodSpec, nodes: Sequence[NodeMetrics]) -> str | None:
     d = fallback_decision(
         nodes, reason="teacher", strategy="resource_balanced", pod=pod
@@ -214,6 +351,8 @@ def evaluate_checkpoint(
     placement_pods: int = 32,
     backend=None,
     backend_kwargs: dict | None = None,
+    scenarios: bool = False,
+    scenario_cases_n: int = 32,
 ) -> dict:
     """Evaluate a (possibly distilled) decision model end to end through
     the REAL serving stack: prompt -> grammar-constrained wave decode ->
@@ -250,6 +389,10 @@ def evaluate_checkpoint(
         report = evaluate_decider(
             decide, n_cases=n_cases, placement_pods=placement_pods
         )
+        if scenarios:
+            report["scenarios"] = eval_agreement_by_scenario(
+                decide, n_cases=scenario_cases_n
+            )
         report["model"] = model
         report["checkpoint"] = checkpoint_path
         return report

@@ -6,10 +6,13 @@ import json
 import pytest
 
 from k8s_llm_scheduler_tpu.train.eval import (
+    SCENARIO_CLASSES,
     eval_agreement,
+    eval_agreement_by_scenario,
     eval_placement,
     evaluate_checkpoint,
     random_decide_fn,
+    scenario_cases,
     teacher_decide,
 )
 
@@ -35,6 +38,65 @@ class TestMetrics:
         r = eval_agreement(lambda pod, nodes: None, n_cases=16)
         assert r["valid_pct"] == 0.0
         assert r["agreement_pct"] == 0.0
+
+
+class TestScenarioClasses:
+    """Distribution-shift eval guards (VERDICT r4 item 6): each scenario
+    class must actually EXERCISE its constraint dimension, not just
+    relabel the uniform stream."""
+
+    def _constrained_fraction(self, kind, n=200):
+        """Fraction of cases where the constraint dimension removed at
+        least one READY node from the feasible set."""
+        from k8s_llm_scheduler_tpu.core.validation import feasible_nodes
+
+        cases = scenario_cases(kind, seed=7)
+        hit = 0
+        for _ in range(n):
+            pod, nodes = next(cases)
+            ready = [x for x in nodes if x.is_ready]
+            if len(feasible_nodes(pod, nodes)) < len(ready):
+                hit += 1
+        return hit / n
+
+    def test_tainted_class_excludes_untolerated_nodes(self):
+        assert self._constrained_fraction("tainted") > 0.15
+
+    def test_selector_class_narrows_feasible_set(self):
+        assert self._constrained_fraction("selector") > 0.25
+
+    def test_affinity_class_narrows_feasible_set(self):
+        assert self._constrained_fraction("affinity") > 0.25
+
+    def test_hetero_capacity_produces_resource_infeasibility(self):
+        from k8s_llm_scheduler_tpu.core.validation import resources_fit
+
+        cases = scenario_cases("hetero-capacity", seed=7)
+        saw_small, saw_large, saw_unfit = False, False, False
+        for _ in range(200):
+            pod, nodes = next(cases)
+            caps = {n.available_cpu_cores for n in nodes}
+            saw_small |= min(caps) <= 4.0
+            saw_large |= max(caps) >= 64.0
+            saw_unfit |= any(not resources_fit(pod, n) for n in nodes)
+        assert saw_small and saw_large and saw_unfit
+
+    def test_teacher_is_perfect_per_class_and_random_is_not(self):
+        report = eval_agreement_by_scenario(teacher_decide, n_cases=24)
+        assert set(report) == set(SCENARIO_CLASSES)
+        for kind, row in report.items():
+            assert row["agreement_pct"] == 100.0, (kind, row)
+            assert row["valid_pct"] == 100.0, (kind, row)
+            assert row["n_cases"] > 0, kind
+        rnd = eval_agreement_by_scenario(
+            random_decide_fn(5), n_cases=48, classes=("tainted", "selector")
+        )
+        for kind, row in rnd.items():
+            assert abs(row["agreement_pct"] - row["chance_pct"]) < 30.0, row
+
+    def test_unknown_class_rejected(self):
+        with pytest.raises(ValueError, match="unknown scenario"):
+            next(scenario_cases("nope"))
 
 
 @pytest.mark.slow

@@ -129,6 +129,33 @@ class TestLLMEndToEnd:
         assert decision.latency_ms > 0
 
 
+class TestCotAnswerStyle:
+    def test_cot_decision_through_serving_stack(self):
+        """answer_style='cot' (reasoning before the constrained choice):
+        the full serving path still yields a valid decision whose parsed
+        object matches the reference schema — field order is wire-level
+        only."""
+        from k8s_llm_scheduler_tpu.cluster.interface import raw_pod_to_spec
+
+        backend = build_local_backend(
+            cfg=E2E_CFG, max_slots=2, num_pages=64, page_size=64,
+            prefill_buckets=(512, 1024, 2048, 4096),
+            temperature=0.0, answer_style="cot", tokenizer_name="numeric",
+            compile_cache_dir=None,
+        )
+        try:
+            cluster = synthetic_cluster(3)
+            nodes = cluster.get_node_metrics()
+            cluster.close()
+            pod = raw_pod_to_spec(next(iter(pod_burst(1))))
+            d = backend.get_scheduling_decision(pod, nodes)
+            assert d.selected_node in {n.name for n in nodes}
+            assert 0.0 <= d.confidence <= 1.0
+            assert d.source is DecisionSource.LLM
+        finally:
+            backend.close()
+
+
 class TestShardedBackend:
     """Full decision flow with the model tensor-parallel over the virtual
     8-device CPU mesh — the hermetic stand-in for the v5p TP path."""

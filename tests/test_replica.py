@@ -365,6 +365,25 @@ class TestHealthAwareDispatch:
             f"(routed {timed_routing})"
         )
 
+    def test_one_slow_sample_does_not_starve_forever(self):
+        """A transiently-slow replica (one 'cold compile' sample) must be
+        re-probed after PROBE_IDLE_S and recover its share — the EMA only
+        updates on routed requests, so without probing it would be
+        starved permanently."""
+        transient = StubBackend(latency_s=0.3)  # first sample: very slow
+        fast = StubBackend(latency_s=0.01)
+        fan = FanoutBackend([transient, fast])
+        fan.PROBE_IDLE_S = 0.2  # test-speed probe window
+        nodes = make_nodes()
+        fan.get_scheduling_decision(make_pod(0), nodes)  # slow sample
+        transient.latency_s = 0.01  # transient condition over
+        time.sleep(0.25)  # idle past the probe window
+        for i in range(1, 13):
+            fan.get_scheduling_decision(make_pod(i), nodes)
+        # the probe re-sampled it; with matched latencies it shares again
+        assert fan.routed[0] >= 3, fan.routed
+        assert fan.routed[1] >= 3, fan.routed
+
     def test_failing_replica_enters_cooldown_and_recovers(self):
         fast = StubBackend()
         flaky = StubBackend()

@@ -206,7 +206,7 @@ class TestDistill:
         tok = ByteTokenizer()
         it = teacher_pairs(tok, n_nodes=3, seed=0)
         for _ in range(3):
-            ids, ans_start, (ns, ne) = next(it)
+            ids, ans_start, (ns, ne), _cot = next(it)
             assert ids[-1] == tok.eos_id
             assert 0 < ans_start < len(ids)
             text = tok.decode(ids)

@@ -1,0 +1,93 @@
+"""Same-process A/B of the block-decode matmul impls (dense vs ragged).
+
+Cross-run numbers on the tunneled bench chip are weather-confounded
+(dispatch RTT swings 100-250 ms over hours) and 8B-scale runs pay minutes
+of host init + weight transfer EACH — so this harness builds ONE set of
+weights and runs bench.model_throughput's wave phase for both impls
+back to back in one process, interleaved A/B/A/B to cancel slow drift.
+
+Usage:
+    python tools/ab_decode.py --model llama-3.2-1b-instruct
+    python tools/ab_decode.py --model llama-3.1-8b-instruct --quantize int8
+
+Prints one JSON line per (impl, rep) plus a final summary line with the
+decisions/s and decode-MFU ratios (the VERDICT r4 item 2/5 A/B numbers).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import bench  # noqa: E402
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="llama-3.2-1b-instruct")
+    ap.add_argument("--quantize", default=None)
+    ap.add_argument("--slots", type=int, default=16)
+    ap.add_argument("--reps", type=int, default=2)
+    ap.add_argument("--peak-tflops", type=float, default=None)
+    args = ap.parse_args()
+
+    import jax
+
+    from k8s_llm_scheduler_tpu.models.llama import init_params
+
+    cfg = bench.build_cfg(args.model)
+    if args.quantize == "int8":
+        from k8s_llm_scheduler_tpu.models.quant import init_params_int8_host
+
+        params = init_params_int8_host(0, cfg)
+    else:
+        params = init_params(jax.random.PRNGKey(0), cfg)
+
+    results: dict[str, list[dict]] = {"dense": [], "ragged": []}
+    for rep in range(args.reps):
+        for impl in ("dense", "ragged"):
+            r = bench.model_throughput(
+                args.model, args.quantize, args.peak_tflops,
+                slots=args.slots, decode_matmul=impl, params=params,
+            )
+            r["extra"]["rep"] = rep
+            results[impl].append(r)
+            print(json.dumps(r), flush=True)
+
+    def best(impl: str, key: str) -> float:
+        return max(r["extra"][key] for r in results[impl])
+
+    summary = {
+        "metric": "decode_matmul_ab",
+        "model": args.model,
+        "quantize": args.quantize,
+        "reps": args.reps,
+        "decisions_per_s": {
+            impl: [r["extra"]["decisions_per_s"] for r in results[impl]]
+            for impl in results
+        },
+        "mfu_decode": {
+            impl: [r["extra"].get("mfu_decode") for r in results[impl]]
+            for impl in results
+        },
+        "wave_avg_ms": {
+            impl: [r["extra"]["wave_avg_ms"] for r in results[impl]]
+            for impl in results
+        },
+        "speedup_decisions_per_s": round(
+            best("ragged", "decisions_per_s") / best("dense", "decisions_per_s"), 3
+        ),
+    }
+    if results["dense"][0]["extra"].get("mfu_decode") is not None:
+        summary["mfu_decode_ratio"] = round(
+            best("ragged", "mfu_decode") / best("dense", "mfu_decode"), 3
+        )
+    print(json.dumps(summary), flush=True)
+
+
+if __name__ == "__main__":
+    main()
