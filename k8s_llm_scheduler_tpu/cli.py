@@ -453,6 +453,17 @@ def cmd_train(args: argparse.Namespace, cfg: Config) -> int:
     from k8s_llm_scheduler_tpu.models.configs import get_config
     from k8s_llm_scheduler_tpu.train.distill import train_and_save
 
+    if cfg.get("llm.answer_style", "direct") != "cot" and (
+        args.micro_frac or args.cot_weight != 1.0
+    ):
+        # these knobs only shape CoT batches; silently ignoring them
+        # would waste a multi-hour run (reviewer finding)
+        print(
+            "--micro-frac/--cot-weight require llm.answer_style: cot "
+            "(set it in the config or LLM_ANSWER_STYLE)",
+            file=sys.stderr,
+        )
+        return 2
     # Training is SPMD: every process enters the same step (dp/fsdp axes
     # may span hosts via parallel/distributed.multihost_mesh).
     _maybe_init_distributed(cfg)
@@ -473,6 +484,8 @@ def cmd_train(args: argparse.Namespace, cfg: Config) -> int:
         save_every=args.save_every,
         resume=args.resume,
         answer_style=cfg.get("llm.answer_style", "direct"),
+        cot_weight=args.cot_weight,
+        micro_frac=args.micro_frac,
     )
     print(f"final loss {loss:.4f}; checkpoint at {args.out}")
     if args.eval:
@@ -653,6 +666,16 @@ def main(argv: list[str] | None = None) -> int:
         "--name-weight", type=float, default=8.0,
         help="loss upweight on the selected_node value tokens (the one "
              "decision-bearing span of the answer)",
+    )
+    p_train.add_argument(
+        "--cot-weight", type=float, default=1.0,
+        help="loss weight on the CoT score tokens (answer_style=cot); the "
+             "argmax digit and name always carry --name-weight",
+    )
+    p_train.add_argument(
+        "--micro-frac", type=float, default=0.0,
+        help="fraction of batch rows replaced by bare argmax drills "
+             "(answer_style=cot; train-only scaffolding)",
     )
     p_train.add_argument(
         "--probe-every", type=int, default=0,

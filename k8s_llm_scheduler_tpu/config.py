@@ -134,6 +134,8 @@ ENV_OVERRIDES: dict[str, str] = {
     "LLM_TIMEOUT": "llm.timeout",
     "LLM_MAX_BATCH": "llm.max_batch",
     "LLM_CHECKPOINT_PATH": "llm.checkpoint_path",
+    "LLM_TOKENIZER": "llm.tokenizer",
+    "LLM_ANSWER_STYLE": "llm.answer_style",
     "MAX_RETRIES": "llm.max_retries",
     "CACHE_ENABLED": "cache.enabled",
     "CACHE_TTL": "cache.ttl_seconds",

@@ -284,13 +284,31 @@ def _cast(host: np.ndarray, dtype) -> np.ndarray:
 def save_checkpoint(path: str | Path, params: Params) -> None:
     """Write a native orbax checkpoint of the params pytree (overwrites —
     orbax's default refuses an existing dir AFTER a full training run has
-    already been spent)."""
+    already been spent).
+
+    ATOMIC against crashes: orbax's force=True DELETES the existing dir
+    before writing, so a save that wedges mid-transfer (measured on the
+    tunneled bench host) would destroy the only snapshot a --resume run
+    depends on. Write aside, then swap."""
+    import shutil
+
     import orbax.checkpoint as ocp
 
     path = Path(path).resolve()
+    tmp = path.with_name(path.name + ".saving")
+    if tmp.exists():
+        shutil.rmtree(tmp)
     with ocp.StandardCheckpointer() as ckptr:
-        ckptr.save(path, params, force=True)
+        ckptr.save(tmp, params, force=True)
         ckptr.wait_until_finished()
+    old = path.with_name(path.name + ".old")
+    if old.exists():
+        shutil.rmtree(old)
+    if path.exists():
+        path.rename(old)
+    tmp.rename(path)
+    if old.exists():
+        shutil.rmtree(old)
 
 
 def restore_checkpoint(

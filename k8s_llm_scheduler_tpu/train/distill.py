@@ -68,6 +68,28 @@ def random_cases(n_nodes: int = 5, seed: int = 0):
 ANSWER_PREFIX = '{"selected_node": "'
 
 
+def cot_answer_ids(
+    tokenizer: Tokenizer, cot: str, name: str, confidence: float
+) -> tuple[list[int], tuple[int, int], tuple[int, int]]:
+    """(answer token ids incl. EOS, name_span, cot_span) for a CoT-style
+    decision JSON, spans RELATIVE to the answer start. THE single place
+    the span arithmetic matches json.dumps serialization — teacher_pairs
+    and the micro drills both build through here so a format change can
+    never silently shift one of their weighted spans."""
+    answer = json.dumps({
+        "reasoning": cot,
+        "selected_node": name,
+        "confidence": round(confidence, 2),
+    })
+    cs = len(tokenizer.encode('{"reasoning": "'))
+    ce = cs + len(tokenizer.encode(cot))
+    np_ = len(
+        tokenizer.encode(f'{{"reasoning": "{cot}", "selected_node": "')
+    )
+    ne = np_ + len(tokenizer.encode(name))
+    return tokenizer.encode(answer) + [tokenizer.eos_id], (np_, ne), (cs, ce)
+
+
 def teacher_cot(pod, nodes) -> str:
     """The teacher's serialized comparison: per-feasible-node resource-
     balanced scores (integers — single NUM tokens under the numeric
@@ -151,7 +173,10 @@ def teacher_pairs(
     range of the selected_node VALUE — the decision-bearing tokens
     (EVAL.md finding 4); `cot_span` is the reasoning VALUE's range when
     answer_style='cot' (the teacher's serialized per-node scores,
-    teacher_cot), else (0, 0). make_batches upweights both."""
+    teacher_cot), else (0, 0). The LAST token of the cot span is the
+    `best=node-K` argmax digit — the comparison moment itself —
+    and make_batches weights it like the name token (under cot_weight
+    alone it carried ~2% of the gradient, diluted by its own scores)."""
     pe = PromptEngine()
 
     def mixed_cases():
@@ -175,34 +200,30 @@ def teacher_pairs(
             pe.system_prompt, cluster_part + pod_part
         )
         if answer_style == "cot":
-            cot = teacher_cot(pod, nodes)
-            answer = json.dumps(
-                {
-                    "reasoning": cot,
-                    "selected_node": decision.selected_node,
-                    "confidence": round(decision.confidence, 2),
-                }
+            ans_ids, (ns, ne), (cs, ce) = cot_answer_ids(
+                tokenizer, teacher_cot(pod, nodes),
+                decision.selected_node, decision.confidence,
             )
-            cot_start = len(prompt) + len(tokenizer.encode('{"reasoning": "'))
-            cot_span = (cot_start, cot_start + len(tokenizer.encode(cot)))
-            name_prefix = f'{{"reasoning": "{cot}", "selected_node": "'
-        else:
-            answer = json.dumps(
-                {
-                    "selected_node": decision.selected_node,
-                    "confidence": round(decision.confidence, 2),
-                    "reasoning": "resource balanced",
-                }
+            off = len(prompt)
+            yield (
+                prompt + ans_ids, off,
+                (off + ns, off + ne), (off + cs, off + ce),
             )
-            cot_span = (0, 0)
-            name_prefix = ANSWER_PREFIX
+            continue
+        answer = json.dumps(
+            {
+                "selected_node": decision.selected_node,
+                "confidence": round(decision.confidence, 2),
+                "reasoning": "resource balanced",
+            }
+        )
         name_len = len(tokenizer.encode(decision.selected_node))
-        name_start = len(prompt) + len(tokenizer.encode(name_prefix))
+        name_start = len(prompt) + len(tokenizer.encode(ANSWER_PREFIX))
         yield (
             prompt + tokenizer.encode(answer) + [tokenizer.eos_id],
             len(prompt),
             (name_start, name_start + name_len),
-            cot_span,
+            (0, 0),
         )
 
 
@@ -215,18 +236,43 @@ def make_batches(
     name_weight: float = 8.0,
     easy_frac: float = 0.0,
     answer_style: str = "direct",
-    cot_weight: float = 4.0,
+    cot_weight: float = 1.0,
+    micro_frac: float = 0.0,
 ) -> Iterator[tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]]:
     """Batched, padded (tokens, seq_lens, answer_starts, loss_weights) for
     the train step (answer_starts feeds the loss mask; loss_weights
     upweight the FINAL selected_node value token by `name_weight` — the
     corpus' names share a 'node-' prefix, so the last token is the one
     decision-bearing choice of a ~70-token mostly-deterministic answer —
-    and, for answer_style='cot', the reasoning scores by `cot_weight`)."""
+    and, for answer_style='cot', the reasoning scores by `cot_weight`).
+
+    `micro_frac` (cot only): fraction of batch rows replaced by BARE
+    answer-shaped argmax drills — '{"reasoning": "node-0=61 ...
+    best=node-K", "selected_node": "node-K", ...}' with random scores and
+    no prompt. A 1M-param model learns the isolated comparison in ~250
+    steps while the full-prompt task leaves the argmax digit at a
+    position bias for thousands (measured; the score REGRESSION learns
+    fine) — these rows inject that concentrated signal; RoPE's relative
+    attention transfers the local comparison circuit to answers sitting
+    behind a 1.5k-token prompt. Train-only scaffolding: the eval never
+    sees them."""
     pairs = teacher_pairs(
         tokenizer, n_nodes=n_nodes, seed=seed, easy_frac=easy_frac,
         answer_style=answer_style,
     )
+    micro_rng = np.random.default_rng(seed + 7)
+
+    def micro_row() -> tuple[list[int], int, tuple, tuple]:
+        k = int(micro_rng.integers(2, n_nodes + 1))
+        vals = micro_rng.choice(101, size=k, replace=False)
+        best = int(np.argmax(vals))
+        cot = " ".join(
+            f"node-{i}={v}" for i, v in enumerate(vals)
+        ) + f" best=node-{best}"
+        ids, name_span, cot_span = cot_answer_ids(
+            tokenizer, cot, f"node-{best}", 0.4
+        )
+        return ids, 0, name_span, cot_span
     pad = tokenizer.pad_id
     warned = False
     while True:
@@ -235,7 +281,14 @@ def make_batches(
         starts = np.zeros(batch_size, dtype=np.int32)
         weights = np.ones((batch_size, seq_len), dtype=np.float32)
         for b in range(batch_size):
-            ids, ans_start, (ns, ne), (cs, ce) = next(pairs)
+            if (
+                micro_frac
+                and answer_style == "cot"
+                and micro_rng.random() < micro_frac
+            ):
+                ids, ans_start, (ns, ne), (cs, ce) = micro_row()
+            else:
+                ids, ans_start, (ns, ne), (cs, ce) = next(pairs)
             if len(ids) > seq_len:
                 # Truncate from the LEFT: the decision JSON lives at the
                 # tail, and a distillation batch that drops the answer
@@ -256,6 +309,8 @@ def make_batches(
             starts[b] = ans_start
             if ce > cs:
                 weights[b, cs:ce] = cot_weight
+                # the cot's final token is the 'best=node-K' argmax digit
+                weights[b, ce - 1] = name_weight
             if ne > ns:
                 weights[b, ne - 1] = name_weight
         yield tokens, lens, starts, weights
@@ -280,7 +335,9 @@ def numeric_embedding_init(params, tokenizer) -> None:
     import jax
 
     orig = params["embed"]
-    embed = np_mod.asarray(orig, dtype=np_mod.float32)
+    # np.array, not asarray: a CPU-backend jax array yields a READ-ONLY
+    # zero-copy view under asarray and the row assignment below crashes
+    embed = np_mod.array(orig, dtype=np_mod.float32)
     k = np_mod.arange(NumericTokenizer.NUM_COUNT, dtype=np_mod.float32)
     v = k / float(NumericTokenizer.NUM_COUNT - 1)
     feats = []
@@ -425,6 +482,8 @@ def train_and_save(
     save_every: int = 0,
     resume: bool = False,
     answer_style: str = "direct",
+    cot_weight: float = 1.0,
+    micro_frac: float = 0.0,
 ) -> float:
     """Run `steps` of answer-masked fine-tuning on teacher pairs and save
     an orbax checkpoint servable via checkpoint_path. Returns the final
@@ -479,7 +538,22 @@ def train_and_save(
 
         from k8s_llm_scheduler_tpu.models.loader import restore_checkpoint
 
-        if os.path.isdir(out_dir):
+        restore_dir = out_dir
+        if not os.path.isdir(restore_dir):
+            # close save_checkpoint's swap window: a crash between the
+            # renames leaves the snapshot at .old (or fully written at
+            # .saving) — resume from those rather than silently
+            # restarting from random init
+            for suffix in (".old", ".saving"):
+                sibling = out_dir.rstrip("/") + suffix
+                if os.path.isdir(sibling):
+                    restore_dir = sibling
+                    logger.warning(
+                        "resume: %s missing; falling back to %s",
+                        out_dir, sibling,
+                    )
+                    break
+        if os.path.isdir(restore_dir):
             # Resume PARAMS from the latest snapshot (a multi-hour run
             # over a flaky transport must survive a restart). Optimizer
             # moments restart fresh — with warmup in the schedule that
@@ -489,14 +563,14 @@ def train_and_save(
             # restore would mix single-device params into a mesh-sharded
             # opt_state.
             params = restore_checkpoint(
-                out_dir, cfg,
+                restore_dir, cfg,
                 mesh if mesh.devices.size > 1 else None,
                 tp="tp" if mesh.shape.get("tp", 1) > 1 else None,
                 fsdp="fsdp" if mesh.shape.get("fsdp", 1) > 1 else None,
             )
             state = state._replace(params=params)
             resumed = True
-            logger.info("resumed params from %s", out_dir)
+            logger.info("resumed params from %s", restore_dir)
     if not resumed and numeric_init and jax.process_count() == 1:
         # magnitude-aware NUM embedding seed (no-op for byte tokenizer);
         # multi-host skips it — re-placing one leaf of a dcn-sharded tree
@@ -505,6 +579,7 @@ def train_and_save(
     batches = make_batches(
         tokenizer, batch_size, seq_len, seed=seed, name_weight=name_weight,
         easy_frac=easy_frac, answer_style=answer_style,
+        cot_weight=cot_weight, micro_frac=micro_frac,
     )
     probe = (
         make_agreement_probe(
