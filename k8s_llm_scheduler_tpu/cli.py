@@ -486,6 +486,7 @@ def cmd_train(args: argparse.Namespace, cfg: Config) -> int:
         answer_style=cfg.get("llm.answer_style", "direct"),
         cot_weight=args.cot_weight,
         micro_frac=args.micro_frac,
+        seed=args.seed,
     )
     print(f"final loss {loss:.4f}; checkpoint at {args.out}")
     if args.eval:
@@ -688,6 +689,11 @@ def main(argv: list[str] | None = None) -> int:
     p_train.add_argument(
         "--resume", action="store_true",
         help="resume params from --out's latest snapshot if present",
+    )
+    p_train.add_argument(
+        "--seed", type=int, default=0,
+        help="init + data-stream seed; vary it on resumed continuations "
+             "so the stream does not replay from the start",
     )
     p_train.add_argument(
         "--easy-frac", type=float, default=0.0,

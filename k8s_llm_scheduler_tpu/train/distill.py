@@ -81,6 +81,14 @@ def cot_answer_ids(
         "selected_node": name,
         "confidence": round(confidence, 2),
     })
+    expected_prefix = f'{{"reasoning": "{cot}", "selected_node": "{name}"'
+    if not answer.startswith(expected_prefix):
+        # json.dumps escaped something (quote/backslash/non-ASCII in a
+        # logged name or cot) — the span arithmetic below would silently
+        # land the loss weights on the wrong tokens
+        raise ValueError(
+            f"cot/name not serialization-transparent: {answer[:80]!r}"
+        )
     cs = len(tokenizer.encode('{"reasoning": "'))
     ce = cs + len(tokenizer.encode(cot))
     np_ = len(
@@ -262,17 +270,32 @@ def make_batches(
     )
     micro_rng = np.random.default_rng(seed + 7)
 
-    def micro_row() -> tuple[list[int], int, tuple, tuple]:
+    def micro_row(prompt_ids: list[int]) -> tuple[list[int], int, tuple, tuple]:
+        """Argmax drill AT REALISTIC POSITIONS: a random-length slice of a
+        REAL prompt (pure distractor context), then a CoT answer with
+        RANDOM scores. The returned loss_start points at the argmax digit
+        itself: the drill's scores are random (not derivable from the
+        mismatched prompt slice), so supervising them would teach noise —
+        only the comparison (digit), the post-cot format, and the name
+        copy carry loss."""
         k = int(micro_rng.integers(2, n_nodes + 1))
         vals = micro_rng.choice(101, size=k, replace=False)
         best = int(np.argmax(vals))
         cot = " ".join(
             f"node-{i}={v}" for i, v in enumerate(vals)
         ) + f" best=node-{best}"
-        ids, name_span, cot_span = cot_answer_ids(
+        ans, (ns, ne), (cs, ce) = cot_answer_ids(
             tokenizer, cot, f"node-{best}", 0.4
         )
-        return ids, 0, name_span, cot_span
+        max_fill = max(0, min(len(prompt_ids), seq_len - len(ans)))
+        fill = int(micro_rng.integers(0, max_fill + 1))
+        ids = prompt_ids[:fill] + ans
+        return (
+            ids,
+            fill + ce - 1,  # loss from the argmax digit onward
+            (fill + ns, fill + ne),
+            (fill + cs, fill + ce),
+        )
     pad = tokenizer.pad_id
     warned = False
     while True:
@@ -281,14 +304,16 @@ def make_batches(
         starts = np.zeros(batch_size, dtype=np.int32)
         weights = np.ones((batch_size, seq_len), dtype=np.float32)
         for b in range(batch_size):
+            ids, ans_start, (ns, ne), (cs, ce) = next(pairs)
             if (
                 micro_frac
                 and answer_style == "cot"
                 and micro_rng.random() < micro_frac
             ):
-                ids, ans_start, (ns, ne), (cs, ce) = micro_row()
-            else:
-                ids, ans_start, (ns, ne), (cs, ce) = next(pairs)
+                # reuse this pair's PROMPT as the drill's distractor fill
+                ids, ans_start, (ns, ne), (cs, ce) = micro_row(
+                    ids[:ans_start]
+                )
             if len(ids) > seq_len:
                 # Truncate from the LEFT: the decision JSON lives at the
                 # tail, and a distillation batch that drops the answer
@@ -541,10 +566,11 @@ def train_and_save(
         restore_dir = out_dir
         if not os.path.isdir(restore_dir):
             # close save_checkpoint's swap window: a crash between the
-            # renames leaves the snapshot at .old (or fully written at
-            # .saving) — resume from those rather than silently
-            # restarting from random init
-            for suffix in (".old", ".saving"):
+            # renames leaves the snapshot at .old and/or the NEWER one
+            # fully written at .saving (renames only run after the save
+            # completes) — prefer .saving, then .old, rather than
+            # silently restarting from random init
+            for suffix in (".saving", ".old"):
                 sibling = out_dir.rstrip("/") + suffix
                 if os.path.isdir(sibling):
                     restore_dir = sibling
