@@ -69,6 +69,15 @@ DEFAULTS: dict[str, Any] = {
         # (reasoning before the constrained node choice — the parsed
         # object is identical; engine/constrained.py)
         "answer_style": "direct",
+        # token budget for the reasoning field (the decision DFA's free-
+        # text bound; still capped by what fits in llm.max_tokens — the
+        # effective budget is min(this, llm.max_tokens - 62 - name)). The
+        # scratchpad CoT (train/distill.build_cot) measures ~27 tokens
+        # per feasible node + 12 under the numeric tokenizer, ~29 + 12
+        # under byte; 180 covers 5-node clusters on both. Serving larger
+        # clusters with a CoT checkpoint needs this AND llm.max_tokens
+        # raised together.
+        "max_reason_tokens": 180,
         # fairness bound for (prefix, grammar) group switches under load
         # (engine/local.py _submit_waves)
         "group_switch_after_s": 0.25,
@@ -136,6 +145,7 @@ ENV_OVERRIDES: dict[str, str] = {
     "LLM_CHECKPOINT_PATH": "llm.checkpoint_path",
     "LLM_TOKENIZER": "llm.tokenizer",
     "LLM_ANSWER_STYLE": "llm.answer_style",
+    "LLM_MAX_REASON_TOKENS": "llm.max_reason_tokens",
     "MAX_RETRIES": "llm.max_retries",
     "CACHE_ENABLED": "cache.enabled",
     "CACHE_TTL": "cache.ttl_seconds",

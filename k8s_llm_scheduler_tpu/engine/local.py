@@ -108,12 +108,20 @@ class LocalLLMBackend:
         partial_hold_s: float = 0.03,
         prewarm_idle_delay_s: float = 0.5,
         answer_style: str = "direct",
+        max_reason_tokens: int = 180,
     ) -> None:
         self.engine = engine
         # Decision JSON field order: "direct" (reference serialization) or
         # "cot" (reasoning emitted BEFORE the constrained node choice —
         # engine/constrained.py). The parsed object is identical.
         self.answer_style = answer_style
+        # Cap on the reasoning field's token budget (the DFA bound; the
+        # effective cap is min(this, max_new_tokens - 62 - name)). The
+        # scratchpad CoT of a distilled checkpoint (train/distill.build_cot)
+        # measures ~27 tokens per feasible node + 12 numeric-tokenized,
+        # ~29 + 12 byte-tokenized — a 5-node cluster needs ~160 of
+        # reasoning and max_new_tokens ~230; raise both together.
+        self.max_reason_tokens = max_reason_tokens
         # Idle grace before a sibling-geometry prewarm compile may start:
         # a jit blocks the worker for seconds, so it must not fire the
         # instant the queue empties — a burst's next round often arrives
@@ -246,7 +254,8 @@ class LocalLLMBackend:
                     f"need >= {62 + longest_name}"
                 )
             self._dfa_cache[key] = build_decision_dfa(
-                self.tokenizer, list(key), max_reason_tokens=min(budget, 120),
+                self.tokenizer, list(key),
+                max_reason_tokens=min(budget, self.max_reason_tokens),
                 style=self.answer_style,
             )
         return self._dfa_cache[key]
@@ -585,6 +594,7 @@ def build_local_backend(
     prewarm_idle_delay_s: float = 0.5,
     compile_cache_dir: str | None = "auto",
     answer_style: str = "direct",
+    max_reason_tokens: int = 180,
 ) -> LocalLLMBackend:
     """Construct the full local stack: params (from an HF safetensors or
     orbax checkpoint when checkpoint_path is set, random-init otherwise —
@@ -711,4 +721,5 @@ def build_local_backend(
         partial_hold_s=partial_hold_s,
         prewarm_idle_delay_s=prewarm_idle_delay_s,
         answer_style=answer_style,
+        max_reason_tokens=max_reason_tokens,
     )

@@ -98,20 +98,160 @@ def cot_answer_ids(
     return tokenizer.encode(answer) + [tokenizer.eos_id], (np_, ne), (cs, ce)
 
 
-def teacher_cot(pod, nodes) -> str:
-    """The teacher's serialized comparison: per-feasible-node resource-
-    balanced scores (integers — single NUM tokens under the numeric
-    tokenizer) and the argmax, in prompt order. Used as the reasoning
-    field in answer_style='cot' training pairs: the model learns to EMIT
-    this computation before the constrained node choice, turning a
-    one-shot global argmax into a stepwise comparison it can attend to."""
+def build_cot(
+    tokenizer: Tokenizer, names: list[str], scores: list[float]
+) -> tuple[str, list[str]]:
+    """Running-max scratchpad CoT: `(cot_string, per-token kinds)`.
+
+    Format (one segment per feasible node, prompt order):
+
+        node-0=61.2 max=61.2@node-0; node-1=43.4 max=61.2@node-0; ... best=node-0
+
+    Every cognitive step is LOCAL — this is the load-bearing redesign
+    after the round-5 finding that the linear score list left the final
+    argmax at a position bias for thousands of steps (the model had to
+    run a k-way comparison over tokens up to 100 positions back) while
+    isolated drills learned in ~250:
+
+    - score emission (`=61.2`): the per-node regression from the prompt
+      metrics — measured to learn well in the linear format;
+    - running max value (`max=61.2`): a TWO-way compare between the score
+      just emitted (~6 tokens back) and the previous segment's max
+      (~14 tokens back), emitted as a copy of the winner;
+    - running max name (`@node-0`): copy of the name bound to the winning
+      value (equality binding within the last two segments);
+    - final choice (` best=node-0`): a copy of the adjacent last max name
+      — which the constrained selected_node field then copies again.
+
+    Scores render at ONE decimal (0.1 granularity): rounding is monotone,
+    so a rendered compare can never invert the true compare — it can only
+    tie (~1%/pair at 0.1, vs ~10% at integer rendering, which capped the
+    previous format's ceiling). The running max itself is computed over
+    the TRUE float scores with first-wins tie-break — exactly
+    `max(cand, key=score)` in core/fallback.py — so the rendered `best`
+    always names the teacher's own argmax even on rendered ties.
+
+    Kinds (aligned 1:1 with `tokenizer.encode(cot_string)`):
+    `score_int`/`score_dec` the score value tokens, `cmp_int`/`cmp_dec`
+    the running-max value tokens, `decision` the final token of each
+    max/best NAME (the choice-bearing token), `fmt` everything else.
+    Piece boundaries never split a digit run, so per-piece encoding is
+    concatenation-safe for both builtin tokenizers (asserted)."""
+    pieces: list[tuple[str, str]] = []
+
+    def num(kind: str, tenths: int) -> None:
+        pieces.append((kind + "_int", str(tenths // 10)))
+        pieces.append(("fmt", "."))
+        pieces.append((kind + "_dec", str(tenths % 10)))
+
+    def name(kind: str, text: str) -> None:
+        pieces.append((kind, text))
+
+    best_i = 0
+    for i, (nm, sc) in enumerate(zip(names, scores)):
+        if i and sc > scores[best_i]:  # strict: first-wins, like max()
+            best_i = i
+        if i:
+            pieces.append(("fmt", "; "))
+        name("fmt", nm)
+        pieces.append(("fmt", "="))
+        num("score", round(sc * 10))
+        pieces.append(("fmt", " max="))
+        num("cmp", round(scores[best_i] * 10))
+        pieces.append(("fmt", "@"))
+        name("name", names[best_i])
+    pieces.append(("fmt", " best="))
+    name("name", names[best_i])
+
+    cot = "".join(text for _, text in pieces)
+    kinds: list[str] = []
+    n_tokens = 0
+    for kind, text in pieces:
+        toks = tokenizer.encode(text)
+        if kind == "name":
+            # only the LAST token of a max/best name is the choice; the
+            # shared 'node-' prefix tokens are format
+            kinds.extend(["fmt"] * (len(toks) - 1) + ["decision"])
+        else:
+            kinds.extend([kind] * len(toks))
+        n_tokens += len(toks)
+    if n_tokens != len(tokenizer.encode(cot)):
+        raise AssertionError(
+            "build_cot pieces are not concatenation-safe for this tokenizer"
+        )
+    return cot, kinds
+
+
+def cot_token_weights(
+    kinds: list[str],
+    name_weight: float,
+    cot_weight: float,
+    drill: bool = False,
+) -> np.ndarray:
+    """Per-token loss weights for a build_cot kinds list: score value
+    tokens (int AND decimal digits) at `cot_weight`, compare/choice
+    tokens (cmp value digits, max/best names) at `name_weight`, format
+    at 1. The cmp DECIMAL digit carries name_weight too — when two
+    scores tie at the integer digit, the decimal is where the compare is
+    decided. `drill=True` zeroes the score tokens: micro drills carry
+    RANDOM scores (not derivable from their distractor context), so
+    supervising them would teach noise — only the compares, copies, and
+    format carry loss."""
+    w = np.ones(len(kinds), dtype=np.float32)
+    for i, k in enumerate(kinds):
+        if k in ("score_int", "score_dec"):
+            w[i] = 0.0 if drill else cot_weight
+        elif k in ("cmp_int", "cmp_dec", "decision"):
+            w[i] = name_weight
+    return w
+
+
+def teacher_cot(pod, nodes, tokenizer: Tokenizer) -> tuple[str, list[str]]:
+    """build_cot over the feasible nodes' resource-balanced scores — the
+    teacher's own computation serialized as a running-max scratchpad."""
     from k8s_llm_scheduler_tpu.core.fallback import score_resource_balanced
     from k8s_llm_scheduler_tpu.core.validation import feasible_nodes
 
     cand = feasible_nodes(pod, nodes)
-    parts = [f"{n.name}={score_resource_balanced(n):.0f}" for n in cand]
-    best = max(cand, key=score_resource_balanced)
-    return " ".join(parts) + f" best={best.name}"
+    return build_cot(
+        tokenizer,
+        [n.name for n in cand],
+        [score_resource_balanced(n) for n in cand],
+    )
+
+
+def cot_teacher_case(
+    tokenizer: Tokenizer, pe: PromptEngine, pod, nodes
+) -> tuple[list[int], list[int], tuple[int, int], tuple[int, int], list[str]] | None:
+    """One full teacher scratchpad-CoT sequence, or None if the teacher
+    abstains (no feasible node) or the scratchpad's conclusion would
+    contradict the teacher's answer (cannot happen with the shared scorer
+    and first-wins tie-break; guarded anyway so a divergence skips the
+    pair instead of training on self-contradictory supervision).
+
+    Returns (prompt_ids, answer_ids, name_span, cot_span, kinds) with the
+    spans RELATIVE to the answer start — THE single construction path for
+    the training corpus (teacher_pairs), the circuit diagnostics
+    (make_cot_diagnostics), and any future consumer, so a format or guard
+    change can never make them measure different corpora."""
+    decision = fallback_decision(
+        nodes, reason="teacher", strategy="resource_balanced", pod=pod
+    )
+    if decision is None:
+        return None
+    cot, kinds = teacher_cot(pod, nodes, tokenizer)
+    if not cot.endswith("best=" + decision.selected_node):
+        return None
+    ans_ids, name_span, (cs, ce) = cot_answer_ids(
+        tokenizer, cot, decision.selected_node, decision.confidence,
+    )
+    if ce - cs != len(kinds):
+        raise AssertionError(
+            "cot span arithmetic disagrees with build_cot kinds"
+        )
+    cluster_part, pod_part = pe.split_prompt(pod, nodes)
+    prompt = tokenizer.chat_prompt(pe.system_prompt, cluster_part + pod_part)
+    return prompt, ans_ids, name_span, (cs, ce), kinds
 
 
 def easy_cases(n_nodes: int = 3, seed: int = 1):
@@ -165,10 +305,12 @@ def teacher_pairs(
     seed: int = 0,
     easy_frac: float = 0.0,
     answer_style: str = "direct",
-) -> Iterator[tuple[list[int], int, tuple[int, int], tuple[int, int]]]:
+    name_weight: float = 8.0,
+    cot_weight: float = 1.0,
+) -> Iterator[tuple[list[int], int, tuple[int, int], np.ndarray]]:
     """Endless (prompt + decision tokens, answer_start, name_span,
-    cot_span) samples from the heuristic teacher over randomized synthetic
-    clusters.
+    loss_weights) samples from the heuristic teacher over randomized
+    synthetic clusters.
 
     Each sample is the full chat prompt (system + cluster state + pod)
     followed by the teacher's decision JSON and EOS — exactly the
@@ -179,12 +321,12 @@ def teacher_pairs(
     contributes ~4% of the gradient and the decision head stays near
     uniform for hundreds of steps. `name_span` is the (start, end) token
     range of the selected_node VALUE — the decision-bearing tokens
-    (EVAL.md finding 4); `cot_span` is the reasoning VALUE's range when
-    answer_style='cot' (the teacher's serialized per-node scores,
-    teacher_cot), else (0, 0). The LAST token of the cot span is the
-    `best=node-K` argmax digit — the comparison moment itself —
-    and make_batches weights it like the name token (under cot_weight
-    alone it carried ~2% of the gradient, diluted by its own scores)."""
+    (EVAL.md finding 4). `loss_weights` is aligned 1:1 with the token
+    list: ones outside the answer, `name_weight` on the selected_node
+    choice token, and — for answer_style='cot' — the build_cot kind
+    weights over the scratchpad (cmp/decision tokens at `name_weight`,
+    score tokens at `cot_weight`; under a flat cot weight the choice
+    tokens carried ~2% of the gradient, diluted by their own scores)."""
     pe = PromptEngine()
 
     def mixed_cases():
@@ -198,6 +340,19 @@ def teacher_pairs(
             yield next(easy if rng.random() < easy_frac else hard)
 
     for pod, nodes in mixed_cases():
+        if answer_style == "cot":
+            case = cot_teacher_case(tokenizer, pe, pod, nodes)
+            if case is None:
+                continue
+            prompt, ans_ids, (ns, ne), (cs, ce), kinds = case
+            weights = np.ones(len(prompt) + len(ans_ids), dtype=np.float32)
+            off = len(prompt)
+            weights[off + cs : off + ce] = cot_token_weights(
+                kinds, name_weight, cot_weight
+            )
+            weights[off + ne - 1] = name_weight
+            yield prompt + ans_ids, off, (off + ns, off + ne), weights
+            continue
         decision = fallback_decision(
             nodes, reason="teacher", strategy="resource_balanced", pod=pod
         )
@@ -207,17 +362,6 @@ def teacher_pairs(
         prompt = tokenizer.chat_prompt(
             pe.system_prompt, cluster_part + pod_part
         )
-        if answer_style == "cot":
-            ans_ids, (ns, ne), (cs, ce) = cot_answer_ids(
-                tokenizer, teacher_cot(pod, nodes),
-                decision.selected_node, decision.confidence,
-            )
-            off = len(prompt)
-            yield (
-                prompt + ans_ids, off,
-                (off + ns, off + ne), (off + cs, off + ce),
-            )
-            continue
         answer = json.dumps(
             {
                 "selected_node": decision.selected_node,
@@ -227,12 +371,10 @@ def teacher_pairs(
         )
         name_len = len(tokenizer.encode(decision.selected_node))
         name_start = len(prompt) + len(tokenizer.encode(ANSWER_PREFIX))
-        yield (
-            prompt + tokenizer.encode(answer) + [tokenizer.eos_id],
-            len(prompt),
-            (name_start, name_start + name_len),
-            (0, 0),
-        )
+        ids = prompt + tokenizer.encode(answer) + [tokenizer.eos_id]
+        weights = np.ones(len(ids), dtype=np.float32)
+        weights[name_start + name_len - 1] = name_weight
+        yield ids, len(prompt), (name_start, name_start + name_len), weights
 
 
 def make_batches(
@@ -255,47 +397,50 @@ def make_batches(
     and, for answer_style='cot', the reasoning scores by `cot_weight`).
 
     `micro_frac` (cot only): fraction of batch rows replaced by BARE
-    answer-shaped argmax drills — '{"reasoning": "node-0=61 ...
-    best=node-K", "selected_node": "node-K", ...}' with random scores and
-    no prompt. A 1M-param model learns the isolated comparison in ~250
-    steps while the full-prompt task leaves the argmax digit at a
-    position bias for thousands (measured; the score REGRESSION learns
-    fine) — these rows inject that concentrated signal; RoPE's relative
-    attention transfers the local comparison circuit to answers sitting
-    behind a 1.5k-token prompt. Train-only scaffolding: the eval never
-    sees them."""
+    answer-shaped scratchpad drills — a build_cot answer with RANDOM
+    scores behind a distractor prompt slice. A 1M-param model learns the
+    isolated comparison in ~250 steps while the full-prompt task leaves
+    the choice tokens at a position bias for thousands (measured; the
+    score REGRESSION learns fine) — these rows inject that concentrated
+    compare/copy signal at realistic positions. Train-only scaffolding:
+    the eval never sees them."""
     pairs = teacher_pairs(
         tokenizer, n_nodes=n_nodes, seed=seed, easy_frac=easy_frac,
-        answer_style=answer_style,
+        answer_style=answer_style, name_weight=name_weight,
+        cot_weight=cot_weight,
     )
     micro_rng = np.random.default_rng(seed + 7)
 
-    def micro_row(prompt_ids: list[int]) -> tuple[list[int], int, tuple, tuple]:
-        """Argmax drill AT REALISTIC POSITIONS: a random-length slice of a
-        REAL prompt (pure distractor context), then a CoT answer with
-        RANDOM scores. The returned loss_start points at the argmax digit
-        itself: the drill's scores are random (not derivable from the
-        mismatched prompt slice), so supervising them would teach noise —
-        only the comparison (digit), the post-cot format, and the name
-        copy carry loss."""
+    def micro_row(
+        prompt_ids: list[int],
+    ) -> tuple[list[int], int, tuple, np.ndarray]:
+        """Running-max drill AT REALISTIC POSITIONS: a random-length slice
+        of a REAL prompt (pure distractor context), then a build_cot
+        answer with RANDOM scores. Loss starts at the first running-max
+        value token — everything before it (the drill's score emissions)
+        is unlearnable noise and carries zero weight (cot_token_weights
+        drill=True); the compares, name copies, post-cot format, and the
+        constrained-choice copy all carry loss."""
         k = int(micro_rng.integers(2, n_nodes + 1))
-        vals = micro_rng.choice(101, size=k, replace=False)
-        best = int(np.argmax(vals))
-        cot = " ".join(
-            f"node-{i}={v}" for i, v in enumerate(vals)
-        ) + f" best=node-{best}"
+        tenths = micro_rng.choice(1001, size=k, replace=False)
+        names = [f"node-{i}" for i in range(k)]
+        best = int(np.argmax(tenths))
+        cot, kinds = build_cot(tokenizer, names, [t / 10.0 for t in tenths])
         ans, (ns, ne), (cs, ce) = cot_answer_ids(
-            tokenizer, cot, f"node-{best}", 0.4
+            tokenizer, cot, names[best], 0.4
         )
+        aw = np.ones(len(ans), dtype=np.float32)
+        aw[cs:ce] = cot_token_weights(
+            kinds, name_weight, cot_weight, drill=True
+        )
+        aw[ne - 1] = name_weight
+        first_cmp = cs + kinds.index("cmp_int")
         max_fill = max(0, min(len(prompt_ids), seq_len - len(ans)))
         fill = int(micro_rng.integers(0, max_fill + 1))
         ids = prompt_ids[:fill] + ans
-        return (
-            ids,
-            fill + ce - 1,  # loss from the argmax digit onward
-            (fill + ns, fill + ne),
-            (fill + cs, fill + ce),
-        )
+        weights = np.ones(len(ids), dtype=np.float32)
+        weights[fill:] = aw
+        return ids, fill + first_cmp, (fill + ns, fill + ne), weights
     pad = tokenizer.pad_id
     warned = False
     while True:
@@ -304,14 +449,14 @@ def make_batches(
         starts = np.zeros(batch_size, dtype=np.int32)
         weights = np.ones((batch_size, seq_len), dtype=np.float32)
         for b in range(batch_size):
-            ids, ans_start, (ns, ne), (cs, ce) = next(pairs)
+            ids, ans_start, _name_span, w_ids = next(pairs)
             if (
                 micro_frac
                 and answer_style == "cot"
                 and micro_rng.random() < micro_frac
             ):
                 # reuse this pair's PROMPT as the drill's distractor fill
-                ids, ans_start, (ns, ne), (cs, ce) = micro_row(
+                ids, ans_start, _name_span, w_ids = micro_row(
                     ids[:ans_start]
                 )
             if len(ids) > seq_len:
@@ -320,9 +465,8 @@ def make_batches(
                 # trains on prompt text only (silently learning nothing).
                 cut = len(ids) - seq_len
                 ids = ids[-seq_len:]
+                w_ids = w_ids[-seq_len:]
                 ans_start = max(0, ans_start - cut)
-                ns, ne = max(0, ns - cut), max(0, ne - cut)
-                cs, ce = max(0, cs - cut), max(0, ce - cut)
                 if not warned:
                     logger.warning(
                         "teacher pairs exceed seq_len=%d; truncating prompt "
@@ -332,12 +476,7 @@ def make_batches(
             tokens[b, : len(ids)] = ids
             lens[b] = len(ids)
             starts[b] = ans_start
-            if ce > cs:
-                weights[b, cs:ce] = cot_weight
-                # the cot's final token is the 'best=node-K' argmax digit
-                weights[b, ce - 1] = name_weight
-            if ne > ns:
-                weights[b, ne - 1] = name_weight
+            weights[b, : len(ids)] = w_ids
         yield tokens, lens, starts, weights
 
 
@@ -406,14 +545,17 @@ def make_agreement_probe(
     train/eval.py's held-out seed (10_007): train-time model selection
     never sees the final report card's cases.
 
-    answer_style='cot' probes the ARGMAX MOMENT teacher-forced: the
-    prefix is the teacher's per-node scores up to ' best=node-' and the
-    probed token is the argmax digit — i.e. "given correct scores in
-    context, does the model pick their max?". (Probing the constrained
-    selected_node field instead would be trivial: the teacher cot ends
-    'best=node-K', so that token is a copy.) Serving additionally needs
-    the model to GENERATE its scores; the honest end-to-end number comes
-    from `cli eval`."""
+    answer_style='cot' probes the FINAL-CHOICE token teacher-forced: the
+    prefix is the teacher's running-max scratchpad (build_cot) up to
+    ' best=node-' and the probed token is the choice digit. With the
+    scratchpad in context this is a SHORT-RANGE COPY of the adjacent
+    last 'max=...@node-K' name — deliberately easy, an early-training
+    liveness signal, and NOT comparable to the pre-scratchpad probe
+    that measured a k-way argmax over a linear score list (EVAL.md's
+    round-5 trajectories). The per-circuit numbers that actually bound
+    serving quality (score regression, two-way compares, copies) come
+    from make_cot_diagnostics; the honest end-to-end number only from
+    `cli eval` (free-running generation compounds all three)."""
     import jax
     import jax.numpy as jnp
 
@@ -441,10 +583,13 @@ def make_agreement_probe(
             continue
         cluster_part, pod_part = pe.split_prompt(pod, nodes)
         if answer_style == "cot":
-            cot = teacher_cot(pod, nodes)
+            cot, _kinds = teacher_cot(pod, nodes, tokenizer)
             # up to 'best=' EXCLUSIVE of the final 'node-' — the shared
             # name-prefix tokens are appended below with `shared`, and the
-            # probed token is the argmax digit over the in-context scores
+            # probed token is the final-choice digit: with the running-max
+            # scratchpad in context this is a copy of the adjacent last
+            # 'max=...@node-K' name (teacher-forced; the per-segment
+            # compares are measured by make_cot_diagnostics)
             prefix_str = '{"reasoning": "' + cot[: cot.rfind("node-")]
         else:
             prefix_str = ANSWER_PREFIX
@@ -486,6 +631,93 @@ def make_agreement_probe(
         return float((pred == targets).mean())
 
     return probe
+
+
+def make_cot_diagnostics(
+    cfg,
+    tokenizer: Tokenizer,
+    n_cases: int = 16,
+    n_nodes: int = 5,
+    seed: int = 30_011,
+    seq_len: int = 2048,
+):
+    """Build `diag(params) -> {"score": a, "cmp": b, "copy": c}` —
+    teacher-forced per-circuit accuracies over full teacher sequences,
+    one batched prefill per call.
+
+    The three numbers decompose the serving ceiling for the scratchpad
+    CoT (build_cot): `score` = fraction of score_int tokens where the
+    full-vocab argmax equals the teacher's rendered integer (the
+    prompt→score regression); `cmp` = same for cmp_int tokens (the
+    two-way running-max compare); `copy` = same for decision tokens (the
+    winner-name and final-choice copies). Training logs all three every
+    probe interval: whichever is lowest is the circuit holding back
+    end-to-end agreement, which only `cli eval` measures honestly
+    (free-running generation compounds these per-step accuracies)."""
+    import jax
+    import jax.numpy as jnp
+
+    from k8s_llm_scheduler_tpu.models.llama import forward_prefill
+
+    tokens = np.full((n_cases, seq_len), tokenizer.pad_id, dtype=np.int32)
+    lens = np.zeros(n_cases, dtype=np.int32)
+    pos_rows: list[int] = []
+    pos_cols: list[int] = []
+    pos_kind: list[str] = []
+    pe = PromptEngine()
+    cases = random_cases(n_nodes=n_nodes, seed=seed)
+    filled = 0
+    while filled < n_cases:
+        pod, nodes = next(cases)
+        case = cot_teacher_case(tokenizer, pe, pod, nodes)
+        if case is None:
+            continue
+        prompt, ans_ids, (ns, ne), (cs, ce), kinds = case
+        ids = prompt + ans_ids
+        cut = max(0, len(ids) - seq_len)
+        ids = ids[cut:]
+        off = len(prompt) - cut
+        tokens[filled, : len(ids)] = ids
+        lens[filled] = len(ids)
+        for i, k in enumerate(kinds):
+            col = off + cs + i
+            if col <= 0 or col >= len(ids):
+                continue
+            if k in ("score_int", "cmp_int", "cmp_dec", "decision"):
+                # cmp_dec counts toward the compare circuit: on integer-
+                # digit score ties the decimal is where the compare is
+                # actually decided, and excluding it would let a broken
+                # compare surface as a 'copy' failure instead
+                pos_rows.append(filled)
+                pos_cols.append(col)
+                pos_kind.append(
+                    {"score_int": "score", "cmp_int": "cmp",
+                     "cmp_dec": "cmp"}.get(k, "copy")
+                )
+        # the constrained selected_node choice token is a copy too
+        pos_rows.append(filled)
+        pos_cols.append(off + ne - 1)
+        pos_kind.append("copy")
+        filled += 1
+    row_idx = np.asarray(pos_rows, dtype=np.int32)
+    col_idx = np.asarray(pos_cols, dtype=np.int32)
+    kind_arr = np.asarray(pos_kind)
+
+    @jax.jit
+    def _hits(params, tokens, lens, row_idx, col_idx):
+        logits, _, _ = forward_prefill(params, cfg, tokens, lens)
+        sel = logits[row_idx, col_idx - 1]  # predicting token at col
+        pred = jnp.argmax(sel, axis=-1)
+        return pred == tokens[row_idx, col_idx]
+
+    def diag(params) -> dict[str, float]:
+        hits = np.asarray(_hits(params, tokens, lens, row_idx, col_idx))
+        return {
+            k: float(hits[kind_arr == k].mean())
+            for k in ("score", "cmp", "copy")
+        }
+
+    return diag
 
 
 def train_and_save(
@@ -614,6 +846,11 @@ def train_and_save(
         if probe_every
         else None
     )
+    diag = (
+        make_cot_diagnostics(cfg, tokenizer, seq_len=seq_len)
+        if probe_every and answer_style == "cot"
+        else None
+    )
     loss = float("nan")
     for step in range(1, steps + 1):
         tokens, lens, starts, weights = next(batches)
@@ -631,6 +868,14 @@ def train_and_save(
                 " (teacher-forced CoT)" if answer_style == "cot" else "",
                 100.0 * probe(state.params),
             )
+            if diag is not None:
+                d = diag(state.params)
+                logger.info(
+                    "step %d/%d cot circuits (teacher-forced): score %.1f%% "
+                    "cmp %.1f%% copy %.1f%%",
+                    step, steps,
+                    100.0 * d["score"], 100.0 * d["cmp"], 100.0 * d["copy"],
+                )
         if (
             save_every
             and step % save_every == 0

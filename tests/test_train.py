@@ -220,6 +220,96 @@ class TestDistill:
             # the name span decodes to exactly the selected node's name
             assert tok.decode(ids[ns:ne]) == obj["selected_node"]
 
+    def test_build_cot_running_max_scratchpad(self):
+        """The scratchpad renders a LOCAL running max: each segment's
+        max= field carries the best-so-far (first-wins on true-score
+        ties), the final best is the last segment's max name, and the
+        kinds list aligns 1:1 with the token stream for both builtin
+        tokenizers."""
+        from k8s_llm_scheduler_tpu.engine.tokenizer import (
+            ByteTokenizer, NumericTokenizer,
+        )
+        from k8s_llm_scheduler_tpu.train.distill import build_cot
+
+        names = ["node-0", "node-1", "node-2"]
+        scores = [61.24, 77.06, 77.01]  # rendered 61.2, 77.1, 77.0
+        for tok in (NumericTokenizer(), ByteTokenizer()):
+            cot, kinds = build_cot(tok, names, scores)
+            assert cot == (
+                "node-0=61.2 max=61.2@node-0; "
+                "node-1=77.1 max=77.1@node-1; "
+                "node-2=77.0 max=77.1@node-1 best=node-1"
+            )
+            assert len(kinds) == len(tok.encode(cot))
+            assert kinds.count("decision") == 4  # 3 max names + best
+        # rendered ties keep the TRUE argmax (monotone rounding can tie,
+        # never invert): true winner is index 0 here despite equal render
+        cot, _ = build_cot(NumericTokenizer(), names, [50.04, 49.96, 10.0])
+        assert cot.endswith("best=node-0")
+        assert "node-0=50.0 max=50.0@node-0; node-1=50.0 max=50.0@node-0" in cot
+
+    def test_cot_pairs_weights_and_self_consistency(self):
+        from k8s_llm_scheduler_tpu.engine.tokenizer import NumericTokenizer
+        from k8s_llm_scheduler_tpu.train.distill import teacher_pairs
+        import json as _json
+
+        tok = NumericTokenizer()
+        it = teacher_pairs(
+            tok, n_nodes=4, seed=3, answer_style="cot",
+            name_weight=9.0, cot_weight=2.0,
+        )
+        for _ in range(3):
+            ids, st, (ns, ne), w = next(it)
+            assert len(w) == len(ids)
+            obj = _json.loads(tok.decode(ids[st:-1]))
+            # the scratchpad's own conclusion IS the answer
+            assert obj["reasoning"].endswith("best=" + obj["selected_node"])
+            assert w[ne - 1] == 9.0
+            # decision/cmp tokens carry name_weight, scores cot_weight
+            assert (w == 9.0).sum() >= 3  # >=1 segment: cmp+maxname+choice
+            assert (w == 2.0).sum() >= 1
+            assert (w[:st] == 1.0).all()
+
+    def test_micro_drill_supervises_compares_not_scores(self):
+        from k8s_llm_scheduler_tpu.engine.tokenizer import NumericTokenizer
+        from k8s_llm_scheduler_tpu.train.distill import make_batches
+
+        tok = NumericTokenizer()
+        b = make_batches(
+            tok, 2, 1024, seed=1, answer_style="cot", micro_frac=1.0,
+        )
+        tokens, lens, starts, weights = next(b)
+        for r in range(2):
+            row = [int(x) for x in tokens[r][: lens[r]]]
+            # loss starts at the first running-max value token: the text
+            # from there must begin with the max value, and every zeroed
+            # weight (the unlearnable random scores) sits in the row
+            tail = tok.decode(row[starts[r]:])
+            prior = tok.decode(row[: starts[r]])
+            assert prior.rstrip().endswith("max=")
+            assert (weights[r][: lens[r]] == 0.0).sum() >= 2
+            assert '"selected_node"' in tail
+
+    def test_cot_diagnostics_decomposes_circuits(self):
+        from k8s_llm_scheduler_tpu.engine.tokenizer import NumericTokenizer
+        from k8s_llm_scheduler_tpu.train.distill import make_cot_diagnostics
+        from k8s_llm_scheduler_tpu.models.llama import init_params
+
+        cfg = LlamaConfig(
+            name="diag-test", vocab_size=1536, d_model=32, n_layers=2,
+            n_heads=2, n_kv_heads=2, d_ff=64, max_seq_len=2048,
+            rope_theta=10000.0, dtype=jnp.float32, tie_embeddings=True,
+        )
+        tok = NumericTokenizer()
+        diag = make_cot_diagnostics(cfg, tok, n_cases=4, seq_len=2048)
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        out = diag(params)
+        assert set(out) == {"score", "cmp", "copy"}
+        for v in out.values():
+            assert 0.0 <= v <= 1.0
+        # a random-init model cannot beat chance on the 1000-way scores
+        assert out["score"] < 0.5
+
     def test_train_and_save_then_serve(self, tmp_path):
         from k8s_llm_scheduler_tpu.engine.local import build_local_backend
         from k8s_llm_scheduler_tpu.train.distill import train_and_save
