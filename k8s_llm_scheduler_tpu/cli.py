@@ -51,7 +51,7 @@ def _backend_kwargs(cfg: Config, **overrides) -> dict:
         tokenizer_name=cfg.get("llm.tokenizer", "byte"),
         decode_matmul=cfg.get("llm.decode_matmul", "dense"),
         answer_style=cfg.get("llm.answer_style", "direct"),
-        max_reason_tokens=int(cfg.get("llm.max_reason_tokens", 180)),
+        max_reason_tokens=int(cfg.get("llm.max_reason_tokens", 288)),
         quantize=cfg.get("llm.quantization"),
         request_timeout_s=float(cfg.get("llm.timeout")),
         group_switch_after_s=float(cfg.get("llm.group_switch_after_s")),
@@ -119,6 +119,9 @@ def _build_stack(cfg: Config, cluster) -> Any:
         cluster, cluster, client,
         scheduler_name=cfg.get("scheduler.name"),
         error_backoff_s=cfg.get("scheduler.error_backoff_seconds"),
+        prefix_prewarm_s=float(
+            cfg.get("scheduler.prefix_prewarm_seconds", 0.25)
+        ),
     )
     return scheduler, backend
 

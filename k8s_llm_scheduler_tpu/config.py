@@ -37,6 +37,10 @@ DEFAULTS: dict[str, Any] = {
         "namespace": "kube-system",
         "watch_interval": 60,  # watch re-list timeout seconds (live, unlike ref)
         "error_backoff_seconds": 5.0,  # scheduler.py:685
+        # advisory prefix-prewarm tick (0 disables): while idle, keep the
+        # engine's cluster-state prefix KV pointed at the live snapshot so
+        # the next burst's first wave skips the prefix prefill
+        "prefix_prewarm_seconds": 0.25,
     },
     "llm": {
         "model": "llama-3.2-1b-instruct",
@@ -72,12 +76,11 @@ DEFAULTS: dict[str, Any] = {
         # token budget for the reasoning field (the decision DFA's free-
         # text bound; still capped by what fits in llm.max_tokens — the
         # effective budget is min(this, llm.max_tokens - 62 - name)). The
-        # scratchpad CoT (train/distill.build_cot) measures ~27 tokens
-        # per feasible node + 12 under the numeric tokenizer, ~29 + 12
-        # under byte; 180 covers 5-node clusters on both. Serving larger
-        # clusters with a CoT checkpoint needs this AND llm.max_tokens
-        # raised together.
-        "max_reason_tokens": 180,
+        # scratchpad CoT with input echoes (train/distill.build_cot)
+        # measures <=245 tokens for 5 feasible nodes under the numeric
+        # tokenizer, <=280 under byte; 288 covers both. Serving a CoT
+        # checkpoint needs llm.max_tokens >= 62 + name + this (e.g. 360).
+        "max_reason_tokens": 288,
         # fairness bound for (prefix, grammar) group switches under load
         # (engine/local.py _submit_waves)
         "group_switch_after_s": 0.25,
@@ -138,6 +141,7 @@ DEFAULTS: dict[str, Any] = {
 ENV_OVERRIDES: dict[str, str] = {
     "SCHEDULER_NAME": "scheduler.name",
     "SCHEDULER_NAMESPACE": "scheduler.namespace",
+    "SCHEDULER_PREFIX_PREWARM_SECONDS": "scheduler.prefix_prewarm_seconds",
     "LLM_MODEL": "llm.model",
     "LLM_BACKEND": "llm.backend",
     "LLM_TIMEOUT": "llm.timeout",

@@ -121,8 +121,14 @@ class PromptEngine:
         """Full user prompt: shared cluster prefix + per-pod suffix."""
         return cluster_prefix(nodes) + "\n" + pod_suffix(pod)
 
+    def cluster_part(self, nodes: Sequence[NodeMetrics]) -> str:
+        """The burst-shared prefix half of split_prompt — THE single
+        definition, so prefix prewarming (engine/local.prewarm_prefix)
+        and real decisions can never drift onto different group keys."""
+        return cluster_prefix(nodes) + "\n"
+
     def split_prompt(
         self, pod: PodSpec, nodes: Sequence[NodeMetrics]
     ) -> tuple[str, str]:
         """(shared_prefix, pod_tail) for prefix-cached prefill."""
-        return cluster_prefix(nodes) + "\n", pod_suffix(pod)
+        return self.cluster_part(nodes), pod_suffix(pod)

@@ -108,7 +108,7 @@ class LocalLLMBackend:
         partial_hold_s: float = 0.03,
         prewarm_idle_delay_s: float = 0.5,
         answer_style: str = "direct",
-        max_reason_tokens: int = 180,
+        max_reason_tokens: int = 288,
     ) -> None:
         self.engine = engine
         # Decision JSON field order: "direct" (reference serialization) or
@@ -117,10 +117,10 @@ class LocalLLMBackend:
         self.answer_style = answer_style
         # Cap on the reasoning field's token budget (the DFA bound; the
         # effective cap is min(this, max_new_tokens - 62 - name)). The
-        # scratchpad CoT of a distilled checkpoint (train/distill.build_cot)
-        # measures ~27 tokens per feasible node + 12 numeric-tokenized,
-        # ~29 + 12 byte-tokenized — a 5-node cluster needs ~160 of
-        # reasoning and max_new_tokens ~230; raise both together.
+        # scratchpad CoT of a distilled checkpoint (train/distill.build_cot
+        # with input echoes) measures <=245 tokens at 5 feasible nodes
+        # numeric-tokenized, <=280 byte-tokenized — CoT serving needs
+        # max_new_tokens ~360 alongside the 288 default here.
         self.max_reason_tokens = max_reason_tokens
         # Idle grace before a sibling-geometry prewarm compile may start:
         # a jit blocks the worker for seconds, so it must not fire the
@@ -188,6 +188,49 @@ class LocalLLMBackend:
             ready_names if self.constrained else None,
         )
         return _WorkItem(prefix_ids, suffix_ids, group_key)
+
+    def prewarm_prefix(self, nodes: Sequence[NodeMetrics]) -> Future:
+        """Advisory: install this snapshot's (prefix KV, grammar) group
+        while the engine is idle, so the FIRST wave of the next burst
+        skips the chunked cluster-state prefill (~145 ms at 1B/64 nodes —
+        the dominant term in SCALING.md's burst1000 floor decomposition).
+
+        Returns a Future resolving True if the group was installed (or
+        already current), False if dropped — the engine was busy (real
+        traffic decides groups; an advisory must never preempt a wave or
+        force a switch mid-burst) or the snapshot had no ready nodes.
+        Thread-safe; never blocks the caller.
+
+        The prefix tokens are built exactly as _prepare_item builds them
+        for a real pod — the pod part only ever lands in the suffix — so
+        a subsequent burst on the same snapshot matches this group key
+        and pays zero prefix cost."""
+        item = self._prepare_prewarm(nodes)
+        if item is None:
+            f: Future = Future()
+            f.set_result(False)
+            return f
+        self._queue.put(item)
+        return item.future
+
+    def _prepare_prewarm(self, nodes: Sequence[NodeMetrics]):
+        ready_names = tuple(sorted(n.name for n in nodes if n.is_ready))
+        if not ready_names:
+            return None
+        cluster_part = self.prompt_engine.cluster_part(nodes)
+        # Any non-empty stand-in suffix yields the identical prefix ids:
+        # chat_prompt_parts splits at the end of the user-prefix string,
+        # so the prefix depends only on (system, cluster_part). An EMPTY
+        # suffix would degrade the HF adapter to no-split (prefix []).
+        prefix_ids, _ = self.tokenizer.chat_prompt_parts(
+            self.prompt_engine.system_prompt, cluster_part, "x"
+        )
+        group_key = (
+            tuple(prefix_ids),
+            ready_names if self.constrained else None,
+        )
+        item = _WorkItem(prefix_ids, None, group_key)
+        return item
 
     def get_scheduling_decision(
         self, pod: PodSpec, nodes: Sequence[NodeMetrics]
@@ -282,6 +325,35 @@ class LocalLLMBackend:
         Returns items that must keep waiting (held ragged tails, other
         groups not yet switched to).
         """
+        if any(i.suffix_ids is None for i in pending):
+            # Advisory prefix installs (prewarm_prefix) are diverted HERE —
+            # the single consumer of `pending` — because the coalescing and
+            # straggler-poll loops both drain the queue mid-tick and can
+            # hand this function a prewarm at any point. Only the LATEST
+            # snapshot matters, and it applies only when the engine is
+            # genuinely idle: real traffic always decides groups.
+            prewarms = [i for i in pending if i.suffix_ids is None]
+            pending = [i for i in pending if i.suffix_ids is not None]
+            for stale in prewarms[:-1]:
+                stale.resolve(False)
+            latest = prewarms[-1]
+            if latest.group_key == self._current_group:
+                latest.resolve(True)
+            elif pending or waves:
+                latest.resolve(False)
+            else:
+                self._current_group = None
+                try:
+                    self.engine.set_prefix(latest.prefix_ids)
+                    names = latest.group_key[1]
+                    self.engine.set_grammar(
+                        self._grammar_for(names) if names is not None else None
+                    )
+                    self._current_group = latest.group_key
+                    latest.resolve(True)
+                except Exception:
+                    logger.exception("prefix prewarm failed")
+                    latest.resolve(False)
         rest: list[_WorkItem] = []
 
         def submit(batch: list[_WorkItem]) -> None:
@@ -594,7 +666,7 @@ def build_local_backend(
     prewarm_idle_delay_s: float = 0.5,
     compile_cache_dir: str | None = "auto",
     answer_style: str = "direct",
-    max_reason_tokens: int = 180,
+    max_reason_tokens: int = 288,
 ) -> LocalLLMBackend:
     """Construct the full local stack: params (from an HF safetensors or
     orbax checkpoint when checkpoint_path is set, random-init otherwise —

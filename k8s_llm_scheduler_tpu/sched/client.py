@@ -268,6 +268,14 @@ class DecisionClient:
         logger.warning("all %d attempts failed (%s), using fallback", self.max_retries, last_error)
         return self._fallback(nodes, f"retries_exhausted:{last_error}", pod)
 
+    def prewarm_prefix(self, nodes):
+        """Forward an advisory prefix prewarm to the backend (see
+        engine/local.prewarm_prefix). Returns the backend's Future, or
+        None when the backend doesn't support prewarming (stub/remote
+        backends) — the caller disables its prewarm loop on None."""
+        fn = getattr(self.backend, "prewarm_prefix", None)
+        return None if fn is None else fn(nodes)
+
     def get_stats(self) -> dict:
         out = dict(self.stats)
         if self.cache is not None:

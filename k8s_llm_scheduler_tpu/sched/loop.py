@@ -49,6 +49,7 @@ class Scheduler:
         max_concurrency: int = 64,
         snapshot_ttl_s: float = 1.0,
         error_backoff_s: float = 5.0,
+        prefix_prewarm_s: float = 0.25,
     ) -> None:
         self.cluster = cluster
         self.binder = binder
@@ -56,6 +57,14 @@ class Scheduler:
         self.scheduler_name = scheduler_name
         self.error_backoff_s = error_backoff_s
         self.snapshot_ttl_s = snapshot_ttl_s
+        # Advisory prefix prewarming (0 disables): while idle, keep the
+        # engine's (prefix KV, grammar) group pointed at the CURRENT
+        # cluster snapshot so the first wave of the next burst skips the
+        # chunked prefix prefill — the dominant term in the burst1000
+        # floor (SCALING.md). `_prewarm_last` is written from the engine
+        # worker thread's future callback (str compare/assign only).
+        self.prefix_prewarm_s = prefix_prewarm_s
+        self._prewarm_last: str | None = None
         self._sem = asyncio.Semaphore(max_concurrency)
         # Blocking (executor) binds get their own bound so they can't
         # monopolize the shared to_thread pool (snapshot runs there too).
@@ -273,6 +282,65 @@ class Scheduler:
                 self._tasks.add(task)
                 task.add_done_callback(self._tasks.discard)
 
+    async def _prefix_prewarm_loop(self) -> None:
+        """Keep the engine's prefix group pointed at the current cluster
+        snapshot while idle (engine/local.prewarm_prefix — advisory: the
+        engine drops installs whenever real traffic is in flight). The
+        rendered cluster prefix is the change signature: re-prewarm only
+        when the snapshot's PROMPT TEXT changed, so a steady-state tick
+        costs one ~0.1 ms render plus at most 1/snapshot_ttl_s snapshot
+        refreshes — and a refresh is an in-memory read for this repo's
+        ClusterState impls (cluster/kube.py is a watch-driven informer
+        serving get_node_metrics from its local cache with zero API
+        calls; cluster/fake.py is memory), NOT recurring apiserver load.
+        A polling ClusterState impl would pay its poll here at 1 Hz; gate
+        with scheduler.prefix_prewarm_seconds: 0 in that case. Exits on
+        the first tick if the backend doesn't support prewarming."""
+        from k8s_llm_scheduler_tpu.core.prompt import PromptEngine
+
+        pe = PromptEngine()
+        while not self._stop_event.is_set():
+            try:
+                await asyncio.wait_for(
+                    self._stop_event.wait(), timeout=self.prefix_prewarm_s
+                )
+                return
+            except asyncio.TimeoutError:
+                pass
+            if self._tasks:
+                # Decisions in flight: the engine would drop the install
+                # anyway (real traffic decides groups) — skip the render/
+                # encode entirely instead of blocking the event loop at
+                # tick rate for the whole burst. The tick resumes once the
+                # burst drains, when the snapshot has settled post-binds.
+                continue
+            try:
+                nodes = await self._node_snapshot()
+                sig = pe.cluster_part(nodes)
+                if sig == self._prewarm_last:
+                    continue
+                fut = self.client.prewarm_prefix(nodes)
+                if fut is None:
+                    return  # backend can't prewarm; stop ticking
+                self._prewarm_last = sig
+
+                def _done(f, s=sig):
+                    # engine-worker thread: GIL-atomic compare/assign only.
+                    # A dropped install (engine busy) clears the signature
+                    # so the next tick retries.
+                    try:
+                        ok = f.result()
+                    except Exception:
+                        ok = False
+                    if not ok and self._prewarm_last == s:
+                        self._prewarm_last = None
+
+                fut.add_done_callback(_done)
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                logger.exception("prefix prewarm tick failed")
+
     async def run(self) -> None:
         """Watch loop: stream pending pods, schedule each concurrently.
         Self-heals on stream errors (reference scheduler.py:683-685).
@@ -285,6 +353,11 @@ class Scheduler:
         # fresh task per pod costs two task creations + a cancel on the
         # ingest hot path (~50 ms across a 1000-pod burst).
         stop_task = asyncio.ensure_future(self._stop_event.wait())
+        prewarm_task = (
+            asyncio.create_task(self._prefix_prewarm_loop())
+            if self.prefix_prewarm_s > 0
+            else None
+        )
         try:
             while self.running:
                 stream = None
@@ -341,6 +414,12 @@ class Scheduler:
                 await stop_task
             except asyncio.CancelledError:
                 pass
+            if prewarm_task is not None:
+                prewarm_task.cancel()
+                try:
+                    await prewarm_task
+                except asyncio.CancelledError:
+                    pass
         await self.drain()
 
     async def drain(self) -> None:

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import json
 import logging
+import re
 from typing import Iterator
 
 import numpy as np
@@ -99,29 +100,40 @@ def cot_answer_ids(
 
 
 def build_cot(
-    tokenizer: Tokenizer, names: list[str], scores: list[float]
+    tokenizer: Tokenizer,
+    names: list[str],
+    scores: list[float],
+    echoes: list[tuple[str, str, str]] | None = None,
 ) -> tuple[str, list[str]]:
     """Running-max scratchpad CoT: `(cot_string, per-token kinds)`.
 
-    Format (one segment per feasible node, prompt order):
+    Format (one segment per feasible node, prompt order; echo fields
+    present when `echoes` is given):
 
-        node-0=61.2 max=61.2@node-0; node-1=43.4 max=61.2@node-0; ... best=node-0
+        node-0 c=61.2 m=43.4 p=12/110 s=59.9 max=59.9@node-0; ... best=node-0
 
-    Every cognitive step is LOCAL — this is the load-bearing redesign
-    after the round-5 finding that the linear score list left the final
-    argmax at a position bias for thousands of steps (the model had to
-    run a k-way comparison over tokens up to 100 positions back) while
-    isolated drills learned in ~250:
+    Every cognitive step is LOCAL — the load-bearing redesign after the
+    round-5 finding that the linear score list left the final argmax at a
+    position bias for thousands of steps (a k-way comparison over tokens
+    up to 100 positions back) while isolated drills learned in ~250:
 
-    - score emission (`=61.2`): the per-node regression from the prompt
-      metrics — measured to learn well in the linear format;
-    - running max value (`max=61.2`): a TWO-way compare between the score
-      just emitted (~6 tokens back) and the previous segment's max
-      (~14 tokens back), emitted as a copy of the winner;
-    - running max name (`@node-0`): copy of the name bound to the winning
-      value (equality binding within the last two segments);
-    - final choice (` best=node-0`): a copy of the adjacent last max name
-      — which the constrained selected_node field then copies again.
+    - input echoes (`c= m= p=`): LITERAL token copies of the node's
+      prompt metrics (the strings are rendered exactly as
+      core/prompt.render_node_block renders them, so under the numeric
+      tokenizer each value is the same NUM token appearing in the
+      prompt) — induction-head retrieval, decoupled from arithmetic.
+      Without them the score head must fuse long-range retrieval WITH
+      the weighted sum: measured at tiny capacity that plateaued at
+      score MAE ~8 while the compare/copy circuits hit 100%;
+    - score emission (`s=59.9`): the weighted-sum regression, now over
+      the ADJACENT echoed values;
+    - running max value (`max=59.9`): a TWO-way compare between the
+      score just emitted and the previous segment's max, emitted as a
+      copy of the winner;
+    - running max name (`@node-0`): copy of the name bound to the
+      winning value;
+    - final choice (` best=node-0`): a copy of the adjacent last max
+      name — which the constrained selected_node field copies again.
 
     Scores render at ONE decimal (0.1 granularity): rounding is monotone,
     so a rendered compare can never invert the true compare — it can only
@@ -131,15 +143,24 @@ def build_cot(
     `max(cand, key=score)` in core/fallback.py — so the rendered `best`
     always names the teacher's own argmax even on rendered ties.
 
-    Kinds (aligned 1:1 with `tokenizer.encode(cot_string)`):
-    `score_int`/`score_dec` the score value tokens, `cmp_int`/`cmp_dec`
-    the running-max value tokens, `decision` the final token of each
-    max/best NAME (the choice-bearing token), `fmt` everything else.
-    Piece boundaries never split a digit run, so per-piece encoding is
-    concatenation-safe for both builtin tokenizers (asserted)."""
+    Kinds (aligned 1:1 with `tokenizer.encode(cot_string)`): `echo` the
+    copied metric values, `score_int`/`score_dec` the score value tokens,
+    `cmp_int`/`cmp_dec` the running-max value tokens, `decision` the
+    final token of each max/best NAME (the choice-bearing token), `fmt`
+    everything else. Piece boundaries never split a digit run, so
+    per-piece encoding is concatenation-safe for both builtin tokenizers
+    (asserted)."""
     pieces: list[tuple[str, str]] = []
 
     def num(kind: str, tenths: int) -> None:
+        if tenths < 0:
+            # floor-division rendering is wrong below zero (-12 would
+            # render '-2.8'); the resource_balanced teacher is 0-100 by
+            # construction — refuse rather than emit self-inconsistent
+            # supervision if a future caller distills a signed scorer
+            raise ValueError(
+                f"build_cot scores must be non-negative, got {tenths / 10}"
+            )
         pieces.append((kind + "_int", str(tenths // 10)))
         pieces.append(("fmt", "."))
         pieces.append((kind + "_dec", str(tenths % 10)))
@@ -154,7 +175,20 @@ def build_cot(
         if i:
             pieces.append(("fmt", "; "))
         name("fmt", nm)
-        pieces.append(("fmt", "="))
+        if echoes is not None:
+            for label, value in zip((" c=", " m=", " p="), echoes[i]):
+                pieces.append(("fmt", label))
+                # split the echoed value at its separators so '.'/'/' carry
+                # kind 'fmt': only the DIGIT tokens are retrieval content —
+                # counting separators as echo would both inflate the echo
+                # diagnostic (format learnable with zero retrieval) and
+                # give them cot_weight
+                for part in re.split(r"([./])", value):
+                    if part:
+                        pieces.append(("fmt" if part in "./" else "echo", part))
+            pieces.append(("fmt", " s="))
+        else:
+            pieces.append(("fmt", "="))
         num("score", round(sc * 10))
         pieces.append(("fmt", " max="))
         num("cmp", round(scores[best_i] * 10))
@@ -199,7 +233,7 @@ def cot_token_weights(
     format carry loss."""
     w = np.ones(len(kinds), dtype=np.float32)
     for i, k in enumerate(kinds):
-        if k in ("score_int", "score_dec"):
+        if k in ("echo", "score_int", "score_dec"):
             w[i] = 0.0 if drill else cot_weight
         elif k in ("cmp_int", "cmp_dec", "decision"):
             w[i] = name_weight
@@ -208,7 +242,10 @@ def cot_token_weights(
 
 def teacher_cot(pod, nodes, tokenizer: Tokenizer) -> tuple[str, list[str]]:
     """build_cot over the feasible nodes' resource-balanced scores — the
-    teacher's own computation serialized as a running-max scratchpad."""
+    teacher's own computation serialized as a running-max scratchpad. The
+    echo fields render EXACTLY as core/prompt.render_node_block renders
+    the same metrics, so each echo is a literal token copy from the
+    prompt under the numeric tokenizer."""
     from k8s_llm_scheduler_tpu.core.fallback import score_resource_balanced
     from k8s_llm_scheduler_tpu.core.validation import feasible_nodes
 
@@ -217,6 +254,14 @@ def teacher_cot(pod, nodes, tokenizer: Tokenizer) -> tuple[str, list[str]]:
         tokenizer,
         [n.name for n in cand],
         [score_resource_balanced(n) for n in cand],
+        echoes=[
+            (
+                f"{n.cpu_usage_percent:.1f}",
+                f"{n.memory_usage_percent:.1f}",
+                f"{n.pod_count}/{n.max_pods}",
+            )
+            for n in cand
+        ],
     )
 
 
@@ -425,7 +470,20 @@ def make_batches(
         tenths = micro_rng.choice(1001, size=k, replace=False)
         names = [f"node-{i}" for i in range(k)]
         best = int(np.argmax(tenths))
-        cot, kinds = build_cot(tokenizer, names, [t / 10.0 for t in tenths])
+        # random echoes (zero-weighted, like the random scores): they keep
+        # the drill's token geometry identical to real answers so the
+        # compare/copy circuits train at the true positions
+        echoes = [
+            (
+                f"{micro_rng.uniform(0, 100):.1f}",
+                f"{micro_rng.uniform(0, 100):.1f}",
+                f"{int(micro_rng.integers(0, 110))}/110",
+            )
+            for _ in range(k)
+        ]
+        cot, kinds = build_cot(
+            tokenizer, names, [t / 10.0 for t in tenths], echoes=echoes
+        )
         ans, (ns, ne), (cs, ce) = cot_answer_ids(
             tokenizer, cot, names[best], 0.4
         )
@@ -683,7 +741,7 @@ def make_cot_diagnostics(
             col = off + cs + i
             if col <= 0 or col >= len(ids):
                 continue
-            if k in ("score_int", "cmp_int", "cmp_dec", "decision"):
+            if k in ("echo", "score_int", "cmp_int", "cmp_dec", "decision"):
                 # cmp_dec counts toward the compare circuit: on integer-
                 # digit score ties the decimal is where the compare is
                 # actually decided, and excluding it would let a broken
@@ -691,7 +749,7 @@ def make_cot_diagnostics(
                 pos_rows.append(filled)
                 pos_cols.append(col)
                 pos_kind.append(
-                    {"score_int": "score", "cmp_int": "cmp",
+                    {"echo": "echo", "score_int": "score", "cmp_int": "cmp",
                      "cmp_dec": "cmp"}.get(k, "copy")
                 )
         # the constrained selected_node choice token is a copy too
@@ -704,18 +762,37 @@ def make_cot_diagnostics(
     kind_arr = np.asarray(pos_kind)
 
     @jax.jit
-    def _hits(params, tokens, lens, row_idx, col_idx):
+    def _preds(params, tokens, lens, row_idx, col_idx):
         logits, _, _ = forward_prefill(params, cfg, tokens, lens)
         sel = logits[row_idx, col_idx - 1]  # predicting token at col
-        pred = jnp.argmax(sel, axis=-1)
-        return pred == tokens[row_idx, col_idx]
+        return jnp.argmax(sel, axis=-1), tokens[row_idx, col_idx]
+
+    num_base = getattr(tokenizer, "NUM_BASE", None)
+    num_count = getattr(tokenizer, "NUM_COUNT", 0)
 
     def diag(params) -> dict[str, float]:
-        hits = np.asarray(_hits(params, tokens, lens, row_idx, col_idx))
-        return {
+        pred, tgt = (
+            np.asarray(a)
+            for a in _preds(params, tokens, lens, row_idx, col_idx)
+        )
+        hits = pred == tgt
+        out = {
             k: float(hits[kind_arr == k].mean())
-            for k in ("score", "cmp", "copy")
+            for k in ("echo", "score", "cmp", "copy")
         }
+        if num_base is not None:
+            # score regression error in INTEGER UNITS (numeric tokenizer:
+            # token id - NUM_BASE is the value): exact-token accuracy is
+            # too strict to watch a regression converge — what bounds
+            # end-to-end agreement is |error| vs the top-2 score gap
+            sc = kind_arr == "score"
+            p, t = pred[sc], tgt[sc]
+            in_range = (p >= num_base) & (p < num_base + num_count)
+            err = np.where(
+                in_range, np.abs(p.astype(np.int64) - t), num_count
+            )
+            out["score_mae"] = float(err.mean())
+        return out
 
     return diag
 
@@ -871,10 +948,14 @@ def train_and_save(
             if diag is not None:
                 d = diag(state.params)
                 logger.info(
-                    "step %d/%d cot circuits (teacher-forced): score %.1f%% "
-                    "cmp %.1f%% copy %.1f%%",
-                    step, steps,
-                    100.0 * d["score"], 100.0 * d["cmp"], 100.0 * d["copy"],
+                    "step %d/%d cot circuits (teacher-forced): echo %.1f%% "
+                    "score %.1f%%%s cmp %.1f%% copy %.1f%%",
+                    step, steps, 100.0 * d["echo"], 100.0 * d["score"],
+                    (
+                        f" (mae {d['score_mae']:.1f})"
+                        if "score_mae" in d else ""
+                    ),
+                    100.0 * d["cmp"], 100.0 * d["copy"],
                 )
         if (
             save_every

@@ -129,6 +129,36 @@ class TestLLMEndToEnd:
         assert decision.latency_ms > 0
 
 
+class TestPrefixPrewarm:
+    def test_prewarm_installs_the_real_group_key(self):
+        """prewarm_prefix's dummy-suffix construction must land on the
+        EXACT group key a real pod produces — otherwise the install is
+        useless (the burst would switch groups anyway) and silently so."""
+        from k8s_llm_scheduler_tpu.cluster.interface import raw_pod_to_spec
+
+        backend = build_local_backend(
+            cfg=E2E_CFG, max_slots=2, num_pages=64, page_size=64,
+            prefill_buckets=(512, 1024, 2048, 4096),
+            temperature=0.0, compile_cache_dir=None,
+        )
+        try:
+            cluster = synthetic_cluster(3)
+            nodes = cluster.get_node_metrics()
+            cluster.close()
+            assert backend.prewarm_prefix(nodes).result(timeout=120) is True
+            pod = raw_pod_to_spec(next(iter(pod_burst(1))))
+            item = backend._prepare_item(pod, nodes)
+            assert backend._current_group == item.group_key
+            # a decision on the warm group serves without switching
+            d = backend.get_scheduling_decision(pod, nodes)
+            assert d.selected_node in {n.name for n in nodes}
+            assert backend._current_group == item.group_key
+            # idempotent: same snapshot re-prewarms as a no-op True
+            assert backend.prewarm_prefix(nodes).result(timeout=30) is True
+        finally:
+            backend.close()
+
+
 class TestCotAnswerStyle:
     def test_cot_decision_through_serving_stack(self):
         """answer_style='cot' (reasoning before the constrained choice):

@@ -9,7 +9,7 @@ import asyncio
 
 import pytest
 
-from k8s_llm_scheduler_tpu.cluster.fake import FakeCluster
+from k8s_llm_scheduler_tpu.cluster.fake import FakeCluster, FakeNode
 from k8s_llm_scheduler_tpu.core.breaker import CircuitBreaker
 from k8s_llm_scheduler_tpu.core.cache import DecisionCache
 from k8s_llm_scheduler_tpu.engine.backend import StubBackend
@@ -162,6 +162,81 @@ class TestE2E:
         stats = scheduler.get_stats()
         assert stats["total_scheduled"] == 3
         assert stats["client"]["total_requests"] == 3
+
+
+class TestPrefixPrewarm:
+    """Advisory prefix prewarming: the idle loop keeps the engine's
+    cluster-state prefix pointed at the live snapshot (VERDICT r4 #3 —
+    the burst1000 floor's dominant term is the cold prefix prefill)."""
+
+    async def test_prewarm_fires_once_per_snapshot_change(self):
+        from concurrent.futures import Future
+
+        cluster = synthetic_cluster(3)
+        backend = StubBackend()
+        calls: list[int] = []
+
+        def prewarm_prefix(nodes):
+            calls.append(len(nodes))
+            f: Future = Future()
+            f.set_result(True)
+            return f
+
+        backend.prewarm_prefix = prewarm_prefix
+        scheduler = make_scheduler(cluster, backend, prefix_prewarm_s=0.02)
+        task = asyncio.create_task(scheduler.run())
+        try:
+            async with asyncio.timeout(5):
+                while not calls:
+                    await asyncio.sleep(0.01)
+            n_first = len(calls)
+            # unchanged snapshot -> rendered-prefix dedupe: no more installs
+            await asyncio.sleep(0.15)
+            assert len(calls) == n_first
+            # cluster state changes (a new node changes the rendered
+            # prefix) -> the loop re-prewarms
+            cluster.add_node(FakeNode(name="node-new"))
+            async with asyncio.timeout(5):
+                while len(calls) == n_first:
+                    await asyncio.sleep(0.01)
+        finally:
+            scheduler.stop()
+            cluster.close()
+            await asyncio.wait_for(task, timeout=5)
+
+    async def test_dropped_install_retries_next_tick(self):
+        from concurrent.futures import Future
+
+        cluster = synthetic_cluster(2)
+        backend = StubBackend()
+        results = [False, True]  # first install dropped (engine "busy")
+        calls: list[int] = []
+
+        def prewarm_prefix(nodes):
+            calls.append(len(nodes))
+            f: Future = Future()
+            f.set_result(results[min(len(calls) - 1, 1)])
+            return f
+
+        backend.prewarm_prefix = prewarm_prefix
+        scheduler = make_scheduler(cluster, backend, prefix_prewarm_s=0.02)
+        task = asyncio.create_task(scheduler.run())
+        try:
+            async with asyncio.timeout(5):
+                while len(calls) < 2:  # False result clears the signature
+                    await asyncio.sleep(0.01)
+        finally:
+            scheduler.stop()
+            cluster.close()
+            await asyncio.wait_for(task, timeout=5)
+
+    async def test_backend_without_prewarm_is_harmless(self):
+        cluster = synthetic_cluster(2)
+        for raw in fixture_pods():
+            cluster.add_pod(raw)
+        scheduler = make_scheduler(cluster, prefix_prewarm_s=0.01)
+        await run_until_scheduled(scheduler, cluster, 3)
+        assert scheduler.stats["total_scheduled"] == 3
 
 
 class TestStopWhileIdle:

@@ -248,6 +248,29 @@ class TestDistill:
         assert cot.endswith("best=node-0")
         assert "node-0=50.0 max=50.0@node-0; node-1=50.0 max=50.0@node-0" in cot
 
+    def test_build_cot_echoes_are_prompt_literal_copies(self):
+        """With echoes, every echoed value must be token-identical to the
+        prompt rendering of the same metric (the copy-circuit premise),
+        and the echo tokens carry their own kind."""
+        from k8s_llm_scheduler_tpu.engine.tokenizer import NumericTokenizer
+        from k8s_llm_scheduler_tpu.train.distill import (
+            random_cases, teacher_cot,
+        )
+        from k8s_llm_scheduler_tpu.core.prompt import render_node_block
+
+        tok = NumericTokenizer()
+        pod, nodes = next(random_cases(n_nodes=3, seed=5))
+        cot, kinds = teacher_cot(pod, nodes, tok)
+        assert kinds.count("echo") >= 6  # >=2 nodes x 3 echoed values
+        for n in nodes:
+            block = render_node_block(n)
+            for val in (
+                f"{n.cpu_usage_percent:.1f}", f"{n.memory_usage_percent:.1f}",
+                f"{n.pod_count}/{n.max_pods}",
+            ):
+                assert val in block  # the prompt really shows this string
+                assert val in cot  # ...and the scratchpad echoes it
+
     def test_cot_pairs_weights_and_self_consistency(self):
         from k8s_llm_scheduler_tpu.engine.tokenizer import NumericTokenizer
         from k8s_llm_scheduler_tpu.train.distill import teacher_pairs
@@ -304,11 +327,12 @@ class TestDistill:
         diag = make_cot_diagnostics(cfg, tok, n_cases=4, seq_len=2048)
         params = init_params(jax.random.PRNGKey(0), cfg)
         out = diag(params)
-        assert set(out) == {"score", "cmp", "copy"}
-        for v in out.values():
-            assert 0.0 <= v <= 1.0
+        assert {"echo", "score", "cmp", "copy", "score_mae"} == set(out)
+        for k in ("echo", "score", "cmp", "copy"):
+            assert 0.0 <= out[k] <= 1.0
         # a random-init model cannot beat chance on the 1000-way scores
         assert out["score"] < 0.5
+        assert out["score_mae"] > 1.0
 
     def test_train_and_save_then_serve(self, tmp_path):
         from k8s_llm_scheduler_tpu.engine.local import build_local_backend
