@@ -319,7 +319,13 @@ class Scheduler:
                 sig = pe.cluster_part(nodes)
                 if sig == self._prewarm_last:
                     continue
-                fut = self.client.prewarm_prefix(nodes)
+                # to_thread: the local backend's prewarm_prefix is a queue
+                # put, but a FanoutBackend forwards over the decision-RPC
+                # wire — ReplicaClient may BLOCK dialing a dead worker for
+                # connect_timeout_s, which must not wedge the event loop
+                fut = await asyncio.to_thread(
+                    self.client.prewarm_prefix, nodes
+                )
                 if fut is None:
                     return  # backend can't prewarm; stop ticking
                 self._prewarm_last = sig

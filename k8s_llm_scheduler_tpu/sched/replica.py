@@ -247,6 +247,15 @@ class ReplicaServer:
     def _serve_one(self, conn, send_lock, req: dict) -> None:
         rid = req.get("id")
         try:
+            if req.get("op") == "prewarm":
+                # Advisory prefix install forwarded by the coordinator
+                # (engine/local.prewarm_prefix semantics). The response is
+                # sent from the backend future's callback, so this pool
+                # slot frees immediately (the return runs the finally's
+                # inflight decrement); a backend without prewarm support
+                # answers ok=False.
+                self._serve_prewarm(conn, send_lock, req)
+                return
             pod = pod_from_wire(req["pod"])
             nodes = [node_from_wire(n) for n in req["nodes"]]
             decision = self.backend.get_scheduling_decision(pod, nodes)
@@ -265,6 +274,37 @@ class ReplicaServer:
                 _send_frame(conn, resp)
         except OSError:
             pass  # client gone; nothing to deliver to
+
+    def _serve_prewarm(self, conn, send_lock, req: dict) -> None:
+        rid = req.get("id")
+
+        def reply(ok: bool) -> None:
+            try:
+                with send_lock:
+                    _send_frame(conn, {"id": rid, "ok": ok})
+            except OSError:
+                pass  # client gone; nothing to deliver to
+
+        fn = getattr(self.backend, "prewarm_prefix", None)
+        if fn is None:
+            reply(False)
+            return
+        try:
+            nodes = [node_from_wire(n) for n in req["nodes"]]
+            fut = fn(nodes)
+        except Exception:
+            logger.exception("replica prewarm failed")
+            reply(False)
+            return
+
+        def _done(f) -> None:
+            try:
+                ok = bool(f.result())
+            except Exception:
+                ok = False
+            reply(ok)
+
+        fut.add_done_callback(_done)
 
     def close(self) -> None:
         self._stop.set()
@@ -423,9 +463,13 @@ class ReplicaClient:
                     BackendError(f"replica {self.addr} connection lost")
                 )
 
-    def _submit(
-        self, pod: PodSpec, nodes: Sequence[NodeMetrics]
+    def _submit_frame(
+        self, payload: dict
     ) -> tuple[int, Future, socket.socket]:
+        """Allocate an id, register the pending future, and send
+        `payload` (id added) — THE single copy of the registration/send/
+        reader-death protocol, shared by decisions and prewarms so a fix
+        to its subtleties can never drift between them."""
         sock, reader = self._ensure_connected()
         rid = next(self._ids)
         fut: Future = Future()
@@ -435,11 +479,7 @@ class ReplicaClient:
             self._pending[rid] = fut
         try:
             with self._send_lock:
-                _send_frame(sock, {
-                    "id": rid,
-                    "pod": pod_to_wire(pod),
-                    "nodes": [node_to_wire(n) for n in nodes],
-                })
+                _send_frame(sock, {"id": rid, **payload})
         except OSError as exc:
             with self._pending_lock:
                 self._pending.pop(rid, None)
@@ -457,6 +497,55 @@ class ReplicaClient:
                     BackendError(f"replica {self.addr} connection lost")
                 )
         return rid, fut, sock
+
+    def _submit(
+        self, pod: PodSpec, nodes: Sequence[NodeMetrics]
+    ) -> tuple[int, Future, socket.socket]:
+        return self._submit_frame({
+            "pod": pod_to_wire(pod),
+            "nodes": [node_to_wire(n) for n in nodes],
+        })
+
+    def prewarm_prefix(self, nodes: Sequence[NodeMetrics]) -> Future:
+        """Forward an advisory prefix install to the worker's backend
+        (engine/local.prewarm_prefix over the wire). Resolves False on ANY
+        failure — transport errors included — because an advisory must
+        never surface as a backend fault; the prewarm loop simply retries
+        on its next tick. Deadline-bounded by request_timeout_s: a worker
+        that accepts the frame but never replies (engine stuck in a long
+        compile) must not leave this future — and the scheduler's
+        _prewarm_last signature — wedged forever."""
+        out: Future = Future()
+        try:
+            rid, fut, _sock = self._submit_frame({
+                "op": "prewarm",
+                "nodes": [node_to_wire(n) for n in nodes],
+            })
+        except Exception:
+            out.set_result(False)
+            return out
+
+        def _expire() -> None:
+            self._drop(rid)
+            if not out.done():
+                out.set_result(False)
+
+        timer = threading.Timer(self.request_timeout_s, _expire)
+        timer.daemon = True
+
+        def _done(f) -> None:
+            timer.cancel()
+            try:
+                resp = f.result()
+                if not out.done():
+                    out.set_result(bool(resp.get("ok")))
+            except Exception:
+                if not out.done():
+                    out.set_result(False)
+
+        fut.add_done_callback(_done)
+        timer.start()
+        return out
 
     def _resolve(self, resp: dict) -> SchedulingDecision:
         if "decision" in resp:
@@ -693,6 +782,41 @@ class FanoutBackend:
                         + self.EMA_ALPHA * elapsed_s
                     )
             h.probing = False
+
+    def prewarm_prefix(self, nodes: Sequence[NodeMetrics]):
+        """Fan the advisory prefix install out to EVERY replica that
+        supports it (shared-prefix economics hold per replica — each one
+        pays its own cluster-state prefill on the first leader otherwise).
+        Returns None when no replica supports prewarming (disables the
+        scheduler's prewarm loop), else a Future resolving True iff every
+        forwarded install succeeded — any False re-arms the loop's retry
+        on its next idle tick."""
+        futs = []
+        for r in self.replicas:
+            fn = getattr(r, "prewarm_prefix", None)
+            if fn is not None:
+                futs.append(fn(nodes))
+        if not futs:
+            return None
+        out: Future = Future()
+        state = {"left": len(futs), "ok": True}
+        lock = threading.Lock()
+
+        def _done(f) -> None:
+            try:
+                ok = bool(f.result())
+            except Exception:
+                ok = False
+            with lock:
+                state["ok"] &= ok
+                state["left"] -= 1
+                finished = state["left"] == 0
+            if finished and not out.done():
+                out.set_result(state["ok"])
+
+        for f in futs:
+            f.add_done_callback(_done)
+        return out
 
     def get_scheduling_decision(
         self, pod: PodSpec, nodes: Sequence[NodeMetrics]

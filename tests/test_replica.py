@@ -192,6 +192,89 @@ class TestClientServer:
             srv.close()
 
 
+class TestPrewarmOverWire:
+    def test_prewarm_forwards_and_resolves(self):
+        from concurrent.futures import Future
+
+        stub = StubBackend()
+        seen: list[int] = []
+
+        def prewarm_prefix(nodes):
+            seen.append(len(nodes))
+            f: Future = Future()
+            f.set_result(True)
+            return f
+
+        stub.prewarm_prefix = prewarm_prefix
+        srv = ReplicaServer(stub, host="127.0.0.1", port=0)
+        client = ReplicaClient("127.0.0.1", srv.port)
+        try:
+            assert client.prewarm_prefix(make_nodes(3)).result(timeout=5) is True
+            assert seen == [3]
+            # node metrics survive the wire: the worker prewarms the SAME
+            # snapshot the coordinator rendered
+        finally:
+            client.close()
+            srv.close()
+
+    def test_prewarm_unsupported_backend_answers_false(self):
+        srv = ReplicaServer(StubBackend(), host="127.0.0.1", port=0)
+        client = ReplicaClient("127.0.0.1", srv.port)
+        try:
+            assert client.prewarm_prefix(make_nodes(2)).result(timeout=5) is False
+        finally:
+            client.close()
+            srv.close()
+
+    def test_prewarm_unanswered_expires_false(self):
+        """A worker that accepts the frame but never replies must not wedge
+        the future forever — the request deadline resolves it False."""
+        from concurrent.futures import Future
+
+        stub = StubBackend()
+        stub.prewarm_prefix = lambda nodes: Future()  # never resolves
+        srv = ReplicaServer(stub, host="127.0.0.1", port=0)
+        client = ReplicaClient("127.0.0.1", srv.port, request_timeout_s=0.3)
+        try:
+            assert client.prewarm_prefix(make_nodes(2)).result(timeout=5) is False
+        finally:
+            client.close()
+            srv.close()
+
+    def test_prewarm_unreachable_resolves_false_not_raises(self):
+        client = ReplicaClient("127.0.0.1", 1, connect_timeout_s=0.2)
+        try:
+            assert client.prewarm_prefix(make_nodes(2)).result(timeout=5) is False
+        finally:
+            client.close()
+
+    def test_fanout_aggregates_all_replicas(self):
+        from concurrent.futures import Future
+        from k8s_llm_scheduler_tpu.sched.replica import FanoutBackend
+
+        class Warmable(StubBackend):
+            def __init__(self, ok):
+                super().__init__()
+                self.ok = ok
+                self.warmed = 0
+
+            def prewarm_prefix(self, nodes):
+                self.warmed += 1
+                f: Future = Future()
+                f.set_result(self.ok)
+                return f
+
+        a, b = Warmable(True), Warmable(True)
+        fo = FanoutBackend([a, b])
+        assert fo.prewarm_prefix(make_nodes(2)).result(timeout=5) is True
+        assert (a.warmed, b.warmed) == (1, 1)
+        # one dropped install surfaces as False (re-arms the loop's retry)
+        b.ok = False
+        assert fo.prewarm_prefix(make_nodes(2)).result(timeout=5) is False
+        # no replica supports it -> None (prewarm loop disables)
+        assert FanoutBackend([StubBackend()]).prewarm_prefix(make_nodes(2)) is None
+
+
 class TestConnectionLifecycle:
     def test_unreachable_replica_fails_fast_then_heals(self):
         """Constructing a client to a not-yet-up worker must not raise
