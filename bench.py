@@ -146,6 +146,16 @@ PRESETS = {
     # default suite at 1 round (bounded); standalone runs get 2.
     "steady": {"pods": 128, "nodes": 32, "shapes": 16, "rounds": 2,
                "arrival_rate": 100.0},
+    # burst AFTER a cluster-state change: every round perturbs node usage
+    # (so the cluster prefix differs from the engine's resident group),
+    # idles perturb_idle seconds, then bursts — the production shape
+    # (binds mutate state between bursts; SCALING.md burst1000 floor).
+    # A/B the scheduler's prefix prewarming with --prefix-prewarm 0:
+    # with it off the burst's first wave pays the prefix prefill + DFA
+    # switch; with it on (default) the idle loop installs the new group
+    # before the burst lands.
+    "restate": {"pods": 1000, "nodes": 64, "shapes": 32,
+                "perturb_idle": 1.0, "rounds": 3},
 }
 
 
@@ -261,6 +271,7 @@ async def bench_preset(args, backend=None) -> dict:
             cluster, cluster, client,
             scheduler_name=SCHEDULER_NAME, snapshot_ttl_s=300.0,
             max_concurrency=256,
+            prefix_prewarm_s=float(getattr(args, "prefix_prewarm", 0.25)),
         )
         # Tag every bound pod with its decision source so per-pod latencies
         # split into cold (LLM leader — paid a real wave round trip) and
@@ -276,6 +287,21 @@ async def bench_preset(args, backend=None) -> dict:
 
         scheduler._note_bind = tagging_note
         task = asyncio.create_task(scheduler.run())
+        if getattr(args, "perturb_idle", 0):
+            # Burst-after-state-change (restate preset): shift every
+            # node's usage deterministically per round so the rendered
+            # cluster prefix DIFFERS from the engine's resident group,
+            # then idle so prefix prewarming (if enabled) can install the
+            # new group before the burst lands. crc32, not hash():
+            # per-process hash salting would randomize the perturbation
+            # across the A and B runs of an A/B.
+            import zlib
+
+            seed = zlib.crc32(round_id.encode()) % 90
+            for i, node in enumerate(cluster._nodes.values()):
+                node.cpu_usage_percent = 5.0 + (i * 37 + seed) % 90
+                node.memory_usage_percent = 5.0 + (i * 53 + seed) % 90
+            await asyncio.sleep(float(args.perturb_idle))
         pods = pod_burst(n_pods, distinct_shapes=args.shapes)
         # distinct names per round so bind bookkeeping stays unambiguous
         import dataclasses as _dc
@@ -383,6 +409,7 @@ async def bench_preset(args, backend=None) -> dict:
             # pretrained weights. Throughput/MFU are weight-independent.
             "weights": "random-init",
             "preset": args.preset,
+            "prefix_prewarm_s": float(getattr(args, "prefix_prewarm", 0.25)),
             "baseline_note": "reference publishes no numbers; target p50<200ms (BASELINE.md)",
         },
     }
@@ -550,7 +577,7 @@ DEFAULTS = {
     # bucket, so its waves run at R=8.
     "pods": 64, "nodes": 32, "shapes": 8, "slots": 16, "model": "bench",
     "chunk_steps": 24, "max_new_tokens": 72, "temperature": 0.3,
-    "rounds": 3,
+    "rounds": 3, "perturb_idle": 0.0, "prefix_prewarm": 0.25,
 }
 
 
@@ -755,6 +782,16 @@ def main() -> None:
         "--arrival-rate", type=float, default=None,
         help="pods/sec arrival pacing instead of burst-at-t0 (steady preset)",
     )
+    parser.add_argument(
+        "--perturb-idle", type=float, default=None,
+        help="perturb node usage then idle this many seconds before each "
+             "round's burst (restate preset: burst after a state change)",
+    )
+    parser.add_argument(
+        "--prefix-prewarm", type=float, default=None,
+        help="scheduler prefix-prewarm tick seconds (0 disables; the "
+             "restate preset's A/B knob)",
+    )
     parser.add_argument("--quantize", choices=["int8"], default=None)
     parser.add_argument(
         "--preset", choices=sorted(PRESETS) + ["suite", "throughput"],
@@ -784,7 +821,8 @@ def main() -> None:
             name for name in (
                 "pods", "nodes", "shapes", "slots", "model", "chunk_steps",
                 "max_new_tokens", "temperature", "rounds", "arrival_rate",
-                "quantize", "profile_dir", "decode_matmul",
+                "quantize", "profile_dir", "decode_matmul", "perturb_idle",
+                "prefix_prewarm",
             )
             if getattr(args, name) is not None
         ]

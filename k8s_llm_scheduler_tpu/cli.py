@@ -490,6 +490,7 @@ def cmd_train(args: argparse.Namespace, cfg: Config) -> int:
         answer_style=cfg.get("llm.answer_style", "direct"),
         cot_weight=args.cot_weight,
         micro_frac=args.micro_frac,
+        prompt_lm_frac=args.prompt_lm_frac,
         seed=args.seed,
     )
     print(f"final loss {loss:.4f}; checkpoint at {args.out}")
@@ -681,6 +682,12 @@ def main(argv: list[str] | None = None) -> int:
         "--micro-frac", type=float, default=0.0,
         help="fraction of batch rows replaced by bare argmax drills "
              "(answer_style=cot; train-only scaffolding)",
+    )
+    p_train.add_argument(
+        "--prompt-lm-frac", type=float, default=0.0,
+        help="fraction of rows trained with plain full-sequence LM loss "
+             "(induction-head pressure from the repetitive prompt text; "
+             "the echo/retrieval circuit needs it — train/distill.py)",
     )
     p_train.add_argument(
         "--probe-every", type=int, default=0,

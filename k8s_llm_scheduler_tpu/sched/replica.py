@@ -508,40 +508,51 @@ class ReplicaClient:
 
     def prewarm_prefix(self, nodes: Sequence[NodeMetrics]) -> Future:
         """Forward an advisory prefix install to the worker's backend
-        (engine/local.prewarm_prefix over the wire). Resolves False on ANY
-        failure — transport errors included — because an advisory must
-        never surface as a backend fault; the prewarm loop simply retries
-        on its next tick. Deadline-bounded by request_timeout_s: a worker
-        that accepts the frame but never replies (engine stuck in a long
-        compile) must not leave this future — and the scheduler's
-        _prewarm_last signature — wedged forever."""
+        (engine/local.prewarm_prefix over the wire). The future resolves
+        bool for an ANSWERED advisory (True installed / False dropped —
+        both mean the worker is alive) and raises BackendError on
+        TRANSPORT failure (connect/send/reader-death/deadline) — the
+        distinction FanoutBackend's health gating needs: drops are
+        healthy, transport failures feed the cooldown. Deadline-bounded
+        by request_timeout_s so a worker that accepts the frame but never
+        replies (engine stuck in a long compile) cannot wedge this future
+        — or the scheduler's _prewarm_last signature — forever."""
         out: Future = Future()
         try:
             rid, fut, _sock = self._submit_frame({
                 "op": "prewarm",
                 "nodes": [node_to_wire(n) for n in nodes],
             })
-        except Exception:
-            out.set_result(False)
+        except Exception as exc:
+            out.set_exception(
+                BackendError(f"replica {self.addr} prewarm: {exc}")
+            )
             return out
 
         def _expire() -> None:
             self._drop(rid)
             if not out.done():
-                out.set_result(False)
+                out.set_exception(
+                    BackendError(
+                        f"replica {self.addr} prewarm unanswered after "
+                        f"{self.request_timeout_s}s"
+                    )
+                )
 
         timer = threading.Timer(self.request_timeout_s, _expire)
         timer.daemon = True
 
         def _done(f) -> None:
             timer.cancel()
+            if out.done():
+                return
             try:
                 resp = f.result()
-                if not out.done():
-                    out.set_result(bool(resp.get("ok")))
-            except Exception:
-                if not out.done():
-                    out.set_result(False)
+                out.set_result(bool(resp.get("ok")))
+            except Exception as exc:
+                out.set_exception(
+                    BackendError(f"replica {self.addr} prewarm: {exc}")
+                )
 
         fut.add_done_callback(_done)
         timer.start()
@@ -761,10 +772,17 @@ class FanoutBackend:
             self.routed[i] += 1
             return i
 
-    def _record(self, i: int, elapsed_s: float | None, failed: bool) -> None:
+    def _record(
+        self,
+        i: int,
+        elapsed_s: float | None,
+        failed: bool,
+        adjust_inflight: bool = True,
+    ) -> None:
         with self._lock:
             h = self._health[i]
-            h.inflight = max(0, h.inflight - 1)
+            if adjust_inflight:
+                h.inflight = max(0, h.inflight - 1)
             if failed:
                 h.failures += 1
                 backoff = min(
@@ -784,29 +802,53 @@ class FanoutBackend:
             h.probing = False
 
     def prewarm_prefix(self, nodes: Sequence[NodeMetrics]):
-        """Fan the advisory prefix install out to EVERY replica that
-        supports it (shared-prefix economics hold per replica — each one
-        pays its own cluster-state prefill on the first leader otherwise).
+        """Fan the advisory prefix install out to every replica that
+        supports it AND is not in failure cooldown (shared-prefix
+        economics hold per replica — each one pays its own cluster-state
+        prefill on the first leader otherwise).
+
+        Health integration: a TRANSPORT failure (connect/send/deadline —
+        the replica client raises) feeds the same exponential cooldown
+        decisions use, so a black-holed worker costs at most one blocking
+        dial per cooldown expiry instead of one per prewarm tick; an
+        advisory drop (the worker answered ok=False — e.g. busy) is a
+        HEALTHY fast answer and clears failures. Cooling replicas are
+        skipped outright.
+
         Returns None when no replica supports prewarming (disables the
         scheduler's prewarm loop), else a Future resolving True iff every
-        forwarded install succeeded — any False re-arms the loop's retry
+        replica that was actually forwarded to installed — False (any
+        drop, any failure, or everyone cooling) re-arms the loop's retry
         on its next idle tick."""
-        futs = []
-        for r in self.replicas:
+        now = time.monotonic()
+        futs: list[tuple[int, Future]] = []
+        supported = 0
+        for i, r in enumerate(self.replicas):
             fn = getattr(r, "prewarm_prefix", None)
-            if fn is not None:
-                futs.append(fn(nodes))
-        if not futs:
+            if fn is None:
+                continue
+            supported += 1
+            with self._lock:
+                cooling = self._health[i].cooldown_until > now
+            if cooling:
+                continue
+            futs.append((i, fn(nodes)))
+        if not supported:
             return None
         out: Future = Future()
+        if not futs:  # all supported replicas cooling: retry next tick
+            out.set_result(False)
+            return out
         state = {"left": len(futs), "ok": True}
         lock = threading.Lock()
 
-        def _done(f) -> None:
+        def _done(i: int, f: Future) -> None:
             try:
                 ok = bool(f.result())
+                failed = False
             except Exception:
-                ok = False
+                ok, failed = False, True
+            self._record(i, None, failed, adjust_inflight=False)
             with lock:
                 state["ok"] &= ok
                 state["left"] -= 1
@@ -814,8 +856,8 @@ class FanoutBackend:
             if finished and not out.done():
                 out.set_result(state["ok"])
 
-        for f in futs:
-            f.add_done_callback(_done)
+        for i, f in futs:
+            f.add_done_callback(lambda fut, i=i: _done(i, fut))
         return out
 
     def get_scheduling_decision(

@@ -433,6 +433,7 @@ def make_batches(
     answer_style: str = "direct",
     cot_weight: float = 1.0,
     micro_frac: float = 0.0,
+    prompt_lm_frac: float = 0.0,
 ) -> Iterator[tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]]:
     """Batched, padded (tokens, seq_lens, answer_starts, loss_weights) for
     the train step (answer_starts feeds the loss mask; loss_weights
@@ -448,7 +449,16 @@ def make_batches(
     the choice tokens at a position bias for thousands (measured; the
     score REGRESSION learns fine) — these rows inject that concentrated
     compare/copy signal at realistic positions. Train-only scaffolding:
-    the eval never sees them."""
+    the eval never sees them.
+
+    `prompt_lm_frac`: fraction of rows trained with PLAIN full-sequence
+    LM loss (loss_start 0, uniform weights) instead of answer masking.
+    The prompt's node blocks are highly repetitive structured text —
+    next-token pressure on them is the classic driver of induction-head
+    formation, which the echo/retrieval circuit needs and which
+    answer-only loss provides no gradient for (measured: echo accuracy
+    flatlined at ~22% through 1.5k answer-masked steps while the local
+    compare/copy circuits passed 90%)."""
     pairs = teacher_pairs(
         tokenizer, n_nodes=n_nodes, seed=seed, easy_frac=easy_frac,
         answer_style=answer_style, name_weight=name_weight,
@@ -508,11 +518,12 @@ def make_batches(
         weights = np.ones((batch_size, seq_len), dtype=np.float32)
         for b in range(batch_size):
             ids, ans_start, _name_span, w_ids = next(pairs)
-            if (
-                micro_frac
+            is_drill = (
+                bool(micro_frac)
                 and answer_style == "cot"
                 and micro_rng.random() < micro_frac
-            ):
+            )
+            if is_drill:
                 # reuse this pair's PROMPT as the drill's distractor fill
                 ids, ans_start, _name_span, w_ids = micro_row(
                     ids[:ans_start]
@@ -535,6 +546,16 @@ def make_batches(
             lens[b] = len(ids)
             starts[b] = ans_start
             weights[b, : len(ids)] = w_ids
+            if (
+                prompt_lm_frac
+                and not is_drill  # a drill's random scores/echoes are
+                # deliberately unlearnable — full-sequence loss on them
+                # would push score positions toward uniform noise
+                and micro_rng.random() < prompt_lm_frac
+            ):
+                # plain-LM row: model the whole sequence (see docstring)
+                starts[b] = 0
+                weights[b] = 1.0
         yield tokens, lens, starts, weights
 
 
@@ -818,6 +839,7 @@ def train_and_save(
     answer_style: str = "direct",
     cot_weight: float = 1.0,
     micro_frac: float = 0.0,
+    prompt_lm_frac: float = 0.0,
 ) -> float:
     """Run `steps` of answer-masked fine-tuning on teacher pairs and save
     an orbax checkpoint servable via checkpoint_path. Returns the final
@@ -915,6 +937,7 @@ def train_and_save(
         tokenizer, batch_size, seq_len, seed=seed, name_weight=name_weight,
         easy_frac=easy_frac, answer_style=answer_style,
         cot_weight=cot_weight, micro_frac=micro_frac,
+        prompt_lm_frac=prompt_lm_frac,
     )
     probe = (
         make_agreement_probe(

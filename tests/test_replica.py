@@ -226,9 +226,10 @@ class TestPrewarmOverWire:
             client.close()
             srv.close()
 
-    def test_prewarm_unanswered_expires_false(self):
+    def test_prewarm_unanswered_expires_as_transport_failure(self):
         """A worker that accepts the frame but never replies must not wedge
-        the future forever — the request deadline resolves it False."""
+        the future forever — the request deadline raises BackendError (a
+        transport failure, which FanoutBackend's health gating cools)."""
         from concurrent.futures import Future
 
         stub = StubBackend()
@@ -236,15 +237,17 @@ class TestPrewarmOverWire:
         srv = ReplicaServer(stub, host="127.0.0.1", port=0)
         client = ReplicaClient("127.0.0.1", srv.port, request_timeout_s=0.3)
         try:
-            assert client.prewarm_prefix(make_nodes(2)).result(timeout=5) is False
+            with pytest.raises(BackendError):
+                client.prewarm_prefix(make_nodes(2)).result(timeout=5)
         finally:
             client.close()
             srv.close()
 
-    def test_prewarm_unreachable_resolves_false_not_raises(self):
+    def test_prewarm_unreachable_raises_transport_failure(self):
         client = ReplicaClient("127.0.0.1", 1, connect_timeout_s=0.2)
         try:
-            assert client.prewarm_prefix(make_nodes(2)).result(timeout=5) is False
+            with pytest.raises(BackendError):
+                client.prewarm_prefix(make_nodes(2)).result(timeout=5)
         finally:
             client.close()
 
@@ -269,10 +272,45 @@ class TestPrewarmOverWire:
         assert fo.prewarm_prefix(make_nodes(2)).result(timeout=5) is True
         assert (a.warmed, b.warmed) == (1, 1)
         # one dropped install surfaces as False (re-arms the loop's retry)
+        # but is a HEALTHY answer: no cooldown
         b.ok = False
         assert fo.prewarm_prefix(make_nodes(2)).result(timeout=5) is False
+        assert fo._health[1].cooldown_until == 0.0
         # no replica supports it -> None (prewarm loop disables)
         assert FanoutBackend([StubBackend()]).prewarm_prefix(make_nodes(2)) is None
+
+    def test_fanout_transport_failure_cools_replica(self):
+        """A replica whose prewarm RAISES (dead host) enters the same
+        exponential cooldown decisions use; subsequent prewarms skip it
+        (no blocking dial per tick) until the cooldown expires."""
+        from concurrent.futures import Future
+        from k8s_llm_scheduler_tpu.sched.replica import FanoutBackend
+
+        class Dead(StubBackend):
+            def __init__(self):
+                super().__init__()
+                self.dials = 0
+
+            def prewarm_prefix(self, nodes):
+                self.dials += 1
+                f: Future = Future()
+                f.set_exception(BackendError("black hole"))
+                return f
+
+        class Good(StubBackend):
+            def prewarm_prefix(self, nodes):
+                f: Future = Future()
+                f.set_result(True)
+                return f
+
+        dead, good = Dead(), Good()
+        fo = FanoutBackend([good, dead])
+        assert fo.prewarm_prefix(make_nodes(2)).result(timeout=5) is False
+        assert dead.dials == 1
+        assert fo._health[1].cooldown_until > 0
+        # cooling: the dead replica is NOT dialed again; healthy one is
+        assert fo.prewarm_prefix(make_nodes(2)).result(timeout=5) is True
+        assert dead.dials == 1
 
 
 class TestConnectionLifecycle:
