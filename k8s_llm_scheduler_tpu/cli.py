@@ -491,6 +491,8 @@ def cmd_train(args: argparse.Namespace, cfg: Config) -> int:
         cot_weight=args.cot_weight,
         micro_frac=args.micro_frac,
         prompt_lm_frac=args.prompt_lm_frac,
+        placement_frac=args.placement_frac,
+        diverse_frac=args.diverse_frac,
         seed=args.seed,
     )
     print(f"final loss {loss:.4f}; checkpoint at {args.out}")
@@ -682,6 +684,16 @@ def main(argv: list[str] | None = None) -> int:
         "--micro-frac", type=float, default=0.0,
         help="fraction of batch rows replaced by bare argmax drills "
              "(answer_style=cot; train-only scaffolding)",
+    )
+    p_train.add_argument(
+        "--placement-frac", type=float, default=0.0,
+        help="fraction of cases drawn from sequential-placement rollouts "
+             "(the fold manifold eval_placement walks; train/distill.py)",
+    )
+    p_train.add_argument(
+        "--diverse-frac", type=float, default=0.0,
+        help="fraction of cases drawn from constraint scenarios (hetero "
+             "SKUs, taints, selectors, affinity) at train-disjoint seeds",
     )
     p_train.add_argument(
         "--prompt-lm-frac", type=float, default=0.0,

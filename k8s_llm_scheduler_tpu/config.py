@@ -150,6 +150,7 @@ ENV_OVERRIDES: dict[str, str] = {
     "LLM_TOKENIZER": "llm.tokenizer",
     "LLM_ANSWER_STYLE": "llm.answer_style",
     "LLM_MAX_REASON_TOKENS": "llm.max_reason_tokens",
+    "LLM_MAX_TOKENS": "llm.max_tokens",
     "MAX_RETRIES": "llm.max_retries",
     "CACHE_ENABLED": "cache.enabled",
     "CACHE_TTL": "cache.ttl_seconds",

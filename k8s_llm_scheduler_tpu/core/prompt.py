@@ -102,6 +102,23 @@ def pod_suffix(pod: PodSpec) -> str:
                 f"{t.get('key', '*')}:{t.get('effect', '')}" for t in pod.tolerations
             )
         )
+    if pod.affinity_rules.get("node_affinity_terms"):
+        # required node affinity (core/validation.node_affinity_matches):
+        # terms OR'd, expressions within a term AND'd. The reference
+        # always dropped affinity before prompting (scheduler.py:762) —
+        # rendering it is what makes the constraint LEARNABLE by a
+        # distilled decider (a model cannot honor a filter it never sees).
+        rendered_terms = []
+        for term in pod.affinity_rules["node_affinity_terms"]:
+            exprs = ", ".join(
+                f"{e.get('key', '?')} {e.get('operator', 'In')} "
+                f"[{', '.join(e.get('values', []) or [])}]"
+                for e in term
+            )
+            if exprs:
+                rendered_terms.append(f"({exprs})")
+        if rendered_terms:
+            lines.append("  Node affinity: " + " OR ".join(rendered_terms))
     lines.append("")
     lines.append(
         'Select the best node. Respond with ONLY the JSON object: '

@@ -296,9 +296,24 @@ class LocalLLMBackend:
                     f"empty decision for node names up to {longest_name} tokens; "
                     f"need >= {62 + longest_name}"
                 )
+            effective = min(budget, self.max_reason_tokens)
+            if self.answer_style == "cot" and effective < self.max_reason_tokens:
+                # Silent truncation burns distilled-checkpoint quality: the
+                # scratchpad gets force-closed mid-comparison and the
+                # constrained choice runs off a half-built argument
+                # (measured: eval agreement 40/40 -> 44% from exactly
+                # this). One loud line beats a quiet quality cliff.
+                logger.warning(
+                    "answer_style=cot but max_new_tokens=%d caps reasoning "
+                    "at %d tokens (< max_reason_tokens=%d) — scratchpads "
+                    "for larger clusters will be truncated; raise "
+                    "llm.max_tokens to >= %d",
+                    self.max_new_tokens, effective, self.max_reason_tokens,
+                    self.max_reason_tokens + 62 + longest_name + 2,
+                )
             self._dfa_cache[key] = build_decision_dfa(
                 self.tokenizer, list(key),
-                max_reason_tokens=min(budget, self.max_reason_tokens),
+                max_reason_tokens=effective,
                 style=self.answer_style,
             )
         return self._dfa_cache[key]

@@ -344,6 +344,69 @@ def easy_cases(n_nodes: int = 3, seed: int = 1):
             )
 
 
+def placement_cases(n_nodes: int = 5, seed: int = 2):
+    """States visited by sequential placement ROLLOUTS — the fold
+    manifold: after each teacher decision the placed node's usage is
+    re-synthesized from its pod count ((pods/max)*50, exactly
+    train/eval._apply_placement / reference scheduler.py:149-151) while
+    its peers keep their original metrics. eval_placement walks this
+    manifold for 32 consecutive decisions, so its tipping points — a
+    node's synthesized usage just overtaking a peer, score gaps under 1
+    point — dominate the spread metric; a model trained only on
+    independent U(5,95) states carries ~±0.3 score error there and
+    piles onto a stale favorite (measured: placement spread 0.295 vs
+    the teacher's 0.019 at 100% single-shot agreement). Train-time seeds
+    are disjoint from the eval streams; the manifold coverage is what
+    transfers, not the cases."""
+    import dataclasses
+
+    from k8s_llm_scheduler_tpu.train.eval import (
+        _apply_placement,
+        teacher_decide,
+    )
+
+    rng = np.random.default_rng(seed)
+    base = random_cases(n_nodes=n_nodes, seed=seed + 11)
+    while True:
+        pod, nodes = next(base)
+        nodes = list(nodes)
+        for _ in range(int(rng.integers(4, 17))):
+            p = dataclasses.replace(
+                pod,
+                cpu_request=round(float(rng.uniform(0.05, 2.0)), 3),
+                memory_request=round(float(rng.uniform(0.064, 2.0)), 3),
+            )
+            yield p, list(nodes)
+            target = teacher_decide(p, nodes)
+            if target is None:
+                break
+            nodes = _apply_placement(nodes, target)
+
+
+def diverse_cases(n_nodes: int = 5, seed: int = 4):
+    """Constraint-dimension cases for training: heterogeneous SKUs,
+    taints/tolerations, selectors, and required node affinity — the
+    train/eval.scenario_cases generator family at TRAIN-DISJOINT seeds
+    (the eval's scenario table stays held out; what transfers is the
+    distribution, not the cases). Without these the decider learns the
+    global argmax and lands BELOW chance on constrained clusters — the
+    teacher's feasible-set argmax needs the model to apply the filters
+    the prompt states (measured: selector class 25% vs 58% chance)."""
+    from k8s_llm_scheduler_tpu.train.eval import (
+        SCENARIO_CLASSES,
+        scenario_cases,
+    )
+
+    gens = [
+        scenario_cases(kind, n_nodes=n_nodes, seed=seed + 101 + i)
+        for i, kind in enumerate(SCENARIO_CLASSES)
+        if kind != "uniform"
+    ]
+    rng = np.random.default_rng(seed)
+    while True:
+        yield next(gens[int(rng.integers(len(gens)))])
+
+
 def teacher_pairs(
     tokenizer: Tokenizer,
     n_nodes: int = 5,
@@ -352,6 +415,8 @@ def teacher_pairs(
     answer_style: str = "direct",
     name_weight: float = 8.0,
     cot_weight: float = 1.0,
+    placement_frac: float = 0.0,
+    diverse_frac: float = 0.0,
 ) -> Iterator[tuple[list[int], int, tuple[int, int], np.ndarray]]:
     """Endless (prompt + decision tokens, answer_start, name_span,
     loss_weights) samples from the heuristic teacher over randomized
@@ -374,15 +439,35 @@ def teacher_pairs(
     tokens carried ~2% of the gradient, diluted by their own scores)."""
     pe = PromptEngine()
 
+    fracs = (placement_frac, diverse_frac, easy_frac)
+    if any(f < 0 for f in fracs) or sum(fracs) > 1.0:
+        # oversubscribed fractions would silently cannibalize the later
+        # streams (the cumulative-threshold chain below) — the hard
+        # stream, THE training distribution, could vanish with no warning
+        raise ValueError(
+            f"placement_frac+diverse_frac+easy_frac must be in [0, 1]: "
+            f"{fracs}"
+        )
+
     def mixed_cases():
         hard = random_cases(n_nodes=n_nodes, seed=seed)
-        if not easy_frac:
+        if not easy_frac and not placement_frac and not diverse_frac:
             yield from hard
             return
         easy = easy_cases(seed=seed + 1)
+        rollout = placement_cases(n_nodes=n_nodes, seed=seed + 3)
+        diverse = diverse_cases(n_nodes=n_nodes, seed=seed + 4)
         rng = np.random.default_rng(seed + 2)
         while True:
-            yield next(easy if rng.random() < easy_frac else hard)
+            r = rng.random()
+            if r < placement_frac:
+                yield next(rollout)
+            elif r < placement_frac + diverse_frac:
+                yield next(diverse)
+            elif r < placement_frac + diverse_frac + easy_frac:
+                yield next(easy)
+            else:
+                yield next(hard)
 
     for pod, nodes in mixed_cases():
         if answer_style == "cot":
@@ -434,6 +519,8 @@ def make_batches(
     cot_weight: float = 1.0,
     micro_frac: float = 0.0,
     prompt_lm_frac: float = 0.0,
+    placement_frac: float = 0.0,
+    diverse_frac: float = 0.0,
 ) -> Iterator[tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]]:
     """Batched, padded (tokens, seq_lens, answer_starts, loss_weights) for
     the train step (answer_starts feeds the loss mask; loss_weights
@@ -462,7 +549,8 @@ def make_batches(
     pairs = teacher_pairs(
         tokenizer, n_nodes=n_nodes, seed=seed, easy_frac=easy_frac,
         answer_style=answer_style, name_weight=name_weight,
-        cot_weight=cot_weight,
+        cot_weight=cot_weight, placement_frac=placement_frac,
+        diverse_frac=diverse_frac,
     )
     micro_rng = np.random.default_rng(seed + 7)
 
@@ -840,6 +928,8 @@ def train_and_save(
     cot_weight: float = 1.0,
     micro_frac: float = 0.0,
     prompt_lm_frac: float = 0.0,
+    placement_frac: float = 0.0,
+    diverse_frac: float = 0.0,
 ) -> float:
     """Run `steps` of answer-masked fine-tuning on teacher pairs and save
     an orbax checkpoint servable via checkpoint_path. Returns the final
@@ -937,7 +1027,8 @@ def train_and_save(
         tokenizer, batch_size, seq_len, seed=seed, name_weight=name_weight,
         easy_frac=easy_frac, answer_style=answer_style,
         cot_weight=cot_weight, micro_frac=micro_frac,
-        prompt_lm_frac=prompt_lm_frac,
+        prompt_lm_frac=prompt_lm_frac, placement_frac=placement_frac,
+        diverse_frac=diverse_frac,
     )
     probe = (
         make_agreement_probe(

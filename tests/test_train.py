@@ -334,6 +334,78 @@ class TestDistill:
         assert out["score"] < 0.5
         assert out["score_mae"] > 1.0
 
+    def test_placement_cases_walk_the_fold_manifold(self):
+        from k8s_llm_scheduler_tpu.train.distill import placement_cases
+
+        it = placement_cases(n_nodes=4, seed=9)
+        seen_fold = False
+        prev_nodes = None
+        for _ in range(20):
+            pod, nodes = next(it)
+            if (
+                prev_nodes is not None
+                and len(nodes) == len(prev_nodes)
+                # a FOLD step (not a rollout restart, which can reuse the
+                # same base cluster and differ only in the reset node):
+                # exactly one node changed, and it gained exactly one pod
+                and sum(a != b for a, b in zip(prev_nodes, nodes)) == 1
+                and any(
+                    a != b and b.pod_count == a.pod_count + 1
+                    for a, b in zip(prev_nodes, nodes)
+                )
+            ):
+                for a, b in zip(prev_nodes, nodes):
+                    if a == b:
+                        continue
+                    # the folded node's usage is re-synthesized (pods/max)*50
+                    synth = (b.pod_count / b.max_pods) * 50.0
+                    assert abs(b.cpu_usage_percent - synth) < 1e-9
+                    assert abs(b.memory_usage_percent - synth) < 1e-9
+                    seen_fold = True
+            prev_nodes = nodes
+        assert seen_fold
+
+    def test_diverse_cases_cover_constraint_dimensions(self):
+        from k8s_llm_scheduler_tpu.train.distill import diverse_cases
+
+        it = diverse_cases(seed=7)
+        saw = {"taint": False, "selector": False, "affinity": False,
+               "hetero": False}
+        for _ in range(200):
+            pod, nodes = next(it)
+            if any(n.taints for n in nodes):
+                saw["taint"] = True
+            if pod.node_selector:
+                saw["selector"] = True
+            if pod.affinity_rules:
+                saw["affinity"] = True
+            if len({n.max_pods for n in nodes}) > 1:
+                saw["hetero"] = True
+        assert all(saw.values()), saw
+
+    def test_affinity_rendered_in_prompt(self):
+        from k8s_llm_scheduler_tpu.core.prompt import pod_suffix
+        from k8s_llm_scheduler_tpu.types import PodSpec
+
+        pod = PodSpec(
+            name="p", namespace="default", cpu_request=0.1,
+            memory_request=0.1, node_selector={}, tolerations=(),
+            priority=0,
+            affinity_rules={
+                "node_affinity_terms": [
+                    [{"key": "zone", "operator": "In", "values": ["z0", "z2"]}]
+                ]
+            },
+        )
+        text = pod_suffix(pod)
+        assert "Node affinity: (zone In [z0, z2])" in text
+        # no affinity -> no line (reference pods carry none)
+        bare = PodSpec(
+            name="p", namespace="default", cpu_request=0.1,
+            memory_request=0.1, node_selector={}, tolerations=(), priority=0,
+        )
+        assert "affinity" not in pod_suffix(bare).lower()
+
     def test_train_and_save_then_serve(self, tmp_path):
         from k8s_llm_scheduler_tpu.engine.local import build_local_backend
         from k8s_llm_scheduler_tpu.train.distill import train_and_save
