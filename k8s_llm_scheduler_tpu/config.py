@@ -151,6 +151,7 @@ ENV_OVERRIDES: dict[str, str] = {
     "LLM_ANSWER_STYLE": "llm.answer_style",
     "LLM_MAX_REASON_TOKENS": "llm.max_reason_tokens",
     "LLM_MAX_TOKENS": "llm.max_tokens",
+    "LLM_TEMPERATURE": "llm.temperature",
     "MAX_RETRIES": "llm.max_retries",
     "CACHE_ENABLED": "cache.enabled",
     "CACHE_TTL": "cache.ttl_seconds",

@@ -149,3 +149,19 @@ class TestCLI:
         rc = main(["--config", str(cfg_file), "run"])
         assert rc == 2
         assert "fake-cluster" in capsys.readouterr().err
+
+    def test_eval_backend_defaults_to_greedy(self, tmp_path):
+        """cli eval measures the decider GREEDY by default (deterministic
+        report card; --temperature opts into sampled measurement) while
+        serving keeps llm.temperature (EVAL.md round-5 traps)."""
+        from k8s_llm_scheduler_tpu.cli import (
+            _backend_kwargs, _eval_backend_kwargs,
+        )
+        from k8s_llm_scheduler_tpu.config import load_config
+
+        cfg_file = tmp_path / "config.yaml"
+        cfg_file.write_text("llm:\n  temperature: 0.5\n")
+        cfg = load_config(str(cfg_file))
+        assert _backend_kwargs(cfg)["temperature"] == 0.5  # serving
+        assert _eval_backend_kwargs(cfg)["temperature"] == 0.0  # report card
+        assert _eval_backend_kwargs(cfg, temperature=0.7)["temperature"] == 0.7
