@@ -51,7 +51,7 @@ def _backend_kwargs(cfg: Config, **overrides) -> dict:
         tokenizer_name=cfg.get("llm.tokenizer", "byte"),
         decode_matmul=cfg.get("llm.decode_matmul", "dense"),
         answer_style=cfg.get("llm.answer_style", "direct"),
-        max_reason_tokens=int(cfg.get("llm.max_reason_tokens", 288)),
+        max_reason_tokens=int(cfg.get("llm.max_reason_tokens", 320)),
         quantize=cfg.get("llm.quantization"),
         request_timeout_s=float(cfg.get("llm.timeout")),
         group_switch_after_s=float(cfg.get("llm.group_switch_after_s")),

@@ -78,9 +78,9 @@ DEFAULTS: dict[str, Any] = {
         # effective budget is min(this, llm.max_tokens - 62 - name)). The
         # scratchpad CoT with input echoes (train/distill.build_cot)
         # measures <=245 tokens for 5 feasible nodes under the numeric
-        # tokenizer, <=280 under byte; 288 covers both. Serving a CoT
-        # checkpoint needs llm.max_tokens >= 62 + name + this (e.g. 360).
-        "max_reason_tokens": 288,
+        # tokenizer, <=290 under byte; 320 covers both. Serving a CoT
+        # checkpoint needs llm.max_tokens >= 62 + name + this (e.g. 390).
+        "max_reason_tokens": 320,
         # fairness bound for (prefix, grammar) group switches under load
         # (engine/local.py _submit_waves)
         "group_switch_after_s": 0.25,

@@ -108,7 +108,7 @@ class LocalLLMBackend:
         partial_hold_s: float = 0.03,
         prewarm_idle_delay_s: float = 0.5,
         answer_style: str = "direct",
-        max_reason_tokens: int = 288,
+        max_reason_tokens: int = 320,
     ) -> None:
         self.engine = engine
         # Decision JSON field order: "direct" (reference serialization) or
@@ -119,8 +119,8 @@ class LocalLLMBackend:
         # effective cap is min(this, max_new_tokens - 62 - name)). The
         # scratchpad CoT of a distilled checkpoint (train/distill.build_cot
         # with input echoes) measures <=245 tokens at 5 feasible nodes
-        # numeric-tokenized, <=280 byte-tokenized — CoT serving needs
-        # max_new_tokens ~360 alongside the 288 default here.
+        # numeric-tokenized, <=290 byte-tokenized — CoT serving needs
+        # max_new_tokens ~390 alongside the 320 default here.
         self.max_reason_tokens = max_reason_tokens
         # Idle grace before a sibling-geometry prewarm compile may start:
         # a jit blocks the worker for seconds, so it must not fire the
@@ -681,7 +681,7 @@ def build_local_backend(
     prewarm_idle_delay_s: float = 0.5,
     compile_cache_dir: str | None = "auto",
     answer_style: str = "direct",
-    max_reason_tokens: int = 288,
+    max_reason_tokens: int = 320,
 ) -> LocalLLMBackend:
     """Construct the full local stack: params (from an HF safetensors or
     orbax checkpoint when checkpoint_path is set, random-init otherwise —

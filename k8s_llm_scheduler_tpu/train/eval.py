@@ -360,8 +360,10 @@ def evaluate_checkpoint(
     model (the floor). Pass `backend` to reuse an already-built one, or
     `backend_kwargs` (e.g. the cli's cfg mapping — quantization,
     tokenizer, mesh, compile cache) so the report card measures the model
-    AS SERVED, not a default-configured twin. temperature is forced to 0:
-    the report evaluates the argmax policy deterministically."""
+    AS SERVED, not a default-configured twin. temperature DEFAULTS to 0
+    (deterministic argmax-policy report) but honors an explicit
+    backend_kwargs["temperature"] — `cli eval --temperature` threads
+    through here for sampled measurement."""
     from k8s_llm_scheduler_tpu.engine.backend import (
         BackendError,
         NoFeasibleNodeError,
@@ -374,8 +376,8 @@ def evaluate_checkpoint(
         kwargs.update(
             model=model,
             checkpoint_path=checkpoint_path,
-            temperature=0.0,
         )
+        kwargs.setdefault("temperature", 0.0)
         kwargs.setdefault("max_slots", 4)
         backend = build_local_backend(**kwargs)
     try:
