@@ -110,7 +110,7 @@ def build_cot(
     Format (one segment per feasible node, prompt order; echo fields
     present when `echoes` is given):
 
-        node-0 c=61.2 m=43.4 p=12/110 s=59.92 max=59.92@node-0; ... best=node-0
+        node-0 c=61.2 m=43.4 p=12/110 s=59.9 max=59.9@node-0; ... best=node-0
 
     Every cognitive step is LOCAL — the load-bearing redesign after the
     round-5 finding that the linear score list left the final argmax at a
@@ -135,20 +135,20 @@ def build_cot(
     - final choice (` best=node-0`): a copy of the adjacent last max
       name — which the constrained selected_node field copies again.
 
-    Scores render at TWO decimals (0.01 granularity): rounding is
+    Scores render at ONE decimal (0.1 granularity): rounding is
     monotone, so a rendered compare can never invert the true compare —
-    it can only tie. Granularity is load-bearing for PLACEMENT quality,
-    not just single decisions: the teacher's balancing EQUALIZES scores
-    — sequential placement drives every node's score to within one fold
-    step (~0.6 points at 110 max_pods) of the others, so the top-2 gaps
-    eval_placement visits concentrate in [0, 0.6]. At 0.1 rendering
-    roughly 1 in 6 of those decisions is a rendered TIE whose true order
-    the model cannot possibly learn (measured: spread 0.22 vs the
-    teacher's 0.019 at 100% single-decision agreement); at 0.01 that is
-    ~1 in 60. The running max itself is computed over the TRUE float
-    scores with first-wins tie-break — exactly `max(cand, key=score)` in
-    core/fallback.py — so the rendered `best` always names the teacher's
-    own argmax even on rendered ties.
+    it can only tie (~1%/pair on the uniform distribution). Measured
+    A/B on granularity (EVAL.md v3): TWO-decimal rendering — motivated
+    by sequential placement's equalized-score regime, where ~1 in 6
+    top-2 gaps is a 0.1-rendered tie — DOUBLED the regression's
+    integer-unit MAE (0.3 -> 0.6; a 1000-way fraction target is harder
+    than a 10-way one) and made placement spread WORSE (0.22 -> 0.56):
+    tie resolution only pays if the regression stays tighter than the
+    granularity, and it did not. One decimal is the measured optimum.
+    The running max itself is computed over the TRUE float scores with
+    first-wins tie-break — exactly `max(cand, key=score)` in
+    core/fallback.py — so the rendered `best` always names the
+    teacher's own argmax even on rendered ties.
 
     Kinds (aligned 1:1 with `tokenizer.encode(cot_string)`): `echo` the
     copied metric values, `score_int`/`score_dec` the score value tokens,
@@ -159,21 +159,18 @@ def build_cot(
     (asserted)."""
     pieces: list[tuple[str, str]] = []
 
-    def num(kind: str, hundredths: int) -> None:
-        if hundredths < 0:
+    def num(kind: str, tenths: int) -> None:
+        if tenths < 0:
             # floor-division rendering is wrong below zero; the
             # resource_balanced teacher is 0-100 by construction — refuse
             # rather than emit self-inconsistent supervision if a future
             # caller distills a signed scorer
             raise ValueError(
-                f"build_cot scores must be non-negative, got {hundredths / 100}"
+                f"build_cot scores must be non-negative, got {tenths / 10}"
             )
-        pieces.append((kind + "_int", str(hundredths // 100)))
+        pieces.append((kind + "_int", str(tenths // 10)))
         pieces.append(("fmt", "."))
-        # always two digits so '.05' never renders as '.5'; a leading-zero
-        # fraction byte-tokenizes (NumericTokenizer falls back for '05') —
-        # ~10% of values carry a 2-byte fraction, the rest one NUM token
-        pieces.append((kind + "_dec", f"{hundredths % 100:02d}"))
+        pieces.append((kind + "_dec", str(tenths % 10)))
 
     def name(kind: str, text: str) -> None:
         pieces.append((kind, text))
@@ -199,9 +196,9 @@ def build_cot(
             pieces.append(("fmt", " s="))
         else:
             pieces.append(("fmt", "="))
-        num("score", round(sc * 100))
+        num("score", round(sc * 10))
         pieces.append(("fmt", " max="))
-        num("cmp", round(scores[best_i] * 100))
+        num("cmp", round(scores[best_i] * 10))
         pieces.append(("fmt", "@"))
         name("name", names[best_i])
     pieces.append(("fmt", " best="))
@@ -575,9 +572,9 @@ def make_batches(
         drill=True); the compares, name copies, post-cot format, and the
         constrained-choice copy all carry loss."""
         k = int(micro_rng.integers(2, n_nodes + 1))
-        hundredths = micro_rng.choice(10_001, size=k, replace=False)
+        tenths = micro_rng.choice(1001, size=k, replace=False)
         names = [f"node-{i}" for i in range(k)]
-        best = int(np.argmax(hundredths))
+        best = int(np.argmax(tenths))
         # random echoes (zero-weighted, like the random scores): they keep
         # the drill's token geometry identical to real answers so the
         # compare/copy circuits train at the true positions
@@ -590,7 +587,7 @@ def make_batches(
             for _ in range(k)
         ]
         cot, kinds = build_cot(
-            tokenizer, names, [h / 100.0 for h in hundredths], echoes=echoes
+            tokenizer, names, [t / 10.0 for t in tenths], echoes=echoes
         )
         ans, (ns, ne), (cs, ce) = cot_answer_ids(
             tokenizer, cot, names[best], 0.4
