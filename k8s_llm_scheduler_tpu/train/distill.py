@@ -104,6 +104,7 @@ def build_cot(
     names: list[str],
     scores: list[float],
     echoes: list[tuple[str, str, str]] | None = None,
+    tiebreak: list[float] | None = None,
 ) -> tuple[str, list[str]]:
     """Running-max scratchpad CoT: `(cot_string, per-token kinds)`.
 
@@ -145,10 +146,13 @@ def build_cot(
     than a 10-way one) and made placement spread WORSE (0.22 -> 0.56):
     tie resolution only pays if the regression stays tighter than the
     granularity, and it did not. One decimal is the measured optimum.
-    The running max itself is computed over the TRUE float scores with
-    first-wins tie-break — exactly `max(cand, key=score)` in
-    core/fallback.py — so the rendered `best` always names the
-    teacher's own argmax even on rendered ties.
+
+    The running max follows the RENDERED compare with an explicit
+    `tiebreak` rule on rendered ties (see `beats`); a pair whose
+    procedure disagrees with the teacher's true-float argmax is DROPPED
+    by cot_teacher_case's consistency guard, so supervision is always
+    self-consistent — the corpus trades ~1-2% of near-tie cases for a
+    tie policy the model can actually compute from its context.
 
     Kinds (aligned 1:1 with `tokenizer.encode(cot_string)`): `echo` the
     copied metric values, `score_int`/`score_dec` the score value tokens,
@@ -175,9 +179,27 @@ def build_cot(
     def name(kind: str, text: str) -> None:
         pieces.append((kind, text))
 
+    def beats(i: int, j: int) -> bool:
+        """Does candidate i beat the running best j? On a RENDERED tie
+        (equal at 0.1) the tiebreak values decide (lower wins — teacher_cot
+        passes pod counts, so the rule is 'fewest pods', derivable from
+        the ADJACENT p= echo): sequential placement equalizes true scores
+        to sub-rendering gaps, and a tie rule the model can actually
+        compute from its context is the only learnable policy there
+        (EVAL.md v3/v4: neither finer rendering nor near-exact regression
+        transferred, because the deciding information was rounded away).
+        Off ties, the rendered compare decides (strict >: first-wins,
+        like max())."""
+        ri, rj = round(scores[i] * 10), round(scores[j] * 10)
+        if ri != rj:
+            return ri > rj
+        if tiebreak is not None and tiebreak[i] != tiebreak[j]:
+            return tiebreak[i] < tiebreak[j]
+        return False  # full tie: keep the incumbent (first wins)
+
     best_i = 0
     for i, (nm, sc) in enumerate(zip(names, scores)):
-        if i and sc > scores[best_i]:  # strict: first-wins, like max()
+        if i and beats(i, best_i):
             best_i = i
         if i:
             pieces.append(("fmt", "; "))
@@ -269,6 +291,10 @@ def teacher_cot(pod, nodes, tokenizer: Tokenizer) -> tuple[str, list[str]]:
             )
             for n in cand
         ],
+        # rendered-tie rule: fewest pods wins — computable from the p=
+        # echo sitting ~10 tokens back, unlike the rounded-away sub-0.1
+        # score difference the teacher's true argmax actually used
+        tiebreak=[float(n.pod_count) for n in cand],
     )
 
 
@@ -276,10 +302,12 @@ def cot_teacher_case(
     tokenizer: Tokenizer, pe: PromptEngine, pod, nodes
 ) -> tuple[list[int], list[int], tuple[int, int], tuple[int, int], list[str]] | None:
     """One full teacher scratchpad-CoT sequence, or None if the teacher
-    abstains (no feasible node) or the scratchpad's conclusion would
-    contradict the teacher's answer (cannot happen with the shared scorer
-    and first-wins tie-break; guarded anyway so a divergence skips the
-    pair instead of training on self-contradictory supervision).
+    abstains (no feasible node) or the scratchpad's conclusion
+    contradicts the teacher's answer. The second branch is LOAD-BEARING:
+    build_cot's running max breaks rendered ties by the explicit
+    tiebreak rule (fewest pods), which can disagree with the teacher's
+    true-float argmax on ~1-2% of near-tie cases — those pairs are
+    dropped so supervision is always self-consistent.
 
     Returns (prompt_ids, answer_ids, name_span, cot_span, kinds) with the
     spans RELATIVE to the answer start — THE single construction path for
@@ -758,6 +786,13 @@ def make_agreement_probe(
         cluster_part, pod_part = pe.split_prompt(pod, nodes)
         if answer_style == "cot":
             cot, _kinds = teacher_cot(pod, nodes, tokenizer)
+            if not cot.endswith("best=" + decision.selected_node):
+                # same consistency guard as cot_teacher_case: on rendered
+                # ties the scratchpad's tiebreak rule can conclude a
+                # different node than the teacher's true-float argmax —
+                # probing such a case would score a perfectly-trained
+                # copy procedure as WRONG
+                continue
             # up to 'best=' EXCLUSIVE of the final 'node-' — the shared
             # name-prefix tokens are appended below with `shared`, and the
             # probed token is the final-choice digit: with the running-max

@@ -248,6 +248,29 @@ class TestDistill:
         assert cot.endswith("best=node-0")
         assert "node-0=50.0 max=50.0@node-0; node-1=50.0 max=50.0@node-0" in cot
 
+    def test_rendered_tie_breaks_by_tiebreak_value(self):
+        """On a 0.1-rendered score tie the explicit tiebreak (fewest
+        pods) decides the running max — a rule the model can compute
+        from the adjacent p= echo, unlike the rounded-away sub-0.1
+        score difference (EVAL.md: the placement-spread mechanism)."""
+        from k8s_llm_scheduler_tpu.engine.tokenizer import NumericTokenizer
+        from k8s_llm_scheduler_tpu.train.distill import build_cot
+
+        tok = NumericTokenizer()
+        names = ["node-0", "node-1"]
+        # true scores tie at one decimal (both render 50.0); node-1 has
+        # FEWER pods so the tie rule picks it despite the lower true score
+        cot, _ = build_cot(
+            tok, names, [50.04, 49.96], tiebreak=[30.0, 5.0]
+        )
+        assert cot.endswith("best=node-1")
+        # no tiebreak values -> incumbent keeps the tie (first wins)
+        cot, _ = build_cot(tok, names, [50.04, 49.96])
+        assert cot.endswith("best=node-0")
+        # off ties the rendered compare decides regardless of tiebreak
+        cot, _ = build_cot(tok, names, [60.0, 40.0], tiebreak=[99.0, 0.0])
+        assert cot.endswith("best=node-0")
+
     def test_build_cot_echoes_are_prompt_literal_copies(self):
         """With echoes, every echoed value must be token-identical to the
         prompt rendering of the same metric (the copy-circuit premise),
