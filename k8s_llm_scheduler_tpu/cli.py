@@ -56,6 +56,13 @@ def _backend_kwargs(cfg: Config, **overrides) -> dict:
         request_timeout_s=float(cfg.get("llm.timeout")),
         group_switch_after_s=float(cfg.get("llm.group_switch_after_s")),
         compile_cache_dir=cfg.get("llm.compile_cache_dir"),
+        spec_enabled=bool(cfg.get("llm.spec_enabled", False)),
+        spec_draft_model=cfg.get("llm.spec_draft_model", "tiny"),
+        spec_draft_checkpoint=cfg.get("llm.spec_draft_checkpoint", None),
+        spec_k=int(cfg.get("llm.spec_k", 4)),
+        spec_disable_threshold=float(
+            cfg.get("llm.spec_disable_threshold", 0.3)
+        ),
     )
     if cfg.get("distributed.enabled"):
         # Multi-host: after jax.distributed.initialize, jax.devices() is
@@ -629,6 +636,8 @@ def cmd_complete(args: argparse.Namespace, cfg: Config) -> int:
     )
     if args.temperature is not None:
         overrides["temperature"] = args.temperature
+    if getattr(args, "spec", False):
+        overrides["spec_enabled"] = True
     backend = build_local_backend(**_backend_kwargs(cfg, **overrides))
     try:
         engine = backend.engine
@@ -775,6 +784,11 @@ def main(argv: list[str] | None = None) -> int:
     p_complete.add_argument(
         "--chat", action="store_true",
         help="wrap the prompt in the chat template",
+    )
+    p_complete.add_argument(
+        "--spec", action="store_true",
+        help="speculative decoding: distilled-draft propose, target verify "
+             "(llm.spec_* config keys pick the draft and K)",
     )
 
     args = parser.parse_args(argv)

@@ -84,6 +84,19 @@ DEFAULTS: dict[str, Any] = {
         # fairness bound for (prefix, grammar) group switches under load
         # (engine/local.py _submit_waves)
         "group_switch_after_s": 0.25,
+        # --- speculative decoding (spec/decoder.py; general-completion
+        # paged path only — decision waves are already grammar-accelerated
+        # and never speculate) ---
+        "spec_enabled": False,
+        # draft model: a config name (models/configs.py) random-initialized,
+        # or serve the distilled checkpoint via spec_draft_checkpoint
+        # (train/distill.py output — the intended production draft)
+        "spec_draft_model": "tiny",
+        "spec_draft_checkpoint": None,
+        "spec_k": 4,  # draft tokens proposed per round
+        # acceptance-rate EWMA floor: below it speculation auto-disables
+        # for the request and decode falls back to the plain chunked path
+        "spec_disable_threshold": 0.3,
         # persistent XLA compile cache dir ("auto" = ~/.cache/...; null
         # disables) — utils/compile_cache.py
         "compile_cache_dir": "auto",
@@ -152,6 +165,11 @@ ENV_OVERRIDES: dict[str, str] = {
     "LLM_MAX_REASON_TOKENS": "llm.max_reason_tokens",
     "LLM_MAX_TOKENS": "llm.max_tokens",
     "LLM_TEMPERATURE": "llm.temperature",
+    "SPEC_ENABLED": "llm.spec_enabled",
+    "SPEC_K": "llm.spec_k",
+    "SPEC_DRAFT_MODEL": "llm.spec_draft_model",
+    "SPEC_DRAFT_CHECKPOINT": "llm.spec_draft_checkpoint",
+    "SPEC_DISABLE_THRESHOLD": "llm.spec_disable_threshold",
     "MAX_RETRIES": "llm.max_retries",
     "CACHE_ENABLED": "cache.enabled",
     "CACHE_TTL": "cache.ttl_seconds",

@@ -666,6 +666,11 @@ class InferenceEngine:
         self._prefix_cache: OrderedDict[tuple[int, ...], _PrefixKV] = OrderedDict()
         self._empty_prefix: _PrefixKV | None = None
 
+        # Speculative-decoding subsystem (spec/decoder.py), attached after
+        # construction via attach_spec(): generate() routes through it when
+        # present. None = plain decode only.
+        self.spec = None
+
         self._rng = jax.random.PRNGKey(rng_seed)
         self._req_counter = 0
         self._by_slot: dict[int, _Request] = {}
@@ -1406,6 +1411,19 @@ class InferenceEngine:
                 self.stats["completed"] += 1
         return finished
 
+    def release_slot(self, slot: int) -> None:
+        """Tear down one admitted slot out-of-band: drop its request, free
+        its pages, and clear the host + device decode state. THE teardown
+        for completions that bypass step() (spec/decoder.py finishes and
+        rollbacks) — every per-slot engine field is cleared in exactly one
+        place so new state can't silently leak through an external path."""
+        del self._by_slot[slot]
+        self.kv.free_slot(slot)
+        self._act_np[slot] = False
+        self._budget_np[slot] = 0
+        self._act_d = self._act_d.at[slot].set(False)
+        self._budget_d = self._budget_d.at[slot].set(0)
+
     def abort_all(self) -> None:
         """Free every in-flight slot and its KV pages — recovery path after a
         failed dispatch so the engine never leaks capacity."""
@@ -1418,10 +1436,35 @@ class InferenceEngine:
         self._budget_d = jnp.zeros(self.max_slots + 1, dtype=jnp.int32)
 
     # ------------------------------------------------------------ convenience
+    def attach_spec(self, decoder) -> None:
+        """Attach a speculative decoder (spec/decoder.py SpeculativeDecoder).
+
+        generate() then routes single-request completions through
+        draft-propose/target-verify; the plain paged path remains the
+        fallback (unsupported prompts, auto-disable) and the multi-slot
+        add_requests/step surface is unchanged."""
+        self.spec = decoder
+
     def generate(
-        self, prompt_ids: list[int], max_new_tokens: int = 200
+        self,
+        prompt_ids: list[int],
+        max_new_tokens: int = 200,
+        use_spec: bool | None = None,
     ) -> Finished:
-        """Synchronous single-request generation (tests, simple callers)."""
+        """Synchronous single-request generation (tests, simple callers).
+
+        `use_spec`: None = speculative when a decoder is attached
+        (attach_spec) and the request fits it; True/False force the path
+        (bench A/Bs pass False for the plain arm on a spec-enabled
+        engine)."""
+        if use_spec is None:
+            use_spec = self.spec is not None
+        if (
+            use_spec
+            and self.spec is not None
+            and self.spec.supports(prompt_ids, max_new_tokens)
+        ):
+            return self.spec.generate(prompt_ids, max_new_tokens)
         req_id = self.add_request(prompt_ids, max_new_tokens)
         while True:
             for fin in self.step():
@@ -1429,5 +1472,8 @@ class InferenceEngine:
                     return fin
 
     def get_stats(self) -> dict[str, Any]:
-        return {**self.stats, "pages_free": self.kv.pages_free,
-                "slots_free": self.free_slots}
+        out = {**self.stats, "pages_free": self.kv.pages_free,
+               "slots_free": self.free_slots}
+        if self.spec is not None:
+            out["spec"] = self.spec.stats.snapshot()
+        return out

@@ -166,6 +166,41 @@ class PagedKVCache:
     def note_token_appended(self, slot: int) -> None:
         self._slots[slot].length += 1
 
+    def truncate(self, slot: int, new_length: int) -> None:
+        """Shrink a slot to `new_length` tokens, freeing the tail pages.
+
+        The paged-KV rollback op for speculative decoding (spec/decoder.py):
+        rejected draft tokens wrote K/V into the slot's tail pages, and the
+        whole tail beyond the accepted prefix unwinds by releasing exactly
+        the pages no longer needed to cover `new_length` tokens. Freed pages
+        return to the pool (refcounted — never double-freed) and a
+        subsequent ensure_capacity/allocate reuses them. Device-side page
+        contents are NOT cleared: stale K/V past `new_length` is never
+        attended because every reader masks by valid length, and the next
+        append overwrites it. A slot always keeps >= 1 page (matching
+        allocate_slot). Idempotent at the same `new_length`.
+
+        Contract: PAGES only ever shrink here (truncate never allocates),
+        but the slot's RECORDED length is SET to `new_length` (clamped to
+        page capacity) — callers own the invariant that `new_length` never
+        exceeds the tokens actually written, or slot_length() would report
+        uninitialized positions as valid. The engine-driven spec path
+        tracks its own host-side count and satisfies this by construction;
+        manual-API callers (write_prefill/note_token_appended) must only
+        ever truncate downward from their written length.
+        """
+        if new_length < 0:
+            raise ValueError(f"new_length must be >= 0, got {new_length}")
+        info = self._slots[slot]
+        keep = self.pages_needed(new_length)
+        if keep < len(info.pages):
+            dropped = info.pages[keep:]
+            del info.pages[keep:]
+            self._release_pages(dropped)
+            self._tables_np[slot, keep:] = 0
+            self._tables_dirty = True
+        info.length = min(new_length, len(info.pages) * self.page_size)
+
     # --------------------------------------------------------------- prefill
     def write_prefill(
         self,

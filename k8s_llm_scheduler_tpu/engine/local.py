@@ -651,6 +651,47 @@ class LocalLLMBackend:
         return self.engine.get_stats()
 
 
+def _attach_spec(
+    engine: InferenceEngine,
+    *,
+    draft_model: str,
+    draft_checkpoint: str | None,
+    k: int,
+    disable_threshold: float,
+    rng_seed: int,
+) -> None:
+    """Build the draft model and attach a SpeculativeDecoder to the engine.
+
+    The draft serves the SAME tokenizer as the target (a distilled draft —
+    train/distill.py — trains on exactly that vocab). A random-init draft
+    config narrower than the tokenizer is widened so every legal token is
+    proposable; a checkpoint must already match (SpeculativeDecoder
+    validates)."""
+    from k8s_llm_scheduler_tpu.spec import SpeculativeDecoder
+    from k8s_llm_scheduler_tpu.spec.draft import build_random_draft
+
+    draft_cfg = get_config(draft_model)
+    if draft_checkpoint:
+        from k8s_llm_scheduler_tpu.models.loader import restore_checkpoint
+
+        draft_params = restore_checkpoint(Path(draft_checkpoint), draft_cfg, None)
+    else:
+        draft_params, draft_cfg = build_random_draft(
+            draft_cfg, engine.tokenizer.vocab_size, rng_seed + 1
+        )
+    engine.attach_spec(
+        SpeculativeDecoder(
+            engine, draft_params, draft_cfg,
+            k=k, disable_threshold=disable_threshold,
+        )
+    )
+    logger.info(
+        "speculative decoding attached: draft=%s k=%d disable<%.2f%s",
+        draft_cfg.name, k, disable_threshold,
+        " (checkpoint)" if draft_checkpoint else " (random-init)",
+    )
+
+
 def build_local_backend(
     model: str = "tiny",
     mesh_axes: dict[str, int] | None = None,
@@ -682,6 +723,11 @@ def build_local_backend(
     compile_cache_dir: str | None = "auto",
     answer_style: str = "direct",
     max_reason_tokens: int = 320,
+    spec_enabled: bool = False,
+    spec_draft_model: str = "tiny",
+    spec_draft_checkpoint: str | None = None,
+    spec_k: int = 4,
+    spec_disable_threshold: float = 0.3,
 ) -> LocalLLMBackend:
     """Construct the full local stack: params (from an HF safetensors or
     orbax checkpoint when checkpoint_path is set, random-init otherwise —
@@ -801,6 +847,23 @@ def build_local_backend(
         decode_matmul=decode_matmul,
         mesh=mesh if multi else None,
     )
+    if spec_enabled:
+        if multi:
+            # The spec programs carry no sharding annotations yet; on a tp
+            # mesh they would gather the sharded caches through GSPMD's
+            # worst guesses. Plain decode is the honest multi-device path.
+            logger.warning(
+                "spec_enabled is single-device; tp mesh keeps plain decode"
+            )
+        else:
+            _attach_spec(
+                engine,
+                draft_model=spec_draft_model,
+                draft_checkpoint=spec_draft_checkpoint,
+                k=spec_k,
+                disable_threshold=spec_disable_threshold,
+                rng_seed=rng_seed,
+            )
     return LocalLLMBackend(
         engine, tokenizer, max_new_tokens=max_new_tokens, constrained=constrained,
         request_timeout_s=request_timeout_s,

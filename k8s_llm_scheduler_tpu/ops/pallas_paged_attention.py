@@ -26,6 +26,11 @@ from __future__ import annotations
 import functools
 
 import jax
+
+from k8s_llm_scheduler_tpu.utils.jax_compat import (
+    compiler_params,
+    shard_map_compat,
+)
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
@@ -202,7 +207,7 @@ def paged_decode_attention_parts_shmap(
     rule)."""
     P = jax.sharding.PartitionSpec
     fn = functools.partial(paged_decode_attention_parts, interpret=interpret)
-    return jax.shard_map(
+    return shard_map_compat(
         fn,
         mesh=mesh,
         in_specs=(
@@ -273,7 +278,7 @@ def _paged_call(q, k_cache, v_cache, page_table, seq_lens, *, normalize, interpr
         out_shape=out_shape,
         grid_spec=grid_spec,
         interpret=interpret,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=compiler_params(
             dimension_semantics=("parallel", "arbitrary"),
         ),
     )(page_table.astype(jnp.int32), seq_lens.astype(jnp.int32), q, k_cache, v_cache)

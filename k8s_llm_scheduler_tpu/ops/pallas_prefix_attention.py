@@ -27,6 +27,11 @@ from __future__ import annotations
 import functools
 
 import jax
+
+from k8s_llm_scheduler_tpu.utils.jax_compat import (
+    compiler_params,
+    shard_map_compat,
+)
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
@@ -286,7 +291,7 @@ def flash_causal_attention_parts(
         ),
         grid_spec=grid_spec,
         interpret=interpret,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=compiler_params(
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
         ),
     )(lens.astype(jnp.int32), qr, kt, vt)
@@ -366,7 +371,7 @@ def flash_prefix_attention_parts(
         ),
         grid_spec=grid_spec,
         interpret=interpret,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
     )(
@@ -397,7 +402,7 @@ def flash_prefix_attention_parts_shmap(
     """flash_prefix_attention_parts with heads sharded over `mesh[axis]`."""
     P = jax.sharding.PartitionSpec
     fn = functools.partial(flash_prefix_attention_parts, interpret=interpret)
-    return jax.shard_map(
+    return shard_map_compat(
         fn,
         mesh=mesh,
         in_specs=(
@@ -422,7 +427,7 @@ def flash_causal_attention_parts_shmap(
     P = jax.sharding.PartitionSpec
     fn = functools.partial(flash_causal_attention_parts, interpret=interpret)
     head_spec = P(None, None, axis, None)  # [B, S, heads, hd]
-    return jax.shard_map(
+    return shard_map_compat(
         fn,
         mesh=mesh,
         in_specs=(head_spec, head_spec, head_spec, P(None)),

@@ -1,0 +1,27 @@
+"""Speculative decoding subsystem (SwiftSpec-shaped; PAPERS.md 2506.11309).
+
+A small distilled DRAFT model (train/distill.py produces exactly this) runs
+K tokens ahead of the big TARGET on the engine's general paged-decode path;
+the target scores all K proposals in ONE forward and accepts the longest
+target-consistent prefix (greedy) or rejection-samples so the emitted
+distribution is exactly the target's (sampling). Rejected draft tokens
+unwind through the paged-KV rollback op (engine/kv_cache.py truncate).
+Grammar composition is built in: proposals and verification both sample
+through the engine's SparseDFATables, so speculation can never emit a token
+the constrained decoder would forbid.
+
+Modules:
+- draft.py   — DraftRunner: dense-KV draft state + the fused K-step
+               propose program (one dispatch proposes all K tokens).
+- verify.py  — the one-forward target scoring program over the paged cache
+               plus on-device accept logic (greedy longest-prefix /
+               distribution-preserving rejection sampling).
+- decoder.py — SpeculativeDecoder: orchestration, per-request acceptance
+               EWMA with auto-disable, fallback to plain chunked decode,
+               metrics/trace export.
+"""
+
+from k8s_llm_scheduler_tpu.spec.decoder import SpeculativeDecoder
+from k8s_llm_scheduler_tpu.spec.draft import DraftRunner
+
+__all__ = ["SpeculativeDecoder", "DraftRunner"]

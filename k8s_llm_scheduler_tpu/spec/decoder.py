@@ -1,0 +1,353 @@
+"""SpeculativeDecoder: draft-propose / target-verify over the paged path.
+
+Orchestrates one request at a time through the engine's general paged-decode
+state (engine/engine.py `generate()` routes here when a decoder is
+attached): admission reuses the engine's own batched-admission program (so
+prompt prefill and the first sampled token are bit-identical to plain
+decode), then each round is
+
+    draft.propose (1 dispatch, K tokens)
+    -> _verify_impl (1 dispatch: target scores K+1 positions, accepts)
+    -> ONE host fetch
+    -> kv_cache.truncate rolls back the rejected tail's pages
+
+Robustness is part of the loop, not an afterthought:
+
+- A per-request acceptance-rate EWMA auto-disables speculation when the
+  draft stops earning its keep (below `disable_threshold` after
+  `min_rounds`); the request hands off MID-STREAM to the engine's plain
+  fused-chunk decode path — device slot state is restored and
+  `engine.step()` finishes the request, so a bad draft costs a few wasted
+  rounds, never a broken or slow completion.
+- Acceptance rate, emitted-tokens-per-round, and disable events export
+  through the engine's stats (observability/metrics.py serves them at
+  /metrics); draft/verify phases are span'd through observability/trace.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import time
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from k8s_llm_scheduler_tpu.models.configs import LlamaConfig
+from k8s_llm_scheduler_tpu.models.llama import Params
+from k8s_llm_scheduler_tpu.observability.trace import recorder
+from k8s_llm_scheduler_tpu.spec.draft import DraftRunner
+from k8s_llm_scheduler_tpu.spec.verify import _verify_impl
+
+
+@dataclasses.dataclass
+class SpecStats:
+    requests: int = 0
+    rounds: int = 0
+    proposed: int = 0
+    accepted: int = 0
+    emitted: int = 0
+    disables: int = 0
+    fallback_requests: int = 0
+    unsupported_requests: int = 0
+
+    def snapshot(self) -> dict[str, Any]:
+        out = dataclasses.asdict(self)
+        out["acceptance_rate"] = (
+            self.accepted / self.proposed if self.proposed else 0.0
+        )
+        out["tokens_per_round"] = (
+            self.emitted / self.rounds if self.rounds else 0.0
+        )
+        return out
+
+
+class SpeculativeDecoder:
+    """Speculative decoding over one engine + one draft model."""
+
+    def __init__(
+        self,
+        engine,  # InferenceEngine (not annotated: avoids an import cycle)
+        draft_params: Params,
+        draft_cfg: LlamaConfig,
+        *,
+        k: int = 4,
+        disable_threshold: float = 0.3,
+        ewma_alpha: float = 0.3,
+        min_rounds: int = 4,
+    ) -> None:
+        if k < 1:
+            raise ValueError(f"spec_k must be >= 1, got {k}")
+        if not 0.0 <= disable_threshold <= 1.0:
+            raise ValueError(
+                f"disable_threshold must be in [0, 1], got {disable_threshold}"
+            )
+        tok_vocab = engine.tokenizer.vocab_size
+        if draft_cfg.vocab_size < tok_vocab:
+            raise ValueError(
+                f"draft vocab {draft_cfg.vocab_size} < tokenizer vocab "
+                f"{tok_vocab} — the draft cannot propose every legal token"
+            )
+        self.engine = engine
+        self.k = int(k)
+        self.disable_threshold = float(disable_threshold)
+        self.ewma_alpha = float(ewma_alpha)
+        self.min_rounds = int(min_rounds)
+        self.stats = SpecStats()
+        # Draft masks the same undecodable tail as the target (a draft with
+        # a wider padded vocab must never propose past the tokenizer).
+        draft_limit = tok_vocab if tok_vocab < draft_cfg.vocab_size else None
+        self.draft = DraftRunner(
+            draft_params, draft_cfg, vocab_limit=draft_limit
+        )
+        self._verify = jax.jit(
+            functools.partial(
+                _verify_impl,
+                vocab_limit=engine._vocab_limit,
+                prefix_impl=engine.prefix_attn_impl,
+            ),
+            static_argnums=(1, 21, 22),
+            donate_argnums=(7, 8),
+        )
+
+    # ------------------------------------------------------------- plumbing
+    def supports(self, prompt_ids: list[int], max_new_tokens: int) -> bool:
+        """Whether this request can take the speculative path (the caller
+        falls back to plain decode when not — never an error)."""
+        eng = self.engine
+        total = eng.prefix_len + len(prompt_ids)
+        # The draft prefills the full context single-shot; cap it at the
+        # engine's largest bucket like every other prefill.
+        return total <= eng.prefill_buckets[-1]
+
+    def _round_io(self, slot: int, n_own: int, w: int, hard_cap: int):
+        """Host-side page bookkeeping for one round: grow the slot to cover
+        the block, then map each block position to (page, offset). Positions
+        past `hard_cap` (draft tokens that could never be kept within the
+        budget) route to the reserved scratch page 0."""
+        eng = self.engine
+        ps = eng.kv.page_size
+        eng.kv.ensure_capacity(slot, min(n_own + w, hard_cap))
+        pages = eng.kv.slot_pages(slot)
+        page_ids = np.zeros(w, dtype=np.int32)
+        offs = np.zeros(w, dtype=np.int32)
+        for i, p in enumerate(range(n_own, n_own + w)):
+            blk = p // ps
+            if p < hard_cap and blk < len(pages):
+                page_ids[i] = pages[blk]
+                offs[i] = p % ps
+        return jnp.asarray(page_ids), jnp.asarray(offs)
+
+    # ------------------------------------------------------------- generate
+    def generate(self, prompt_ids: list[int], max_new_tokens: int = 200):
+        """Speculative replacement for the engine's plain generate():
+        greedy output is token-identical to plain decode, sampling output
+        follows the target distribution exactly (spec/verify.py)."""
+        eng = self.engine
+        if not self.supports(prompt_ids, max_new_tokens):
+            self.stats.unsupported_requests += 1
+            return eng.generate(
+                prompt_ids, max_new_tokens, use_spec=False
+            )
+        self.stats.requests += 1
+        # Admission through the engine's own program: prompt KV lands in the
+        # slot's pages and the first token samples exactly as plain decode.
+        req_id = eng.add_request(prompt_ids, max_new_tokens)
+        slot = next(s for s, r in eng._by_slot.items() if r.req_id == req_id)
+        try:
+            return self._generate_admitted(
+                req_id, slot, prompt_ids, max_new_tokens
+            )
+        except Exception:
+            # Mirror add_requests' rollback: a failed round must not leak
+            # the slot or its pages (no later recovery path would — the
+            # request never reaches step()'s teardown).
+            if slot in eng._by_slot:
+                eng.release_slot(slot)
+            raise
+
+    def _generate_admitted(
+        self,
+        req_id: int,
+        slot: int,
+        prompt_ids: list[int],
+        max_new_tokens: int,
+    ):
+        eng = self.engine
+        first_np, act_np, st_np = jax.device_get(
+            (eng._first_d, eng._act_d, eng._st_d)
+        )
+        eng.stats["syncs"] += 1
+        t_cur = int(first_np[slot])
+        st_cur = int(st_np[slot])
+        generated = [t_cur]
+        finished = not bool(act_np[slot])
+        eos = eng.tokenizer.eos_id
+        pad = eng.tokenizer.pad_id
+        done_state = int(eng._done_state)
+        prefix = eng._prefix or eng._get_empty_prefix()
+        n_prompt = len(prompt_ids)
+        n_own = n_prompt  # tokens with valid KV in the slot's pages
+        # Release the admission-time full decode reservation: the spec loop
+        # grows per round and truncate() rolls rejected tails back, which
+        # only means anything if the tail pages are actually freeable.
+        eng.kv.truncate(slot, n_own)
+        hard_cap = n_prompt + max_new_tokens + 1
+        w = self.k + 1
+        ewma: float | None = None
+        req_rounds = 0
+        disabled = False
+
+        if not finished and max_new_tokens > 1:
+            ctx = list(prefix.token_ids) + list(prompt_ids)
+            with recorder.phase("spec_draft_prefill"):
+                self.draft.begin(
+                    ctx, pad, extra=max_new_tokens + self.k + 2
+                )
+
+        while not finished and len(generated) < max_new_tokens:
+            if disabled:
+                return self._fallback(
+                    req_id, slot, generated, t_cur, st_cur, n_own,
+                    max_new_tokens,
+                )
+            abs_pos = eng.prefix_len + n_own
+            eng._rng, r_draft, r_verify = jax.random.split(eng._rng, 3)
+            with recorder.phase("spec_draft"):
+                d_toks, d_states, d_idx, d_logits = self.draft.propose(
+                    t_cur, abs_pos, st_cur,
+                    eng._sp_tokens, eng._sp_next, pad,
+                    r_draft, eng.temperature, self.k, eng._constrained,
+                )
+            blk_tok = jnp.concatenate(
+                [jnp.asarray([t_cur], dtype=jnp.int32), d_toks]
+            )
+            mask_states = jnp.concatenate(
+                [jnp.asarray([st_cur], dtype=jnp.int32), d_states]
+            )[:w]
+            positions = jnp.arange(abs_pos, abs_pos + w, dtype=jnp.int32)
+            page_ids, offs = self._round_io(slot, n_own, w, hard_cap)
+            table_row = eng.kv.page_tables()[slot][None, :]
+            with recorder.phase("spec_verify"):
+                a_d, t_next_d, st_next_d, eng.kv.k, eng.kv.v = self._verify(
+                    eng.params, eng.cfg,
+                    blk_tok, positions,
+                    prefix.k, prefix.v, jnp.int32(prefix.length),
+                    eng.kv.k, eng.kv.v,
+                    table_row, jnp.int32(n_own), page_ids, offs,
+                    mask_states, d_idx, d_logits,
+                    eng._sp_tokens, eng._sp_next,
+                    jnp.int32(pad),
+                    r_verify, jnp.float32(eng.temperature),
+                    eng._constrained, eng.temperature == 0.0,
+                )
+                a, t_next, st_next, d_toks_np, d_states_np = jax.device_get(
+                    (a_d, t_next_d, st_next_d, d_toks, d_states)
+                )
+            eng.stats["syncs"] += 1
+            a = int(a)
+            req_rounds += 1
+            self.stats.rounds += 1
+            self.stats.proposed += self.k
+            self.stats.accepted += a
+
+            # Emit: the accepted draft prefix, then the verifier's token
+            # (correction or bonus). All are target-consistent; trim to
+            # budget and stop at EOS / DFA done.
+            cand = [(int(d_toks_np[i]), int(d_states_np[i])) for i in range(a)]
+            cand.append((int(t_next), int(st_next)))
+            for tok, stt in cand:
+                if len(generated) >= max_new_tokens:
+                    break
+                generated.append(tok)
+                self.stats.emitted += 1
+                if tok == eos or stt == done_state:
+                    finished = True
+                    break
+                t_cur, st_cur = tok, stt
+            # n_own counts tokens whose KV is resident: t_cur's KV lands
+            # only when it is processed next round, so the resident count
+            # is prompt + (emitted - 1).
+            n_own = n_prompt + len(generated) - 1
+            # Paged-KV rollback: free the rejected tail's pages.
+            eng.kv.truncate(slot, n_own)
+
+            rate = a / self.k
+            ewma = (
+                rate
+                if ewma is None
+                else self.ewma_alpha * rate + (1 - self.ewma_alpha) * ewma
+            )
+            # PER-REQUEST warmup (req_rounds, not the decoder-global round
+            # counter): every request gets min_rounds of EWMA settling
+            # before it can disable — a global counter would let any
+            # request after the first disable on its very first bad round.
+            if (
+                req_rounds >= self.min_rounds
+                and not finished
+                and ewma < self.disable_threshold
+            ):
+                disabled = True
+                self.stats.disables += 1
+
+        return self._finish(req_id, slot, generated, max_new_tokens)
+
+    # ------------------------------------------------------------- teardown
+    def _finish(
+        self, req_id: int, slot: int, generated: list[int], max_new: int
+    ):
+        """Complete the request: free the slot and build Finished exactly
+        like the plain step() path does."""
+        from k8s_llm_scheduler_tpu.engine.engine import Finished
+
+        eng = self.engine
+        req = eng._by_slot[slot]
+        eng.release_slot(slot)
+        ids = generated[:max_new]
+        # First token is accounted like the plain path (not a decode token).
+        eng.stats["decode_tokens"] += max(len(ids) - 1, 0)
+        eng.stats["completed"] += 1
+        return Finished(
+            req_id=req_id,
+            token_ids=ids,
+            text=eng.tokenizer.decode(ids),
+            latency_ms=(time.perf_counter() - req.submitted_at) * 1000.0,
+        )
+
+    def _fallback(
+        self,
+        req_id: int,
+        slot: int,
+        generated: list[int],
+        t_cur: int,
+        st_cur: int,
+        n_own: int,
+        max_new: int,
+    ):
+        """Auto-disable hand-off: restore the slot's device-resident decode
+        state and let the engine's plain fused-chunk path finish the
+        request (engine/engine.py step())."""
+        eng = self.engine
+        self.stats.fallback_requests += 1
+        remaining = max_new - len(generated)
+        req = eng._by_slot[slot]
+        req.generated = list(generated)
+        req.first_pending = False
+        eng.kv.ensure_capacity(slot, n_own + remaining + 1)
+        eng._tok_d = eng._tok_d.at[slot].set(t_cur)
+        eng._pos_d = eng._pos_d.at[slot].set(eng.prefix_len + n_own)
+        eng._act_d = eng._act_d.at[slot].set(True)
+        eng._st_d = eng._st_d.at[slot].set(st_cur)
+        eng._budget_d = eng._budget_d.at[slot].set(remaining)
+        eng._act_np[slot] = True
+        eng._budget_np[slot] = remaining
+        # The spec-emitted tokens are already in req.generated; the plain
+        # path's completion accounting takes over from here.
+        eng.stats["decode_tokens"] += max(len(generated) - 1, 0)
+        with recorder.phase("spec_fallback"):
+            while True:
+                for fin in eng.step():
+                    if fin.req_id == req_id:
+                        return fin

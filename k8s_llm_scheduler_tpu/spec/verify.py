@@ -1,0 +1,211 @@
+"""Target-side verification: score K draft tokens in ONE forward, accept.
+
+`_verify_impl` is one device program per (W, grammar-shape) geometry that
+
+1. runs the target over the W-token block [t_cur, d_1..d_K] against
+   (shared dense prefix | own paged KV | causal-within-block) — the same
+   three-part log-sum-exp cascade the plain chunked-decode path uses
+   (models/llama.forward_decode_buffered), so greedy speculative output is
+   token-identical to plain decode;
+2. scatters the block's K/V into the slot's cache pages as it goes (the
+   accepted prefix is then already resident; the rejected tail unwinds via
+   kv_cache.truncate — stale page contents are never attended because every
+   reader masks by valid length);
+3. applies the SAME grammar masking as the constrained decoder
+   (SparseDFATables in K-space) to every position's target distribution —
+   verification can never accept or emit a grammar-illegal token;
+4. accepts on device: greedy mode takes the longest draft prefix matching
+   the target argmax and emits the target's token at the first divergence
+   (so output == plain greedy decode by construction); sampling mode runs
+   standard speculative rejection sampling (accept d_i with prob
+   min(1, p_i/q_i); on rejection resample from normalize(max(p-q, 0))),
+   which preserves the target distribution exactly.
+
+Returns (accept_count, next_token, next_state, k_cache, v_cache) — one
+fetch per round, everything else stays on device.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from k8s_llm_scheduler_tpu.models.configs import LlamaConfig
+from k8s_llm_scheduler_tpu.models.llama import (
+    Params,
+    _dense,
+    _logits,
+    _mlp,
+    apply_rope,
+    rms_norm,
+    rope_inv_freq,
+)
+from k8s_llm_scheduler_tpu.ops.attention import (
+    NEG_INF,
+    attend_part,
+    merge_attention_parts,
+    prefix_attend_parts,
+)
+
+
+def _forward_verify_block(
+    params: Params,
+    cfg: LlamaConfig,
+    blk_tok,      # [W] int32 — [t_cur, d_1..d_K]
+    positions,    # [W] absolute positions
+    prefix_k, prefix_v,  # [L, Sp, n_kv, hd] shared dense prefix
+    prefix_len,   # scalar int32
+    k_cache, v_cache,    # [L, num_pages, ps, n_kv, hd] (donated by caller)
+    page_table,   # [1, P] — the slot's own-page table row
+    own_len,      # scalar int32 — valid own tokens in pages (< positions[0])
+    page_ids, offs,      # [W] scatter destinations for the block's KV
+    prefix_impl=None,    # static
+):
+    """Target forward over the block; returns (logits [W, V] f32, caches)."""
+    W = blk_tok.shape[0]
+    hd = cfg.head_dim
+    ps = k_cache.shape[2]
+    P = page_table.shape[1]
+    inv_freq = rope_inv_freq(cfg)
+    pos_b = positions[None, :]  # [1, W]
+
+    x = params["embed"][blk_tok][None]  # [1, W, D]
+    own_mask = (jnp.arange(P * ps)[None, :] < own_len)[:, None, None, None, :]
+    j = jnp.arange(W)
+    blk_mask = (j[:, None] >= j[None, :])[None, None, None, :, :]
+
+    def body(carry, xs):
+        x, kc, vc = carry
+        lp, pk, pv, idx = xs
+        h = rms_norm(x, lp["attn_norm"], cfg.rms_eps)
+        q = _dense(h, lp["wq"], "bsd,dh->bsh").reshape(1, W, cfg.n_heads, hd)
+        k = _dense(h, lp["wk"], "bsd,dh->bsh").reshape(1, W, cfg.n_kv_heads, hd)
+        v = _dense(h, lp["wv"], "bsd,dh->bsh").reshape(1, W, cfg.n_kv_heads, hd)
+        q = apply_rope(q, pos_b, inv_freq)
+        k = apply_rope(k, pos_b, inv_freq)
+        qg = (q.astype(jnp.float32) * hd**-0.5).reshape(
+            1, W, cfg.n_kv_heads, cfg.q_per_kv, hd
+        )
+        k_own = kc[idx][page_table].reshape(1, P * ps, cfg.n_kv_heads, hd)
+        v_own = vc[idx][page_table].reshape(1, P * ps, cfg.n_kv_heads, hd)
+        parts = [
+            prefix_attend_parts(q, qg, pk, pv, prefix_len, impl=prefix_impl),
+            attend_part(qg, k_own, v_own, own_mask, "bqkgh,bskh->bkgqs"),
+            attend_part(qg, k, v, blk_mask, "bqkgh,bskh->bkgqs"),
+        ]
+        attn = merge_attention_parts(parts)  # [1, n_kv, g, W, hd]
+        attn = jnp.moveaxis(attn, 3, 1).reshape(1, W, cfg.n_heads * hd)
+        x = x + _dense(attn.astype(x.dtype), lp["wo"], "bsh,hd->bsd")
+        x = x + _mlp(lp, cfg, x)
+        kc = kc.at[idx, page_ids, offs].set(k[0].astype(kc.dtype))
+        vc = vc.at[idx, page_ids, offs].set(v[0].astype(vc.dtype))
+        return (x, kc, vc), None
+
+    (x, k_cache, v_cache), _ = jax.lax.scan(
+        body, (x, k_cache, v_cache),
+        (params["layers"], prefix_k, prefix_v, jnp.arange(cfg.n_layers)),
+    )
+    return _logits(params, cfg, x[0]), k_cache, v_cache
+
+
+def _verify_impl(
+    params: Params,
+    cfg: LlamaConfig,  # static
+    blk_tok,      # [W] — [t_cur, d_1..d_K]
+    positions,    # [W]
+    prefix_k, prefix_v, prefix_len,
+    k_cache, v_cache,  # donated
+    page_table, own_len, page_ids, offs,
+    mask_states,   # [W] — DFA state governing the token AFTER blk_tok[i]
+    choice_idx,    # [K] — draft's sampled index per step (rejection path)
+    draft_logits,  # [K, X] — draft's masked proposal logits (rejection path)
+    sp_tokens, sp_next,
+    pad_id,
+    rng, temperature,
+    constrained: bool,          # static
+    greedy: bool,               # static — temperature == 0 fast path
+    vocab_limit: int | None = None,  # static
+    prefix_impl=None,           # static
+):
+    """Score + accept in one program. See module doc for the contract."""
+    W = blk_tok.shape[0]
+    K = W - 1
+    logits_all, k_cache, v_cache = _forward_verify_block(
+        params, cfg, blk_tok, positions, prefix_k, prefix_v, prefix_len,
+        k_cache, v_cache, page_table, own_len, page_ids, offs,
+        prefix_impl=prefix_impl,
+    )
+
+    if constrained:
+        rows_all = sp_tokens[mask_states]          # [W, Kw]
+        next_all = sp_next[mask_states]            # [W, Kw]
+        gathered = jnp.take_along_axis(
+            logits_all, jnp.maximum(rows_all, 0), axis=1
+        )
+        masked = jnp.where(rows_all >= 0, gathered, NEG_INF)  # [W, Kw]
+
+        def idx_to_tok(i, k_idx):
+            return rows_all[i, k_idx], next_all[i, k_idx]
+    else:
+        V = logits_all.shape[-1]
+        ids = jnp.arange(V)[None, :]
+        bad = ids == pad_id
+        if vocab_limit is not None and vocab_limit < V:
+            bad = bad | (ids >= vocab_limit)
+        masked = jnp.where(bad, NEG_INF, logits_all)  # [W, V]
+
+        def idx_to_tok(i, k_idx):
+            return k_idx, mask_states[i]
+
+    drafts = blk_tok[1:]  # [K]
+    if greedy:
+        tgt_k = jnp.argmax(masked, axis=-1)  # [W]
+        if constrained:
+            tgt_tok = jnp.take_along_axis(rows_all, tgt_k[:, None], 1)[:, 0]
+        else:
+            tgt_tok = tgt_k
+        match = (tgt_tok[:K] == drafts).astype(jnp.int32)
+        a = jnp.sum(jnp.cumprod(match)) if K > 0 else jnp.int32(0)
+        t_next, st_next = idx_to_tok(a, tgt_k[a])
+    else:
+        if not constrained:
+            # Align vocab widths: the draft's padded vocab may differ from
+            # the target's (widened to a 128 multiple, or simply a
+            # different config). Both maskings confine all legal mass to
+            # [0, tokenizer_vocab), which is <= both widths, so slicing to
+            # the common width drops only NEG_INF/zero-probability tail.
+            v_common = min(masked.shape[-1], draft_logits.shape[-1])
+            masked = masked[:, :v_common]
+            draft_logits = draft_logits[:, :v_common]
+        t = jnp.maximum(temperature, 1e-6)
+        p = jax.nn.softmax(masked / t, axis=-1)        # [W, X]
+        rng_u, rng_s = jax.random.split(rng)
+        if K > 0:
+            q = jax.nn.softmax(draft_logits / t, axis=-1)  # [K, X]
+            p_tok = jnp.take_along_axis(p[:K], choice_idx[:, None], 1)[:, 0]
+            q_tok = jnp.take_along_axis(q, choice_idx[:, None], 1)[:, 0]
+            u = jax.random.uniform(rng_u, (K,))
+            acc = (u * q_tok < p_tok).astype(jnp.int32)
+            a = jnp.sum(jnp.cumprod(acc))
+            # Rejection at position a: resample from the residual
+            # normalize(max(p - q, 0)) — the correction that makes the
+            # emitted marginal exactly the target's. All-accepted (a == K):
+            # the bonus token samples from the target directly.
+            p_a = p[a]
+            q_a = q[jnp.minimum(a, K - 1)]
+            resid = jnp.clip(p_a - q_a, 0.0, None)
+            resid = jnp.where(jnp.sum(resid) > 0, resid, p_a)
+            dist = jnp.where(a < K, resid, p_a)
+        else:
+            a = jnp.int32(0)
+            dist = p[0]
+        k_choice = jax.random.categorical(rng_s, jnp.log(dist + 1e-30))
+        t_next, st_next = idx_to_tok(a, k_choice)
+
+    return (
+        a.astype(jnp.int32),
+        t_next.astype(jnp.int32),
+        st_next.astype(jnp.int32),
+        k_cache,
+        v_cache,
+    )

@@ -5,14 +5,78 @@ The reference ships 3 nginx fixture pods with graduated requests
 targeting schedulerName ai-llama-scheduler. `fixture_pods()` reproduces that
 workload; `synthetic_cluster`/`pod_burst` generate the BASELINE stress shapes
 (64/256-node clusters, 1000-pod bursts).
+
+Also home of `async_deadline()` — the Python-3.10-compatible stand-in for
+the 3.11+ ``asyncio.timeout`` context manager that every async test's
+watchdog goes through (the package floor is >=3.10; tools/py310_lint.py
+keeps direct 3.11+-only calls from creeping back in).
 """
 
 from __future__ import annotations
+
+import asyncio
 
 from k8s_llm_scheduler_tpu.cluster.fake import FakeCluster, FakeNode
 from k8s_llm_scheduler_tpu.cluster.interface import RawPod
 
 SCHEDULER_NAME = "ai-llama-scheduler"
+
+
+class _Py310Deadline:
+    """Minimal backport of the 3.11 timeout context manager: arm a timer
+    that cancels the CURRENT task; translate the resulting CancelledError
+    into TimeoutError iff this deadline (not an outer cancel) fired."""
+
+    def __init__(self, seconds: float) -> None:
+        self._seconds = seconds
+        self._fired = False
+        self._handle = None
+        self._task = None
+
+    async def __aenter__(self) -> "_Py310Deadline":
+        self._task = asyncio.current_task()
+        loop = asyncio.get_running_loop()
+        self._handle = loop.call_later(self._seconds, self._on_timeout)
+        return self
+
+    def _on_timeout(self) -> None:
+        self._fired = True
+        if self._task is not None:
+            self._task.cancel()
+
+    async def __aexit__(self, exc_type, exc, tb) -> bool:
+        if self._handle is not None:
+            self._handle.cancel()
+        if self._fired:
+            if exc_type is asyncio.CancelledError:
+                raise TimeoutError(
+                    f"deadline of {self._seconds}s expired"
+                ) from exc
+            if exc_type is None:
+                # Timer fired in the gap between the block's last await and
+                # exit: the task.cancel() is still pending and would escape
+                # as a bare CancelledError at the caller's NEXT await.
+                # Absorb it at a checkpoint here and report the expiry
+                # (3.11's native timeout resolves this boundary the same
+                # way, via Task.uncancel bookkeeping).
+                try:
+                    await asyncio.sleep(0)
+                except asyncio.CancelledError:
+                    raise TimeoutError(
+                        f"deadline of {self._seconds}s expired"
+                    ) from None
+        return False
+
+
+def async_deadline(seconds: float):
+    """``async with async_deadline(30): ...`` — bound an async block's wall
+    time. Python 3.11+'s native scoped timeout when available (it handles
+    nested-cancellation bookkeeping via Task.uncancel); a call_later-based
+    shim with the same raise-TimeoutError contract on 3.10."""
+    native = getattr(asyncio, "timeout", None)  # 3.11+
+    if native is not None:
+        return native(seconds)
+    return _Py310Deadline(seconds)
 
 
 def fixture_pods(scheduler_name: str = SCHEDULER_NAME) -> list[RawPod]:

@@ -1,0 +1,53 @@
+"""Version-compat shims for JAX API drift.
+
+The repo targets current JAX (`jax.shard_map`, `check_vma`); some
+deployment images pin older 0.4.x where shard_map still lives at
+`jax.experimental.shard_map.shard_map` with the `check_rep` parameter.
+These shims keep the call sites written against the modern API.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def compiler_params(**kwargs):
+    """Pallas-TPU compiler params across the CompilerParams /
+    TPUCompilerParams rename (same fields either side)."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+    return cls(**kwargs)
+
+
+def pvary_compat(x, axes):
+    """Mark `x` device-varying over `axes` (jax.lax.pcast, VMA-era API).
+    Older JAX has no varying/manual-axis tracking, so the cast is an
+    identity there — the fori_loop carry-type concern it solves does not
+    exist without VMA."""
+    pcast = getattr(jax.lax, "pcast", None)
+    if pcast is not None:
+        return pcast(x, axes, to="varying")
+    return x
+
+
+def shard_map_compat(fn, *, mesh, in_specs, out_specs, check_vma: bool = False):
+    """`jax.shard_map` when available, else the experimental spelling.
+
+    `check_vma` maps to the old API's `check_rep` — both gate the
+    replication/varying-axis verifier. The collective-free pallas wrappers
+    pass False (pallas_call carries no rule for it); callers with real
+    collectives (ring attention) pass True to keep the verifier on.
+    """
+    sm = getattr(jax, "shard_map", None)
+    if sm is not None:
+        return sm(
+            fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=check_vma,
+        )
+    from jax.experimental.shard_map import shard_map as sm_old
+
+    return sm_old(
+        fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=check_vma,
+    )

@@ -17,6 +17,8 @@ import types
 
 import pytest
 
+from k8s_llm_scheduler_tpu.testing import async_deadline
+
 
 def _ns(**kw):
     return types.SimpleNamespace(**kw)
@@ -340,7 +342,7 @@ class TestInformer:
         ]]
         stream = cluster.watch_pending_pods("ai-sched")
         got = []
-        async with asyncio.timeout(30):
+        async with async_deadline(30):
             async for raw in stream:
                 got.append(raw.name)
                 break
@@ -368,7 +370,7 @@ class TestInformer:
         try:
             # a broken stream may have dropped events: snapshots must fall
             # back to relisting until the watch recovers
-            async with asyncio.timeout(10):
+            async with async_deadline(10):
                 while state["list_pods_calls"] == calls_before:
                     cluster.get_node_metrics()
                     await asyncio.sleep(0.02)
@@ -471,7 +473,7 @@ class TestWatchContinuation:
         stream = cluster.watch_pending_pods("ai-sched")
         consume = asyncio.ensure_future(stream.__anext__())
         try:
-            async with asyncio.timeout(30):
+            async with async_deadline(30):
                 # let the first stream (the fresh start) complete before
                 # snapshotting — before its first event the watch is not
                 # yet proven and a relist would be correct behavior
@@ -519,7 +521,7 @@ class TestWatchContinuation:
         stream = cluster.watch_pending_pods("ai-sched")
         consume = asyncio.ensure_future(stream.__anext__())
         try:
-            async with asyncio.timeout(30):
+            async with async_deadline(30):
                 # wait for the watch to cycle past the 410 and recover
                 # (fresh-start stream completes) WITHOUT snapshotting
                 while not (
@@ -566,7 +568,7 @@ class TestNodeWatch:
         stream = cluster.watch_pending_pods("ai-sched")
         consume = asyncio.ensure_future(stream.__anext__())
         try:
-            async with asyncio.timeout(30):
+            async with async_deadline(30):
                 # snapshots before the pod watch proves live would relist
                 # (correctly); wait it out, then assert zero further lists
                 while not cluster._inf_watch_live:
@@ -611,7 +613,7 @@ class TestWatch:
         ]
         seen = []
         stream = cluster.watch_pending_pods("ai-sched")
-        async with asyncio.timeout(30):
+        async with async_deadline(30):
             async for raw in stream:
                 seen.append(raw.name)
                 if len(seen) == 2:
@@ -636,7 +638,7 @@ class TestWatch:
                 got.append(raw.name)
 
         task = asyncio.create_task(consume())
-        async with asyncio.timeout(30):
+        async with async_deadline(30):
             while not got:
                 await asyncio.sleep(0.01)
             cluster.close()

@@ -20,6 +20,7 @@ from k8s_llm_scheduler_tpu.sched.client import DecisionClient
 from k8s_llm_scheduler_tpu.sched.loop import Scheduler
 from k8s_llm_scheduler_tpu.testing import (
     SCHEDULER_NAME,
+    async_deadline,
     fixture_pods,
     pod_burst,
     synthetic_cluster,
@@ -71,7 +72,7 @@ class TestLLMEndToEnd:
         scheduler = make_stack(cluster, backend)
         task = asyncio.create_task(scheduler.run())
         try:
-            async with asyncio.timeout(120):
+            async with async_deadline(120):
                 while cluster.bind_count < 3:
                     await asyncio.sleep(0.05)
         finally:
@@ -99,7 +100,7 @@ class TestLLMEndToEnd:
         scheduler = make_stack(cluster, backend)
         task = asyncio.create_task(scheduler.run())
         try:
-            async with asyncio.timeout(120):
+            async with async_deadline(120):
                 while cluster.bind_count < 12:
                     await asyncio.sleep(0.05)
         finally:
@@ -229,7 +230,7 @@ class TestShardedBackend:
             task = asyncio.create_task(sched.run())
             for pod in pod_burst(4, distinct_shapes=2):
                 cluster.add_pod(pod)
-            async with asyncio.timeout(120):
+            async with async_deadline(120):
                 while cluster.bind_count < 4:
                     await asyncio.sleep(0.02)
             sched.stop()
@@ -381,7 +382,7 @@ class TestGroupSwitching:
             await asyncio.sleep(0.3)
             pod = make_pod(name="cold-pod")
             t0 = asyncio.get_running_loop().time()
-            async with asyncio.timeout(55):
+            async with async_deadline(55):
                 d = await backend.get_scheduling_decision_async(pod, cold)
             waited = asyncio.get_running_loop().time() - t0
             stop_feeding.set()

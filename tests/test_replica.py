@@ -13,6 +13,7 @@ from k8s_llm_scheduler_tpu.engine.backend import (
     NoFeasibleNodeError,
     StubBackend,
 )
+from k8s_llm_scheduler_tpu.testing import async_deadline
 from k8s_llm_scheduler_tpu.sched.replica import (
     FanoutBackend,
     ReplicaClient,
@@ -556,7 +557,7 @@ class TestFanoutSchedulerE2E:
         pods = pod_burst(n_pods, distinct_shapes=8)
         for p in pods:
             cluster.add_pod(p)
-        async with asyncio.timeout(60):
+        async with async_deadline(60):
             while cluster.bind_count < n_pods:
                 await asyncio.sleep(0.01)
         sched.stop()
@@ -628,7 +629,7 @@ class TestFanoutSchedulerE2E:
 
             async def killer():
                 # fire only once remote requests are actually outstanding
-                async with asyncio.timeout(30):
+                async with async_deadline(30):
                     while not client._pending:
                         await asyncio.sleep(0.005)
                 try:

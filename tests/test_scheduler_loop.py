@@ -17,6 +17,7 @@ from k8s_llm_scheduler_tpu.sched.client import DecisionClient
 from k8s_llm_scheduler_tpu.sched.loop import Scheduler
 from k8s_llm_scheduler_tpu.testing import (
     SCHEDULER_NAME,
+    async_deadline,
     fixture_pods,
     pod_burst,
     synthetic_cluster,
@@ -39,7 +40,7 @@ def make_scheduler(cluster, backend=None, **kw):
 async def run_until_scheduled(scheduler, cluster, expected, timeout=10.0):
     task = asyncio.create_task(scheduler.run())
     try:
-        async with asyncio.timeout(timeout):
+        async with async_deadline(timeout):
             while cluster.bind_count < expected:
                 await asyncio.sleep(0.01)
     finally:
@@ -73,7 +74,7 @@ class TestE2E:
         await asyncio.sleep(0.05)
         for pod in fixture_pods():
             cluster.add_pod(pod)
-        async with asyncio.timeout(10):
+        async with async_deadline(10):
             while cluster.bind_count < 3:
                 await asyncio.sleep(0.01)
         scheduler.stop()
@@ -186,7 +187,7 @@ class TestPrefixPrewarm:
         scheduler = make_scheduler(cluster, backend, prefix_prewarm_s=0.02)
         task = asyncio.create_task(scheduler.run())
         try:
-            async with asyncio.timeout(5):
+            async with async_deadline(5):
                 while not calls:
                     await asyncio.sleep(0.01)
             n_first = len(calls)
@@ -196,7 +197,7 @@ class TestPrefixPrewarm:
             # cluster state changes (a new node changes the rendered
             # prefix) -> the loop re-prewarms
             cluster.add_node(FakeNode(name="node-new"))
-            async with asyncio.timeout(5):
+            async with async_deadline(5):
                 while len(calls) == n_first:
                     await asyncio.sleep(0.01)
         finally:
@@ -222,7 +223,7 @@ class TestPrefixPrewarm:
         scheduler = make_scheduler(cluster, backend, prefix_prewarm_s=0.02)
         task = asyncio.create_task(scheduler.run())
         try:
-            async with asyncio.timeout(5):
+            async with async_deadline(5):
                 while len(calls) < 2:  # False result clears the signature
                     await asyncio.sleep(0.01)
         finally:
@@ -270,7 +271,7 @@ class TestBurstFastPath:
             followers = pod_burst(20, distinct_shapes=2)[2:]
             for pod in followers:
                 cluster.add_pod(pod)
-            async with asyncio.timeout(20):
+            async with async_deadline(20):
                 while cluster.bind_count < 20:
                     await asyncio.sleep(0.01)
         finally:
@@ -303,7 +304,7 @@ class TestBurstFastPath:
             await asyncio.sleep(0.05)  # leader in flight
             for pod in pods[1:]:
                 cluster.add_pod(pod)
-            async with asyncio.timeout(20):
+            async with async_deadline(20):
                 while cluster.bind_count < 10:
                     await asyncio.sleep(0.01)
         finally:
@@ -332,7 +333,7 @@ class TestBurstFastPath:
             cluster.fail_next_bindings = 2
             for pod in pods[1:]:
                 cluster.add_pod(pod)
-            async with asyncio.timeout(20):
+            async with async_deadline(20):
                 while cluster.bind_count < 8:
                     await asyncio.sleep(0.01)
             await asyncio.sleep(0.1)  # let any stragglers finish

@@ -1,17 +1,27 @@
-"""Same-process A/B of the block-decode matmul impls (dense vs ragged).
+"""Same-process decode A/Bs: matmul impls, and speculative vs plain.
 
 Cross-run numbers on the tunneled bench chip are weather-confounded
 (dispatch RTT swings 100-250 ms over hours) and 8B-scale runs pay minutes
 of host init + weight transfer EACH — so this harness builds ONE set of
-weights and runs bench.model_throughput's wave phase for both impls
-back to back in one process, interleaved A/B/A/B to cancel slow drift.
+weights and runs both arms back to back in one process, interleaved
+A/B/A/B to cancel slow drift.
+
+Arms:
+- ``--arm matmul`` (default): dense vs ragged block-decode matmuls through
+  bench.model_throughput's wave phase (the VERDICT r4 item 2/5 numbers).
+- ``--arm spec``: speculative (spec/decoder.py) vs plain chunked decode
+  through bench.spec_ab on the general paged path. ``--draft self`` is the
+  acceptance-1.0 upper bound; named configs at random init measure the
+  overhead floor (the production draft is a train/distill.py checkpoint).
 
 Usage:
     python tools/ab_decode.py --model llama-3.2-1b-instruct
     python tools/ab_decode.py --model llama-3.1-8b-instruct --quantize int8
+    python tools/ab_decode.py --arm spec --model llama-3.2-1b-instruct \
+        --draft tiny --spec-k 4
 
 Prints one JSON line per (impl, rep) plus a final summary line with the
-decisions/s and decode-MFU ratios (the VERDICT r4 item 2/5 A/B numbers).
+throughput ratios.
 """
 
 from __future__ import annotations
@@ -33,6 +43,17 @@ def main() -> None:
     ap.add_argument("--slots", type=int, default=16)
     ap.add_argument("--reps", type=int, default=2)
     ap.add_argument("--peak-tflops", type=float, default=None)
+    ap.add_argument(
+        "--arm", choices=("matmul", "spec"), default="matmul",
+        help="matmul: dense-vs-ragged wave decode; spec: speculative vs "
+             "plain paged decode",
+    )
+    ap.add_argument(
+        "--draft", default="tiny",
+        help="spec arm: draft config name, or 'self' for the "
+             "acceptance-1.0 upper bound",
+    )
+    ap.add_argument("--spec-k", type=int, default=4)
     args = ap.parse_args()
 
     import jax
@@ -40,6 +61,18 @@ def main() -> None:
     from k8s_llm_scheduler_tpu.models.llama import init_params
 
     cfg = bench.build_cfg(args.model)
+
+    if args.arm == "spec":
+        if args.quantize is not None:
+            ap.error("--arm spec does not take --quantize (plain bf16 A/B)")
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        # spec_ab interleaves its arms internally; reps widens the best-of
+        summary = bench.spec_ab(
+            args.model, draft=args.draft, spec_k=args.spec_k,
+            reps=args.reps, params=params,
+        )
+        print(json.dumps(summary), flush=True)
+        return
     if args.quantize == "int8":
         from k8s_llm_scheduler_tpu.models.quant import init_params_int8_host
 
