@@ -1,4 +1,4 @@
-"""CLI entry point: run / verify / bench / demo.
+"""CLI entry point: run / verify / bench / demo / train / eval / sim.
 
 Parity surface (reference -> here):
 - `python scheduler.py`            -> `python -m k8s_llm_scheduler_tpu.cli run`
@@ -580,6 +580,168 @@ def cmd_bench(args: argparse.Namespace, cfg: Config) -> int:
     return subprocess.call(cmd)
 
 
+def _sim_arms(args: argparse.Namespace, cfg: Config) -> list:
+    """Arm names -> ArmSpecs. `llm` serves the CONFIGURED decision backend
+    (llm.backend: local builds the real engine with temperature forced to
+    0 — greedy, so the arena's determinism contract holds; stub is the
+    zero-weights stand-in). `stub` always means StubBackend through the
+    full stack. Heuristic names come from core/fallback.SCORERS; `teacher`
+    is the sim/teacher.py reference policy."""
+    from k8s_llm_scheduler_tpu.core.fallback import SCORERS
+    from k8s_llm_scheduler_tpu.sim import ArmSpec, HeuristicBackend, teacher_arm
+
+    specs: list = []
+    for name in [a.strip() for a in args.arms.split(",") if a.strip()]:
+        if name == "llm":
+            if cfg.get("llm.backend") == "stub":
+                from k8s_llm_scheduler_tpu.engine.backend import StubBackend
+
+                specs.append(ArmSpec(name="llm", kind="stack", make=StubBackend))
+            else:
+                def make_llm():
+                    from k8s_llm_scheduler_tpu.engine.local import (
+                        build_local_backend,
+                    )
+
+                    return build_local_backend(
+                        **_backend_kwargs(cfg, temperature=0.0)
+                    )
+
+                specs.append(ArmSpec(name="llm", kind="stack", make=make_llm))
+        elif name == "stub":
+            from k8s_llm_scheduler_tpu.engine.backend import StubBackend
+
+            specs.append(ArmSpec(name="stub", kind="stack", make=StubBackend))
+        elif name == "teacher":
+            specs.append(teacher_arm())
+        elif name in SCORERS:
+            specs.append(
+                ArmSpec(
+                    name=name, kind="stack",
+                    make=lambda n=name: HeuristicBackend(n),
+                )
+            )
+        else:
+            raise SystemExit(
+                f"unknown arm {name!r} (known: llm, stub, teacher, "
+                f"{', '.join(SCORERS)})"
+            )
+    return specs
+
+
+def cmd_sim(args: argparse.Namespace, cfg: Config) -> int:
+    """Cluster-twin scenario arena (sim/): seeded burst/Poisson workloads
+    through the REAL stack over the wire-level fake API server, scored
+    across decision arms, recorded as a bit-identically replayable trace."""
+    from k8s_llm_scheduler_tpu.sim import (
+        ChurnEvent,
+        ScenarioSpec,
+        generate_scenario,
+        run_arena,
+        save_trace,
+        verify_trace,
+    )
+
+    if args.replay:
+        ok, detail = verify_trace(args.replay)
+        print(json.dumps({
+            "metric": "sim_replay", "ok": ok, "trace": args.replay,
+            "detail": detail,
+        }))
+        return 0 if ok else 1
+
+    churn = []
+    for entry in args.churn or []:
+        try:
+            wave_s, kind, node = entry.split(":", 2)
+            churn.append(ChurnEvent(wave=int(wave_s), kind=kind, node=node))
+        except ValueError:
+            raise SystemExit(
+                f"--churn {entry!r}: expected WAVE:KIND:NODE "
+                f"(e.g. 2:fail:sim-node-003)"
+            ) from None
+    spec = ScenarioSpec(
+        name=args.name,
+        seed=args.seed,
+        n_nodes=args.nodes,
+        n_pods=args.pods,
+        shapes=args.shapes,
+        arrival=args.arrival,
+        arrival_rate=args.arrival_rate,
+        n_waves=args.waves,
+        hetero=not args.homogeneous,
+        taint_frac=args.taint_frac,
+        constraint_mix=tuple(
+            c.strip() for c in args.constraints.split(",") if c.strip()
+        ),
+        churn=tuple(churn),
+    )
+    try:
+        scenario = generate_scenario(spec)
+    except ValueError as exc:
+        raise SystemExit(str(exc)) from None
+    arms = _sim_arms(args, cfg)
+
+    live: dict[str, Any] = {"arena": {"done_arms": 0, "arms": {}}}
+    metrics_server = None
+    if args.metrics_port is not None:
+        from k8s_llm_scheduler_tpu.observability.metrics import MetricsServer
+
+        metrics_server = MetricsServer(
+            lambda: live["arena"], port=args.metrics_port
+        )
+        metrics_server.start()
+
+    def on_arm_done(name: str, arm_report: dict) -> None:
+        live["arena"]["done_arms"] += 1
+        live["arena"]["arms"][name] = {
+            "scores": arm_report["scores"],
+            "waves": arm_report["waves"],
+        }
+        print(json.dumps({
+            "metric": "sim_arm",
+            "arm": name,
+            "scores": arm_report["scores"],
+            "placements_digest": arm_report["placements_digest"],
+        }), flush=True)
+
+    try:
+        report = run_arena(
+            scenario, arms,
+            wave_timeout_s=args.wave_timeout,
+            on_arm_done=on_arm_done,
+        )
+    finally:
+        if metrics_server is not None:
+            metrics_server.stop()
+
+    if args.trace:
+        save_trace(report, args.trace)
+    report.pop("_traces")
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as fh:
+            json.dump(report, fh, indent=1, sort_keys=True)
+    # headline: one line, deterministic fields only
+    print(json.dumps({
+        "metric": "sim_arena",
+        "seed": spec.seed,
+        "nodes": spec.n_nodes,
+        "pods": spec.n_pods,
+        "waves": len(scenario.waves),
+        "arms": {
+            name: {
+                "spread": arm["scores"]["spread"],
+                "bound_frac": arm["scores"]["bound_frac"],
+                "constraint_satisfaction":
+                    arm["scores"]["constraint_satisfaction"],
+                "placements_digest": arm["placements_digest"],
+            }
+            for name, arm in report["arms"].items()
+        },
+    }))
+    return 0
+
+
 def cmd_complete(args: argparse.Namespace, cfg: Config) -> int:
     """Free-form generation through the PAGED continuous-batching path —
     the general-completion capability the reference gets from its remote
@@ -771,6 +933,61 @@ def main(argv: list[str] | None = None) -> int:
     )
     p_eval.add_argument("--scenario-cases", type=int, default=32)
 
+    p_sim = sub.add_parser(
+        "sim",
+        help="cluster-twin scenario arena: seeded workloads through the "
+             "real stack, scored across decision arms (sim/)",
+    )
+    p_sim.add_argument("--name", default="scenario")
+    p_sim.add_argument("--seed", type=int, default=0)
+    p_sim.add_argument("--nodes", type=int, default=16)
+    p_sim.add_argument("--pods", type=int, default=64)
+    p_sim.add_argument("--shapes", type=int, default=8)
+    p_sim.add_argument(
+        "--arrival", choices=("burst", "poisson", "waves"), default="burst",
+    )
+    p_sim.add_argument(
+        "--arrival-rate", type=float, default=500.0,
+        help="pods/sec for --arrival poisson",
+    )
+    p_sim.add_argument(
+        "--waves", type=int, default=4,
+        help="wave count for --arrival waves",
+    )
+    p_sim.add_argument(
+        "--homogeneous", action="store_true",
+        help="uniform node SKUs (default: heterogeneous ladder)",
+    )
+    p_sim.add_argument("--taint-frac", type=float, default=0.0)
+    p_sim.add_argument(
+        "--constraints", default="uniform",
+        help="comma list of scenario classes cycled over pod shapes "
+             "(train/eval.SCENARIO_CLASSES: uniform, hetero-capacity, "
+             "tainted, selector, affinity)",
+    )
+    p_sim.add_argument(
+        "--churn", action="append", default=None, metavar="WAVE:KIND:NODE",
+        help="node churn applied before WAVE (kind: fail|recover|add|"
+             "delete); repeatable",
+    )
+    p_sim.add_argument(
+        "--arms",
+        default="stub,resource_balanced,least_loaded,round_robin,teacher",
+        help="comma list: llm (configured backend, greedy), stub, teacher, "
+             "or any core/fallback strategy",
+    )
+    p_sim.add_argument("--trace", default=None, help="record trace here")
+    p_sim.add_argument(
+        "--replay", default=None,
+        help="verify a recorded trace replays bit-identically, then exit",
+    )
+    p_sim.add_argument("--out", default=None, help="full JSON report path")
+    p_sim.add_argument("--wave-timeout", type=float, default=300.0)
+    p_sim.add_argument(
+        "--metrics-port", type=int, default=None,
+        help="serve live arena scores on /metrics while running",
+    )
+
     p_complete = sub.add_parser(
         "complete",
         help="free-form text completion (paged continuous-batching path)",
@@ -805,6 +1022,7 @@ def main(argv: list[str] | None = None) -> int:
         "bench": cmd_bench,
         "train": cmd_train,
         "eval": cmd_eval,
+        "sim": cmd_sim,
         "complete": cmd_complete,
     }
     return handlers[args.command](args, cfg)

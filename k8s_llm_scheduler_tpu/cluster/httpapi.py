@@ -206,6 +206,12 @@ def load_kube_config(path: str | None = None) -> None:
     candidates = [p for p in str(raw).split(os.pathsep) if p]
     existing = [p for p in candidates if os.path.exists(p)]
     if not existing:
+        if _active is not None:
+            # an explicit set_active_config() (tests, sim/arena pointing at
+            # the wire fake) outranks a missing kubeconfig: keep it rather
+            # than failing construction of a client that is already
+            # configured
+            return
         raise FileNotFoundError(
             f"no kubeconfig found at {raw!r}"
         )

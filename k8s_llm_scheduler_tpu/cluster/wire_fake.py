@@ -34,7 +34,7 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
-__all__ = ["WireFakeK8s"]
+__all__ = ["WireFakeK8s", "node_affinity_wire"]
 
 
 def _node_json(
@@ -69,7 +69,26 @@ def _pod_json(
     requests: dict | None,
     node_selector: dict | None,
     tolerations: list | None,
+    affinity: dict | None = None,
+    priority: int = 0,
 ) -> dict:
+    spec: dict = {
+        "schedulerName": scheduler_name,
+        "nodeName": node_name,
+        "nodeSelector": dict(node_selector or {}),
+        "tolerations": list(tolerations or []),
+        "priority": priority,
+        "containers": [
+            {
+                "name": "main",
+                "resources": {"requests": dict(requests or {})},
+            }
+        ],
+    }
+    if affinity:
+        # wire-shape (camelCase) affinity, exactly what the real API
+        # server serves — kube._pod_to_raw must parse it off the wire
+        spec["affinity"] = copy.deepcopy(affinity)
     return {
         "kind": "Pod",
         "apiVersion": "v1",
@@ -78,19 +97,34 @@ def _pod_json(
             "namespace": namespace,
             "uid": f"uid-{namespace}-{name}",
         },
-        "spec": {
-            "schedulerName": scheduler_name,
-            "nodeName": node_name,
-            "nodeSelector": dict(node_selector or {}),
-            "tolerations": list(tolerations or []),
-            "containers": [
-                {
-                    "name": "main",
-                    "resources": {"requests": dict(requests or {})},
-                }
-            ],
-        },
+        "spec": spec,
         "status": {"phase": phase},
+    }
+
+
+def node_affinity_wire(terms: list[list[dict]]) -> dict:
+    """Normalized affinity terms (core/validation shape: terms OR'd,
+    expressions AND'd) -> the camelCase wire JSON a V1Pod carries. The
+    sim's scenario pods go through this so required node affinity crosses
+    the REAL watch/parse path (kube._pod_to_raw), not a shortcut."""
+    return {
+        "nodeAffinity": {
+            "requiredDuringSchedulingIgnoredDuringExecution": {
+                "nodeSelectorTerms": [
+                    {
+                        "matchExpressions": [
+                            {
+                                "key": e.get("key", ""),
+                                "operator": e.get("operator", "In"),
+                                "values": list(e.get("values") or []),
+                            }
+                            for e in term
+                        ]
+                    }
+                    for term in terms
+                ]
+            }
+        }
     }
 
 
@@ -191,12 +225,14 @@ class WireFakeK8s:
         requests: dict | None = None,
         node_selector: dict | None = None,
         tolerations: list | None = None,
+        affinity: dict | None = None,
+        priority: int = 0,
     ) -> None:
         with self._lock:
             pod = _pod_json(
                 name, namespace, scheduler_name, phase, node_name,
                 requests or {"cpu": "100m", "memory": "128Mi"},
-                node_selector, tolerations,
+                node_selector, tolerations, affinity, priority,
             )
             etype = "MODIFIED" if (namespace, name) in self._pods else "ADDED"
             self._pods[(namespace, name)] = pod

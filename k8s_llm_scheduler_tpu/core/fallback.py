@@ -53,11 +53,18 @@ def score_round_robin(node: NodeMetrics) -> float:
     return -float(node.pod_count)
 
 
-_SCORERS = {
+# Public registry: sim/arena.py builds one decision arm per strategy from
+# this map, so a new heuristic automatically becomes a benchmarked arm.
+# These scorers are deliberately STATELESS one-shot rankings — the
+# spread-lookahead / soft-affinity reference policy that folds its own
+# placements lives in sim/teacher.py, where O(candidates x nodes) per
+# decision is affordable; the runtime fallback must stay O(nodes).
+SCORERS = {
     "resource_balanced": score_resource_balanced,
     "least_loaded": score_least_loaded,
     "round_robin": score_round_robin,
 }
+_SCORERS = SCORERS  # backwards-compat alias
 
 
 def fallback_decision(

@@ -309,7 +309,9 @@ class LocalLLMBackend:
                     "for larger clusters will be truncated; raise "
                     "llm.max_tokens to >= %d",
                     self.max_new_tokens, effective, self.max_reason_tokens,
-                    self.max_reason_tokens + 62 + longest_name + 2,
+                    # exact floor: budget = max_new - (60 + name) - 2, so
+                    # budget >= max_reason_tokens at 62 + name + reason
+                    self.max_reason_tokens + 62 + longest_name,
                 )
             self._dfa_cache[key] = build_decision_dfa(
                 self.tokenizer, list(key),

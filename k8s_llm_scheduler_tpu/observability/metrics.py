@@ -39,6 +39,17 @@ def _flatten(stats: dict[str, Any], prefix: str = "") -> dict[str, float]:
         # strings (e.g. breaker state) become labeled gauges below
         elif isinstance(value, str):
             out[f"{name}{{value=\"{value}\"}}"] = 1.0
+        elif isinstance(value, (list, tuple)):
+            # index-labeled gauges: per-replica lists (fanout_routed) and
+            # per-wave arena series (sim/arena) were silently DROPPED
+            # before this — a scrape showed totals but never the series
+            for i, item in enumerate(value):
+                if isinstance(item, dict):
+                    out.update(_flatten(item, f"{name}_{i}"))
+                elif isinstance(item, bool):
+                    out[f"{name}{{index=\"{i}\"}}"] = 1.0 if item else 0.0
+                elif isinstance(item, (int, float)):
+                    out[f"{name}{{index=\"{i}\"}}"] = float(item)
     return out
 
 

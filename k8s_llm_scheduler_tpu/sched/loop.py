@@ -88,6 +88,14 @@ class Scheduler:
             "unschedulable": 0,
         }
 
+    def invalidate_snapshot(self) -> None:
+        """Drop the cached node snapshot so the next decision re-reads the
+        cluster. Wave-barrier drivers (sim/arena.py) call this between
+        waves: each wave must decide against the settled post-bind state
+        even when snapshot_ttl_s is set long enough to pin one snapshot
+        per wave. Plain assignment — the reader re-checks under its lock."""
+        self._snapshot = None
+
     async def _node_snapshot(self) -> Sequence[NodeMetrics]:
         """Cluster snapshot, reused within snapshot_ttl_s across a burst."""
         async with self._snapshot_lock:

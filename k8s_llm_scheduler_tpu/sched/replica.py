@@ -49,7 +49,7 @@ import time
 from collections.abc import Sequence
 from concurrent.futures import Future
 from concurrent.futures import TimeoutError as FuturesTimeout
-from typing import Any
+from typing import Any, Callable
 
 from k8s_llm_scheduler_tpu.engine.backend import (
     BackendError,
@@ -298,11 +298,19 @@ class ReplicaServer:
             return
 
         def _done(f) -> None:
+            # Runs on the ENGINE worker thread (the backend resolves its
+            # prewarm futures there): writing to a slow client socket here
+            # would wedge ALL decision serving behind one blocked send.
+            # Hand the reply to the request pool; the engine thread only
+            # pays a submit.
             try:
                 ok = bool(f.result())
             except Exception:
                 ok = False
-            reply(ok)
+            try:
+                self._pool.submit(reply, ok)
+            except RuntimeError:
+                pass  # pool shut down by close(); client is going away too
 
         fut.add_done_callback(_done)
 
@@ -692,7 +700,11 @@ class FanoutBackend:
     # peaks — where its full latency lands on the burst's tail.
     SLOW_EXCLUDE_RATIO = 4.0
 
-    def __init__(self, replicas: Sequence[Any]) -> None:
+    def __init__(
+        self,
+        replicas: Sequence[Any],
+        clock: "Callable[[], float]" = time.monotonic,
+    ) -> None:
         if not replicas:
             raise ValueError("FanoutBackend needs at least one replica")
         self.replicas = list(replicas)
@@ -700,7 +712,11 @@ class FanoutBackend:
         self._health = [_ReplicaHealth() for _ in self.replicas]
         self._lock = threading.Lock()
         self._rr = itertools.count()  # tiebreak rotation among equals
-        self._last_routed_t = [time.monotonic()] * len(self.replicas)
+        # Injectable time source: every probe-window / cooldown / EMA
+        # judgment reads THIS clock, so tests can advance time explicitly
+        # instead of racing real sleeps on a loaded host (VERDICT r5 #6).
+        self._clock = clock
+        self._last_routed_t = [self._clock()] * len(self.replicas)
         self._picks_total = 0
         self._last_probe_pick = 0
 
@@ -710,7 +726,7 @@ class FanoutBackend:
         skipped unless ALL are cooling down (then least-bad is used — a
         decision must still be attempted so the upstream stack can fall
         back on a real error, not on dispatch refusal)."""
-        now = time.monotonic()
+        now = self._clock()
         rotate = next(self._rr)
         with self._lock:
             candidates = [
@@ -784,12 +800,7 @@ class FanoutBackend:
             if adjust_inflight:
                 h.inflight = max(0, h.inflight - 1)
             if failed:
-                h.failures += 1
-                backoff = min(
-                    self.COOLDOWN_CAP_S,
-                    self.COOLDOWN_BASE_S * (2 ** min(h.failures - 1, 8)),
-                )
-                h.cooldown_until = time.monotonic() + backoff
+                self._note_failure_locked(h)
             else:
                 h.failures = 0
                 h.cooldown_until = 0.0
@@ -801,6 +812,26 @@ class FanoutBackend:
                     )
             h.probing = False
 
+    def _note_failure_locked(self, h: _ReplicaHealth) -> None:
+        """Exponential-backoff cooldown bump (caller holds self._lock)."""
+        h.failures += 1
+        backoff = min(
+            self.COOLDOWN_CAP_S,
+            self.COOLDOWN_BASE_S * (2 ** min(h.failures - 1, 8)),
+        )
+        h.cooldown_until = self._clock() + backoff
+
+    def _record_advisory_failure(self, i: int) -> None:
+        """Prewarm TRANSPORT failure: feed the cooldown, and ONLY the
+        cooldown. Deliberately not _record (ADVICE round 5): an advisory
+        completion must not reset `failures`/`cooldown_until` on success —
+        a healthy prewarm answer from a replica mid-cooldown would
+        re-admit it before its decision backoff expired — and must not
+        clear `probing`, which belongs to an in-flight DECISION probe the
+        prewarm knows nothing about."""
+        with self._lock:
+            self._note_failure_locked(self._health[i])
+
     def prewarm_prefix(self, nodes: Sequence[NodeMetrics]):
         """Fan the advisory prefix install out to every replica that
         supports it AND is not in failure cooldown (shared-prefix
@@ -810,17 +841,18 @@ class FanoutBackend:
         Health integration: a TRANSPORT failure (connect/send/deadline —
         the replica client raises) feeds the same exponential cooldown
         decisions use, so a black-holed worker costs at most one blocking
-        dial per cooldown expiry instead of one per prewarm tick; an
-        advisory drop (the worker answered ok=False — e.g. busy) is a
-        HEALTHY fast answer and clears failures. Cooling replicas are
-        skipped outright.
+        dial per cooldown expiry instead of one per prewarm tick. Any
+        ANSWERED advisory (installed or dropped) is health-neutral: it
+        neither clears decision failure state nor touches an in-flight
+        probe (_record_advisory_failure). Cooling replicas are skipped
+        outright.
 
         Returns None when no replica supports prewarming (disables the
         scheduler's prewarm loop), else a Future resolving True iff every
         replica that was actually forwarded to installed — False (any
         drop, any failure, or everyone cooling) re-arms the loop's retry
         on its next idle tick."""
-        now = time.monotonic()
+        now = self._clock()
         futs: list[tuple[int, Future]] = []
         supported = 0
         for i, r in enumerate(self.replicas):
@@ -848,7 +880,10 @@ class FanoutBackend:
                 failed = False
             except Exception:
                 ok, failed = False, True
-            self._record(i, None, failed, adjust_inflight=False)
+            if failed:
+                # failure path only: successes (installed OR dropped) are
+                # advisory and must not touch decision health state
+                self._record_advisory_failure(i)
             with lock:
                 state["ok"] &= ok
                 state["left"] -= 1
@@ -864,7 +899,7 @@ class FanoutBackend:
         self, pod: PodSpec, nodes: Sequence[NodeMetrics]
     ) -> SchedulingDecision:
         i = self._pick()
-        start = time.monotonic()
+        start = self._clock()
         failed = False
         elapsed = None
         # accounting in finally: a BaseException (e.g. asyncio
@@ -874,11 +909,11 @@ class FanoutBackend:
         # failure: it is not the replica's fault.
         try:
             decision = self.replicas[i].get_scheduling_decision(pod, nodes)
-            elapsed = time.monotonic() - start
+            elapsed = self._clock() - start
             return decision
         except NoFeasibleNodeError:
             # a correct "no" is a healthy, fast answer — not a failure
-            elapsed = time.monotonic() - start
+            elapsed = self._clock() - start
             raise
         except Exception:
             failed = True
@@ -897,7 +932,7 @@ class FanoutBackend:
 
         i = self._pick()
         replica = self.replicas[i]
-        start = time.monotonic()
+        start = self._clock()
         failed = False
         elapsed = None
         try:
@@ -908,10 +943,10 @@ class FanoutBackend:
                 decision = await asyncio.to_thread(
                     replica.get_scheduling_decision, pod, nodes
                 )
-            elapsed = time.monotonic() - start
+            elapsed = self._clock() - start
             return decision
         except NoFeasibleNodeError:
-            elapsed = time.monotonic() - start
+            elapsed = self._clock() - start
             raise
         except Exception:
             failed = True
@@ -929,7 +964,7 @@ class FanoutBackend:
                     round(h.ema_s * 1000.0, 2) for h in self._health
                 ],
                 "fanout_cooling": [
-                    h.cooldown_until > time.monotonic() for h in self._health
+                    h.cooldown_until > self._clock() for h in self._health
                 ],
             }
         local = self.replicas[0]

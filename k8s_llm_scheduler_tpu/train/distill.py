@@ -903,10 +903,15 @@ def make_cot_diagnostics(
                     {"echo": "echo", "score_int": "score", "cmp_int": "cmp",
                      "cmp_dec": "cmp"}.get(k, "copy")
                 )
-        # the constrained selected_node choice token is a copy too
-        pos_rows.append(filled)
-        pos_cols.append(off + ne - 1)
-        pos_kind.append("copy")
+        # the constrained selected_node choice token is a copy too — same
+        # guard as the loop above: on a truncated prompt `off` can be <= 0
+        # and an unguarded off+ne-1 would index from the row's END
+        # (negative wraparound), scoring a pad/garbage position
+        col = off + ne - 1
+        if 0 < col < len(ids):
+            pos_rows.append(filled)
+            pos_cols.append(col)
+            pos_kind.append("copy")
         filled += 1
     row_idx = np.asarray(pos_rows, dtype=np.int32)
     col_idx = np.asarray(pos_cols, dtype=np.int32)

@@ -66,6 +66,35 @@ SCENARIO_CLASSES = (
 )
 
 
+def sample_pod_constraints(
+    kind: str, rng: np.random.Generator
+) -> tuple[dict, tuple, dict]:
+    """One (node_selector, tolerations, affinity_rules) draw for a pod of
+    scenario class `kind` — THE constraint taxonomy, shared by the eval's
+    per-class agreement table below and the sim's workload generators
+    (sim/scenarios.py), so arena scores and eval scores speak the same
+    scenario language. rng call ORDER is part of the contract: existing
+    seeded streams (tests/test_eval.py) must not shift."""
+    selector: dict = {}
+    tolerations: tuple = ()
+    affinity: dict = {}
+    if kind == "selector" and rng.random() < 0.7:
+        selector = {"tier": "db" if rng.random() < 0.5 else "web"}
+    if kind == "tainted" and rng.random() < 0.6:
+        tolerations = (
+            {"key": "dedicated", "operator": "Equal", "value": "gpu",
+             "effect": "NoSchedule"},
+        )
+    if kind == "affinity" and rng.random() < 0.8:
+        zones = [f"z{z}" for z in rng.choice(3, size=2, replace=False)]
+        affinity = {
+            "node_affinity_terms": [
+                [{"key": "zone", "operator": "In", "values": zones}]
+            ]
+        }
+    return selector, tolerations, affinity
+
+
 def scenario_cases(
     kind: str,
     n_nodes: int = 5,
@@ -127,23 +156,7 @@ def scenario_cases(
                     conditions={"Ready": "True"},
                 )
             )
-        selector = {}
-        tolerations: tuple = ()
-        affinity: dict = {}
-        if kind == "selector" and rng.random() < 0.7:
-            selector = {"tier": "db" if rng.random() < 0.5 else "web"}
-        if kind == "tainted" and rng.random() < 0.6:
-            tolerations = (
-                {"key": "dedicated", "operator": "Equal", "value": "gpu",
-                 "effect": "NoSchedule"},
-            )
-        if kind == "affinity" and rng.random() < 0.8:
-            zones = [f"z{z}" for z in rng.choice(3, size=2, replace=False)]
-            affinity = {
-                "node_affinity_terms": [
-                    [{"key": "zone", "operator": "In", "values": zones}]
-                ]
-            }
+        selector, tolerations, affinity = sample_pod_constraints(kind, rng)
         yield (
             PodSpec(
                 name=f"{kind}-pod-{case_idx}",

@@ -23,6 +23,21 @@ class TestMetricsRendering:
         assert "llm_scheduler_client_avg_response_time_ms 12.5" in text
         assert 'llm_scheduler_client_circuit_breaker_state{value="closed"} 1.0' in text
 
+    def test_lists_become_indexed_gauges(self):
+        """Per-replica lists (fanout_routed) and per-wave arena series
+        were silently dropped by _flatten before round 6."""
+        stats = {
+            "fanout_routed": [7, 3],
+            "fanout_cooling": [False, True],
+            "arena": {"waves": [{"wall_ms": 12.5}, {"wall_ms": 8.0}]},
+        }
+        text = render_prometheus(stats)
+        assert 'llm_scheduler_fanout_routed{index="0"} 7.0' in text
+        assert 'llm_scheduler_fanout_routed{index="1"} 3.0' in text
+        assert 'llm_scheduler_fanout_cooling{index="1"} 1.0' in text
+        assert "llm_scheduler_arena_waves_0_wall_ms 12.5" in text
+        assert "llm_scheduler_arena_waves_1_wall_ms 8.0" in text
+
 
 class TestMetricsServer:
     def test_endpoints(self):
