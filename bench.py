@@ -152,6 +152,12 @@ PRESETS = {
     # the arena's determinism contract covers the model arm.
     "arena": {"pods": 256, "nodes": 64, "shapes": 16, "rounds": 4,
               "temperature": 0.0},
+    # hot weight swaps under sustained decode load (rollout/hotswap.py):
+    # identical-params swaps fire while arrival-paced pods keep the engine
+    # in waves; reports swap-pause p50/p99 (admission-held wall time) and
+    # asserts zero failed/dropped requests across every swap.
+    "rollout": {"pods": 192, "nodes": 32, "shapes": 16, "rounds": 1,
+                "arrival_rate": 150.0},
     # burst AFTER a cluster-state change: every round perturbs node usage
     # (so the cluster prefix differs from the engine's resident group),
     # idles perturb_idle seconds, then bursts — the production shape
@@ -417,6 +423,112 @@ async def bench_preset(args, backend=None) -> dict:
             "preset": args.preset,
             "prefix_prewarm_s": float(getattr(args, "prefix_prewarm", 0.25)),
             "baseline_note": "reference publishes no numbers; target p50<200ms (BASELINE.md)",
+        },
+    }
+
+
+# ------------------------------------------------------------- rollout swap
+async def rollout_bench(args) -> dict:
+    """`--preset rollout`: hot-swap pause under active decode load.
+
+    Runs the full stack at a sustained arrival rate while performing
+    identical-params hot swaps through LocalLLMBackend.run_quiesced — the
+    quiesce path a real promotion takes (hold admissions, drain waves,
+    swap the params pointer, invalidate the prefix cache), with identical
+    weights so decision QUALITY is unchanged and only the machinery is
+    measured. Reports swap-pause p50/p99 and asserts every pod bound with
+    zero failures across every swap."""
+    from k8s_llm_scheduler_tpu.core.breaker import CircuitBreaker
+    from k8s_llm_scheduler_tpu.core.cache import DecisionCache
+    from k8s_llm_scheduler_tpu.sched.client import DecisionClient
+    from k8s_llm_scheduler_tpu.sched.loop import Scheduler
+    from k8s_llm_scheduler_tpu.testing import (
+        SCHEDULER_NAME,
+        pod_burst,
+        synthetic_cluster,
+    )
+
+    backend = build_backend(args)
+    engine = backend.engine
+    cache = DecisionCache(max_size=4096)
+    n_swaps = int(getattr(args, "swaps", None) or 6)
+    pauses_ms: list[float] = []
+    try:
+        cluster = synthetic_cluster(args.nodes)
+        client = DecisionClient(
+            backend, cache=cache, breaker=CircuitBreaker(), retry_delay=0.1,
+        )
+        scheduler = Scheduler(
+            cluster, cluster, client,
+            scheduler_name=SCHEDULER_NAME, snapshot_ttl_s=300.0,
+            max_concurrency=256,
+        )
+        task = asyncio.create_task(scheduler.run())
+        pods = pod_burst(args.pods, distinct_shapes=args.shapes)
+
+        swap_done = asyncio.Event()
+
+        async def swap_loop():
+            # identical-params swap: the exact quiesce/invalidate path of a
+            # promotion, with a no-op weight change. Spaced across the run
+            # so swaps land while waves are genuinely in flight.
+            interval = max(args.pods / args.arrival_rate / (n_swaps + 1), 0.05)
+            for _ in range(n_swaps):
+                await asyncio.sleep(interval)
+
+                def do_swap():
+                    engine.swap_params(engine.params)
+                    cache.bump_generation()
+
+                _, pause_s = await asyncio.to_thread(
+                    backend.run_quiesced, do_swap
+                )
+                pauses_ms.append(pause_s * 1000.0)
+            swap_done.set()
+
+        swapper = asyncio.ensure_future(swap_loop())
+        try:
+            latencies, wall_s = await run_burst(
+                scheduler, cluster, pods, timeout_s=600.0,
+                arrival_rate=args.arrival_rate,
+            )
+            await asyncio.wait_for(swap_done.wait(), timeout=120.0)
+        finally:
+            swapper.cancel()
+            scheduler.stop()
+            cluster.close()
+            await asyncio.wait_for(task, timeout=30)
+        stats = scheduler.get_stats()
+    finally:
+        backend.close()
+
+    assert len(latencies) == args.pods, (
+        f"dropped requests across swaps: {len(latencies)}/{args.pods} bound"
+    )
+    assert stats["failed_bindings"] == 0, stats
+    assert stats["client"]["failed_requests"] == 0, stats["client"]
+    pauses = sorted(pauses_ms)
+    lat = sorted(latencies.values())
+    return {
+        "metric": "rollout_swap_pause_ms",
+        "value": round(statistics.median(pauses), 2),
+        "unit": "ms",
+        "extra": {
+            "p99_ms": round(pauses[min(len(pauses) - 1, int(len(pauses) * 0.99))], 2),
+            "pauses_ms": [round(p, 2) for p in pauses],
+            "swaps": len(pauses),
+            "weight_swaps": stats["client"]["engine"].get("weight_swaps", 0),
+            "pods": args.pods,
+            "nodes": args.nodes,
+            "arrival_rate": args.arrival_rate,
+            "pod_p50_ms": round(statistics.median(lat), 2),
+            "pod_p99_ms": round(lat[min(len(lat) - 1, int(len(lat) * 0.99))], 2),
+            "failed_bindings": stats["failed_bindings"],
+            "fallback_decisions": stats["fallback_decisions"],
+            "cache_generation": cache.stats()["generation"],
+            "model": args.model,
+            "weights": "random-init",
+            "note": "identical-params swaps: quiesce machinery only",
         },
     }
 
@@ -1024,6 +1136,11 @@ def main() -> None:
         help="scenario seed for --preset arena (default 0)",
     )
     parser.add_argument(
+        "--swaps", type=int, default=None,
+        help="hot weight swaps performed under load for --preset rollout "
+             "(default 6)",
+    )
+    parser.add_argument(
         "--trace", default=None,
         help="record the --preset arena trace here (replay with "
              "`cli sim --replay`)",
@@ -1038,7 +1155,7 @@ def main() -> None:
                 "pods", "nodes", "shapes", "slots", "model", "chunk_steps",
                 "max_new_tokens", "temperature", "rounds", "arrival_rate",
                 "quantize", "profile_dir", "decode_matmul", "perturb_idle",
-                "prefix_prewarm", "seed", "trace",
+                "prefix_prewarm", "seed", "trace", "swaps",
             )
             if getattr(args, name) is not None
         ]
@@ -1074,6 +1191,9 @@ def main() -> None:
         parser.error("--rounds must be >= 1")
     if args.preset == "arena":
         _emit(arena_bench(args))
+        return
+    if args.preset == "rollout":
+        _emit(asyncio.run(rollout_bench(args)))
         return
     result = asyncio.run(bench_preset(args))
     result["extra"]["dispatch_rtt_ms"] = measure_dispatch_rtt_ms()

@@ -1,4 +1,4 @@
-"""CLI entry point: run / verify / bench / demo / train / eval / sim.
+"""CLI entry point: run / verify / bench / demo / train / eval / sim / rollout.
 
 Parity surface (reference -> here):
 - `python scheduler.py`            -> `python -m k8s_llm_scheduler_tpu.cli run`
@@ -742,6 +742,372 @@ def cmd_sim(args: argparse.Namespace, cfg: Config) -> int:
     return 0
 
 
+def _rollout_registry(args: argparse.Namespace, cfg: Config):
+    from k8s_llm_scheduler_tpu.rollout import CheckpointRegistry
+
+    root = getattr(args, "registry", None) or cfg.get("rollout.registry_dir", None)
+    if not root:
+        raise SystemExit(
+            "no registry: pass --registry DIR or set rollout.registry_dir "
+            "(ROLLOUT_REGISTRY_DIR)"
+        )
+    return CheckpointRegistry(root)
+
+
+def _gate_from_cfg(cfg: Config, seed: int | None = None):
+    from k8s_llm_scheduler_tpu.rollout import GateConfig
+
+    g = cfg.section("rollout").get("gate", {})
+    return GateConfig(
+        seed=seed if seed is not None else int(g.get("seed", 0)),
+        nodes=int(g.get("nodes", 12)),
+        pods=int(g.get("pods", 48)),
+        shapes=int(g.get("shapes", 8)),
+        waves=int(g.get("waves", 2)),
+        spread_tolerance=float(g.get("spread_tolerance", 0.02)),
+        constraint_tolerance=float(g.get("constraint_tolerance", 0.0)),
+        bound_tolerance=float(g.get("bound_tolerance", 0.0)),
+    )
+
+
+def cmd_rollout(args: argparse.Namespace, cfg: Config) -> int:
+    """Live-rollout surface (rollout/): publish a trained checkpoint into
+    the versioned registry, inspect/verify it, gate-and-promote a
+    candidate, roll the active pointer back, or run the live watch loop
+    (shadow scoring + canary controller) against a serving stack."""
+    from k8s_llm_scheduler_tpu.rollout import run_gate  # noqa: F401 (lazy pkg import)
+
+    registry = _rollout_registry(args, cfg)
+
+    if args.rollout_cmd == "publish":
+        from k8s_llm_scheduler_tpu.models.configs import get_config
+
+        model = args.model or cfg.get("llm.model", "tiny")
+        manifest = registry.publish(
+            args.checkpoint,
+            cfg=get_config(model),
+            tokenizer=cfg.get("llm.tokenizer", "byte"),
+            parent=args.parent,
+            note=args.note,
+        )
+        retain = int(cfg.get("rollout.retain", 0))
+        if retain:
+            registry.retain(retain)
+        print(json.dumps({
+            "metric": "rollout_publish",
+            "version": manifest.version,
+            "config": manifest.config_name,
+            "fingerprint": manifest.config_fingerprint,
+            "parent": manifest.parent,
+            "n_files": len(manifest.files),
+        }))
+        return 0
+
+    if args.rollout_cmd == "status":
+        print(json.dumps(registry.status(), indent=1, sort_keys=True))
+        return 0
+
+    if args.rollout_cmd == "fsck":
+        report = registry.fsck()
+        bad = {v: p for v, p in report.items() if p}
+        print(json.dumps({
+            "metric": "rollout_fsck",
+            "versions": len(report),
+            "clean": len(report) - len(bad),
+            "problems": {str(v): p for v, p in bad.items()},
+        }, indent=1, sort_keys=True))
+        return 1 if bad else 0
+
+    if args.rollout_cmd == "rollback":
+        active = registry.active()
+        if active is None:
+            print("no active version to roll back from", file=sys.stderr)
+            return 2
+        target = registry.get(active).parent
+        if target is None:
+            versions = [v for v in registry.versions() if v < active]
+            target = versions[-1] if versions else None
+        if target is None:
+            print(f"active version {active} has no predecessor", file=sys.stderr)
+            return 2
+        registry.set_active(target)
+        print(json.dumps({
+            "metric": "rollout_rollback", "from": active, "to": target,
+        }))
+        return 0
+
+    if args.rollout_cmd == "promote":
+        return _rollout_promote(args, cfg, registry)
+
+    if args.rollout_cmd == "watch":
+        return _rollout_watch(args, cfg, registry)
+
+    raise SystemExit(f"unknown rollout command {args.rollout_cmd!r}")
+
+
+def _rollout_backend_factory(cfg: Config, checkpoint_path: str | None):
+    """make() for a gate arm: the configured local stack serving
+    `checkpoint_path` greedily (the arena's determinism contract)."""
+    def make():
+        from k8s_llm_scheduler_tpu.engine.local import build_local_backend
+
+        return build_local_backend(**_backend_kwargs(
+            cfg, temperature=0.0, checkpoint_path=checkpoint_path,
+        ))
+
+    return make
+
+
+def _rollout_promote(args: argparse.Namespace, cfg: Config, registry) -> int:
+    """Gate a candidate against the incumbent and move the active pointer.
+
+    The incumbent arm serves the ACTIVE registry version (or the config's
+    llm.checkpoint_path, or random-init when neither exists). In-process
+    hot swapping of a separately-running scheduler is `rollout watch`'s
+    job; promote moves the durable pointer that serving processes read at
+    startup (and that watch controllers follow)."""
+    from k8s_llm_scheduler_tpu.rollout import run_gate
+
+    candidate = registry.get(args.version)
+    if args.no_gate:
+        registry.set_active(args.version)
+        print(json.dumps({
+            "metric": "rollout_promote", "version": args.version,
+            "gate": "skipped",
+        }))
+        return 0
+    active = registry.active()
+    incumbent_ckpt = (
+        str(registry.get(active).checkpoint_path)
+        if active is not None
+        else cfg.get("llm.checkpoint_path", None)
+    )
+    verdict = run_gate(
+        _rollout_backend_factory(cfg, incumbent_ckpt),
+        _rollout_backend_factory(cfg, str(candidate.checkpoint_path)),
+        _gate_from_cfg(cfg, seed=args.seed),
+    )
+    registry.record_scores(args.version, {"gate": {
+        "pass": verdict["pass"], "checks": verdict["checks"],
+        "candidate": verdict["candidate"],
+    }})
+    if verdict["pass"]:
+        registry.set_active(args.version)
+    print(json.dumps({
+        "metric": "rollout_promote",
+        "version": args.version,
+        "pass": verdict["pass"],
+        "checks": verdict["checks"],
+        "incumbent": verdict["incumbent"],
+        "candidate": verdict["candidate"],
+        "active": registry.active(),
+    }))
+    return 0 if verdict["pass"] else 1
+
+
+def _rollout_watch(args: argparse.Namespace, cfg: Config, registry) -> int:
+    """Live rollout loop: serve the active version, shadow-score the
+    newest candidate, gate/promote/burn-in/rollback as new versions land.
+    Runs until interrupted; /metrics (when enabled) exports the rollout
+    gauges next to the scheduler stats."""
+    import threading
+    import time as _time
+
+    from k8s_llm_scheduler_tpu.engine.local import build_local_backend
+    from k8s_llm_scheduler_tpu.models.configs import get_config
+    from k8s_llm_scheduler_tpu.rollout import (
+        CanaryController,
+        HotSwapper,
+        ShadowScorer,
+    )
+
+    if cfg.get("llm.backend") == "stub":
+        print("rollout watch needs llm.backend: local", file=sys.stderr)
+        return 2
+
+    active = registry.active()
+    active_ckpt = (
+        str(registry.get(active).checkpoint_path) if active is not None else None
+    )
+    model = cfg.get("llm.model", "tiny")
+    backend = build_local_backend(**_backend_kwargs(
+        cfg, checkpoint_path=active_ckpt or cfg.get("llm.checkpoint_path"),
+    ))
+
+    if args.fake_cluster:
+        from k8s_llm_scheduler_tpu.testing import synthetic_cluster
+
+        cluster = synthetic_cluster(args.fake_nodes)
+    else:
+        from k8s_llm_scheduler_tpu.cluster.kube import KubeCluster
+
+        cluster = KubeCluster(
+            watch_timeout_seconds=cfg.get("scheduler.watch_interval")
+        )
+
+    from k8s_llm_scheduler_tpu.core.breaker import CircuitBreaker
+    from k8s_llm_scheduler_tpu.core.cache import DecisionCache
+    from k8s_llm_scheduler_tpu.sched.client import DecisionClient
+    from k8s_llm_scheduler_tpu.sched.loop import Scheduler
+
+    cache = DecisionCache(
+        ttl_seconds=cfg.get("cache.ttl_seconds"),
+        max_size=cfg.get("cache.max_size"),
+    )
+    client = DecisionClient(
+        backend, cache=cache, breaker=CircuitBreaker(),
+        max_retries=cfg.get("llm.max_retries"),
+        retry_delay=cfg.get("llm.retry_delay"),
+        fallback_strategy=cfg.get("fallback.strategy"),
+        fallback_enabled=cfg.get("fallback.enabled"),
+    )
+    scheduler = Scheduler(
+        cluster, cluster, client,
+        scheduler_name=cfg.get("scheduler.name"),
+    )
+
+    swapper = HotSwapper(
+        backend, registry, get_config(model),
+        # restore onto the SERVING mesh with the serving quantization —
+        # engine programs are compiled against that tree's shardings/dtypes
+        mesh=backend.engine.mesh,
+        quantize=cfg.get("llm.quantization"),
+        cache=cache, mode=cfg.get("rollout.swap_mode", "auto"),
+    )
+
+    def incumbent_factory():
+        # resolved at GATE time, not startup: after a promotion the next
+        # candidate must be compared against the CURRENT active version,
+        # or quality could ratchet back down to the startup checkpoint
+        active_now = registry.active()
+        ckpt = (
+            str(registry.get(active_now).checkpoint_path)
+            if active_now is not None
+            else cfg.get("llm.checkpoint_path")
+        )
+        return _rollout_backend_factory(cfg, ckpt)()
+
+    controller = CanaryController(
+        registry, swapper,
+        stats_provider=scheduler.get_stats,
+        incumbent_factory=incumbent_factory,
+        candidate_factory=lambda v: _rollout_backend_factory(
+            cfg, str(registry.get(v).checkpoint_path)
+        ),
+        gate=_gate_from_cfg(cfg),
+        burn_in_decisions=int(cfg.get("rollout.burn_in_decisions", 200)),
+        trip_fallback_rate=float(cfg.get("rollout.trip_fallback_rate", 0.2)),
+        trip_invalid_rate=float(cfg.get("rollout.trip_invalid_rate", 0.05)),
+        trip_bind_failure_rate=float(
+            cfg.get("rollout.trip_bind_failure_rate", 0.05)
+        ),
+    )
+    shadow_frac = (
+        args.shadow_frac
+        if args.shadow_frac is not None
+        else float(cfg.get("rollout.shadow_fraction", 0.0))
+    )
+    shadow = None
+
+    def refresh_shadow():
+        # Shadow the newest PROMOTABLE candidate: newer than the active
+        # version and not gate/burn-in rejected. Anything else (an older
+        # superseded version, a rejected one) would burn a whole resident
+        # model's HBM scoring a policy that can never be promoted.
+        nonlocal shadow
+        active_now = registry.active() or 0
+        versions = [
+            v for v in registry.versions()
+            if v > active_now and v not in controller.rejected
+        ]
+        if shadow_frac <= 0 or not versions:
+            if shadow is not None:
+                scheduler.shadow = None
+                shadow.close()
+                shadow.candidate.close()
+                shadow = None
+            return
+        newest = versions[-1]
+        if shadow is not None and shadow.candidate_version == newest:
+            return
+        if shadow is not None:
+            scheduler.shadow = None
+            shadow.close()
+            shadow.candidate.close()
+        shadow = ShadowScorer(
+            build_local_backend(**_backend_kwargs(
+                cfg, temperature=0.0,
+                checkpoint_path=str(registry.get(newest).checkpoint_path),
+            )),
+            fraction=shadow_frac,
+            candidate_version=newest,
+        )
+        scheduler.shadow = shadow
+
+    stop = threading.Event()
+
+    def controller_loop():
+        poll = float(cfg.get("rollout.poll_seconds", 5.0))
+        while not stop.wait(poll):
+            try:
+                refresh_shadow()
+                controller.tick()
+            except Exception:
+                logger.exception("rollout controller tick failed")
+
+    ctl_thread = threading.Thread(
+        target=controller_loop, daemon=True, name="rollout-controller"
+    )
+    ctl_thread.start()
+
+    metrics_server = None
+    if cfg.get("metrics.enabled"):
+        from k8s_llm_scheduler_tpu.observability.metrics import MetricsServer
+
+        metrics_server = MetricsServer(
+            lambda: {**scheduler.get_stats(), "rollout": controller.stats()},
+            port=cfg.get("metrics.port"),
+            is_alive=lambda: scheduler.running,
+        )
+        metrics_server.start()
+
+    print(BANNER)
+    logger.info(
+        "rollout watch: registry=%s active=%s shadow_frac=%.3f",
+        registry.root, registry.active(), shadow_frac,
+    )
+
+    async def _serve():
+        task = asyncio.create_task(scheduler.run())
+        try:
+            await task
+        except (KeyboardInterrupt, asyncio.CancelledError):
+            scheduler.stop()
+            close = getattr(cluster, "close", None)
+            if close:
+                close()
+            await asyncio.wait_for(task, timeout=30)
+
+    try:
+        asyncio.run(_serve())
+    except KeyboardInterrupt:
+        pass
+    finally:
+        stop.set()
+        ctl_thread.join(timeout=10)
+        if metrics_server:
+            metrics_server.stop()
+        if shadow is not None:
+            shadow.close()
+            shadow.candidate.close()
+        backend.close()
+        _time.sleep(0)  # let daemon teardown settle before the stats dump
+        print(json.dumps({
+            **scheduler.get_stats(), "rollout": controller.stats(),
+        }, indent=2, default=str))
+    return 0
+
+
 def cmd_complete(args: argparse.Namespace, cfg: Config) -> int:
     """Free-form generation through the PAGED continuous-batching path —
     the general-completion capability the reference gets from its remote
@@ -988,6 +1354,72 @@ def main(argv: list[str] | None = None) -> int:
         help="serve live arena scores on /metrics while running",
     )
 
+    p_rollout = sub.add_parser(
+        "rollout",
+        help="live policy rollout: checkpoint registry, canary gate, "
+             "shadow scoring, hot weight swap (rollout/)",
+    )
+    rsub = p_rollout.add_subparsers(dest="rollout_cmd", required=True)
+
+    def _with_registry(p):
+        p.add_argument(
+            "--registry", default=None,
+            help="registry dir (default: rollout.registry_dir / "
+                 "ROLLOUT_REGISTRY_DIR)",
+        )
+        return p
+
+    p_publish = _with_registry(rsub.add_parser(
+        "publish", help="register a trained checkpoint as a new version"
+    ))
+    p_publish.add_argument(
+        "--checkpoint", required=True,
+        help="orbax checkpoint dir (train/distill.train_and_save output)",
+    )
+    p_publish.add_argument(
+        "--model", default=None,
+        help="config name the checkpoint is shaped for (default llm.model; "
+             "stamps the fingerprint hot-swap compatibility is checked "
+             "against)",
+    )
+    p_publish.add_argument("--parent", type=int, default=None)
+    p_publish.add_argument("--note", default="")
+
+    _with_registry(rsub.add_parser(
+        "status", help="list versions, scores, and the active pointer"
+    ))
+    _with_registry(rsub.add_parser(
+        "fsck", help="digest-verify every version (exit 1 on any damage)"
+    ))
+    _with_registry(rsub.add_parser(
+        "rollback", help="move the active pointer back to its parent"
+    ))
+
+    p_promote = _with_registry(rsub.add_parser(
+        "promote",
+        help="arena-gate a candidate vs the incumbent; set active on pass",
+    ))
+    p_promote.add_argument("--version", type=int, required=True)
+    p_promote.add_argument("--seed", type=int, default=None,
+                           help="gate scenario seed (default rollout.gate.seed)")
+    p_promote.add_argument(
+        "--no-gate", action="store_true",
+        help="skip the arena gate (set active unconditionally)",
+    )
+
+    p_watch = _with_registry(rsub.add_parser(
+        "watch",
+        help="serve the active version and run the live canary loop "
+             "(shadow scoring, gate-promote, burn-in auto-rollback)",
+    ))
+    p_watch.add_argument(
+        "--shadow-frac", type=float, default=None,
+        help="fraction of live decisions mirrored through the newest "
+             "candidate (default rollout.shadow_fraction)",
+    )
+    p_watch.add_argument("--fake-cluster", action="store_true")
+    p_watch.add_argument("--fake-nodes", type=int, default=3)
+
     p_complete = sub.add_parser(
         "complete",
         help="free-form text completion (paged continuous-batching path)",
@@ -1023,6 +1455,7 @@ def main(argv: list[str] | None = None) -> int:
         "train": cmd_train,
         "eval": cmd_eval,
         "sim": cmd_sim,
+        "rollout": cmd_rollout,
         "complete": cmd_complete,
     }
     return handlers[args.command](args, cfg)

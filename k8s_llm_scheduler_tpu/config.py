@@ -125,6 +125,40 @@ DEFAULTS: dict[str, Any] = {
         "timeout": 60,  # config.yaml:42
         "half_open_max_calls": 1,
     },
+    # Live policy rollout (rollout/): checkpoint registry + shadow scoring
+    # + canary gate + zero-downtime hot weight swap. registry_dir null
+    # disables the whole subsystem.
+    "rollout": {
+        "registry_dir": None,
+        # fraction of live schedule_pod decisions mirrored (non-binding)
+        # through the newest candidate (rollout/shadow.py); 0 disables
+        "shadow_fraction": 0.0,
+        # weight-swap residency: "auto" double-buffers when 2x params fit
+        # in HBM, else donates in place (rollout/hotswap.py)
+        "swap_mode": "auto",
+        # keep-last retention after each publish/promote (0 = keep all);
+        # the active version and its rollback parent are always kept
+        "retain": 0,
+        # seeded arena gate (rollout/canary.GateConfig)
+        "gate": {
+            "seed": 0,
+            "nodes": 12,
+            "pods": 48,
+            "shapes": 8,
+            "waves": 2,
+            "spread_tolerance": 0.02,
+            "constraint_tolerance": 0.0,
+            "bound_tolerance": 0.0,
+        },
+        # live burn-in after a promotion: window size in decisions, and
+        # the regression rates that trip an auto-rollback
+        "burn_in_decisions": 200,
+        "trip_fallback_rate": 0.2,
+        "trip_invalid_rate": 0.05,
+        "trip_bind_failure_rate": 0.05,
+        # registry poll period for `cli rollout watch`
+        "poll_seconds": 5.0,
+    },
     # Multi-host JAX (parallel/distributed.py). On TPU pods the launcher
     # auto-detects coordinator/count/id (leave them null); set them
     # explicitly for manual/CPU launches. The control plane (watch/bind)
@@ -179,6 +213,10 @@ ENV_OVERRIDES: dict[str, str] = {
     "METRICS_ENABLED": "metrics.enabled",
     "METRICS_PORT": "metrics.port",
     "FALLBACK_STRATEGY": "fallback.strategy",
+    "ROLLOUT_REGISTRY_DIR": "rollout.registry_dir",
+    "ROLLOUT_SHADOW_FRACTION": "rollout.shadow_fraction",
+    "ROLLOUT_SWAP_MODE": "rollout.swap_mode",
+    "ROLLOUT_BURN_IN_DECISIONS": "rollout.burn_in_decisions",
 }
 
 

@@ -99,6 +99,27 @@ class DecisionCache:
         self._lock = threading.Lock()
         self.hits = 0
         self.misses = 0
+        # Policy generation/epoch. decision_cache_key digests only (pod,
+        # cluster) state, so after a weight swap (rollout/hotswap.py) every
+        # pre-swap entry would still hit — decisions from the RETIRED
+        # policy served indefinitely. The generation is folded into the
+        # stored key; bump_generation() makes every older entry
+        # unreachable (they age out via TTL/size-cap) without flushing
+        # counters or same-generation state.
+        self.generation = 0
+
+    def bump_generation(self) -> int:
+        """Invalidate every cached decision from the current policy epoch
+        (called on hot weight swap). O(1): entries are not flushed, they
+        just become unreachable and age out."""
+        with self._lock:
+            self.generation += 1
+            return self.generation
+
+    def _stored_key(self, key: str, generation: int | None = None) -> str:
+        # caller holds self._lock
+        gen = self.generation if generation is None else generation
+        return f"{gen}:{key}"
 
     def get(
         self,
@@ -110,6 +131,7 @@ class DecisionCache:
             key = decision_cache_key(pod, nodes)
         now = time.monotonic()
         with self._lock:
+            key = self._stored_key(key)
             entry = self._entries.get(key)
             if entry is None:
                 self.misses += 1
@@ -128,14 +150,24 @@ class DecisionCache:
         nodes: Sequence[NodeMetrics],
         decision: SchedulingDecision,
         key: str | None = None,
+        generation: int | None = None,
     ) -> None:
         """Store a decision. Fallback decisions are never cached
-        (reference scheduler.py:398-399)."""
+        (reference scheduler.py:398-399).
+
+        `generation` is the policy epoch the decision was COMPUTED under
+        (captured before the backend call — sched/client.py). Without it,
+        a decision computed under pre-swap weights that lands after
+        bump_generation would be stored under the NEW epoch and served
+        post-promotion; with it, that straggler files under the old epoch
+        and is unreachable. None = the current epoch (single-epoch
+        callers)."""
         if decision.fallback_needed:
             return
         if key is None:
             key = decision_cache_key(pod, nodes)
         with self._lock:
+            key = self._stored_key(key, generation)
             if key in self._entries:
                 del self._entries[key]
             elif len(self._entries) >= self.max_size:
@@ -152,4 +184,9 @@ class DecisionCache:
 
     def stats(self) -> dict[str, int]:
         with self._lock:
-            return {"size": len(self._entries), "hits": self.hits, "misses": self.misses}
+            return {
+                "size": len(self._entries),
+                "hits": self.hits,
+                "misses": self.misses,
+                "generation": self.generation,
+            }

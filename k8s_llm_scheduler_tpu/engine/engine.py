@@ -559,6 +559,9 @@ class InferenceEngine:
                 f"unknown prefix attention impl {prefix_attn_impl!r} "
                 f"(expected 'auto', 'xla', or 'pallas')"
             )
+        # Kept for components that must restore/replace params with the
+        # SAME placement serving booted with (rollout/hotswap.py).
+        self.mesh = mesh
         tp_size = mesh.shape.get("tp", 1) if mesh is not None else 1
         if tp_size > 1:
             from k8s_llm_scheduler_tpu.ops.attention import ShardedAttnImpl
@@ -1434,6 +1437,46 @@ class InferenceEngine:
         self._budget_np[:] = 0
         self._act_d = jnp.zeros(self.max_slots + 1, dtype=bool)
         self._budget_d = jnp.zeros(self.max_slots + 1, dtype=jnp.int32)
+
+    # ---------------------------------------------------------------- swap
+    def swap_params(self, params: Params) -> Params:
+        """Replace the served weights IN PLACE; returns the old params tree
+        (rollout/hotswap.py holds it for double-buffered rollback, or drops
+        it pre-restore for in-place donation at 70B scale).
+
+        Engine-owner thread only, like every dispatch path, and only at a
+        wave barrier (no un-harvested WaveHandles): waves capture `params`
+        by reference at submit, so swapping under an in-flight wave is
+        device-safe but would leave its result attributed to the wrong
+        version. LocalLLMBackend.run_quiesced provides exactly that
+        barrier.
+
+        Everything derived from the old weights is invalidated here:
+        - the on-device prefix-KV cache (every cached cluster-state prefix,
+          including LCP-reuse seeds, was prefilled under the old weights);
+        - the active prefix pointer — unless paged slots are mid-flight
+          (identical-params swaps may run mid-stream; cross-version
+          callers must drain first, which run_quiesced guarantees for the
+          wave path);
+        - grammar tables, decode state, and the paged KV survive: none of
+          them depend on weight values.
+        The decision cache above the engine needs its own epoch bump —
+        rollout/hotswap.py owns that (core/cache.bump_generation)."""
+        old = self.params
+        self.params = params
+        self._prefix_cache.clear()
+        if self._by_slot:
+            # keep the active prefix for in-flight paged decodes; it is
+            # evicted from the cache so no FUTURE request reuses it
+            logger.warning(
+                "weight swap with %d paged request(s) in flight — they "
+                "continue against the pre-swap prefix KV (token-identical "
+                "only for identical params)", len(self._by_slot),
+            )
+        else:
+            self._prefix = None
+        self.stats["weight_swaps"] = self.stats.get("weight_swaps", 0) + 1
+        return old
 
     # ------------------------------------------------------------ convenience
     def attach_spec(self, decoder) -> None:

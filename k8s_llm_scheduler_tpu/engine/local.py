@@ -93,6 +93,26 @@ class _WorkItem:
             self.future.set_exception(exc)
 
 
+class _ControlItem:
+    """An engine-owner-thread control action (rollout/hotswap.py weight
+    swaps) queued alongside work items. The worker HOLDS all admissions
+    while one is pending and executes it only at a wave barrier (every
+    in-flight wave harvested) — the quiesce point a zero-downtime weight
+    swap needs. The future resolves to (fn result, pause_s) where pause_s
+    is the admission-held wall time: enqueue -> barrier drained -> fn done."""
+
+    __slots__ = ("fn", "future", "enqueued_at")
+
+    def __init__(self, fn):
+        self.fn = fn
+        self.future: Future = Future()
+        self.enqueued_at = time.perf_counter()
+
+    def fail(self, exc: Exception) -> None:
+        if not self.future.done():
+            self.future.set_exception(exc)
+
+
 class LocalLLMBackend:
     """DecisionBackend over an in-process InferenceEngine."""
 
@@ -146,6 +166,15 @@ class LocalLLMBackend:
         self._queue: queue.Queue[_WorkItem | None] = queue.Queue()
         self._dfa_cache: dict[tuple[str, ...], Any] = {}
         self._current_group: tuple | None = None
+        # Control items (run_quiesced) parked until the wave barrier; while
+        # any is held, _submit_waves admits nothing (swap quiesce).
+        self._held_controls: list[_ControlItem] = []
+        # Rolling swap-pause bookkeeping surfaced via get_stats/metrics.
+        self.swap_stats = {
+            "quiesce_runs": 0,
+            "last_pause_s": 0.0,
+            "total_pause_s": 0.0,
+        }
         # EMA of per-wave device service time, used to DEADLINE the
         # is_ready() straggler-poll in _worker_tick: on the tunneled TPU
         # backend is_ready() reports when the whole enqueued chain drains,
@@ -342,6 +371,15 @@ class LocalLLMBackend:
         Returns items that must keep waiting (held ragged tails, other
         groups not yet switched to).
         """
+        controls = [i for i in pending if isinstance(i, _ControlItem)]
+        if controls:
+            self._held_controls.extend(controls)
+            pending = [i for i in pending if not isinstance(i, _ControlItem)]
+        if self._held_controls:
+            # Quiesce in progress: hold EVERY admission (work and prewarms
+            # alike) until the control runs at the wave barrier
+            # (_worker_tick). The held wall time is the swap pause metric.
+            return list(pending)
         if any(i.suffix_ids is None for i in pending):
             # Advisory prefix installs (prewarm_prefix) are diverted HERE —
             # the single consumer of `pending` — because the coalescing and
@@ -537,11 +575,16 @@ class LocalLLMBackend:
                 waves.clear()
                 for item in pending:
                     item.fail(BackendError(str(exc)))
+                for ctl in self._held_controls:
+                    ctl.fail(BackendError(str(exc)))
+                self._held_controls = []
                 pending = []
         # Shutdown: fail anything still queued or in flight.
         self._drain_queue(pending, block=False)
         for _, items in waves:
             pending.extend(items)
+        pending.extend(self._held_controls)
+        self._held_controls = []
         for item in pending:
             item.fail(BackendError("backend closed"))
 
@@ -642,7 +685,57 @@ class LocalLLMBackend:
                     self._wave_ema[geo] = ema
                 for fin, item in zip(fins, items):
                     item.resolve(fin.text)
+        if self._held_controls and not waves:
+            # Wave barrier reached (everything in flight harvested above,
+            # admissions held since the control arrived): run the quiesced
+            # actions on this — the engine-owner — thread. Held work in
+            # `pending` resumes on the next tick.
+            controls, self._held_controls = self._held_controls, []
+            for ctl in controls:
+                try:
+                    result = ctl.fn()
+                except Exception as exc:
+                    logger.exception("quiesced control action failed")
+                    ctl.fail(exc)
+                else:
+                    pause_s = time.perf_counter() - ctl.enqueued_at
+                    self.swap_stats["quiesce_runs"] += 1
+                    self.swap_stats["last_pause_s"] = pause_s
+                    self.swap_stats["total_pause_s"] += pause_s
+                    if not ctl.future.done():
+                        ctl.future.set_result((result, pause_s))
+            # A control may have invalidated engine state the group key
+            # stands for (a weight swap clears the prefix KV): drop the
+            # group so the next wave REINSTALLS prefix + grammar instead
+            # of matching the old key and decoding against an empty
+            # prefix. Costs one prefix prefill per quiesce — correctness
+            # over a cache hit.
+            self._current_group = None
         return pending
+
+    def run_quiesced(self, fn, timeout_s: float | None = None):
+        """Run `fn()` on the engine-owner thread at a wave barrier.
+
+        From the moment the control enqueues, the worker holds ALL new
+        admissions, drains every in-flight wave, runs `fn`, and only then
+        resumes — the quiesce discipline a hot weight swap needs (no wave
+        may straddle a params swap, no request is failed or dropped:
+        held work simply waits out the pause). Decode service for queued
+        requests resumes on the very next tick.
+
+        Thread-safe (any caller thread); blocks until done. Returns
+        (fn result, pause_s) where pause_s is the admission-held wall
+        time — THE swap-pause metric. Raises what fn raises."""
+        if self._stopped.is_set():
+            raise BackendError("backend closed")
+        ctl = _ControlItem(fn)
+        self._queue.put(ctl)
+        try:
+            return ctl.future.result(timeout=timeout_s)
+        except FuturesTimeout as exc:
+            raise BackendError(
+                f"quiesced action not executed within {timeout_s}s"
+            ) from exc
 
     def close(self) -> None:
         self._stopped.set()
@@ -650,7 +743,10 @@ class LocalLLMBackend:
         self._worker.join(timeout=5)
 
     def get_stats(self) -> dict[str, Any]:
-        return self.engine.get_stats()
+        out = self.engine.get_stats()
+        if self.swap_stats["quiesce_runs"]:
+            out["swap"] = dict(self.swap_stats)
+        return out
 
 
 def _attach_spec(

@@ -280,6 +280,14 @@ def _cast(host: np.ndarray, dtype) -> np.ndarray:
     return host.astype(target, copy=False)
 
 
+class CheckpointError(RuntimeError):
+    """A native checkpoint is missing, torn, or shaped for another config.
+
+    Raised by restore_checkpoint's pre-validation with the offending path
+    and the FIRST mismatched param — instead of the deep orbax/tensorstore
+    stack trace the raw restore produces for the same faults."""
+
+
 # ------------------------------------------------------------------ orbax
 def save_checkpoint(path: str | Path, params: Params) -> None:
     """Write a native orbax checkpoint of the params pytree (overwrites —
@@ -321,10 +329,25 @@ def restore_checkpoint(
 ) -> Params:
     """Restore a native orbax checkpoint, resharded onto `mesh` (or one
     host device). Restoration is direct-to-shard: orbax reads only each
-    device's slice of every parameter."""
+    device's slice of every parameter.
+
+    Pre-validates before touching orbax's restore path: a missing dir, a
+    partial/torn checkpoint (no orbax metadata), or a stored tree whose
+    shapes don't match `cfg` raises CheckpointError naming the path and
+    the first mismatched param — not a tensorstore traceback."""
     import orbax.checkpoint as ocp
 
     path = Path(path).resolve()
+    if not path.exists():
+        raise CheckpointError(f"checkpoint dir {path} does not exist")
+    if not path.is_dir():
+        raise CheckpointError(f"checkpoint path {path} is not a directory")
+    if not any((path / marker).exists() for marker in ("_METADATA", "_CHECKPOINT_METADATA")):
+        raise CheckpointError(
+            f"{path} is not an orbax checkpoint (no _METADATA — partial or "
+            f"torn save, or an HF safetensors dir passed to the native "
+            f"restore path)"
+        )
     shapes = _expected_shapes(cfg)
     flat_specs = _flat_specs(cfg, tp, fsdp)
 
@@ -347,4 +370,44 @@ def restore_checkpoint(
     if not cfg.tie_embeddings:
         target["lm_head"] = abstract("lm_head")
     with ocp.StandardCheckpointer() as ckptr:
-        return ckptr.restore(path, target)
+        _validate_stored_shapes(ckptr, path, cfg, shapes)
+        try:
+            return ckptr.restore(path, target)
+        except Exception as exc:
+            raise CheckpointError(
+                f"restore of {path} failed for config {cfg.name!r}: {exc}"
+            ) from exc
+
+
+def _validate_stored_shapes(ckptr, path: Path, cfg: LlamaConfig, shapes) -> None:
+    """Compare the stored tree's metadata against the config's expected
+    shapes; raise CheckpointError on the first mismatch or missing param."""
+    try:
+        meta = ckptr.metadata(path)
+    except Exception:
+        # metadata unreadable on this orbax version/layout: fall through to
+        # restore, whose failures are wrapped in CheckpointError anyway
+        return
+
+    def lookup(name: str):
+        node = meta
+        for part in name.split("."):
+            if not isinstance(node, dict) or part not in node:
+                return None
+            node = node[part]
+        return node
+
+    for name in sorted(shapes):
+        leaf = lookup(name)
+        if leaf is None:
+            raise CheckpointError(
+                f"{path}: checkpoint is missing param {name!r} expected by "
+                f"config {cfg.name!r}"
+            )
+        stored = tuple(getattr(leaf, "shape", ()) or ())
+        if stored and stored != tuple(shapes[name]):
+            raise CheckpointError(
+                f"{path}: param {name!r} has shape {stored}, but config "
+                f"{cfg.name!r} expects {tuple(shapes[name])} — the "
+                f"checkpoint was trained for a different config"
+            )

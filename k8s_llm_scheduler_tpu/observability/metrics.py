@@ -54,14 +54,26 @@ def _flatten(stats: dict[str, Any], prefix: str = "") -> dict[str, float]:
 
 
 def render_prometheus(stats: dict[str, Any]) -> str:
-    lines = []
+    # Group samples by metric FAMILY (name sans labels) so each family gets
+    # exactly one `# TYPE <family> gauge` header with its samples contiguous
+    # under it — the exposition-format contract scrapers validate (bare
+    # samples with no TYPE parse, but registries flag them and typed
+    # queries treat them as untyped). Everything here is a point-in-time
+    # reading of a stats dict, so gauge is the honest type for all of it.
+    families: dict[str, list[tuple[str, float]]] = {}
     for name, value in sorted(_flatten(stats).items()):
         metric = f"{_PREFIX}_{name}"
+        family = metric
         # metric names cannot contain '{' — split label part back out
         if "{" in name:
             base, label = name.split("{", 1)
-            metric = f"{_PREFIX}_{base}{{{label}"
-        lines.append(f"{metric} {value}")
+            family = f"{_PREFIX}_{base}"
+            metric = f"{family}{{{label}"
+        families.setdefault(family, []).append((metric, value))
+    lines = []
+    for family, samples in families.items():
+        lines.append(f"# TYPE {family} gauge")
+        lines.extend(f"{metric} {value}" for metric, value in samples)
     return "\n".join(lines) + "\n"
 
 

@@ -1,0 +1,349 @@
+"""Promotion controller: arena gate -> hot swap -> burn-in -> rollback.
+
+The full canary path for a candidate checkpoint:
+
+1. **Gate** (offline, seeded): incumbent and candidate run END TO END as
+   arena stack arms over the same seeded scenario (sim/arena.py — wire
+   fake, real watch/bind, real scheduler loop). The candidate must be no
+   worse than the incumbent within tolerance on the placement metrics the
+   system optimizes: spread (lower better), constraint satisfaction and
+   bound fraction (higher better). A fixed seed suite makes the verdict
+   reproducible — a flaky gate is worse than no gate.
+2. **Promote**: on pass, hot-swap the live engine (rollout/hotswap.py) and
+   move the registry's active pointer. No restart, no dropped traffic.
+3. **Burn-in**: watch the LIVE regression signals from Scheduler.get_stats
+   deltas — fallback rate, invalid-decision rate, bind-failure rate — over
+   a decision-count window. Offline gates can't see everything (real pod
+   shapes, prompt drift); the burn-in can.
+4. **Rollback**: any tripped signal swaps back to the prior registry
+   version and marks the candidate rejected (it is not retried).
+
+For fanout deployments (sched/replica.py), `staggered_swap` promotes one
+replica at a time so the FanoutBackend always has a serving majority: each
+replica's swap is verified before the next begins, and a failed swap stops
+the stagger with the majority still on the incumbent.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+from typing import Any, Callable, Sequence
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class GateConfig:
+    """The seeded scenario suite + tolerances for one gate run."""
+
+    seed: int = 0
+    nodes: int = 12
+    pods: int = 48
+    shapes: int = 8
+    waves: int = 2
+    constraint_mix: tuple[str, ...] = ("uniform", "selector")
+    taint_frac: float = 0.0
+    hetero: bool = True
+    # candidate must satisfy: spread <= incumbent + spread_tolerance;
+    # constraint_satisfaction >= incumbent - constraint_tolerance;
+    # bound_frac >= incumbent - bound_tolerance
+    spread_tolerance: float = 0.02
+    constraint_tolerance: float = 0.0
+    bound_tolerance: float = 0.0
+    wave_timeout_s: float = 120.0
+
+
+def run_gate(
+    incumbent_make: Callable[[], Any],
+    candidate_make: Callable[[], Any],
+    gate: GateConfig | None = None,
+) -> dict:
+    """Run incumbent vs candidate through the seeded arena scenario and
+    return the verdict: {"pass", "checks", "incumbent", "candidate",
+    "seed"}. Backends built by the make() callables are owned by the arena
+    (closed after the run)."""
+    from k8s_llm_scheduler_tpu.sim import ArmSpec, generate_scenario, run_arena
+    from k8s_llm_scheduler_tpu.sim.scenarios import ScenarioSpec
+
+    gate = gate or GateConfig()
+    spec = ScenarioSpec(
+        name="canary-gate",
+        seed=gate.seed,
+        n_nodes=gate.nodes,
+        n_pods=gate.pods,
+        shapes=gate.shapes,
+        arrival="waves",
+        n_waves=gate.waves,
+        constraint_mix=gate.constraint_mix,
+        taint_frac=gate.taint_frac,
+        hetero=gate.hetero,
+    )
+    scenario = generate_scenario(spec)
+    report = run_arena(
+        scenario,
+        [
+            ArmSpec(name="incumbent", kind="stack", make=incumbent_make),
+            ArmSpec(name="candidate", kind="stack", make=candidate_make),
+        ],
+        wave_timeout_s=gate.wave_timeout_s,
+    )
+    inc = report["arms"]["incumbent"]["scores"]
+    cand = report["arms"]["candidate"]["scores"]
+    checks = {
+        "spread": cand["spread"] <= inc["spread"] + gate.spread_tolerance,
+        "constraint_satisfaction": (
+            cand["constraint_satisfaction"]
+            >= inc["constraint_satisfaction"] - gate.constraint_tolerance
+        ),
+        "bound_frac": (
+            cand["bound_frac"] >= inc["bound_frac"] - gate.bound_tolerance
+        ),
+    }
+    return {
+        "pass": all(checks.values()),
+        "checks": checks,
+        "incumbent": inc,
+        "candidate": cand,
+        "seed": gate.seed,
+    }
+
+
+def staggered_swap(
+    swap_fns: Sequence[Callable[[], Any]],
+    verify: Callable[[int, Any], bool] | None = None,
+) -> list[Any]:
+    """Run per-replica swap callables ONE AT A TIME (fanout deployments:
+    the FanoutBackend must always keep a serving majority on a consistent
+    version). `verify(index, result)` returning False — or any raise —
+    stops the stagger; replicas not yet swapped stay on the incumbent.
+    Returns the per-replica results up to the stop point."""
+    results: list[Any] = []
+    for i, fn in enumerate(swap_fns):
+        result = fn()
+        results.append(result)
+        if verify is not None and not verify(i, result):
+            logger.warning(
+                "staggered swap stopped at replica %d/%d (verify failed)",
+                i + 1, len(swap_fns),
+            )
+            break
+    return results
+
+
+class CanaryController:
+    """Watch the registry for candidates; gate, promote, burn in, roll back.
+
+    Pluggable seams so the policy logic is testable without a model:
+    `gate_runner(candidate_version) -> verdict dict` (defaults to run_gate
+    over backend factories), `stats_provider() -> Scheduler.get_stats()`
+    shape for burn-in monitoring, `clock` for deterministic tests."""
+
+    def __init__(
+        self,
+        registry,
+        swapper,                       # HotSwapper (or test double)
+        *,
+        stats_provider: Callable[[], dict] | None = None,
+        gate_runner: Callable[[int], dict] | None = None,
+        incumbent_factory: Callable[[], Any] | None = None,
+        candidate_factory: Callable[[int], Callable[[], Any]] | None = None,
+        gate: GateConfig | None = None,
+        burn_in_decisions: int = 200,
+        trip_fallback_rate: float = 0.2,
+        trip_invalid_rate: float = 0.05,
+        trip_bind_failure_rate: float = 0.05,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        self.registry = registry
+        self.swapper = swapper
+        self.stats_provider = stats_provider
+        self.gate = gate or GateConfig()
+        if gate_runner is None:
+            if incumbent_factory is None or candidate_factory is None:
+                raise ValueError(
+                    "CanaryController needs either gate_runner or both "
+                    "incumbent_factory and candidate_factory"
+                )
+
+            def gate_runner(version: int) -> dict:
+                return run_gate(
+                    incumbent_factory, candidate_factory(version), self.gate
+                )
+
+        self.gate_runner = gate_runner
+        self.burn_in_decisions = int(burn_in_decisions)
+        self.trip_fallback_rate = float(trip_fallback_rate)
+        self.trip_invalid_rate = float(trip_invalid_rate)
+        self.trip_bind_failure_rate = float(trip_bind_failure_rate)
+        self.clock = clock
+        self.rejected: set[int] = set()
+        self._burn: dict | None = None
+        self.counters = {
+            "gate_pass": 0,
+            "gate_fail": 0,
+            "promotions": 0,
+            "rollbacks": 0,
+        }
+        self.last_gate: dict | None = None
+
+    # ------------------------------------------------------------ baseline
+    @staticmethod
+    def _signals(stats: dict) -> dict[str, float]:
+        client = stats.get("client", {})
+        decisions = (
+            stats.get("llm_decisions", 0)
+            + stats.get("cache_decisions", 0)
+            + stats.get("fallback_decisions", 0)
+        )
+        return {
+            "decisions": float(decisions),
+            "fallback": float(stats.get("fallback_decisions", 0)),
+            "invalid": float(client.get("invalid_decisions", 0)),
+            "failed_bindings": float(stats.get("failed_bindings", 0)),
+        }
+
+    # ------------------------------------------------------------- promote
+    def consider(self, version: int) -> dict:
+        """Gate `version`; promote on pass (swap + active pointer + burn-in
+        start). Returns the gate verdict augmented with the action taken."""
+        verdict = dict(self.gate_runner(version))
+        self.last_gate = {"version": version, **verdict}
+        self.registry.record_scores(
+            version, {"gate": {
+                "pass": verdict["pass"], "checks": verdict["checks"],
+                "candidate": verdict.get("candidate"),
+            }}
+        )
+        if not verdict["pass"]:
+            self.counters["gate_fail"] += 1
+            self.rejected.add(version)
+            verdict["action"] = "rejected"
+            logger.info("canary gate REJECTED version %d: %s",
+                        version, verdict["checks"])
+            return verdict
+        self.counters["gate_pass"] += 1
+        prior = self.registry.active()
+        try:
+            swap = self.swapper.swap_to(version)
+        except Exception as exc:
+            # Gate passed but the swap refused (torn checkpoint, wrong
+            # fingerprint, restore failure). Mark the version rejected —
+            # retrying every tick would re-run the full arena gate plus a
+            # restore attempt per poll period, forever, and starve newer
+            # candidates behind it. The engine still serves the incumbent.
+            self.rejected.add(version)
+            self.registry.record_scores(
+                version, {"swap_failed": str(exc)[:500]}
+            )
+            verdict["action"] = "swap_failed"
+            verdict["error"] = str(exc)
+            logger.exception(
+                "gate passed but swap to version %d failed — rejected",
+                version,
+            )
+            return verdict
+        self.registry.set_active(version)
+        self.counters["promotions"] += 1
+        baseline = (
+            self._signals(self.stats_provider())
+            if self.stats_provider is not None
+            else None
+        )
+        self._burn = {
+            "version": version,
+            "prior": prior,
+            "started": self.clock(),
+            "baseline": baseline,
+        }
+        verdict["action"] = "promoted"
+        verdict["swap"] = swap
+        logger.info(
+            "canary gate PASSED version %d — promoted (pause %.1f ms)",
+            version, swap.get("pause_s", 0.0) * 1000.0,
+        )
+        return verdict
+
+    # ------------------------------------------------------------- burn-in
+    def observe_burn_in(self) -> str | None:
+        """Progress the burn-in window. Returns None (no burn-in / still
+        collecting), "ok" (survived — burn-in closed), or "rolled_back"."""
+        if self._burn is None or self.stats_provider is None:
+            return None
+        baseline = self._burn["baseline"]
+        if baseline is None:
+            self._burn = None
+            return "ok"
+        now_sig = self._signals(self.stats_provider())
+        delta_n = now_sig["decisions"] - baseline["decisions"]
+        if delta_n < self.burn_in_decisions:
+            return None
+        rates = {
+            "fallback_rate": (now_sig["fallback"] - baseline["fallback"]) / delta_n,
+            "invalid_rate": (now_sig["invalid"] - baseline["invalid"]) / delta_n,
+            "bind_failure_rate": (
+                now_sig["failed_bindings"] - baseline["failed_bindings"]
+            ) / delta_n,
+        }
+        trips = {
+            "fallback_rate": rates["fallback_rate"] > self.trip_fallback_rate,
+            "invalid_rate": rates["invalid_rate"] > self.trip_invalid_rate,
+            "bind_failure_rate": (
+                rates["bind_failure_rate"] > self.trip_bind_failure_rate
+            ),
+        }
+        version = self._burn["version"]
+        prior = self._burn["prior"]
+        self._burn = None
+        if any(trips.values()):
+            tripped = sorted(k for k, v in trips.items() if v)
+            logger.warning(
+                "burn-in TRIPPED for version %d (%s; rates %s) — rolling "
+                "back to %s", version, tripped, rates, prior,
+            )
+            self.rejected.add(version)
+            self.registry.record_scores(
+                version, {"burn_in": {"tripped": tripped, "rates": rates}}
+            )
+            if prior is not None:
+                self.swapper.swap_to(prior)
+                self.registry.set_active(prior)
+            self.counters["rollbacks"] += 1
+            return "rolled_back"
+        self.registry.record_scores(
+            version, {"burn_in": {"tripped": [], "rates": rates}}
+        )
+        logger.info("burn-in OK for version %d (rates %s)", version, rates)
+        return "ok"
+
+    # ----------------------------------------------------------------- tick
+    def tick(self) -> dict | str | None:
+        """One controller step: finish an open burn-in first, else gate the
+        newest un-rejected candidate above the active version."""
+        if self._burn is not None:
+            return self.observe_burn_in()
+        active = self.registry.active() or 0
+        candidates = [
+            v for v in self.registry.versions()
+            if v > active and v not in self.rejected
+        ]
+        if not candidates:
+            return None
+        return self.consider(candidates[-1])
+
+    def stats(self) -> dict:
+        out = {
+            **self.counters,
+            "active_version": self.registry.active(),
+            "burn_in_open": self._burn is not None,
+            "rejected": sorted(self.rejected),
+        }
+        if self._burn is not None:
+            out["candidate_version"] = self._burn["version"]
+        if self.last_gate is not None:
+            out["last_gate_version"] = self.last_gate["version"]
+            out["last_gate_pass"] = bool(self.last_gate["pass"])
+        if hasattr(self.swapper, "stats"):
+            out["swap"] = self.swapper.stats()
+        return out

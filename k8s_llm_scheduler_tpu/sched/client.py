@@ -169,12 +169,18 @@ class DecisionClient:
         self.stats["total_requests"] += 1
 
         key: str | None = None
+        generation: int | None = None
         my_future: asyncio.Future | None = None
         if self.cache is not None:
             # Staleness is handled by the cache key itself: node names and
             # readiness are part of the digest (core/cache.py), so a node
             # going NotReady or disappearing changes the key and misses.
+            # The policy epoch is captured HERE, before the backend call: a
+            # decision computed under pre-swap weights that resolves after
+            # a hot swap's bump_generation must file under the OLD epoch
+            # (unreachable), not the new one (rollout/hotswap.py).
             key = decision_cache_key(pod, nodes)
+            generation = self.cache.generation
             cached = self.cache.get(pod, nodes, key=key)
             if cached is not None:
                 self.stats["cached_requests"] += 1
@@ -199,9 +205,13 @@ class DecisionClient:
         try:
             if concurrency is not None:
                 async with concurrency:
-                    decision = await self._decide_uncached(pod, nodes, cache_key=key)
+                    decision = await self._decide_uncached(
+                        pod, nodes, cache_key=key, generation=generation
+                    )
             else:
-                decision = await self._decide_uncached(pod, nodes, cache_key=key)
+                decision = await self._decide_uncached(
+                    pod, nodes, cache_key=key, generation=generation
+                )
         except BaseException:
             if my_future is not None:
                 if self._inflight.get(key) is my_future:
@@ -222,6 +232,7 @@ class DecisionClient:
         pod: PodSpec,
         nodes: Sequence[NodeMetrics],
         cache_key: str | None = None,
+        generation: int | None = None,
     ) -> SchedulingDecision | None:
         last_error: Exception | None = None
         for attempt in range(self.max_retries):
@@ -261,7 +272,9 @@ class DecisionClient:
                 decision.latency_ms = elapsed_ms
             self._note_response_time(elapsed_ms)
             if self.cache is not None:
-                self.cache.set(pod, nodes, decision, key=cache_key)
+                self.cache.set(
+                    pod, nodes, decision, key=cache_key, generation=generation
+                )
             return decision
 
         self.stats["failed_requests"] += 1

@@ -79,6 +79,10 @@ class Scheduler:
         # Per-phase wall time of the decision pipeline (SURVEY §5 tracing:
         # the reference has none) — surfaces via get_stats and /metrics.
         self.phases = PhaseRecorder()
+        # Optional shadow scorer (rollout/shadow.ShadowScorer): mirrors a
+        # fraction of decided pods through a candidate backend, non-binding
+        # and off the hot path. Attached by the rollout wiring.
+        self.shadow = None
         self.stats = {
             "total_scheduled": 0,
             "llm_decisions": 0,
@@ -140,6 +144,15 @@ class Scheduler:
             self.stats["cache_decisions"] += 1
         else:
             self.stats["llm_decisions"] += 1
+
+        if self.shadow is not None:
+            # Non-binding candidate mirror (rollout/shadow.py): one counter
+            # check + one executor submit; never on the bind critical path,
+            # and a broken shadow must never affect real scheduling.
+            try:
+                self.shadow.observe(pod, nodes, decision)
+            except Exception:
+                logger.exception("shadow mirror failed")
 
         if getattr(self.binder, "bind_is_nonblocking", False):
             # In-memory binders (FakeCluster) finish in microseconds; the
@@ -447,8 +460,11 @@ class Scheduler:
         self._stop_event.set()
 
     def get_stats(self) -> dict:
-        return {
+        out = {
             **self.stats,
             "client": self.client.get_stats(),
             "phases": self.phases.snapshot(),
         }
+        if self.shadow is not None:
+            out["shadow"] = self.shadow.stats()
+        return out

@@ -151,10 +151,18 @@ class ReplicaServer:
 
     def __init__(self, backend: DecisionBackend, host: str = "localhost",
                  port: int = 9901, max_inflight: int = 64,
-                 max_connections: int = 16) -> None:
+                 max_connections: int = 16,
+                 swap_fn: Callable[[int], dict] | None = None) -> None:
         from concurrent.futures import ThreadPoolExecutor
 
         self.backend = backend
+        # Optional rollout hook: `swap_fn(version) -> dict` hot-swaps THIS
+        # worker's backend to a registry version (rollout/hotswap.py
+        # HotSwapper.swap_to over a registry the worker can read). The
+        # coordinator's canary controller staggers these one replica at a
+        # time (rollout/canary.staggered_swap) so the fanout always keeps
+        # a serving majority. None = the op answers ok=False.
+        self.swap_fn = swap_fn
         self.max_inflight = max_inflight
         self.max_connections = max_connections
         self._pool = ThreadPoolExecutor(
@@ -247,7 +255,24 @@ class ReplicaServer:
     def _serve_one(self, conn, send_lock, req: dict) -> None:
         rid = req.get("id")
         try:
-            if req.get("op") == "prewarm":
+            if req.get("op") == "rollout_swap":
+                # Synchronous on this pool slot ON PURPOSE: the caller
+                # staggers replicas one at a time and needs the verdict
+                # before touching the next one; decision traffic on other
+                # slots keeps flowing until the backend's own quiesce
+                # barrier holds it (engine/local.run_quiesced). The
+                # enclosing finally/send tail does the inflight decrement
+                # and frame send exactly like a decision response.
+                if self.swap_fn is None:
+                    resp = {"id": rid, "ok": False,
+                            "error": "replica has no swap hook"}
+                else:
+                    try:
+                        detail = self.swap_fn(int(req["version"]))
+                        resp = {"id": rid, "ok": True, "detail": detail}
+                    except Exception as exc:
+                        resp = {"id": rid, "ok": False, "error": str(exc)}
+            elif req.get("op") == "prewarm":
                 # Advisory prefix install forwarded by the coordinator
                 # (engine/local.prewarm_prefix semantics). The response is
                 # sent from the backend future's callback, so this pool
@@ -256,10 +281,11 @@ class ReplicaServer:
                 # answers ok=False.
                 self._serve_prewarm(conn, send_lock, req)
                 return
-            pod = pod_from_wire(req["pod"])
-            nodes = [node_from_wire(n) for n in req["nodes"]]
-            decision = self.backend.get_scheduling_decision(pod, nodes)
-            resp = {"id": rid, "decision": decision_to_wire(decision)}
+            else:
+                pod = pod_from_wire(req["pod"])
+                nodes = [node_from_wire(n) for n in req["nodes"]]
+                decision = self.backend.get_scheduling_decision(pod, nodes)
+                resp = {"id": rid, "decision": decision_to_wire(decision)}
             with self._served_lock:
                 self.served += 1
         except NoFeasibleNodeError as exc:
@@ -565,6 +591,29 @@ class ReplicaClient:
         fut.add_done_callback(_done)
         timer.start()
         return out
+
+    def rollout_swap(self, version: int, timeout_s: float | None = None) -> dict:
+        """Ask the worker to hot-swap its backend to a registry version
+        (ReplicaServer swap_fn). BLOCKING — the canary controller staggers
+        replicas one at a time and needs this replica's verdict before
+        touching the next (rollout/canary.staggered_swap). Returns the
+        server's {"ok", "detail"|"error"} payload; transport failures raise
+        BackendError. `timeout_s` defaults to request_timeout_s — raise it
+        for donate-mode swaps whose restore runs inside the pause."""
+        rid, fut, sock = self._submit_frame({
+            "op": "rollout_swap", "version": int(version),
+        })
+        try:
+            resp = fut.result(
+                timeout=self.request_timeout_s if timeout_s is None else timeout_s
+            )
+        except FuturesTimeout as exc:
+            self._drop(rid)
+            self._mark_suspect(sock)
+            raise BackendError(
+                f"replica {self.addr} swap timed out"
+            ) from exc
+        return {k: v for k, v in resp.items() if k != "id"}
 
     def _resolve(self, resp: dict) -> SchedulingDecision:
         if "decision" in resp:

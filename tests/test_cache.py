@@ -70,7 +70,7 @@ class TestDecisionCache:
         cache.set(pod, nodes, make_decision())
         hit = cache.get(pod, nodes)
         assert hit is not None and hit.selected_node == "node-a"
-        assert cache.stats() == {"size": 1, "hits": 1, "misses": 1}
+        assert cache.stats() == {"size": 1, "hits": 1, "misses": 1, "generation": 0}
 
     def test_ttl_expiry_on_read(self):
         cache = DecisionCache(ttl_seconds=0.0)
@@ -117,3 +117,63 @@ class TestConstraintsInKey:
             make_pod(tolerations=({"key": "gpu", "effect": "NoSchedule"},)), nodes
         )
         assert k1 != k2
+
+
+class TestGenerationBump:
+    """Policy-epoch invalidation (rollout satellite): after a hot weight
+    swap the cache must be provably unable to serve a pre-swap decision —
+    the key digests only (pod, cluster) state, so without the epoch every
+    old entry would keep hitting."""
+
+    def test_bump_invalidates_pre_swap_entries(self):
+        cache = DecisionCache()
+        pod, nodes = make_pod(), [make_node()]
+        cache.set(pod, nodes, make_decision())
+        assert cache.get(pod, nodes) is not None
+        assert cache.bump_generation() == 1
+        # identical (pod, cluster) state: the old policy's decision is gone
+        assert cache.get(pod, nodes) is None
+
+    def test_bump_does_not_flush_unrelated_state(self):
+        cache = DecisionCache()
+        pod, nodes = make_pod(), [make_node()]
+        cache.set(pod, nodes, make_decision())
+        cache.get(pod, nodes)   # hit
+        cache.get(make_pod(cpu=0.9), nodes)  # miss
+        before = cache.stats()
+        cache.bump_generation()
+        after = cache.stats()
+        # counters and stored entries survive (old entries age out via
+        # TTL/size-cap; they are unreachable, not flushed)
+        assert after["hits"] == before["hits"] == 1
+        assert after["misses"] == before["misses"] == 1
+        assert after["size"] == before["size"] == 1
+        assert after["generation"] == 1
+        # the new epoch works normally
+        cache.set(pod, nodes, make_decision("node-b"))
+        assert cache.get(pod, nodes).selected_node == "node-b"
+
+    def test_straggler_set_files_under_its_compute_generation(self):
+        """A decision COMPUTED under pre-swap weights that lands after the
+        bump must be stored under the OLD generation (unreachable) — the
+        client captures the epoch before the backend call and passes it to
+        set (sched/client.py)."""
+        cache = DecisionCache()
+        pod, nodes = make_pod(), [make_node()]
+        gen_at_decide = cache.generation
+        cache.bump_generation()  # hot swap lands mid-decision
+        cache.set(pod, nodes, make_decision("stale"), generation=gen_at_decide)
+        assert cache.get(pod, nodes) is None  # never served post-promotion
+        # without the captured epoch it WOULD have been served
+        cache.set(pod, nodes, make_decision("fresh"))
+        assert cache.get(pod, nodes).selected_node == "fresh"
+
+    def test_entries_do_not_leak_across_generations(self):
+        cache = DecisionCache()
+        pod, nodes = make_pod(), [make_node()]
+        cache.set(pod, nodes, make_decision("node-a"))
+        cache.bump_generation()
+        cache.set(pod, nodes, make_decision("node-b"))
+        # same raw key, two epochs, two entries — only the current serves
+        assert len(cache) == 2
+        assert cache.get(pod, nodes).selected_node == "node-b"

@@ -23,6 +23,62 @@ class TestMetricsRendering:
         assert "llm_scheduler_client_avg_response_time_ms 12.5" in text
         assert 'llm_scheduler_client_circuit_breaker_state{value="closed"} 1.0' in text
 
+    def test_type_headers_per_family(self):
+        """Every metric family carries exactly one `# TYPE <family> gauge`
+        header with its samples contiguous under it — bare samples with no
+        TYPE line were what render_prometheus emitted before the rollout
+        round (scrapers flag them; typed queries treat them as untyped)."""
+        stats = {
+            "total_scheduled": 5,
+            "fanout_routed": [7, 3],
+            "breaker": {"state": "closed"},
+        }
+        text = render_prometheus(stats)
+        assert "# TYPE llm_scheduler_total_scheduled gauge" in text
+        assert "# TYPE llm_scheduler_fanout_routed gauge" in text
+        assert "# TYPE llm_scheduler_breaker_state gauge" in text
+        # labeled family: ONE header, both samples under it
+        assert text.count("# TYPE llm_scheduler_fanout_routed gauge") == 1
+
+    def test_exposition_format_validity(self):
+        """Scrape-format contract over a realistic nested stats dict:
+        every non-comment line is `name{labels}? value`, every sample's
+        family has a TYPE header ABOVE it, and samples of one family are
+        contiguous (prometheus rejects interleaved families)."""
+        import re
+
+        stats = {
+            "total_scheduled": 7,
+            "client": {
+                "avg_response_time_ms": 12.5,
+                "circuit_breaker": {"state": "closed"},
+            },
+            "fanout_routed": [4, 2],
+            "fanout_cooling": [False, True],
+            "rollout": {"active_version": 3, "swap": {"last_pause_s": 0.04}},
+            "arena": {"waves": [{"wall_ms": 12.5}]},
+        }
+        text = render_prometheus(stats)
+        sample_re = re.compile(
+            r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? -?[0-9.e+-]+$"
+        )
+        type_re = re.compile(r"^# TYPE ([a-zA-Z_:][a-zA-Z0-9_:]*) gauge$")
+        typed: set[str] = set()
+        family_order: list[str] = []
+        for line in text.strip().splitlines():
+            m = type_re.match(line)
+            if m:
+                assert m.group(1) not in typed, f"duplicate TYPE for {m.group(1)}"
+                typed.add(m.group(1))
+                continue
+            assert sample_re.match(line), f"malformed sample line {line!r}"
+            family = line.split("{", 1)[0].split(" ", 1)[0]
+            assert family in typed, f"sample {line!r} precedes its TYPE header"
+            if not family_order or family_order[-1] != family:
+                family_order.append(family)
+        # contiguity: no family appears in two separate runs
+        assert len(family_order) == len(set(family_order)), family_order
+
     def test_lists_become_indexed_gauges(self):
         """Per-replica lists (fanout_routed) and per-wave arena series
         were silently dropped by _flatten before round 6."""

@@ -29,6 +29,12 @@ class TestRepoIsClean:
         assert "tests/test_replica.py" in files
         assert "k8s_llm_scheduler_tpu/testing.py" in files
         assert "bench.py" in files
+        # the rollout package (new in the live-rollout round) is covered
+        # by the recursive scan — pin it so a SCAN_DIRS refactor can't
+        # silently drop it
+        assert "k8s_llm_scheduler_tpu/rollout/hotswap.py" in files
+        assert "k8s_llm_scheduler_tpu/rollout/registry.py" in files
+        assert "tests/test_rollout.py" in files
         # the lint never lints its own pattern table
         assert "tools/py310_lint.py" not in files
 

@@ -1,0 +1,838 @@
+"""Live policy rollout (rollout/): registry, hot swap, shadow, canary gate.
+
+Fast tier, small configs on CPU. The worker quiesce policy is exercised
+against a stub engine (the test_local_worker pattern); swap correctness —
+identical-params mid-stream token identity, restore-and-swap through a
+real registry, swap under concurrent wave traffic — runs on a micro real
+engine (f32, 2 layers, compiles in seconds)."""
+
+import asyncio
+import json
+import threading
+import time
+from types import SimpleNamespace
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from k8s_llm_scheduler_tpu.core.cache import DecisionCache
+from k8s_llm_scheduler_tpu.engine.backend import StubBackend
+from k8s_llm_scheduler_tpu.engine.local import LocalLLMBackend
+from k8s_llm_scheduler_tpu.engine.tokenizer import ByteTokenizer
+from k8s_llm_scheduler_tpu.models.configs import TINY, LlamaConfig
+from k8s_llm_scheduler_tpu.models.loader import (
+    CheckpointError,
+    restore_checkpoint,
+    save_checkpoint,
+)
+from k8s_llm_scheduler_tpu.rollout import (
+    CanaryController,
+    CheckpointRegistry,
+    GateConfig,
+    HotSwapper,
+    RegistryError,
+    ShadowScorer,
+    config_fingerprint,
+    run_gate,
+    staggered_swap,
+)
+from k8s_llm_scheduler_tpu.types import DecisionSource, SchedulingDecision
+
+from conftest import make_node, make_pod
+
+MICRO = LlamaConfig(
+    name="rollout-micro", vocab_size=512, d_model=64, n_layers=2, n_heads=2,
+    n_kv_heads=1, d_ff=128, max_seq_len=4096, rope_theta=10000.0,
+    dtype=jnp.float32, tie_embeddings=True,
+)
+
+
+def micro_params(seed: int = 0):
+    import jax
+
+    from k8s_llm_scheduler_tpu.models.llama import init_params
+
+    return init_params(jax.random.PRNGKey(seed), MICRO)
+
+
+def micro_engine(params=None, **kw):
+    from k8s_llm_scheduler_tpu.engine.engine import InferenceEngine
+
+    kw.setdefault("num_pages", 64)
+    kw.setdefault("page_size", 16)
+    kw.setdefault("max_slots", 2)
+    kw.setdefault("max_pages_per_seq", 8)
+    kw.setdefault("prefill_buckets", (32, 64, 128, 256))
+    kw.setdefault("chunk_steps", 4)
+    kw.setdefault("temperature", 0.0)
+    return InferenceEngine(
+        params if params is not None else micro_params(), MICRO,
+        ByteTokenizer(), **kw,
+    )
+
+
+def publish_micro(registry, tmp_path, seed: int, tag: str, cfg=MICRO):
+    ckpt = tmp_path / f"ckpt-{tag}"
+    save_checkpoint(ckpt, micro_params(seed))
+    return registry.publish(ckpt, cfg=cfg, note=tag)
+
+
+# ---------------------------------------------------------------- registry
+class TestRegistry:
+    def _publish_dummy(self, registry, tmp_path, tag="a", **kw):
+        src = tmp_path / f"src-{tag}"
+        (src / "sub").mkdir(parents=True)
+        (src / "weights.bin").write_bytes(b"w" * 64 + tag.encode())
+        (src / "sub" / "meta.json").write_text(json.dumps({"tag": tag}))
+        return registry.publish(src, cfg=TINY, **kw)
+
+    def test_publish_latest_get_verify(self, tmp_path):
+        registry = CheckpointRegistry(tmp_path / "reg")
+        m1 = self._publish_dummy(registry, tmp_path, "a")
+        assert m1.version == 1
+        assert m1.config_fingerprint == config_fingerprint(TINY)
+        assert set(m1.files) == {"weights.bin", "sub/meta.json"}
+        m2 = self._publish_dummy(registry, tmp_path, "b")
+        assert m2.version == 2
+        assert registry.versions() == [1, 2]
+        assert registry.latest().version == 2
+        got = registry.get(1)
+        assert got.checkpoint_path.is_dir()
+        ok, problems = registry.verify(1)
+        assert ok and problems == []
+        with pytest.raises(RegistryError):
+            registry.get(99)
+
+    def test_lineage_tracks_active(self, tmp_path):
+        registry = CheckpointRegistry(tmp_path / "reg")
+        m1 = self._publish_dummy(registry, tmp_path, "a")
+        assert m1.parent is None
+        registry.set_active(1)
+        m2 = self._publish_dummy(registry, tmp_path, "b")
+        assert m2.parent == 1  # lineage defaults to the active version
+
+    def test_verify_catches_tamper_truncation_and_extras(self, tmp_path):
+        registry = CheckpointRegistry(tmp_path / "reg")
+        m = self._publish_dummy(registry, tmp_path, "a")
+        target = m.checkpoint_path / "weights.bin"
+        target.write_bytes(b"x" * target.stat().st_size)  # same size, new bytes
+        ok, problems = registry.verify(1)
+        assert not ok and any("digest mismatch" in p for p in problems)
+        target.write_bytes(b"short")  # truncation
+        assert any("bytes" in p for p in registry.verify(1)[1])
+        (m.checkpoint_path / "rogue.tmp").write_text("x")
+        assert any("unmanifested" in p for p in registry.verify(1)[1])
+
+    def test_fsck_reports_per_version(self, tmp_path):
+        registry = CheckpointRegistry(tmp_path / "reg")
+        self._publish_dummy(registry, tmp_path, "a")
+        m2 = self._publish_dummy(registry, tmp_path, "b")
+        (m2.checkpoint_path / "weights.bin").write_bytes(b"corrupt")
+        report = registry.fsck()
+        assert report[1] == [] and report[2] != []
+
+    def test_retention_keeps_active_and_parent(self, tmp_path):
+        registry = CheckpointRegistry(tmp_path / "reg")
+        for tag in "abcde":
+            self._publish_dummy(registry, tmp_path, tag)
+        registry.set_active(2)  # v2's manifest parent is None; keep v2
+        deleted = registry.retain(keep_last=2)
+        assert deleted == [1, 3]
+        assert registry.versions() == [2, 4, 5]
+        # monotonic ids survive deletion
+        m = self._publish_dummy(registry, tmp_path, "f")
+        assert m.version == 6
+
+    def test_record_scores_merges(self, tmp_path):
+        registry = CheckpointRegistry(tmp_path / "reg")
+        self._publish_dummy(registry, tmp_path, "a", scores={"spread": 0.1})
+        registry.record_scores(1, {"gate": {"pass": True}})
+        m = registry.get(1)
+        assert m.scores == {"spread": 0.1, "gate": {"pass": True}}
+
+    def test_crashed_staging_is_swept(self, tmp_path):
+        root = tmp_path / "reg"
+        (root / ".staging-v000007-999").mkdir(parents=True)
+        registry = CheckpointRegistry(root)
+        assert list(root.glob(".staging-*")) == []
+        assert registry.versions() == []
+
+
+# ----------------------------------------------------- loader pre-validation
+class TestCheckpointErrors:
+    def test_missing_dir_is_a_clear_error(self, tmp_path):
+        with pytest.raises(CheckpointError, match="does not exist"):
+            restore_checkpoint(tmp_path / "nope", MICRO)
+
+    def test_partial_dir_is_a_clear_error(self, tmp_path):
+        torn = tmp_path / "torn"
+        torn.mkdir()
+        (torn / "d").mkdir()  # orbax data dir but no _METADATA: torn save
+        with pytest.raises(CheckpointError, match="not an orbax checkpoint"):
+            restore_checkpoint(torn, MICRO)
+
+    def test_shape_mismatch_names_first_param(self, tmp_path):
+        ckpt = tmp_path / "micro"
+        save_checkpoint(ckpt, micro_params(0))
+        wider = LlamaConfig(
+            name="rollout-wide", vocab_size=512, d_model=128, n_layers=2,
+            n_heads=2, n_kv_heads=1, d_ff=128, max_seq_len=4096,
+            rope_theta=10000.0, dtype=jnp.float32, tie_embeddings=True,
+        )
+        with pytest.raises(CheckpointError, match="'embed'") as err:
+            restore_checkpoint(ckpt, wider)
+        assert "different config" in str(err.value)
+
+    def test_happy_path_restores(self, tmp_path):
+        ckpt = tmp_path / "micro"
+        params = micro_params(0)
+        save_checkpoint(ckpt, params)
+        restored = restore_checkpoint(ckpt, MICRO)
+        np.testing.assert_allclose(
+            np.asarray(restored["embed"]), np.asarray(params["embed"])
+        )
+
+
+# ------------------------------------------------------------- worker quiesce
+DECISION = json.dumps(
+    {"selected_node": "node-1", "confidence": 0.9, "reasoning": "stub"}
+)
+
+
+class FakeHandle:
+    def __init__(self, ready_at):
+        self.ready_at = ready_at
+        self.submitted_at = time.perf_counter()
+
+    def is_ready(self):
+        return time.perf_counter() >= self.ready_at
+
+
+class FakeEngine:
+    """Stub engine recording submit/harvest ordering (no jit, fast tier)."""
+
+    max_slots = 4
+    prefill_buckets = (4096,)
+
+    def __init__(self, wave_s=0.15):
+        self.wave_s = wave_s
+        self.submitted = 0
+        self.harvested = 0
+        self.prefixes = 0
+        self.params = object()
+
+    def set_prefix(self, ids):
+        self.prefixes += 1
+
+    def set_grammar(self, dfa):
+        pass
+
+    def submit_wave(self, prompts, max_new_tokens):
+        self.submitted += 1
+        h = FakeHandle(time.perf_counter() + self.wave_s)
+        h.n = len(prompts)
+        return h
+
+    def harvest_wave(self, h):
+        while time.perf_counter() < h.ready_at:
+            time.sleep(0.002)
+        self.harvested += 1
+        return [SimpleNamespace(text=DECISION) for _ in range(h.n)]
+
+    def get_stats(self):
+        return {}
+
+    def prewarm_wave_siblings(self, limit=None):
+        return 0
+
+
+class TestRunQuiesced:
+    def test_swap_runs_at_wave_barrier_with_zero_failures(self):
+        """run_quiesced under concurrent decision traffic: the control
+        executes only once every in-flight wave is harvested, admissions
+        held during the pause are served right after, and no request
+        fails or drops."""
+        eng = FakeEngine(wave_s=0.15)
+        backend = LocalLLMBackend(
+            eng, tokenizer=ByteTokenizer(), max_new_tokens=160,
+            admit_wait_s=0.005,
+        )
+        barrier_state = {}
+
+        def swap():
+            barrier_state["submitted"] = eng.submitted
+            barrier_state["harvested"] = eng.harvested
+            return "swapped"
+
+        try:
+            import concurrent.futures as cf
+
+            nodes = [make_node(f"node-{i}", pods=i) for i in range(3)]
+            with cf.ThreadPoolExecutor(12) as pool:
+                first = [
+                    pool.submit(
+                        backend.get_scheduling_decision, make_pod(cpu=0.1 + i / 100), nodes
+                    )
+                    for i in range(4)
+                ]
+                time.sleep(0.03)  # first wave in flight
+                quiesce = pool.submit(backend.run_quiesced, swap)
+                time.sleep(0.01)
+                late = [
+                    pool.submit(
+                        backend.get_scheduling_decision, make_pod(cpu=0.3 + i / 100), nodes
+                    )
+                    for i in range(4)
+                ]
+                result, pause_s = quiesce.result(timeout=10)
+                for f in first + late:
+                    assert f.result(timeout=10).selected_node == "node-1"
+            assert result == "swapped"
+            assert pause_s > 0.0
+            # the barrier: every submitted wave had been harvested when the
+            # control ran
+            assert barrier_state["submitted"] == barrier_state["harvested"]
+            stats = backend.get_stats()
+            assert stats["swap"]["quiesce_runs"] == 1
+            assert stats["swap"]["last_pause_s"] == pytest.approx(pause_s)
+            # a quiesced control may have invalidated the prefix KV, so the
+            # group must be REINSTALLED for post-swap waves (one initial
+            # install + at least one reinstall) — without this, post-swap
+            # decisions decode against an empty prefix
+            assert eng.prefixes >= 2
+        finally:
+            backend.close()
+
+    def test_quiesced_error_propagates_and_serving_resumes(self):
+        eng = FakeEngine(wave_s=0.05)
+        backend = LocalLLMBackend(eng, tokenizer=ByteTokenizer())
+        try:
+            with pytest.raises(RuntimeError, match="boom"):
+                backend.run_quiesced(
+                    lambda: (_ for _ in ()).throw(RuntimeError("boom"))
+                )
+            nodes = [make_node("node-1")]
+            assert (
+                backend.get_scheduling_decision(make_pod(), nodes).selected_node
+                == "node-1"
+            )
+        finally:
+            backend.close()
+
+    def test_close_fails_pending_controls(self):
+        eng = FakeEngine(wave_s=0.05)
+        backend = LocalLLMBackend(eng, tokenizer=ByteTokenizer())
+        backend.close()
+        from k8s_llm_scheduler_tpu.engine.backend import BackendError
+
+        with pytest.raises(BackendError):
+            backend.run_quiesced(lambda: None)
+
+
+# ------------------------------------------------------------- real-engine swap
+class TestHotSwapEngine:
+    def test_identical_params_swap_mid_stream_is_token_identical(self):
+        """Greedy paged decode with a params swap between chunks emits
+        exactly the tokens of an uninterrupted run."""
+        params = micro_params(0)
+        eng = micro_engine(params)
+        prompt = list(b"hello rollout swap")
+
+        def run(swap_after_first_chunk: bool):
+            req_id = eng.add_request(list(prompt), max_new_tokens=10)
+            out = None
+            first = True
+            while out is None:
+                for fin in eng.step():
+                    if fin.req_id == req_id:
+                        out = fin
+                if first and swap_after_first_chunk:
+                    eng.swap_params(eng.params)  # identical params, mid-stream
+                    first = False
+            return out.token_ids
+
+        baseline = run(swap_after_first_chunk=False)
+        swapped = run(swap_after_first_chunk=True)
+        assert swapped == baseline
+        assert eng.stats["weight_swaps"] == 1
+
+    def test_swap_invalidates_prefix_cache(self):
+        eng = micro_engine()
+        eng.set_prefix(list(b"shared cluster prefix"))
+        assert len(eng._prefix_cache) == 1
+        eng.swap_params(micro_params(1))
+        assert len(eng._prefix_cache) == 0
+        assert eng._prefix is None
+        # same prompt re-prefills (a cache hit here would serve stale KV)
+        before = eng.stats["prefix_prefills"]
+        eng.set_prefix(list(b"shared cluster prefix"))
+        assert eng.stats["prefix_prefills"] == before + 1
+
+    def test_swap_under_concurrent_wave_traffic(self, tmp_path):
+        """The real thing end to end: a LocalLLMBackend serving constrained
+        decision waves while a HotSwapper promotes a registry version.
+        Zero failed/dropped decisions, the engine's params become the new
+        version's, and the decision-cache generation bumps."""
+        registry = CheckpointRegistry(tmp_path / "reg")
+        m1 = publish_micro(registry, tmp_path, seed=0, tag="v1")
+        m2 = publish_micro(registry, tmp_path, seed=1, tag="v2")
+        registry.set_active(m1.version)
+
+        params_v1 = restore_checkpoint(m1.checkpoint_path, MICRO)
+        eng = micro_engine(params_v1)
+        backend = LocalLLMBackend(
+            eng, max_new_tokens=80, constrained=True,
+            prewarm_idle_delay_s=100.0,  # no surprise prewarm compiles
+        )
+        cache = DecisionCache()
+        swapper = HotSwapper(backend, registry, MICRO, cache=cache)
+        swapper.active_version = m1.version
+        try:
+            import concurrent.futures as cf
+
+            nodes = [make_node(f"node-{i}", pods=i) for i in range(2)]
+            with cf.ThreadPoolExecutor(8) as pool:
+                first = [
+                    pool.submit(
+                        backend.get_scheduling_decision,
+                        make_pod(cpu=0.1 + i / 100), nodes,
+                    )
+                    for i in range(2)
+                ]
+                swap = pool.submit(swapper.swap_to, m2.version)
+                late = [
+                    pool.submit(
+                        backend.get_scheduling_decision,
+                        make_pod(cpu=0.3 + i / 100), nodes,
+                    )
+                    for i in range(2)
+                ]
+                swap_result = swap.result(timeout=300)
+                names = {n.name for n in nodes}
+                for f in first + late:
+                    assert f.result(timeout=300).selected_node in names
+            assert swap_result["version"] == m2.version
+            assert swap_result["pause_s"] > 0.0
+            assert cache.generation == 1  # pre-swap decisions unreachable
+            expected = restore_checkpoint(m2.checkpoint_path, MICRO)
+            np.testing.assert_allclose(
+                np.asarray(eng.params["embed"]), np.asarray(expected["embed"])
+            )
+            # rollback restores v1's weights and bumps the epoch again
+            swapper.rollback()
+            np.testing.assert_allclose(
+                np.asarray(eng.params["embed"]),
+                np.asarray(params_v1["embed"]),
+            )
+            assert cache.generation == 2
+            assert swapper.stats()["rollbacks"] == 1
+        finally:
+            backend.close()
+
+    def test_swap_rejects_wrong_fingerprint_and_bad_digest(self, tmp_path):
+        registry = CheckpointRegistry(tmp_path / "reg")
+        m1 = publish_micro(registry, tmp_path, seed=0, tag="v1")
+        # a version published for a DIFFERENT config
+        wrong = publish_micro(registry, tmp_path, seed=0, tag="wrong", cfg=TINY)
+        eng = micro_engine()
+        backend = LocalLLMBackend(eng, prewarm_idle_delay_s=100.0)
+        swapper = HotSwapper(backend, registry, MICRO)
+        try:
+            with pytest.raises(CheckpointError, match="shaped for config"):
+                swapper.swap_to(wrong.version)
+            # tamper with v1: digest verification must stop the swap
+            victim = next(
+                p for p in sorted(m1.checkpoint_path.rglob("*")) if p.is_file()
+            )
+            victim.write_bytes(b"garbage")
+            with pytest.raises(CheckpointError, match="digest"):
+                swapper.swap_to(m1.version)
+        finally:
+            backend.close()
+
+
+# ----------------------------------------------------------------- shadow arm
+class TestShadow:
+    def _decision(self, node="node-0"):
+        return SchedulingDecision(
+            selected_node=node, confidence=0.9, reasoning="t",
+            source=DecisionSource.LLM,
+        )
+
+    def test_mirrors_fraction_and_scores(self):
+        scorer = ShadowScorer(StubBackend(), fraction=0.5, candidate_version=7)
+        try:
+            nodes = [make_node(f"node-{i}", pods=5 * i) for i in range(3)]
+            for _ in range(10):
+                scorer.observe(make_pod(), nodes, self._decision("node-2"))
+            assert scorer.drain()
+            stats = scorer.stats()
+            assert stats["mirrored"] == 5  # deterministic counter sampling
+            assert stats["candidate_version"] == 7
+            # StubBackend picks the least-loaded feasible node (node-0);
+            # the incumbent stacked onto node-2: zero agreement, and the
+            # candidate's choices project a better (lower) spread
+            assert stats["agree_frac"] == 0.0
+            assert stats["spread_delta_mean"] < 0
+            assert stats["teacher_agree_candidate_frac"] is not None
+        finally:
+            scorer.close()
+
+    def test_candidate_errors_counted_never_raised(self):
+        bad = StubBackend()
+        bad.fail_next = 100
+        scorer = ShadowScorer(bad, fraction=1.0)
+        try:
+            nodes = [make_node("node-0")]
+            for _ in range(3):
+                scorer.observe(make_pod(), nodes, self._decision())
+            assert scorer.drain()
+            assert scorer.stats()["errors"] == 3
+            assert scorer.stats()["mirrored"] == 0
+        finally:
+            scorer.close()
+
+    def test_scheduler_hook_mirrors_live_decisions(self):
+        """scheduler.shadow hooks schedule_pod: decided pods are mirrored
+        non-binding and the scorer surfaces in get_stats."""
+        from k8s_llm_scheduler_tpu.core.breaker import CircuitBreaker
+        from k8s_llm_scheduler_tpu.sched.client import DecisionClient
+        from k8s_llm_scheduler_tpu.sched.loop import Scheduler
+        from k8s_llm_scheduler_tpu.testing import fixture_pods, synthetic_cluster
+
+        cluster = synthetic_cluster(3)
+        client = DecisionClient(
+            StubBackend(), cache=DecisionCache(), breaker=CircuitBreaker()
+        )
+        scheduler = Scheduler(cluster, cluster, client)
+        scorer = ShadowScorer(StubBackend(), fraction=1.0)
+        scheduler.shadow = scorer
+        try:
+            for raw in fixture_pods():
+                cluster.add_pod(raw)  # bind target must exist in the fake
+                assert asyncio.run(scheduler.schedule_pod(raw))
+            assert scorer.drain()
+            stats = scheduler.get_stats()
+            assert stats["shadow"]["mirrored"] == 3
+            assert stats["shadow"]["agree_frac"] == 1.0  # same policy
+            assert stats["total_scheduled"] == 3
+        finally:
+            scorer.close()
+            cluster.close()
+
+
+# ---------------------------------------------------------------- canary gate
+class StackingBackend:
+    """Deliberately bad policy: piles every pod onto ONE node (first by
+    name) — the candidate the gate must reject on spread."""
+
+    def get_scheduling_decision(self, pod, nodes):
+        from k8s_llm_scheduler_tpu.core.validation import feasible_nodes
+        from k8s_llm_scheduler_tpu.engine.backend import NoFeasibleNodeError
+
+        candidates = feasible_nodes(pod, nodes)
+        if not candidates:
+            raise NoFeasibleNodeError(f"no feasible node for {pod.name}")
+        worst = min(candidates, key=lambda n: n.name)
+        return SchedulingDecision(
+            selected_node=worst.name, confidence=0.9, reasoning="stack",
+            source=DecisionSource.LLM,
+        )
+
+
+# homogeneous SKUs + a tight spread tolerance: fill spread is directly
+# comparable across arms, and a one-node stacker is unambiguously worse
+SMALL_GATE = GateConfig(
+    seed=3, nodes=6, pods=18, shapes=6, waves=2, hetero=False,
+    spread_tolerance=0.005,
+)
+
+
+class TestCanaryGate:
+    def test_gate_rejects_worse_candidate(self):
+        from k8s_llm_scheduler_tpu.sim import HeuristicBackend
+
+        verdict = run_gate(
+            lambda: HeuristicBackend("resource_balanced"),
+            StackingBackend,
+            SMALL_GATE,
+        )
+        assert not verdict["pass"]
+        assert not verdict["checks"]["spread"]
+        assert verdict["candidate"]["spread"] > verdict["incumbent"]["spread"]
+
+    def test_gate_promotes_no_worse_candidate(self):
+        from k8s_llm_scheduler_tpu.sim import HeuristicBackend
+
+        verdict = run_gate(
+            lambda: HeuristicBackend("resource_balanced"),
+            lambda: HeuristicBackend("resource_balanced"),
+            SMALL_GATE,
+        )
+        assert verdict["pass"]
+        assert all(verdict["checks"].values())
+
+
+class FakeSwapper:
+    def __init__(self):
+        self.calls = []
+
+    def swap_to(self, version):
+        self.calls.append(version)
+        return {"version": version, "pause_s": 0.01, "mode": "double"}
+
+    def stats(self):
+        return {"swaps": len(self.calls)}
+
+
+class TestCanaryController:
+    def _registry(self, tmp_path, n=3):
+        registry = CheckpointRegistry(tmp_path / "reg")
+        for i in range(n):
+            src = tmp_path / f"src{i}"
+            src.mkdir()
+            (src / "w.bin").write_bytes(bytes([i]) * 32)
+            registry.publish(src, cfg=MICRO)
+        return registry
+
+    def test_promote_then_regression_rolls_back(self, tmp_path):
+        registry = self._registry(tmp_path, n=2)
+        registry.set_active(1)
+        swapper = FakeSwapper()
+        stats = {
+            "llm_decisions": 0, "cache_decisions": 0, "fallback_decisions": 0,
+            "failed_bindings": 0, "client": {"invalid_decisions": 0},
+        }
+        controller = CanaryController(
+            registry, swapper,
+            stats_provider=lambda: dict(stats, client=dict(stats["client"])),
+            gate_runner=lambda v: {"pass": True, "checks": {}},
+            burn_in_decisions=100,
+        )
+        verdict = controller.tick()  # finds v2, gates, promotes
+        assert verdict["action"] == "promoted"
+        assert swapper.calls == [2]
+        assert registry.active() == 2
+        # burn-in still collecting below the window
+        stats["llm_decisions"] = 50
+        assert controller.tick() is None
+        # regression: fallback rate way past the trip threshold
+        stats["llm_decisions"] = 150
+        stats["fallback_decisions"] = 100
+        assert controller.tick() == "rolled_back"
+        assert swapper.calls == [2, 1]
+        assert registry.active() == 1
+        assert 2 in controller.rejected
+        assert controller.tick() is None  # rejected versions are not retried
+        assert controller.counters["rollbacks"] == 1
+        burn = registry.get(2).scores["burn_in"]
+        assert "fallback_rate" in burn["tripped"]
+
+    def test_clean_burn_in_keeps_promotion(self, tmp_path):
+        registry = self._registry(tmp_path, n=2)
+        registry.set_active(1)
+        swapper = FakeSwapper()
+        stats = {
+            "llm_decisions": 0, "cache_decisions": 0, "fallback_decisions": 0,
+            "failed_bindings": 0, "client": {"invalid_decisions": 0},
+        }
+        controller = CanaryController(
+            registry, swapper,
+            stats_provider=lambda: dict(stats, client=dict(stats["client"])),
+            gate_runner=lambda v: {"pass": True, "checks": {}},
+            burn_in_decisions=100,
+        )
+        controller.tick()
+        stats["llm_decisions"] = 80
+        stats["cache_decisions"] = 40
+        stats["fallback_decisions"] = 1  # 1/121 — well under the 0.2 trip
+        assert controller.tick() == "ok"
+        assert registry.active() == 2
+        assert swapper.calls == [2]
+        assert registry.get(2).scores["burn_in"]["tripped"] == []
+
+    def test_gate_fail_rejects_without_swapping(self, tmp_path):
+        registry = self._registry(tmp_path, n=2)
+        registry.set_active(1)
+        swapper = FakeSwapper()
+        controller = CanaryController(
+            registry, swapper,
+            gate_runner=lambda v: {
+                "pass": False, "checks": {"spread": False},
+            },
+        )
+        verdict = controller.tick()
+        assert verdict["action"] == "rejected"
+        assert swapper.calls == []
+        assert registry.active() == 1
+        assert registry.get(2).scores["gate"]["pass"] is False
+
+    def test_swap_failure_after_passed_gate_rejects_version(self, tmp_path):
+        """A gate-passing candidate whose swap refuses (torn checkpoint)
+        must be rejected, not re-gated every tick forever."""
+        registry = self._registry(tmp_path, n=2)
+        registry.set_active(1)
+
+        class FailingSwapper:
+            def swap_to(self, version):
+                raise CheckpointError(f"version {version} failed digests")
+
+        gates = []
+
+        def gate_runner(v):
+            gates.append(v)
+            return {"pass": True, "checks": {}}
+
+        controller = CanaryController(
+            registry, FailingSwapper(), gate_runner=gate_runner,
+        )
+        verdict = controller.tick()
+        assert verdict["action"] == "swap_failed"
+        assert registry.active() == 1  # incumbent still serving
+        assert 2 in controller.rejected
+        assert "swap_failed" in registry.get(2).scores
+        assert controller.tick() is None  # NOT re-gated
+        assert gates == [2]
+
+    def test_staggered_swap_stops_on_failure(self):
+        order = []
+
+        def mk(i, ok=True):
+            def swap():
+                order.append(i)
+                return ok
+
+            return swap
+
+        results = staggered_swap(
+            [mk(0), mk(1, ok=False), mk(2)],
+            verify=lambda i, result: result,
+        )
+        assert order == [0, 1]  # replica 2 never touched: majority intact
+        assert results == [True, False]
+
+
+# ------------------------------------------------------------- replica swap op
+class TestReplicaSwapOp:
+    def test_swap_op_round_trip_and_stagger(self):
+        from k8s_llm_scheduler_tpu.sched.replica import (
+            ReplicaClient,
+            ReplicaServer,
+        )
+
+        swapped = []
+
+        def swap_fn(version):
+            swapped.append(version)
+            return {"version": version, "pause_s": 0.01}
+
+        server = ReplicaServer(StubBackend(), port=0, swap_fn=swap_fn)
+        bare = ReplicaServer(StubBackend(), port=0)  # no hook
+        client = ReplicaClient("localhost", server.port)
+        bare_client = ReplicaClient("localhost", bare.port)
+        try:
+            resp = client.rollout_swap(5)
+            assert resp["ok"] and resp["detail"]["version"] == 5
+            assert swapped == [5]
+            assert not bare_client.rollout_swap(5)["ok"]
+            # decisions still served on the same connection after a swap
+            nodes = [make_node("node-0")]
+            assert client.get_scheduling_decision(
+                make_pod(), nodes
+            ).selected_node == "node-0"
+            # stagger across both replicas stops at the hook-less one
+            results = staggered_swap(
+                [
+                    lambda: client.rollout_swap(6),
+                    lambda: bare_client.rollout_swap(6),
+                    lambda: client.rollout_swap(7),
+                ],
+                verify=lambda i, r: r["ok"],
+            )
+            assert [r["ok"] for r in results] == [True, False]
+            assert swapped == [5, 6]
+        finally:
+            client.close()
+            bare_client.close()
+            server.close()
+            bare.close()
+
+
+# -------------------------------------------------------------------- the CLI
+class TestCliRollout:
+    def _publish(self, tmp_path, reg, tag="a"):
+        from k8s_llm_scheduler_tpu.cli import main
+
+        src = tmp_path / f"cli-src-{tag}"
+        src.mkdir()
+        (src / "weights.bin").write_bytes(tag.encode() * 32)
+        rc = main([
+            "rollout", "publish", "--registry", str(reg),
+            "--checkpoint", str(src), "--model", "tiny", "--note", tag,
+        ])
+        assert rc == 0
+
+    def test_publish_status_fsck_promote_rollback(self, tmp_path, capsys):
+        from k8s_llm_scheduler_tpu.cli import main
+
+        reg = tmp_path / "registry"
+        self._publish(tmp_path, reg, "a")
+        self._publish(tmp_path, reg, "b")
+        out = capsys.readouterr().out
+        assert '"version": 1' in out and '"version": 2' in out
+
+        assert main(["rollout", "status", "--registry", str(reg)]) == 0
+        status = json.loads(capsys.readouterr().out)
+        assert [v["version"] for v in status["versions"]] == [1, 2]
+        assert status["active"] is None
+
+        assert main(["rollout", "fsck", "--registry", str(reg)]) == 0
+        assert json.loads(capsys.readouterr().out)["clean"] == 2
+
+        # promote v1 then v2 (pointer only), then roll back to v1
+        assert main([
+            "rollout", "promote", "--registry", str(reg),
+            "--version", "1", "--no-gate",
+        ]) == 0
+        assert main([
+            "rollout", "promote", "--registry", str(reg),
+            "--version", "2", "--no-gate",
+        ]) == 0
+        capsys.readouterr()
+        assert main(["rollout", "rollback", "--registry", str(reg)]) == 0
+        roll = json.loads(capsys.readouterr().out)
+        assert roll["from"] == 2 and roll["to"] == 1
+        assert CheckpointRegistry(reg).active() == 1
+
+    def test_fsck_exits_nonzero_on_damage(self, tmp_path, capsys):
+        from k8s_llm_scheduler_tpu.cli import main
+
+        reg = tmp_path / "registry"
+        self._publish(tmp_path, reg, "a")
+        capsys.readouterr()
+        registry = CheckpointRegistry(reg)
+        victim = registry.get(1).checkpoint_path / "weights.bin"
+        victim.write_bytes(b"tampered")
+        assert main(["rollout", "fsck", "--registry", str(reg)]) == 1
+        report = json.loads(capsys.readouterr().out)
+        assert report["problems"]["1"]
+
+    def test_no_registry_configured_is_a_clear_error(self, tmp_path, capsys, monkeypatch):
+        from k8s_llm_scheduler_tpu.cli import main
+
+        monkeypatch.delenv("ROLLOUT_REGISTRY_DIR", raising=False)
+        monkeypatch.chdir(tmp_path)  # no config.yaml
+        with pytest.raises(SystemExit, match="registry"):
+            main(["rollout", "status"])
+
+    def test_env_override_supplies_registry(self, tmp_path, capsys, monkeypatch):
+        from k8s_llm_scheduler_tpu.cli import main
+
+        reg = tmp_path / "registry"
+        self._publish(tmp_path, reg, "a")
+        capsys.readouterr()
+        monkeypatch.chdir(tmp_path)
+        monkeypatch.setenv("ROLLOUT_REGISTRY_DIR", str(reg))
+        assert main(["rollout", "status"]) == 0
+        assert json.loads(capsys.readouterr().out)["versions"]
