@@ -137,13 +137,41 @@ async def _run_scheduler(cfg: Config, cluster, demo_pods: bool = False) -> int:
     scheduler, backend = _build_stack(cfg, cluster)
 
     metrics_server = None
+    sampler = None
     if cfg.get("metrics.enabled"):
         from k8s_llm_scheduler_tpu.observability.metrics import MetricsServer
 
+        stats_provider = scheduler.get_stats
+        engine = getattr(backend, "engine", None)
+        if engine is not None:
+            # Background engine telemetry (observability/sampler.py): ring
+            # series of occupancy / KV utilization / prefix hit rate /
+            # tokens-per-s / HBM watermark, served at /debug/engine with
+            # the latest values merged into /metrics as gauges.
+            from k8s_llm_scheduler_tpu.observability.sampler import (
+                EngineSampler,
+            )
+
+            sampler = EngineSampler(
+                engine,
+                interval_s=float(
+                    cfg.get("observability.sampler_interval_s", 1.0)
+                ),
+                window=int(cfg.get("observability.sampler_window", 600)),
+            )
+            sampler.start()
+            base_provider = scheduler.get_stats
+
+            def stats_provider(
+                _base=base_provider, _sampler=sampler,
+            ):
+                return {**_base(), "engine_telemetry": _sampler.latest()}
+
         metrics_server = MetricsServer(
-            scheduler.get_stats,
+            stats_provider,
             port=cfg.get("metrics.port"),
             is_alive=lambda: scheduler.running,
+            engine_sampler=sampler,
         )
         metrics_server.start()
 
@@ -172,6 +200,8 @@ async def _run_scheduler(cfg: Config, cluster, demo_pods: bool = False) -> int:
             close()
         await asyncio.wait_for(task, timeout=30)
     finally:
+        if sampler is not None:
+            sampler.stop()
         if metrics_server:
             metrics_server.stop()
         close_backend = getattr(backend, "close", None)
@@ -1001,6 +1031,7 @@ def _rollout_watch(args: argparse.Namespace, cfg: Config, registry) -> int:
         trip_bind_failure_rate=float(
             cfg.get("rollout.trip_bind_failure_rate", 0.05)
         ),
+        trip_decide_p99_ms=cfg.get("rollout.trip_decide_p99_ms", None),
     )
     shadow_frac = (
         args.shadow_frac
@@ -1108,6 +1139,139 @@ def _rollout_watch(args: argparse.Namespace, cfg: Config, registry) -> int:
     return 0
 
 
+def _debug_get(host: str, port: int, path: str, timeout: float = 5.0):
+    import urllib.request
+
+    url = f"http://{host}:{port}{path}"
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.read().decode()
+
+
+def _format_span_tree(node: dict, depth: int = 0) -> list[str]:
+    dur = node.get("dur_ms")
+    dur_txt = f"{dur:.2f}ms" if isinstance(dur, (int, float)) else "open"
+    attrs = node.get("attrs") or {}
+    attr_txt = (
+        " " + " ".join(f"{k}={v}" for k, v in sorted(attrs.items()))
+        if attrs else ""
+    )
+    status = "" if node.get("status", "ok") == "ok" else " [ERROR]"
+    lines = [f"{'  ' * depth}{node['name']}  {dur_txt}{status}{attr_txt}"]
+    for child in node.get("children", []):
+        lines.extend(_format_span_tree(child, depth + 1))
+    return lines
+
+
+def cmd_trace(args: argparse.Namespace, cfg: Config) -> int:
+    """Query a RUNNING scheduler's decision flight recorder over its
+    metrics port (observability/spans.py; /debug/decisions + /debug/trace).
+
+        cli trace list                 # newest decision traces
+        cli trace show <trace-id>      # one trace's span tree
+        cli trace tail                 # follow new traces as they complete
+        cli trace export --out f.jsonl # dump the ring as JSONL (replayable
+                                       # records, same shape as sim traces)
+    """
+    import time as _time
+    import urllib.error
+
+    from k8s_llm_scheduler_tpu.observability.spans import build_span_tree
+
+    host = args.host
+    port = args.port if args.port is not None else int(cfg.get("metrics.port"))
+
+    def summarize(entry: dict) -> str:
+        meta = entry.get("meta") or {}
+        dur = entry.get("dur_ms")
+        return (
+            f"{entry['trace_id']:<16} {entry['name']:<10} "
+            f"{(f'{dur:.1f}ms' if dur is not None else 'open'):>10} "
+            f"{meta.get('source', '-'):<9} "
+            f"{meta.get('selected_node', '-'):<20} "
+            f"{meta.get('outcome', meta.get('fallback_reason', '-'))}"
+        )
+
+    try:
+        if args.trace_cmd == "list":
+            data = json.loads(_debug_get(
+                host, port, f"/debug/decisions?n={args.n}"
+            ))
+            print(
+                f"{'trace_id':<16} {'name':<10} {'duration':>10} "
+                f"{'source':<9} {'node':<20} outcome"
+            )
+            for entry in data["traces"]:
+                print(summarize(entry))
+            rec = data["recorder"]
+            print(
+                f"-- {rec['held']}/{rec['capacity']} held, "
+                f"{rec['recorded']} recorded total"
+            )
+            return 0
+
+        if args.trace_cmd == "show":
+            try:
+                body = _debug_get(
+                    host, port, f"/debug/trace/{args.trace_id}"
+                )
+            except urllib.error.HTTPError as exc:
+                if exc.code == 404:
+                    print(
+                        f"trace {args.trace_id!r} not found "
+                        f"(ring may have evicted it)", file=sys.stderr,
+                    )
+                    return 1
+                raise
+            entry = json.loads(body)
+            meta = entry.get("meta") or {}
+            print(f"trace {entry['trace_id']}  meta={json.dumps(meta)}")
+            for line in _format_span_tree(build_span_tree(entry["spans"])):
+                print(line)
+            return 0
+
+        if args.trace_cmd == "tail":
+            since = 0
+            while True:
+                data = json.loads(_debug_get(
+                    host, port, f"/debug/decisions?n=1000&since={since}"
+                ))
+                for entry in data["traces"]:
+                    print(summarize(entry), flush=True)
+                    since = max(since, entry["seq"])
+                _time.sleep(args.interval)
+
+        if args.trace_cmd == "export":
+            body = _debug_get(host, port, "/debug/export", timeout=30.0)
+            if args.out:
+                with open(args.out, "w", encoding="utf-8") as fh:
+                    fh.write(body)
+                print(f"wrote {body.count(chr(10))} trace(s) to {args.out}")
+            else:
+                sys.stdout.write(body)
+            return 0
+    except KeyboardInterrupt:
+        return 0
+    except urllib.error.HTTPError as exc:
+        # BEFORE OSError (HTTPError subclasses it): a server-side 500
+        # carries the handler's exception text in its body — surface it
+        # instead of misdiagnosing the endpoint as unreachable
+        body = exc.read().decode(errors="replace").strip()
+        print(
+            f"metrics endpoint at {host}:{port} answered {exc.code}: "
+            f"{body or exc.reason}",
+            file=sys.stderr,
+        )
+        return 2
+    except OSError as exc:
+        print(
+            f"cannot reach scheduler metrics endpoint at {host}:{port} "
+            f"({exc}) — is it running with metrics.enabled?",
+            file=sys.stderr,
+        )
+        return 2
+    raise SystemExit(f"unknown trace command {args.trace_cmd!r}")
+
+
 def cmd_complete(args: argparse.Namespace, cfg: Config) -> int:
     """Free-form generation through the PAGED continuous-batching path —
     the general-completion capability the reference gets from its remote
@@ -1168,13 +1332,32 @@ def cmd_complete(args: argparse.Namespace, cfg: Config) -> int:
         overrides["spec_enabled"] = True
     backend = build_local_backend(**_backend_kwargs(cfg, **overrides))
     try:
+        from k8s_llm_scheduler_tpu.observability import spans
+
         engine = backend.engine
-        if len(ids) > tail:
-            engine.set_prefix(ids[:-tail])
-        fin = engine.generate(ids[-tail:], max_new_tokens=args.max_new_tokens)
+        # Trace the completion: generate() runs on THIS thread, so the
+        # engine's ambient spans (prefix_prefill for the chunked long-
+        # prompt path, prefill_dispatch, per-chunk decode_chunk, and
+        # spec_decode accept/reject when --spec) land in one flight-
+        # recorder trace — the paged path's answer to the decision
+        # traces the scheduler records.
+        with spans.start_trace(
+            "completion", prompt_tokens=len(ids), spec=bool(
+                getattr(args, "spec", False)
+            ),
+        ) as trace:
+            if len(ids) > tail:
+                engine.set_prefix(ids[:-tail])
+            fin = engine.generate(
+                ids[-tail:], max_new_tokens=args.max_new_tokens
+            )
+            if trace is not None:
+                trace.meta["generated_tokens"] = len(fin.token_ids)
         print(fin.text)
         logger.info(
-            "completed %d tokens in %.1f ms", len(fin.token_ids), fin.latency_ms
+            "completed %d tokens in %.1f ms%s", len(fin.token_ids),
+            fin.latency_ms,
+            f" (trace {trace.trace_id})" if trace is not None else "",
         )
         return 0
     finally:
@@ -1420,6 +1603,40 @@ def main(argv: list[str] | None = None) -> int:
     p_watch.add_argument("--fake-cluster", action="store_true")
     p_watch.add_argument("--fake-nodes", type=int, default=3)
 
+    p_trace = sub.add_parser(
+        "trace",
+        help="decision flight recorder: list/show/tail/export traces from "
+             "a running scheduler's /debug endpoints (observability/)",
+    )
+    tsub = p_trace.add_subparsers(dest="trace_cmd", required=True)
+
+    def _with_endpoint(p):
+        p.add_argument("--host", default="127.0.0.1")
+        p.add_argument(
+            "--port", type=int, default=None,
+            help="metrics port (default metrics.port from config)",
+        )
+        return p
+
+    p_tlist = _with_endpoint(tsub.add_parser(
+        "list", help="newest decision traces (summary lines)"
+    ))
+    p_tlist.add_argument("-n", type=int, default=20)
+    p_tshow = _with_endpoint(tsub.add_parser(
+        "show", help="one trace's full span tree"
+    ))
+    p_tshow.add_argument("trace_id")
+    p_ttail = _with_endpoint(tsub.add_parser(
+        "tail", help="follow new traces as they complete (Ctrl-C to stop)"
+    ))
+    p_ttail.add_argument("--interval", type=float, default=1.0)
+    p_texport = _with_endpoint(tsub.add_parser(
+        "export",
+        help="dump the ring as JSONL (one canonical-JSON trace per line, "
+             "replayable alongside sim traces)",
+    ))
+    p_texport.add_argument("--out", default=None, help="file (default stdout)")
+
     p_complete = sub.add_parser(
         "complete",
         help="free-form text completion (paged continuous-batching path)",
@@ -1447,6 +1664,15 @@ def main(argv: list[str] | None = None) -> int:
         fmt=cfg.get("logging.format"),
         file=cfg.get("logging.file"),
     )
+    # Apply the observability block ONCE for every command: tracing on/off
+    # and the flight-recorder ring size are process-global (spans.py), the
+    # same way logging is.
+    from k8s_llm_scheduler_tpu.observability import spans
+
+    spans.configure(
+        enabled=bool(cfg.get("observability.tracing", True)),
+        capacity=int(cfg.get("observability.flight_recorder_size", 256)),
+    )
     handlers = {
         "run": cmd_run,
         "demo": cmd_demo,
@@ -1456,6 +1682,7 @@ def main(argv: list[str] | None = None) -> int:
         "eval": cmd_eval,
         "sim": cmd_sim,
         "rollout": cmd_rollout,
+        "trace": cmd_trace,
         "complete": cmd_complete,
     }
     return handlers[args.command](args, cfg)

@@ -115,6 +115,19 @@ DEFAULTS: dict[str, Any] = {
         "enabled": False,
         "port": 9090,  # config.yaml:31 — made real by observability/metrics.py
     },
+    # Decision flight recorder + engine telemetry (observability/spans.py,
+    # observability/sampler.py). Tracing is cheap (<2% of decision p50,
+    # bench.py --preset obs-overhead) and on by default; the sampler rides
+    # the metrics server and only runs when metrics are enabled.
+    "observability": {
+        "tracing": True,
+        # complete decision traces held in the ring (/debug/decisions,
+        # cli trace); one trace is ~a few KB
+        "flight_recorder_size": 256,
+        # engine telemetry sampling period + ring length (per series)
+        "sampler_interval_s": 1.0,
+        "sampler_window": 600,
+    },
     "fallback": {
         "enabled": True,
         "strategy": "resource_balanced",  # config.yaml:36
@@ -156,6 +169,12 @@ DEFAULTS: dict[str, Any] = {
         "trip_fallback_rate": 0.2,
         "trip_invalid_rate": 0.05,
         "trip_bind_failure_rate": 0.05,
+        # decide-latency p99 budget (ms) over the burn-in window, derived
+        # from PhaseRecorder histogram deltas; null disables the trip.
+        # Bucket-quantized conservatively: rollback fires only when the
+        # window p99's bucket LOWER bound exceeds this, so a healthy
+        # candidate sharing a 2x bucket with the budget never trips
+        "trip_decide_p99_ms": None,
         # registry poll period for `cli rollout watch`
         "poll_seconds": 5.0,
     },
@@ -212,6 +231,10 @@ ENV_OVERRIDES: dict[str, str] = {
     "LOG_FORMAT": "logging.format",
     "METRICS_ENABLED": "metrics.enabled",
     "METRICS_PORT": "metrics.port",
+    "OBS_TRACING": "observability.tracing",
+    "OBS_FLIGHT_RECORDER_SIZE": "observability.flight_recorder_size",
+    "OBS_SAMPLER_INTERVAL_S": "observability.sampler_interval_s",
+    "OBS_SAMPLER_WINDOW": "observability.sampler_window",
     "FALLBACK_STRATEGY": "fallback.strategy",
     "ROLLOUT_REGISTRY_DIR": "rollout.registry_dir",
     "ROLLOUT_SHADOW_FRACTION": "rollout.shadow_fraction",

@@ -71,6 +71,7 @@ from k8s_llm_scheduler_tpu.engine.constrained import (
     sparse_tables,
     wave_iterations,
 )
+from k8s_llm_scheduler_tpu.observability import spans
 from k8s_llm_scheduler_tpu.engine.kv_cache import PagedKVCache
 from k8s_llm_scheduler_tpu.engine.tokenizer import ByteTokenizer, Tokenizer
 from k8s_llm_scheduler_tpu.models.configs import LlamaConfig
@@ -703,6 +704,18 @@ class InferenceEngine:
             "chunks": 0,
             "prefills": 0,
             "syncs": 0,
+            # Pre-initialized (not lazily inserted on first use): the
+            # telemetry sampler copies this dict from another thread, and
+            # a first-time key insert resizing it mid-iteration would
+            # raise "dictionary changed size during iteration" and drop
+            # the sample covering exactly that event (e.g. the first hot
+            # weight swap's HBM/occupancy transient).
+            "waves": 0,
+            "wave_model_calls": 0,
+            "wave_prewarms": 0,
+            "wave_prewarm_failures": 0,
+            "prefix_reused_tokens": 0,
+            "weight_swaps": 0,
         }
 
     # ------------------------------------------------------------- grammar
@@ -784,12 +797,18 @@ class InferenceEngine:
         if not prompt_ids:
             self._prefix = self._get_empty_prefix()
             return
+        with spans.span("prefix_prefill", tokens=len(prompt_ids)) as _sp:
+            self._set_prefix_inner(prompt_ids, _sp)
+
+    def _set_prefix_inner(self, prompt_ids: list[int], _sp) -> None:
         key = tuple(prompt_ids)
         cached = self._prefix_cache.get(key)
         if cached is not None:
             self._prefix_cache.move_to_end(key)
             self._prefix = cached
             self.stats["prefix_hits"] += 1
+            if _sp is not None:
+                _sp.attrs["cached"] = True
             return
         n = len(prompt_ids)
         if n > self.cfg.max_seq_len:
@@ -1057,11 +1076,15 @@ class InferenceEngine:
                 reqs.append(req)
 
             self._rng, sub = jax.random.split(self._rng)
-            (
-                self.kv.k, self.kv.v,
-                self._tok_d, self._pos_d, self._act_d, self._st_d,
-                self._budget_d, self._first_d,
-            ) = self._admit(
+            with spans.span(
+                "prefill_dispatch",
+                tokens=int(suffix_lens.sum()), requests=len(prompts),
+            ):
+                (
+                    self.kv.k, self.kv.v,
+                    self._tok_d, self._pos_d, self._act_d, self._st_d,
+                    self._budget_d, self._first_d,
+                ) = self._admit(
                 self.params, self.cfg,
                 jnp.asarray(tokens), jnp.asarray(suffix_lens),
                 prefix.k, prefix.v, jnp.int32(prefix.length),
@@ -1342,6 +1365,15 @@ class InferenceEngine:
         sync), then ONE host sync; returns requests that finished."""
         if not self._by_slot:
             return []
+        with spans.span("decode_chunk", chunks=chunks) as sp:
+            before = self.stats["decode_tokens"]
+            finished = self._step_inner(chunks)
+            if sp is not None:
+                sp.attrs["finished"] = len(finished)
+                sp.attrs["tokens"] = self.stats["decode_tokens"] - before
+        return finished
+
+    def _step_inner(self, chunks: int) -> list[Finished]:
         prefix = self._prefix or self._get_empty_prefix()
         n = self.chunk_steps
         emissions: list[jax.Array] = []

@@ -47,6 +47,7 @@ import jax
 
 from k8s_llm_scheduler_tpu.core.prompt import PromptEngine
 from k8s_llm_scheduler_tpu.core.validation import feasible_nodes
+from k8s_llm_scheduler_tpu.observability import spans
 from k8s_llm_scheduler_tpu.engine.backend import BackendError, NoFeasibleNodeError
 from k8s_llm_scheduler_tpu.engine.constrained import build_decision_dfa
 from k8s_llm_scheduler_tpu.engine.engine import InferenceEngine
@@ -71,7 +72,10 @@ logger = logging.getLogger(__name__)
 
 
 class _WorkItem:
-    __slots__ = ("prefix_ids", "suffix_ids", "group_key", "future", "enqueued_at")
+    __slots__ = (
+        "prefix_ids", "suffix_ids", "group_key", "future", "enqueued_at",
+        "enqueued_wall", "trace",
+    )
 
     def __init__(self, prefix_ids, suffix_ids, group_key):
         self.prefix_ids = prefix_ids
@@ -79,6 +83,14 @@ class _WorkItem:
         self.group_key = group_key  # (prefix token tuple, grammar names) pair
         self.future: Future = Future()
         self.enqueued_at = time.perf_counter()
+        # wall-clock twin of enqueued_at: retroactive flight-recorder spans
+        # are wall-anchored (observability/spans), while all durations stay
+        # perf_counter deltas
+        self.enqueued_wall = time.time()
+        # (Trace, SpanContext) captured on the SUBMITTING thread — the
+        # engine worker attaches admission-wait/prefill/decode spans to it
+        # at harvest. None when no trace is ambient (tracing off, prewarms).
+        self.trace = None
 
     def resolve(self, text: str) -> None:
         """Set the result unless the caller already cancelled/timed out —
@@ -216,7 +228,9 @@ class LocalLLMBackend:
             tuple(prefix_ids),
             ready_names if self.constrained else None,
         )
-        return _WorkItem(prefix_ids, suffix_ids, group_key)
+        item = _WorkItem(prefix_ids, suffix_ids, group_key)
+        item.trace = spans.capture()
+        return item
 
     def prewarm_prefix(self, nodes: Sequence[NodeMetrics]) -> Future:
         """Advisory: install this snapshot's (prefix KV, grammar) group
@@ -684,6 +698,7 @@ class LocalLLMBackend:
                         ema = 0.9 * ema + 0.1 * min(service, 4.0 * ema)
                     self._wave_ema[geo] = ema
                 for fin, item in zip(fins, items):
+                    self._attach_item_spans(item, handle, fin, now)
                     item.resolve(fin.text)
         if self._held_controls and not waves:
             # Wave barrier reached (everything in flight harvested above,
@@ -712,6 +727,56 @@ class LocalLLMBackend:
             # over a cache hit.
             self._current_group = None
         return pending
+
+    @staticmethod
+    def _attach_item_spans(item: _WorkItem, handle, fin, now: float) -> None:
+        """Attach this item's engine-side spans to its decision trace at
+        harvest (the first moment all the numbers exist):
+
+        - admission_wait: enqueue -> wave dispatch (queue + coalescing
+          window + group-switch fairness holds);
+        - prefill / decode: the wave's wall time apportioned by token
+          counts (the wave is ONE fused device program — the split is the
+          same token-apportioned estimate sim/arena uses, flagged
+          `apportioned`), carrying suffix/emission token counts.
+
+        Runs on the engine-owner thread; Trace.add_span is lock-guarded
+        for exactly this producer."""
+        cap = item.trace
+        if cap is None:
+            return
+        try:
+            trace, ctx = cap
+            # perf_counter -> wall clock via this item's own enqueue pair
+            wall_offset = item.enqueued_wall - item.enqueued_at
+            submitted = getattr(handle, "submitted_at", item.enqueued_at)
+            admission_ms = max(submitted - item.enqueued_at, 0.0) * 1000.0
+            # publish=False + one flush: on the late-harvest path (root
+            # already recorded) each publishing add_span would pay a full
+            # trace reserialization — batch the three, re-publish once
+            trace.add_span(
+                "admission_wait", start_unix=item.enqueued_wall,
+                dur_ms=admission_ms, parent_id=ctx.span_id, publish=False,
+            )
+            wave_ms = max(now - submitted, 0.0) * 1000.0
+            pf = len(item.suffix_ids or ())
+            dc = len(fin.token_ids)
+            total = pf + dc
+            prefill_ms = wave_ms * pf / total if total else 0.0
+            submit_wall = submitted + wall_offset
+            trace.add_span(
+                "prefill", start_unix=submit_wall, dur_ms=prefill_ms,
+                parent_id=ctx.span_id, tokens=pf, apportioned=True,
+                publish=False,
+            )
+            trace.add_span(
+                "decode", start_unix=submit_wall + prefill_ms / 1000.0,
+                dur_ms=wave_ms - prefill_ms, parent_id=ctx.span_id,
+                tokens=dc, apportioned=True, publish=False,
+            )
+            trace.flush()
+        except Exception:  # tracing must never fail a decision
+            logger.exception("failed to attach engine spans")
 
     def run_quiesced(self, fn, timeout_s: float | None = None):
         """Run `fn()` on the engine-owner thread at a wave barrier.

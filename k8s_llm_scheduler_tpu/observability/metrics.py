@@ -1,13 +1,21 @@
-"""Prometheus-style metrics endpoint.
+"""Prometheus-style metrics endpoint + debug surfaces.
 
 The reference *declares* `metrics: {enabled, port: 9090}` in its config but
 no server exists — the keys are read by nothing (reference config.yaml:29-31,
 SURVEY §5 "dead config"; README.md:184 defers it to future work). This module
 makes the endpoint real: a stdlib ThreadingHTTPServer serving
 
-    /metrics   Prometheus text exposition of the scheduler + engine stats
-    /healthz   liveness (200 when the loop is running)
-    /stats     the full merged stats dict as JSON
+    /metrics           Prometheus text exposition of scheduler + engine stats
+                       (gauges, plus genuine `histogram` families for every
+                       PhaseRecorder phase — `_bucket`/`_sum`/`_count` with
+                       derived p50/p95/p99 gauges beside them)
+    /healthz           liveness (200 when the loop is running)
+    /stats             the full merged stats dict as JSON
+    /debug/decisions   flight-recorder trace summaries (observability/spans;
+                       ?n= limit, ?since= seq cursor for `cli trace tail`)
+    /debug/trace/<id>  one complete decision trace (span tree + metadata)
+    /debug/export      every held trace as JSONL (replayable records)
+    /debug/engine      engine telemetry ring series (observability/sampler)
 
 Stats are pulled from a provider callable at scrape time — no push path,
 no extra locks on the hot path.
@@ -21,14 +29,28 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable
 
+from k8s_llm_scheduler_tpu.observability.trace import BUCKET_BOUNDS_S, HIST_KEY
+
 logger = logging.getLogger(__name__)
 
 _PREFIX = "llm_scheduler"
 
 
+def _escape_label_value(value: str) -> str:
+    """Escape a label VALUE per the Prometheus exposition spec: backslash,
+    double quote, and newline must be escaped or the line is unparseable
+    (a node name or breaker-state string containing any of them previously
+    emitted invalid exposition text)."""
+    return (
+        value.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+    )
+
+
 def _flatten(stats: dict[str, Any], prefix: str = "") -> dict[str, float]:
     out: dict[str, float] = {}
     for key, value in stats.items():
+        if key == HIST_KEY:
+            continue  # histogram payloads render as their own families
         name = f"{prefix}_{key}" if prefix else key
         if isinstance(value, dict):
             out.update(_flatten(value, name))
@@ -38,7 +60,7 @@ def _flatten(stats: dict[str, Any], prefix: str = "") -> dict[str, float]:
             out[name] = float(value)
         # strings (e.g. breaker state) become labeled gauges below
         elif isinstance(value, str):
-            out[f"{name}{{value=\"{value}\"}}"] = 1.0
+            out[f'{name}{{value="{_escape_label_value(value)}"}}'] = 1.0
         elif isinstance(value, (list, tuple)):
             # index-labeled gauges: per-replica lists (fanout_routed) and
             # per-wave arena series (sim/arena) were silently DROPPED
@@ -47,10 +69,37 @@ def _flatten(stats: dict[str, Any], prefix: str = "") -> dict[str, float]:
                 if isinstance(item, dict):
                     out.update(_flatten(item, f"{name}_{i}"))
                 elif isinstance(item, bool):
-                    out[f"{name}{{index=\"{i}\"}}"] = 1.0 if item else 0.0
+                    out[f'{name}{{index="{i}"}}'] = 1.0 if item else 0.0
                 elif isinstance(item, (int, float)):
-                    out[f"{name}{{index=\"{i}\"}}"] = float(item)
+                    out[f'{name}{{index="{i}"}}'] = float(item)
     return out
+
+
+def _collect_histograms(
+    stats: dict[str, Any], prefix: str = ""
+) -> list[tuple[str, dict]]:
+    """(flattened path, histogram payload) pairs for every embedded
+    PhaseRecorder histogram (trace.HIST_KEY dicts) in the stats tree."""
+    out: list[tuple[str, dict]] = []
+    for key, value in stats.items():
+        if not isinstance(value, dict):
+            continue
+        name = f"{prefix}_{key}" if prefix else key
+        hist = value.get(HIST_KEY)
+        if (
+            isinstance(hist, dict)
+            and "counts" in hist
+            and len(hist["counts"]) == len(BUCKET_BOUNDS_S) + 1
+        ):
+            out.append((name, hist))
+        out.extend(_collect_histograms(value, name))
+    return out
+
+
+def _format_bound(bound: float) -> str:
+    """Stable short text for a bucket bound (no float noise in labels)."""
+    text = f"{bound:.10f}".rstrip("0").rstrip(".")
+    return text or "0"
 
 
 def render_prometheus(stats: dict[str, Any]) -> str:
@@ -58,8 +107,11 @@ def render_prometheus(stats: dict[str, Any]) -> str:
     # exactly one `# TYPE <family> gauge` header with its samples contiguous
     # under it — the exposition-format contract scrapers validate (bare
     # samples with no TYPE parse, but registries flag them and typed
-    # queries treat them as untyped). Everything here is a point-in-time
-    # reading of a stats dict, so gauge is the honest type for all of it.
+    # queries treat them as untyped). Point-in-time readings render as
+    # gauges; PhaseRecorder phases additionally render as genuine
+    # `histogram` families (cumulative `_bucket{le=...}` + `_sum`/`_count`)
+    # so bind p99 under burst is a PromQL histogram_quantile away, not a
+    # guess from an average.
     families: dict[str, list[tuple[str, float]]] = {}
     for name, value in sorted(_flatten(stats).items()):
         metric = f"{_PREFIX}_{name}"
@@ -74,11 +126,28 @@ def render_prometheus(stats: dict[str, Any]) -> str:
     for family, samples in families.items():
         lines.append(f"# TYPE {family} gauge")
         lines.extend(f"{metric} {value}" for metric, value in samples)
+    for path, hist in sorted(_collect_histograms(stats)):
+        family = f"{_PREFIX}_{path}_seconds"
+        lines.append(f"# TYPE {family} histogram")
+        acc = 0
+        for bound, count in zip(BUCKET_BOUNDS_S, hist["counts"]):
+            acc += int(count)
+            lines.append(
+                f'{family}_bucket{{le="{_format_bound(bound)}"}} {acc}'
+            )
+        acc += int(hist["counts"][-1])  # overflow bucket
+        lines.append(f'{family}_bucket{{le="+Inf"}} {acc}')
+        lines.append(f"{family}_sum {float(hist['sum_s'])}")
+        lines.append(f"{family}_count {int(hist['count'])}")
     return "\n".join(lines) + "\n"
 
 
 class MetricsServer:
-    """Serve scheduler stats on the (formerly dead) metrics port."""
+    """Serve scheduler stats on the (formerly dead) metrics port.
+
+    `flight_recorder` (default: the global spans.flight) backs the
+    /debug/decisions + /debug/trace surfaces; `engine_sampler` (optional)
+    backs /debug/engine."""
 
     def __init__(
         self,
@@ -86,38 +155,53 @@ class MetricsServer:
         port: int = 9090,
         host: str = "0.0.0.0",
         is_alive: Callable[[], bool] = lambda: True,
+        flight_recorder: Any | None = None,
+        engine_sampler: Any | None = None,
     ) -> None:
+        from k8s_llm_scheduler_tpu.observability import spans
+
         self.stats_provider = stats_provider
         self.is_alive = is_alive
+        self.flight_recorder = (
+            flight_recorder if flight_recorder is not None else spans.flight
+        )
+        self.engine_sampler = engine_sampler
 
-        provider = self.stats_provider
-        alive = self.is_alive
+        server = self
 
         class Handler(BaseHTTPRequestHandler):
+            # Socket deadline for the whole exchange: a stalled scraper
+            # (connects, never finishes its request, or stops reading the
+            # response) must not pin a handler thread forever.
+            timeout = 10.0
+
             def do_GET(self) -> None:  # noqa: N802
                 try:
-                    if self.path.startswith("/metrics"):
-                        body = render_prometheus(provider()).encode()
-                        ctype = "text/plain; version=0.0.4"
-                        code = 200
-                    elif self.path.startswith("/healthz"):
-                        ok = alive()
-                        body = (b"ok" if ok else b"not running")
-                        ctype = "text/plain"
-                        code = 200 if ok else 503
-                    elif self.path.startswith("/stats"):
-                        body = json.dumps(provider()).encode()
-                        ctype = "application/json"
-                        code = 200
-                    else:
-                        body, ctype, code = b"not found", "text/plain", 404
+                    body, ctype, code = server._route(self.path)
                 except Exception as exc:  # pragma: no cover
                     body, ctype, code = str(exc).encode(), "text/plain", 500
-                self.send_response(code)
-                self.send_header("Content-Type", ctype)
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
+                try:
+                    self.send_response(code)
+                    self.send_header("Content-Type", ctype)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                except (BrokenPipeError, ConnectionResetError, TimeoutError):
+                    # Client disconnected mid-write (or stopped reading past
+                    # the socket timeout): nothing to deliver to, and a
+                    # traceback from the handler thread helps nobody.
+                    self.close_connection = True
+
+            def handle(self) -> None:
+                # BaseHTTPRequestHandler surfaces a socket timeout (the
+                # class attr above) by raising from rfile reads; contain it
+                # like a disconnect instead of dumping a thread traceback.
+                try:
+                    super().handle()
+                except (
+                    BrokenPipeError, ConnectionResetError, TimeoutError
+                ):
+                    self.close_connection = True
 
             def log_message(self, fmt: str, *args: Any) -> None:
                 logger.debug("metrics: " + fmt, *args)
@@ -128,9 +212,84 @@ class MetricsServer:
             target=self._server.serve_forever, daemon=True, name="metrics"
         )
 
+    # ------------------------------------------------------------- routing
+    @staticmethod
+    def _query_int(path: str, key: str, default: int) -> int:
+        from urllib.parse import parse_qs, urlsplit
+
+        try:
+            values = parse_qs(urlsplit(path).query).get(key)
+            return int(values[0]) if values else default
+        except (ValueError, TypeError):
+            return default
+
+    def _route(self, path: str) -> tuple[bytes, str, int]:
+        if path.startswith("/metrics"):
+            return (
+                render_prometheus(self.stats_provider()).encode(),
+                "text/plain; version=0.0.4",
+                200,
+            )
+        if path.startswith("/healthz"):
+            ok = self.is_alive()
+            return (b"ok" if ok else b"not running"), "text/plain", (
+                200 if ok else 503
+            )
+        if path.startswith("/stats"):
+            return (
+                json.dumps(self.stats_provider()).encode(),
+                "application/json",
+                200,
+            )
+        if path.startswith("/debug/decisions"):
+            body = json.dumps({
+                "recorder": self.flight_recorder.stats(),
+                "traces": self.flight_recorder.list(
+                    n=self._query_int(path, "n", 50),
+                    since_seq=self._query_int(path, "since", 0),
+                ),
+            }).encode()
+            return body, "application/json", 200
+        if path.startswith("/debug/trace/"):
+            from urllib.parse import urlsplit
+
+            trace_id = urlsplit(path).path[len("/debug/trace/"):]
+            entry = self.flight_recorder.get(trace_id)
+            if entry is None:
+                return b"trace not found (ring may have evicted it)", (
+                    "text/plain"
+                ), 404
+            return json.dumps(entry).encode(), "application/json", 200
+        if path.startswith("/debug/export"):
+            return (
+                self.flight_recorder.export_jsonl().encode(),
+                "application/x-ndjson",
+                200,
+            )
+        if path.startswith("/debug/engine"):
+            if self.engine_sampler is None:
+                return b"no engine sampler attached", "text/plain", 404
+            if self.engine_sampler.samples_taken == 0:
+                # cold sampler (queried before its first interval): tick
+                # it once so the endpoint answers with data, not an empty
+                # ring — sample_once is read-only against the engine
+                try:
+                    self.engine_sampler.sample_once()
+                except Exception:
+                    logger.exception("cold engine sample failed")
+            return (
+                json.dumps(self.engine_sampler.series()).encode(),
+                "application/json",
+                200,
+            )
+        return b"not found", "text/plain", 404
+
     def start(self) -> None:
         self._thread.start()
-        logger.info("metrics endpoint on :%d (/metrics /healthz /stats)", self.port)
+        logger.info(
+            "metrics endpoint on :%d (/metrics /healthz /stats /debug/*)",
+            self.port,
+        )
 
     def stop(self) -> None:
         self._server.shutdown()

@@ -154,6 +154,7 @@ class CanaryController:
         trip_fallback_rate: float = 0.2,
         trip_invalid_rate: float = 0.05,
         trip_bind_failure_rate: float = 0.05,
+        trip_decide_p99_ms: float | None = None,
         clock: Callable[[], float] = time.monotonic,
     ) -> None:
         self.registry = registry
@@ -177,6 +178,13 @@ class CanaryController:
         self.trip_fallback_rate = float(trip_fallback_rate)
         self.trip_invalid_rate = float(trip_invalid_rate)
         self.trip_bind_failure_rate = float(trip_bind_failure_rate)
+        # Optional latency trip: decide p99 over the burn-in WINDOW (from
+        # PhaseRecorder histogram bucket deltas — a lifetime average would
+        # dilute a fresh regression under the incumbent's history). None
+        # disables; rates/percentiles are always recorded either way.
+        self.trip_decide_p99_ms = (
+            None if trip_decide_p99_ms is None else float(trip_decide_p99_ms)
+        )
         self.clock = clock
         self.rejected: set[int] = set()
         self._burn: dict | None = None
@@ -246,16 +254,20 @@ class CanaryController:
             return verdict
         self.registry.set_active(version)
         self.counters["promotions"] += 1
-        baseline = (
-            self._signals(self.stats_provider())
-            if self.stats_provider is not None
-            else None
-        )
+        baseline = phases_baseline = None
+        if self.stats_provider is not None:
+            stats_now = self.stats_provider()
+            baseline = self._signals(stats_now)
+            # phases snapshot at promotion: burn-in latency percentiles
+            # come from HISTOGRAM DELTAS against this (only the window's
+            # own decisions, not lifetime averages)
+            phases_baseline = stats_now.get("phases", {})
         self._burn = {
             "version": version,
             "prior": prior,
             "started": self.clock(),
             "baseline": baseline,
+            "phases_baseline": phases_baseline,
         }
         verdict["action"] = "promoted"
         verdict["swap"] = swap
@@ -275,7 +287,8 @@ class CanaryController:
         if baseline is None:
             self._burn = None
             return "ok"
-        now_sig = self._signals(self.stats_provider())
+        now_stats = self.stats_provider()
+        now_sig = self._signals(now_stats)
         delta_n = now_sig["decisions"] - baseline["decisions"]
         if delta_n < self.burn_in_decisions:
             return None
@@ -286,6 +299,24 @@ class CanaryController:
                 now_sig["failed_bindings"] - baseline["failed_bindings"]
             ) / delta_n,
         }
+        # Window latency percentiles (histogram bucket deltas vs the
+        # promotion-time snapshot): recorded always, tripping only when a
+        # trip_decide_p99_ms budget is configured.
+        from k8s_llm_scheduler_tpu.observability.trace import (
+            delta_hist,
+            hist_percentiles,
+        )
+
+        phases_base = self._burn.get("phases_baseline") or {}
+        dh = delta_hist(
+            phases_base.get("decide"),
+            now_stats.get("phases", {}).get("decide"),
+        )
+        if dh and dh["count"]:
+            p50, p95, p99 = hist_percentiles(dh["counts"])
+            rates["decide_p50_ms"] = round(p50, 3)
+            rates["decide_p95_ms"] = round(p95, 3)
+            rates["decide_p99_ms"] = round(p99, 3)
         trips = {
             "fallback_rate": rates["fallback_rate"] > self.trip_fallback_rate,
             "invalid_rate": rates["invalid_rate"] > self.trip_invalid_rate,
@@ -293,6 +324,19 @@ class CanaryController:
                 rates["bind_failure_rate"] > self.trip_bind_failure_rate
             ),
         }
+        if (
+            self.trip_decide_p99_ms is not None
+            and "decide_p99_ms" in rates
+        ):
+            # The percentile estimate is the UPPER bound of a 2x-spaced
+            # bucket (observability/trace.hist_percentiles), so the true
+            # p99 lies in (est/2, est]. Trip on the LOWER bound: est/2 >
+            # budget guarantees the true p99 exceeded it — comparing the
+            # upper bound directly would spuriously roll back healthy
+            # candidates whose true p99 sits at ~half the budget.
+            trips["decide_p99_ms"] = (
+                rates["decide_p99_ms"] / 2.0 > self.trip_decide_p99_ms
+            )
         version = self._burn["version"]
         prior = self._burn["prior"]
         self._burn = None

@@ -29,6 +29,7 @@ import time
 from collections.abc import Sequence
 
 from k8s_llm_scheduler_tpu.core.breaker import CircuitBreaker, CircuitOpenError
+from k8s_llm_scheduler_tpu.observability import spans
 from k8s_llm_scheduler_tpu.core.cache import DecisionCache, decision_cache_key
 from k8s_llm_scheduler_tpu.core.fallback import fallback_decision
 from k8s_llm_scheduler_tpu.core.validation import validate_decision
@@ -114,6 +115,9 @@ class DecisionClient:
     def _fallback(
         self, nodes: Sequence[NodeMetrics], reason: str, pod: PodSpec | None = None
     ) -> SchedulingDecision | None:
+        trace = spans.current_trace()
+        if trace is not None:
+            trace.meta["fallback_reason"] = reason
         if not self.fallback_enabled:
             return None
         decision = fallback_decision(
@@ -181,16 +185,24 @@ class DecisionClient:
             # (unreachable), not the new one (rollout/hotswap.py).
             key = decision_cache_key(pod, nodes)
             generation = self.cache.generation
+            trace = spans.current_trace()
+            if trace is not None:
+                # prompt/decision identity for the flight recorder: the
+                # cache key digests (pod shape, cluster snapshot) — the
+                # same equivalence class the prompt prefix is keyed by
+                trace.meta["cache_key"] = key[:16]
+                trace.meta["cache_generation"] = generation
             cached = self.cache.get(pod, nodes, key=key)
             if cached is not None:
                 self.stats["cached_requests"] += 1
                 return dataclasses.replace(cached, source=DecisionSource.CACHE)
             existing = self._inflight.get(key)
             if existing is not None:
-                try:
-                    leader = await asyncio.shield(existing)
-                except Exception:
-                    leader = None
+                with spans.span("coalesce_wait"):
+                    try:
+                        leader = await asyncio.shield(existing)
+                    except Exception:
+                        leader = None
                 if leader is not None:
                     self.stats["coalesced_requests"] += 1
                     self.stats["cached_requests"] += 1
@@ -238,7 +250,8 @@ class DecisionClient:
         for attempt in range(self.max_retries):
             start = time.perf_counter()  # per attempt: excludes backoff sleeps
             try:
-                decision = await self._call_backend_async(pod, nodes)
+                with spans.span("backend", attempt=attempt):
+                    decision = await self._call_backend_async(pod, nodes)
             except CircuitOpenError as exc:
                 logger.warning("circuit open, using fallback: %s", exc)
                 return self._fallback(nodes, "circuit_open", pod)

@@ -32,11 +32,28 @@ from k8s_llm_scheduler_tpu.cluster.interface import (
     RawPod,
     raw_pod_to_spec,
 )
+from k8s_llm_scheduler_tpu.observability import spans
 from k8s_llm_scheduler_tpu.observability.trace import PhaseRecorder
 from k8s_llm_scheduler_tpu.sched.client import DecisionClient
 from k8s_llm_scheduler_tpu.types import DecisionSource, NodeMetrics
 
 logger = logging.getLogger(__name__)
+
+
+def _stamp_decision(trace, decision) -> None:
+    """THE decision-metadata stamp (full, fast, and follower paths all
+    converge here so /debug/decisions entries carry one field set)."""
+    if trace is not None:
+        trace.meta.update(
+            source=decision.source.value,
+            selected_node=decision.selected_node,
+            confidence=decision.confidence,
+        )
+
+
+def _stamp_outcome(trace, outcome: str) -> None:
+    if trace is not None:
+        trace.meta["outcome"] = outcome
 
 
 class Scheduler:
@@ -114,17 +131,29 @@ class Scheduler:
         """One pod through the full pipeline (reference scheduler.py:690-729).
         Returns True iff the pod was bound. `pod` is the optional
         already-converted PodSpec (the fast path computes it before falling
-        through; don't pay raw_pod_to_spec twice on the ingest hot path)."""
+        through; don't pay raw_pod_to_spec twice on the ingest hot path).
+
+        Each pod gets its own flight-recorder trace (observability/spans):
+        snapshot/decide/bind child spans here, backend/admission/prefill/
+        decode spans attached downstream (sched/client, engine/local), so
+        "why was THIS placement slow?" is answerable from /debug/trace."""
         if pod is None:
             pod = raw_pod_to_spec(raw)
-        with self.phases.phase("snapshot"):
+        with spans.start_trace(
+            "decision", pod=f"{pod.namespace}/{pod.name}", path="full"
+        ) as trace:
+            return await self._schedule_pod_inner(pod, trace)
+
+    async def _schedule_pod_inner(self, pod, trace) -> bool:
+        with self.phases.phase("snapshot"), spans.span("snapshot"):
             nodes = await self._node_snapshot()
         if not nodes:
             logger.warning("no nodes in cluster, leaving %s pending", pod.name)
             self.stats["unschedulable"] += 1
+            _stamp_outcome(trace, "unschedulable")
             return False
 
-        with self.phases.phase("decide"):
+        with self.phases.phase("decide"), spans.span("decide"):
             # The semaphore is passed THROUGH: the client acquires it only
             # around real backend work. Cache hits and single-flight
             # follower waits never hold a slot (during a burst, followers
@@ -136,6 +165,7 @@ class Scheduler:
             )
         if decision is None:
             self.stats["unschedulable"] += 1
+            _stamp_outcome(trace, "unschedulable")
             return False
 
         if decision.source is DecisionSource.FALLBACK:
@@ -144,6 +174,7 @@ class Scheduler:
             self.stats["cache_decisions"] += 1
         else:
             self.stats["llm_decisions"] += 1
+        _stamp_decision(trace, decision)
 
         if self.shadow is not None:
             # Non-binding candidate mirror (rollout/shadow.py): one counter
@@ -165,12 +196,13 @@ class Scheduler:
             # flood of cache-hit binds can't saturate the executor and
             # starve _node_snapshot's to_thread behind it.
             async with self._bind_sem:
-                with self.phases.phase("bind"):
+                with self.phases.phase("bind"), spans.span("bind"):
                     ok = await asyncio.to_thread(
                         self.binder.bind_pod_to_node,
                         pod.name, pod.namespace, decision.selected_node,
                     )
             self._note_bind(ok, pod, decision)
+        _stamp_outcome(trace, "bound" if ok else "bind_failed")
         if not ok:
             return False
         logger.info(
@@ -218,38 +250,54 @@ class Scheduler:
             return False, None
         pod = raw_pod_to_spec(raw)
         t0 = time.perf_counter()
+        t0_wall = time.time()
         decision, fut = self.client.fast_decision(pod, nodes)
         if decision is not None:
             # Record the decide phase only when the fast path handles the
             # pod — an unhandled probe falls through to schedule_pod, which
             # records its own decide (double counting otherwise).
-            self.phases.record("decide", time.perf_counter() - t0)
+            decide_s = time.perf_counter() - t0
+            self.phases.record("decide", decide_s)
             self.stats["cache_decisions"] += 1
-            try:
-                self._bind_now(pod, decision)
-            except Exception:
-                # Contained HERE, pod counts as handled: re-running it
-                # through the full path would double-count the decide/cache
-                # stats just recorded (and could double-bind). A raising
-                # binder is accounted like a failed bind; the pod stays
-                # Pending and the watch re-observes it.
-                self.stats["failed_bindings"] += 1
-                logger.exception(
-                    "fast-path bind raised: %s/%s", pod.namespace, pod.name
-                )
+            # backdated to the watch event: the trace opens after the
+            # cache hit resolved, but its root must cover decide + bind
+            with spans.start_trace(
+                "decision", pod=f"{pod.namespace}/{pod.name}", path="fast",
+                start_unix=t0_wall, start_perf=t0,
+            ) as trace:
+                if trace is not None:
+                    trace.add_span(
+                        "decide", start_unix=t0_wall,
+                        dur_ms=decide_s * 1000.0, cache_hit=True,
+                    )
+                _stamp_decision(trace, decision)
+                try:
+                    ok = self._bind_now(pod, decision)
+                    _stamp_outcome(trace, "bound" if ok else "bind_failed")
+                except Exception:
+                    # Contained HERE, pod counts as handled: re-running it
+                    # through the full path would double-count the decide/
+                    # cache stats just recorded (and could double-bind). A
+                    # raising binder is accounted like a failed bind; the
+                    # pod stays Pending and the watch re-observes it.
+                    self.stats["failed_bindings"] += 1
+                    _stamp_outcome(trace, "bind_raised")
+                    logger.exception(
+                        "fast-path bind raised: %s/%s", pod.namespace, pod.name
+                    )
             return True, pod
         if fut is not None:
             batch = self._followers.get(fut)
             if batch is None:
                 self._followers[fut] = batch = []
                 fut.add_done_callback(self._flush_followers)
-            batch.append((raw, pod, t0))
+            batch.append((raw, pod, t0, t0_wall))
             return True, pod
         return False, pod
 
     def _bind_now(self, pod, decision) -> bool:
         """Synchronous bind + bookkeeping (nonblocking binders only)."""
-        with self.phases.phase("bind"):
+        with self.phases.phase("bind"), spans.span("bind"):
             ok = self.binder.bind_pod_to_node(
                 pod.name, pod.namespace, decision.selected_node
             )
@@ -281,7 +329,7 @@ class Scheduler:
             self.client.note_coalesced(len(batch))
             decision = dataclasses.replace(leader, source=DecisionSource.CACHE)
             now = time.perf_counter()
-            for _raw, pod, parked_at in batch:
+            for _raw, pod, parked_at, parked_wall in batch:
                 # Per-item isolation: one raising bind must not drop the
                 # rest of the batch (this runs in a future done-callback).
                 try:
@@ -289,7 +337,22 @@ class Scheduler:
                     # matching what the shield-await path used to measure
                     self.phases.record("decide", now - parked_at)
                     self.stats["cache_decisions"] += 1
-                    self._bind_now(pod, decision)
+                    # backdated to the park time: the root covers the
+                    # whole park -> leader -> bind interval, not just bind
+                    with spans.start_trace(
+                        "decision", pod=f"{pod.namespace}/{pod.name}",
+                        path="follower",
+                        start_unix=parked_wall, start_perf=parked_at,
+                    ) as trace:
+                        if trace is not None:
+                            trace.add_span(
+                                "decide", start_unix=parked_wall,
+                                dur_ms=(now - parked_at) * 1000.0,
+                                coalesced=True,
+                            )
+                        _stamp_decision(trace, decision)
+                        ok = self._bind_now(pod, decision)
+                        _stamp_outcome(trace, "bound" if ok else "bind_failed")
                 except Exception:
                     self.stats["failed_bindings"] += 1
                     logger.exception(
@@ -298,7 +361,7 @@ class Scheduler:
         else:
             # leader failed or fell back: each follower decides on the full
             # path (which records its own decide phase)
-            for raw, pod, _t0 in batch:
+            for raw, pod, _t0, _t0w in batch:
                 task = asyncio.create_task(self._spawn(raw, pod))
                 self._tasks.add(task)
                 task.add_done_callback(self._tasks.discard)

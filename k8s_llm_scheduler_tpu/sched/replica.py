@@ -56,6 +56,7 @@ from k8s_llm_scheduler_tpu.engine.backend import (
     DecisionBackend,
     NoFeasibleNodeError,
 )
+from k8s_llm_scheduler_tpu.observability import spans
 from k8s_llm_scheduler_tpu.types import (
     DecisionSource,
     NodeMetrics,
@@ -284,8 +285,33 @@ class ReplicaServer:
             else:
                 pod = pod_from_wire(req["pod"])
                 nodes = [node_from_wire(n) for n in req["nodes"]]
-                decision = self.backend.get_scheduling_decision(pod, nodes)
-                resp = {"id": rid, "decision": decision_to_wire(decision)}
+                wire_trace = req.get("trace")
+                if wire_trace and spans.enabled():
+                    # Continue the COORDINATOR's trace on this side: same
+                    # trace id, rooted under the caller's span, so the
+                    # stitched tree shows exactly where the wire hop sits.
+                    # The worker-side spans ride back in the response for
+                    # the client to graft (ReplicaClient._resolve); the
+                    # worker's own flight recorder keeps a copy too.
+                    with spans.start_trace(
+                        "replica.decide",
+                        trace_id=str(wire_trace.get("trace_id")),
+                        parent_id=str(wire_trace.get("span_id")),
+                        pod=f"{pod.namespace}/{pod.name}",
+                    ) as rtrace:
+                        decision = self.backend.get_scheduling_decision(
+                            pod, nodes
+                        )
+                    resp = {
+                        "id": rid,
+                        "decision": decision_to_wire(decision),
+                        "spans": [s.to_dict() for s in rtrace.spans]
+                        if rtrace is not None
+                        else [],
+                    }
+                else:
+                    decision = self.backend.get_scheduling_decision(pod, nodes)
+                    resp = {"id": rid, "decision": decision_to_wire(decision)}
             with self._served_lock:
                 self.served += 1
         except NoFeasibleNodeError as exc:
@@ -535,10 +561,17 @@ class ReplicaClient:
     def _submit(
         self, pod: PodSpec, nodes: Sequence[NodeMetrics]
     ) -> tuple[int, Future, socket.socket]:
-        return self._submit_frame({
+        payload = {
             "pod": pod_to_wire(pod),
             "nodes": [node_to_wire(n) for n in nodes],
-        })
+        }
+        # Trace propagation: the ambient decision trace's (trace_id,
+        # span_id) rides the frame so the worker's spans stitch into ONE
+        # cross-host tree (ReplicaServer returns them in the response).
+        wire_trace = spans.wire_context()
+        if wire_trace is not None:
+            payload["trace"] = wire_trace
+        return self._submit_frame(payload)
 
     def prewarm_prefix(self, nodes: Sequence[NodeMetrics]) -> Future:
         """Forward an advisory prefix install to the worker's backend
@@ -617,6 +650,13 @@ class ReplicaClient:
 
     def _resolve(self, resp: dict) -> SchedulingDecision:
         if "decision" in resp:
+            remote_spans = resp.get("spans")
+            if remote_spans:
+                trace = spans.current_trace()
+                if trace is not None:
+                    # merge_remote_spans drops spans whose trace id does
+                    # not match — a desynced frame cannot pollute the tree
+                    trace.merge_remote_spans(remote_spans)
             return decision_from_wire(resp["decision"])
         if resp.get("kind") == "infeasible":
             raise NoFeasibleNodeError(resp.get("error", ""))

@@ -465,6 +465,22 @@ def _wave_attribution(
     decide_ms = _phase_delta(phases_before, phases_after, "decide")
     bind_ms = _phase_delta(phases_before, phases_after, "bind")
     admission_ms = max(decide_ms - backend_ms, 0.0)
+    # Window percentiles from HISTOGRAM bucket deltas (observability/trace):
+    # this wave's own decide/bind p50/p95 — a per-wave total only says how
+    # much time was spent, not how it was distributed over the wave's pods
+    # (the avg hid exactly the tail the attribution exists to expose).
+    from k8s_llm_scheduler_tpu.observability.trace import (
+        delta_hist,
+        hist_percentiles,
+    )
+
+    phase_pcts = {}
+    for phase in ("decide", "bind"):
+        dh = delta_hist(phases_before.get(phase), phases_after.get(phase))
+        if dh and dh["count"]:
+            p50, p95, _ = hist_percentiles(dh["counts"])
+            phase_pcts[f"{phase}_p50_ms"] = round(p50, 3)
+            phase_pcts[f"{phase}_p95_ms"] = round(p95, 3)
     out = {
         "wave": wave_idx,
         "n_pods": n,
@@ -481,6 +497,7 @@ def _wave_attribution(
         "bind_ms": round(bind_ms, 3),
         "backend_ms": round(backend_ms, 3),
         "admission_ms": round(admission_ms, 3),
+        **phase_pcts,
     }
     pf = engine_after.get("prefill_tokens", 0) - engine_before.get(
         "prefill_tokens", 0

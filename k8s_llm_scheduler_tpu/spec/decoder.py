@@ -156,10 +156,27 @@ class SpeculativeDecoder:
         # slot's pages and the first token samples exactly as plain decode.
         req_id = eng.add_request(prompt_ids, max_new_tokens)
         slot = next(s for s, r in eng._by_slot.items() if r.req_id == req_id)
+        from k8s_llm_scheduler_tpu.observability import spans
+
+        # one span for the whole speculative decode, carrying the round's
+        # accept/reject deltas — per-round spans would be dozens per request
+        s0 = self.stats
+        before = (s0.proposed, s0.accepted, s0.rounds, s0.disables)
         try:
-            return self._generate_admitted(
-                req_id, slot, prompt_ids, max_new_tokens
-            )
+            with spans.span("spec_decode") as sp:
+                out = self._generate_admitted(
+                    req_id, slot, prompt_ids, max_new_tokens
+                )
+                if sp is not None:
+                    sp.attrs.update(
+                        proposed=s0.proposed - before[0],
+                        accepted=s0.accepted - before[1],
+                        rejected=(s0.proposed - before[0])
+                        - (s0.accepted - before[1]),
+                        rounds=s0.rounds - before[2],
+                        disabled=bool(s0.disables - before[3]),
+                    )
+            return out
         except Exception:
             # Mirror add_requests' rollback: a failed round must not leak
             # the slot or its pages (no later recovery path would — the

@@ -144,6 +144,71 @@ class TestPhaseRecorder:
         assert rec.snapshot() == {}
 
 
+class TestTraceCLI:
+    """`cli trace` against a live MetricsServer: list/show/export round-trip
+    the flight recorder over the /debug endpoints."""
+
+    @pytest.fixture()
+    def served_trace(self):
+        from k8s_llm_scheduler_tpu.observability import spans
+
+        old = spans.flight
+        spans.flight = rec = spans.FlightRecorder(capacity=16)
+        spans.configure(enabled=True)
+        with spans.start_trace("decision", pod="ns/demo") as t:
+            with spans.span("decide"):
+                pass
+            t.meta.update(source="llm", selected_node="node-1",
+                          outcome="bound")
+        server = MetricsServer(
+            lambda: {}, port=0, host="127.0.0.1", flight_recorder=rec,
+        )
+        server.start()
+        yield server, t
+        server.stop()
+        spans.flight = old
+
+    def test_trace_list_show_export(self, served_trace, capsys, tmp_path):
+        from k8s_llm_scheduler_tpu.cli import main
+
+        server, trace = served_trace
+        rc = main(["trace", "list", "--port", str(server.port)])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert trace.trace_id in out
+        assert "node-1" in out
+
+        rc = main(["trace", "show", trace.trace_id,
+                   "--port", str(server.port)])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "decision" in out and "decide" in out
+
+        out_file = tmp_path / "traces.jsonl"
+        rc = main(["trace", "export", "--port", str(server.port),
+                   "--out", str(out_file)])
+        assert rc == 0
+        entry = json.loads(out_file.read_text().splitlines()[0])
+        assert entry["trace_id"] == trace.trace_id
+
+    def test_trace_show_missing_id(self, served_trace, capsys):
+        from k8s_llm_scheduler_tpu.cli import main
+
+        server, _ = served_trace
+        rc = main(["trace", "show", "no-such-id",
+                   "--port", str(server.port)])
+        assert rc == 1
+        assert "not found" in capsys.readouterr().err
+
+    def test_trace_unreachable_endpoint(self, capsys):
+        from k8s_llm_scheduler_tpu.cli import main
+
+        # closed port: a clean pointer at metrics.enabled, not a traceback
+        rc = main(["trace", "list", "--port", "1"])
+        assert rc == 2
+        assert "metrics.enabled" in capsys.readouterr().err
+
+
 class TestCLI:
     def test_verify_fast(self, capsys):
         from k8s_llm_scheduler_tpu.cli import main

@@ -35,6 +35,12 @@ class TestRepoIsClean:
         assert "k8s_llm_scheduler_tpu/rollout/hotswap.py" in files
         assert "k8s_llm_scheduler_tpu/rollout/registry.py" in files
         assert "tests/test_rollout.py" in files
+        # observability round: span tracing + sampler modules (contextvars-
+        # heavy async code is exactly where 3.11+-only asyncio APIs creep in)
+        assert "k8s_llm_scheduler_tpu/observability/spans.py" in files
+        assert "k8s_llm_scheduler_tpu/observability/sampler.py" in files
+        assert "k8s_llm_scheduler_tpu/observability/metrics.py" in files
+        assert "tests/test_observability.py" in files
         # the lint never lints its own pattern table
         assert "tools/py310_lint.py" not in files
 

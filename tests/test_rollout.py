@@ -652,6 +652,61 @@ class TestCanaryController:
         assert swapper.calls == [2]
         assert registry.get(2).scores["burn_in"]["tripped"] == []
 
+    def test_burn_in_latency_trip_from_histogram_window(self, tmp_path):
+        """trip_decide_p99_ms uses the burn-in WINDOW's histogram delta
+        (observability/trace) and compares the bucket's LOWER bound so a
+        healthy candidate whose true p99 merely shares a 2x bucket with
+        the budget is never spuriously rolled back."""
+        from k8s_llm_scheduler_tpu.observability.trace import PhaseRecorder
+
+        def build(trip_ms, window_latency_s, tag=""):
+            base = tmp_path / f"case{tag}"
+            base.mkdir()
+            registry = self._registry(base, n=2)
+            registry.set_active(1)
+            swapper = FakeSwapper()
+            rec = PhaseRecorder()
+            rec.record("decide", 0.001)  # pre-promotion history
+            stats = {
+                "llm_decisions": 0, "cache_decisions": 0,
+                "fallback_decisions": 0, "failed_bindings": 0,
+                "client": {"invalid_decisions": 0},
+            }
+            controller = CanaryController(
+                registry, swapper,
+                stats_provider=lambda: {
+                    **stats, "client": dict(stats["client"]),
+                    "phases": rec.snapshot(),
+                },
+                gate_runner=lambda v: {"pass": True, "checks": {}},
+                burn_in_decisions=100,
+                trip_decide_p99_ms=trip_ms,
+            )
+            controller.tick()  # promote v2, baseline captured
+            for _ in range(120):
+                rec.record("decide", window_latency_s)
+            stats["llm_decisions"] = 120
+            return controller, registry, rec
+
+        # window p99 ~3.2s against a 100ms budget: certain regression
+        controller, registry, _ = build(
+            trip_ms=100.0, window_latency_s=3.0, tag="trip"
+        )
+        assert controller.tick() == "rolled_back"
+        burn = registry.get(2).scores["burn_in"]
+        assert "decide_p99_ms" in burn["tripped"]
+        assert burn["rates"]["decide_p99_ms"] >= 3000.0
+
+        # window p99 estimate 102.4ms (true 60ms) against a 100ms budget:
+        # upper-bound comparison would spuriously trip; lower-bound must not
+        controller, registry, _ = build(
+            trip_ms=100.0, window_latency_s=0.06, tag="ok"
+        )
+        assert controller.tick() == "ok"
+        burn = registry.get(2).scores["burn_in"]
+        assert burn["tripped"] == []
+        assert burn["rates"]["decide_p99_ms"] == pytest.approx(102.4)
+
     def test_gate_fail_rejects_without_swapping(self, tmp_path):
         registry = self._registry(tmp_path, n=2)
         registry.set_active(1)
