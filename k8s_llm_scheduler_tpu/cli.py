@@ -20,6 +20,7 @@ import importlib
 import json
 import logging
 import sys
+from pathlib import Path
 from typing import Any
 
 from k8s_llm_scheduler_tpu.config import Config, load_config
@@ -1272,6 +1273,29 @@ def cmd_trace(args: argparse.Namespace, cfg: Config) -> int:
     raise SystemExit(f"unknown trace command {args.trace_cmd!r}")
 
 
+def cmd_lint(args: argparse.Namespace, cfg: Config) -> int:
+    """graftlint over the first-party tree (tools/graftlint): the AST
+    concurrency + JAX-purity rule families plus the py310 checks, with
+    the framework's exit-code contract (0 clean / 1 findings / 2 usage
+    error). `--rules` filters by rule id or family; `--format jsonl`
+    emits one JSON object per finding for CI consumers."""
+    repo_root = Path(__file__).resolve().parent.parent
+    if str(repo_root) not in sys.path:
+        # `tools` is a repo-root package, not part of the installed
+        # k8s_llm_scheduler_tpu distribution
+        sys.path.insert(0, str(repo_root))
+    from tools.graftlint.__main__ import main as graftlint_main
+
+    argv: list[str] = []
+    if args.list_rules:
+        argv.append("--list-rules")
+    if args.rules:
+        argv.extend(["--rules", args.rules])
+    argv.extend(["--format", args.lint_format])
+    argv.extend(args.paths)
+    return graftlint_main(argv)
+
+
 def cmd_complete(args: argparse.Namespace, cfg: Config) -> int:
     """Free-form generation through the PAGED continuous-batching path —
     the general-completion capability the reference gets from its remote
@@ -1352,7 +1376,7 @@ def cmd_complete(args: argparse.Namespace, cfg: Config) -> int:
                 ids[-tail:], max_new_tokens=args.max_new_tokens
             )
             if trace is not None:
-                trace.meta["generated_tokens"] = len(fin.token_ids)
+                trace.set_meta(generated_tokens=len(fin.token_ids))
         print(fin.text)
         logger.info(
             "completed %d tokens in %.1f ms%s", len(fin.token_ids),
@@ -1637,6 +1661,28 @@ def main(argv: list[str] | None = None) -> int:
     ))
     p_texport.add_argument("--out", default=None, help="file (default stdout)")
 
+    p_lint = sub.add_parser(
+        "lint",
+        help="graftlint: AST concurrency & JAX-purity analyzer + py310 "
+             "checks over the first-party tree (tools/graftlint)",
+    )
+    p_lint.add_argument(
+        "--rules", default=None,
+        help="comma-separated rule ids or families (concurrency, jax, "
+             "py310); default: all",
+    )
+    p_lint.add_argument(
+        "--format", choices=("human", "jsonl"), default="human",
+        dest="lint_format",
+    )
+    p_lint.add_argument(
+        "--list-rules", action="store_true", help="print the rule catalog",
+    )
+    p_lint.add_argument(
+        "paths", nargs="*",
+        help="files to lint (default: the whole first-party tree)",
+    )
+
     p_complete = sub.add_parser(
         "complete",
         help="free-form text completion (paged continuous-batching path)",
@@ -1683,6 +1729,7 @@ def main(argv: list[str] | None = None) -> int:
         "sim": cmd_sim,
         "rollout": cmd_rollout,
         "trace": cmd_trace,
+        "lint": cmd_lint,
         "complete": cmd_complete,
     }
     return handlers[args.command](args, cfg)

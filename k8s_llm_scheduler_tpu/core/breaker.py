@@ -62,10 +62,16 @@ class CircuitBreaker:
     @property
     def state(self) -> CircuitState:
         with self._lock:
-            return self._effective_state()
+            return self._effective_state_locked()
 
-    def _effective_state(self) -> CircuitState:
-        """OPEN decays to HALF_OPEN after the cooldown (scheduler.py:311-314)."""
+    def _effective_state_locked(self) -> CircuitState:
+        """OPEN decays to HALF_OPEN after the cooldown (scheduler.py:311-314).
+
+        Writes `self._state`; caller holds self._lock — the `*_locked`
+        suffix is the repo's called-with-lock-held contract (cluster/
+        kube.py convention, enforced by graftlint's unguarded-attr-write
+        rule: this PR's sweep found the old name `_effective_state`
+        carrying a lock-guarded write with no visible contract)."""
         if (
             self._state is CircuitState.OPEN
             and time.monotonic() - self._opened_at >= self.timeout_seconds
@@ -77,7 +83,7 @@ class CircuitBreaker:
         """Shared admission gate; returns True when this call is the
         HALF_OPEN probe (caller must release via _release_probe)."""
         with self._lock:
-            state = self._effective_state()
+            state = self._effective_state_locked()
             if state is CircuitState.OPEN:
                 raise CircuitOpenError(
                     f"circuit open for {self.timeout_seconds - (time.monotonic() - self._opened_at):.1f}s more"
@@ -139,14 +145,14 @@ class CircuitBreaker:
 
     def record_success(self) -> None:
         with self._lock:
-            if self._effective_state() is CircuitState.HALF_OPEN:
+            if self._effective_state_locked() is CircuitState.HALF_OPEN:
                 self._state = CircuitState.CLOSED
             self._failure_count = 0
 
     def record_failure(self) -> None:
         with self._lock:
             self._failure_count += 1
-            state = self._effective_state()
+            state = self._effective_state_locked()
             if state is CircuitState.HALF_OPEN or self._failure_count >= self.failure_threshold:
                 if self._state is not CircuitState.OPEN:
                     self.trip_count += 1
@@ -161,7 +167,7 @@ class CircuitBreaker:
     def stats(self) -> dict[str, Any]:
         with self._lock:
             return {
-                "state": self._effective_state().value,
+                "state": self._effective_state_locked().value,
                 "failure_count": self._failure_count,
                 "trips": self.trip_count,
             }

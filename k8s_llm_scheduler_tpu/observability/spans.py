@@ -85,7 +85,12 @@ class Span:
             "parent_id": self.parent_id,
             "start_unix": self.start_unix,
             "dur_ms": self.dur_ms,
-            "attrs": self.attrs,
+            # copy, never alias: span() callers mutate the live attrs dict
+            # mid-block (engine wave/spec counters), while a recorded ring
+            # entry may be serialized by a /debug handler thread at any
+            # time — an aliased dict is the same changed-size-during-
+            # iteration race set_meta exists to prevent for trace meta
+            "attrs": dict(self.attrs),
             "status": self.status,
         }
 
@@ -173,6 +178,19 @@ class Trace:
             self.flush()
         return sp
 
+    def set_meta(self, **meta: Any) -> None:
+        """Stamp decision metadata under the trace lock.
+
+        Stamps arrive from the pipeline (sched/loop, sched/client, cli)
+        while a metrics-server handler thread may be serializing this
+        very trace for /debug/decisions — an unguarded `self.meta[...] =`
+        during to_dict's `dict(self.meta)` copy is a "dictionary changed
+        size during iteration" RuntimeError that kills the scrape.
+        (Found by this PR's concurrency sweep; direct `trace.meta[...]`
+        writes outside this module are the hazard.)"""
+        with self._lock:
+            self.meta.update(meta)
+
     def flush(self) -> None:
         """Re-publish this trace's ring entry if it was already recorded
         (root closed before this producer caught up — e.g. the decision
@@ -204,14 +222,17 @@ class Trace:
 
     def to_dict(self) -> dict[str, Any]:
         with self._lock:
+            # meta copied under the SAME lock set_meta writes under — a
+            # concurrent stamp must not blow up this serialization
             spans = [s.to_dict() for s in self.spans]
+            meta = dict(self.meta)
         return {
             "trace_id": self.trace_id,
             "name": self.root.name,
             "start_unix": self.root.start_unix,
             "dur_ms": self.root.dur_ms,
             "status": self.root.status,
-            "meta": dict(self.meta),
+            "meta": meta,
             "spans": spans,
         }
 

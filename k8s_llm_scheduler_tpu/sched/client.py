@@ -117,7 +117,7 @@ class DecisionClient:
     ) -> SchedulingDecision | None:
         trace = spans.current_trace()
         if trace is not None:
-            trace.meta["fallback_reason"] = reason
+            trace.set_meta(fallback_reason=reason)
         if not self.fallback_enabled:
             return None
         decision = fallback_decision(
@@ -190,8 +190,7 @@ class DecisionClient:
                 # prompt/decision identity for the flight recorder: the
                 # cache key digests (pod shape, cluster snapshot) — the
                 # same equivalence class the prompt prefix is keyed by
-                trace.meta["cache_key"] = key[:16]
-                trace.meta["cache_generation"] = generation
+                trace.set_meta(cache_key=key[:16], cache_generation=generation)
             cached = self.cache.get(pod, nodes, key=key)
             if cached is not None:
                 self.stats["cached_requests"] += 1

@@ -44,7 +44,9 @@ def _stamp_decision(trace, decision) -> None:
     """THE decision-metadata stamp (full, fast, and follower paths all
     converge here so /debug/decisions entries carry one field set)."""
     if trace is not None:
-        trace.meta.update(
+        # set_meta, never trace.meta[...]=: stamps race /debug handlers
+        # serializing the trace from metrics-server threads
+        trace.set_meta(
             source=decision.source.value,
             selected_node=decision.selected_node,
             confidence=decision.confidence,
@@ -53,7 +55,7 @@ def _stamp_decision(trace, decision) -> None:
 
 def _stamp_outcome(trace, outcome: str) -> None:
     if trace is not None:
-        trace.meta["outcome"] = outcome
+        trace.set_meta(outcome=outcome)
 
 
 class Scheduler:

@@ -37,6 +37,43 @@ def pytest_configure(config):
     config.addinivalue_line("markers", "asyncio: run test as a coroutine")
 
 
+@pytest.fixture
+def lock_sanitizer():
+    """Opt-in runtime lock-order sanitizer (k8s_llm_scheduler_tpu/testing
+    LockOrderSanitizer): wraps threading.Lock creation for the test body,
+    fails the test at teardown on acquisition-order cycles or locks held
+    across an event-loop hop."""
+    from k8s_llm_scheduler_tpu.testing import LockOrderSanitizer
+
+    san = LockOrderSanitizer()
+    with san:
+        yield san
+    san.assert_clean()
+
+
+# GRAFT_LOCK_SANITIZER=1 arms the sanitizer for EVERY test — the "record
+# the acquisition graph across the fast tier" sweep mode. Off by default:
+# wrapping threading.Lock globally taxes every queue/condition op.
+_SANITIZE_ALL = os.environ.get("GRAFT_LOCK_SANITIZER") == "1"
+
+
+@pytest.fixture(autouse=_SANITIZE_ALL)
+def _lock_sanitizer_everywhere(request):
+    # The sanitizer's own suite seeds deliberate violations (ABBA cycles,
+    # held-across-hop) and asserts on factory install/uninstall state —
+    # an ambient sanitizer would both catch the seeded hazards and break
+    # the factory assertions, so its module opts out of the sweep.
+    if not _SANITIZE_ALL or request.module.__name__ == "test_lock_sanitizer":
+        yield
+        return
+    from k8s_llm_scheduler_tpu.testing import LockOrderSanitizer
+
+    san = LockOrderSanitizer()
+    with san:
+        yield
+    san.assert_clean()
+
+
 @pytest.hookimpl(tryfirst=True)
 def pytest_pyfunc_call(pyfuncitem):
     """Minimal async test support (pytest-asyncio is not in the image)."""

@@ -1,0 +1,41 @@
+"""TRUE-POSITIVE fixture: blocking-call-in-async.
+
+Reproduces the reference scheduler's retry loop (reference
+scheduler.py:409-412, SURVEY §2 component 12): `time.sleep` backoff
+inside the async decision path, which parks the entire event loop — the
+bug sched/client.py's `await asyncio.sleep` backoff exists to avoid.
+"""
+
+import subprocess
+import time
+
+
+class DecisionClient:
+    max_retries = 3
+
+    async def _decide_uncached(self, pod, nodes):
+        for attempt in range(self.max_retries):
+            try:
+                return self._call_backend(pod, nodes)
+            except Exception:
+                # BAD: blocks the loop for the whole backoff
+                time.sleep(1.0 * (2 ** attempt))
+        return None
+
+    async def _probe(self, host):
+        # BAD: blocking subprocess inside a coroutine
+        return subprocess.run(["ping", "-c1", host], capture_output=True)
+
+    async def _suppressed(self):
+        time.sleep(0.001)  # graftlint: ok[blocking-call-in-async] — fixture: pragma-suppression demo
+
+    async def good_backoff(self, attempt):
+        import asyncio
+
+        await asyncio.sleep(1.0 * (2 ** attempt))
+
+    def sync_path_is_fine(self):
+        time.sleep(0.01)  # not async: no finding
+
+    def _call_backend(self, pod, nodes):
+        raise RuntimeError

@@ -1,0 +1,27 @@
+"""TRUE-POSITIVE fixture: event-loop-in-thread.
+
+The watcher-delivery shape cluster/fake.py dances around correctly: a
+worker thread calling `asyncio.get_event_loop()` gets a NEW, never-
+running loop (or a DeprecationWarning-then-error on newer Pythons), so
+the call_soon_threadsafe handoff silently goes nowhere.
+"""
+
+import asyncio
+
+
+def deliver_from_thread(queue, item) -> None:
+    # BAD: on a non-loop thread this creates a fresh dead loop
+    loop = asyncio.get_event_loop()
+    loop.call_soon_threadsafe(queue.put_nowait, item)
+
+
+def deliver_suppressed(queue, item) -> None:
+    loop = asyncio.get_event_loop()  # graftlint: ok[event-loop-in-thread] — fixture: pragma-suppression demo
+    loop.call_soon_threadsafe(queue.put_nowait, item)
+
+
+async def good_capture_then_hand_off(queue) -> object:
+    # the shipped discipline (fake.py watch_pending_pods): capture the
+    # RUNNING loop in async context, pass it to the thread explicitly
+    loop = asyncio.get_running_loop()
+    return loop

@@ -1,0 +1,51 @@
+"""TRUE-POSITIVE fixture: jit-closure-mutation.
+
+The "my counter stopped at 1" class: Python-side mutation inside a
+traced function runs once at trace time and never again — the engine's
+discipline is host-side accounting AFTER harvest (engine/engine.py
+updates `self.stats` outside every jit'd program).
+"""
+
+import jax
+import jax.numpy as jnp
+
+_trace_log: list[str] = []
+
+
+class Engine:
+    def __init__(self) -> None:
+        self.calls = 0
+        self._step = jax.jit(self._step_impl)
+
+    def _step_impl(self, x):
+        # BAD: traced method mutating self — bumps once, at trace time
+        self.calls = self.calls + 1
+        # BAD: discarded mutation of closed-over module state
+        _trace_log.append("step")
+        return x * 2
+
+
+def make_counter():
+    n = 0
+
+    @jax.jit
+    def step(x):
+        nonlocal n  # BAD: rebind happens at trace time only
+        n = n + 1
+        return x + 1
+
+    return step
+
+
+@jax.jit
+def step_suppressed(x):
+    _trace_log.append("traced")  # graftlint: ok[jit-closure-mutation] — fixture: pragma-suppression demo
+    return x
+
+
+@jax.jit
+def good_pure(x, acc):
+    # the JAX way: thread state through as values
+    local_scratch = []
+    local_scratch.append(x)  # local list: not closed-over, no finding
+    return acc + jnp.sum(jnp.stack(local_scratch))

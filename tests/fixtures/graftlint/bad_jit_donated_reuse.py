@@ -1,0 +1,34 @@
+"""TRUE-POSITIVE fixture: jit-donated-reuse.
+
+engine/kv_cache.py's shape: the KV page pool is donated into the update
+program (`donate_argnums=(0,)`) so XLA reuses its buffer for the output.
+Reading the donated variable AFTER the call sees deallocated (or output-
+aliased) memory — the caller must rebind to the returned tree.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def append_kv(pages, new_k, new_v):
+    return pages + new_k + new_v
+
+
+_append = jax.jit(append_kv, donate_argnums=(0,))
+
+
+def update_bad(pages, new_k, new_v):
+    out = _append(pages, new_k, new_v)
+    # BAD: `pages` was donated — its buffer now belongs to `out`
+    checksum = jnp.sum(pages)
+    return out, checksum
+
+
+def update_suppressed(pages, new_k, new_v):
+    out = _append(pages, new_k, new_v)
+    return out, pages  # graftlint: ok[jit-donated-reuse] — fixture: pragma-suppression demo
+
+
+def update_good(pages, new_k, new_v):
+    pages = _append(pages, new_k, new_v)  # rebind: the donated name dies
+    return pages, jnp.sum(pages)

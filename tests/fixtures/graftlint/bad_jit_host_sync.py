@@ -1,0 +1,55 @@
+"""TRUE-POSITIVE fixture: jit-host-sync.
+
+Reproduces the pre-discipline shape of engine/engine.py's wave path:
+the shipped engine keeps `_wave_impl` pure and does the host conversion
+(`jax.device_get`, `int(...)` on result arrays) at HARVEST, outside the
+jit boundary (engine.py harvest_wave). This fixture moves those syncs
+inside the traced function — the form that either fails at trace time
+or, with a concrete-value escape, silently forces a device round trip
+per call.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def _count_alive(act):
+    # BAD: reachable from the jit root below; .item() syncs per call
+    return int(act.sum().item())
+
+
+def _wave_impl(params, n_iters, tokens, act):
+    out = jnp.zeros_like(tokens)
+    for _ in range(n_iters):
+        out = out + tokens
+    # BAD: host syncs inside the traced function
+    host_toks = jax.device_get(out)
+    alive = _count_alive(act)
+    return host_toks, alive
+
+
+class Engine:
+    def __init__(self, params) -> None:
+        self._wave = jax.jit(
+            functools.partial(_wave_impl, params), static_argnums=(0,)
+        )
+
+
+def _suppressed_helper(budget):
+    return float(budget.shape)  # graftlint: ok[jit-host-sync] — fixture: pragma-suppression demo
+
+
+def _wave_suppressed(params, tokens, budget):
+    return tokens * _suppressed_helper(budget)
+
+
+_wave2 = jax.jit(_wave_suppressed)
+
+
+def good_harvest(handle):
+    """The shipped discipline: device_get AFTER the jit'd program, on the
+    host-side harvest path (not reachable from any jit root)."""
+    toks_np, iters_np = jax.device_get((handle.toks_d, handle.iters_d))
+    return toks_np, int(iters_np)

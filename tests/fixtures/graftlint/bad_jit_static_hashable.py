@@ -1,0 +1,57 @@
+"""TRUE-POSITIVE fixture: jit-static-hashable.
+
+static_argnums/static_argnames values become compile-cache dict keys —
+an unhashable (list/dict/set) at a static position raises TypeError at
+every call, and a mutable default on a static parameter raises on the
+first defaulted call.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def forward(tokens, buckets, scale=1.0):
+    return tokens * scale + len(buckets)
+
+
+_fwd = jax.jit(forward, static_argnums=(1,))
+
+
+def run_bad(tokens):
+    # BAD: list literal at the static position — unhashable cache key
+    return _fwd(tokens, [128, 256, 512])
+
+
+def run_suppressed(tokens):
+    return _fwd(tokens, [128])  # graftlint: ok[jit-static-hashable] — fixture: pragma-suppression demo
+
+
+def run_good(tokens):
+    return _fwd(tokens, (128, 256, 512))  # tuple: hashable
+
+
+@functools.partial(jax.jit, static_argnames=("buckets",))
+def forward_named(tokens, buckets=[128, 256]):  # BAD: mutable static default
+    return tokens + len(buckets)
+
+
+def run_named_bad(tokens):
+    # BAD: dict literal for a static-by-name parameter
+    return forward_named(tokens, buckets={"a": 1})
+
+
+def good_shapes(tokens):
+    return jnp.reshape(tokens, (-1,))
+
+
+def forward_partial(cfg, tokens, buckets=[9, 9]):  # BAD: mutable default on
+    # a static param — static_argnums=(1,) below is in the PARTIAL's
+    # signature (cfg is bound positionally), so it names `buckets` here
+    return tokens + len(buckets) + len(cfg)
+
+
+_fwd_partial = jax.jit(
+    functools.partial(forward_partial, {"heads": 4}), static_argnums=(1,)
+)

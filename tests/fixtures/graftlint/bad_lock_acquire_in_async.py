@@ -1,0 +1,32 @@
+"""TRUE-POSITIVE fixture: lock-acquire-in-async (blocking
+threading.Lock.acquire() parks the event-loop thread)."""
+
+import threading
+
+
+class Recorder:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.entries: list[str] = []
+
+    async def record(self, entry: str) -> None:
+        # BAD: a contended acquire blocks the whole loop, not just this task
+        self._lock.acquire()
+        try:
+            self.entries.append(entry)
+        finally:
+            self._lock.release()
+
+    async def suppressed(self, entry: str) -> None:
+        self._lock.acquire()  # graftlint: ok[lock-acquire-in-async] — fixture: pragma-suppression demo
+        self._lock.release()
+
+    async def good_nonblocking(self, entry: str) -> bool:
+        # non-blocking try-acquire cannot park the loop
+        if self._lock.acquire(blocking=False):
+            try:
+                self.entries.append(entry)
+            finally:
+                self._lock.release()
+            return True
+        return False

@@ -1,0 +1,49 @@
+"""TRUE-POSITIVE fixture: lock-across-await.
+
+Reproduces the pre-discipline shape of sched/replica.py's
+ReplicaClient.get_scheduling_decision_async: guarding the pending-reply
+table race by holding the threading lock ACROSS the await. The shipped
+code releases before awaiting and re-acquires in _drop — the exact
+discipline this rule makes unlandable to regress (the event loop would
+run arbitrary tasks with `_pending_lock` held; the reader thread's
+resolve path then deadlocks against the loop).
+
+This directory is EXCLUDED from repo-wide scans (tools/graftlint/core.py
+EXCLUDE_PARTS); tests/test_graftlint.py runs the rules on it explicitly.
+"""
+
+import asyncio
+import threading
+
+
+class ReplicaClient:
+    def __init__(self) -> None:
+        self._pending_lock = threading.Lock()
+        self._pending: dict[int, object] = {}
+
+    async def get_scheduling_decision_async(self, rid: int, fut):
+        with self._pending_lock:
+            # BAD: the lock is held while the loop suspends this task
+            resp = await asyncio.wait_for(fut, timeout=60.0)
+            self._pending.pop(rid, None)
+        return resp
+
+    async def suppressed_variant(self, rid: int, fut):
+        with self._pending_lock:
+            resp = await fut  # graftlint: ok[lock-across-await] — fixture: pragma-suppression demo
+        return resp
+
+    async def watch_bad(self):
+        # BAD: async-generator shape (cluster/fake.py watch_pending_pods
+        # pre-discipline): each yield suspends to the consumer with the
+        # lock held
+        with self._pending_lock:
+            for rid in list(self._pending):
+                yield rid
+
+    async def good_variant(self, rid: int, fut):
+        # the shipped discipline: await first, take the lock briefly after
+        resp = await asyncio.wait_for(fut, timeout=60.0)
+        with self._pending_lock:
+            self._pending.pop(rid, None)
+        return resp

@@ -1,0 +1,36 @@
+"""TRUE-POSITIVE fixture: py310 family (minus the except-star syntax,
+which has its own file because it does not parse everywhere).
+
+REAL pre-fix site from this repo: the seed's tests called the 3.11+-only
+asyncio scoped-timeout API on a 3.10 interpreter — ALL 20 of the seed's
+tier-1 failures traced to it (tests/test_scheduler_loop.py and friends,
+fixed in PR 1 via testing.async_deadline). The first bad block below
+reproduces that site shape.
+"""
+
+import asyncio
+
+
+async def seed_watchdog_shape(scheduler):
+    # BAD: the seed's idiom (test_scheduler_loop.py pre-PR-1)
+    async with asyncio.timeout(5):
+        await scheduler.drain()
+
+
+def raise_grouped(errors):
+    raise ExceptionGroup("backend failures", errors)  # BAD: 3.11+ builtin
+
+
+async def suppressed_native(seconds):
+    native = asyncio.timeout(seconds)  # py310-ok: fixture — historical pragma spelling
+    alias = asyncio.timeout(seconds)  # graftlint: ok[py310] — fixture: family-pragma spelling
+    group_type = ExceptionGroup  # graftlint: ok[py310-exception-group] — fixture: rule-id pragma spelling
+    return native, alias, group_type
+
+
+# comment-only mentions are exempt: asyncio.timeout(5) would be wrong here
+async def good_watchdog(scheduler):
+    from k8s_llm_scheduler_tpu.testing import async_deadline
+
+    async with async_deadline(5):
+        await scheduler.drain()

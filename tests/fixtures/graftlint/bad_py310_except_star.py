@@ -1,0 +1,17 @@
+"""TRUE-POSITIVE fixture: py310-except-star (own file: the except-star
+syntax is a SyntaxError before 3.11, so this must stay importable-never
+— the line rule still scans it even when the AST pass can't)."""
+
+
+def handle(fn):
+    try:
+        fn()
+    except* ValueError:
+        pass
+
+
+def handle_suppressed(fn):
+    try:
+        fn()
+    except* TypeError:  # py310-ok: fixture — historical-pragma suppression demo
+        pass

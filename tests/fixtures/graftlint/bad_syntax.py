@@ -1,0 +1,4 @@
+# parse-error fixture: graftlint must report the broken file (not crash,
+# not silently skip) and still run its line-based rules over it.
+def broken(:
+    pass

@@ -1,0 +1,48 @@
+"""TRUE-POSITIVE fixture: unguarded-attr-write.
+
+REAL pre-fix site from this repo: core/breaker.py's CircuitBreaker
+`_effective_state` wrote `self._state` (lock-guarded everywhere else in
+the class) without holding `self._lock` and without the `*_locked`
+called-with-lock-held naming convention. Every call site did in fact
+hold the lock — which is exactly why the convention must be in the NAME:
+the next caller can't see the contract. Fixed in this PR by renaming to
+`_effective_state_locked` (cluster/kube.py's existing convention).
+"""
+
+import threading
+import time
+
+
+class CircuitBreaker:
+    def __init__(self) -> None:
+        self._state = "closed"
+        self._opened_at = 0.0
+        self.timeout_seconds = 60.0
+        self._lock = threading.Lock()
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._effective_state()
+
+    def _effective_state(self) -> str:
+        if (
+            self._state == "open"
+            and time.monotonic() - self._opened_at >= self.timeout_seconds
+        ):
+            # BAD: guarded by self._lock in record_failure, unguarded here
+            # (and the method name doesn't carry the *_locked contract)
+            self._state = "half_open"
+        return self._state
+
+    def record_failure(self) -> None:
+        with self._lock:
+            self._state = "open"
+            self._opened_at = time.monotonic()
+
+    def reset_suppressed(self) -> None:
+        self._state = "closed"  # graftlint: ok[unguarded-attr-write] — fixture: pragma-suppression demo
+
+    def _decay_locked(self) -> None:
+        # *_locked naming: caller holds the lock by contract — no finding
+        self._state = "half_open"

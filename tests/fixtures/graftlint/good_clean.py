@@ -1,0 +1,51 @@
+"""NEGATIVE fixture: the repo's sanctioned concurrency and JAX patterns.
+Every rule must report ZERO findings here — this file pins the false-
+positive floor."""
+
+import asyncio
+import threading
+
+import jax
+import jax.numpy as jnp
+
+
+class Disciplined:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._aio_lock = asyncio.Lock()
+        self.count = 0
+        self.closed = False
+
+    async def brief_critical_section(self) -> int:
+        # threading lock in a coroutine is FINE when no await intervenes
+        with self._lock:
+            self.count += 1
+            snapshot = self.count
+        await asyncio.sleep(0)
+        return snapshot
+
+    async def asyncio_lock_across_await(self) -> None:
+        # asyncio.Lock is DESIGNED to be held across suspension points
+        async with self._aio_lock:
+            await asyncio.sleep(0)
+
+    def thread_side(self) -> None:
+        with self._lock:
+            self.count += 1
+
+    async def loop_handle(self):
+        return asyncio.get_running_loop()
+
+
+@jax.jit
+def pure_step(x, scale):
+    y = x * scale
+    acc = []
+    acc.append(jnp.sum(y))  # local accumulation is fine
+    return jnp.stack(acc)
+
+
+def host_side_harvest(device_result):
+    # host conversion OUTSIDE any jit root: fine
+    arr = jax.device_get(device_result)
+    return int(arr.sum().item())

@@ -1,0 +1,245 @@
+"""graftlint both works and passes on the tree.
+
+Three layers, mirroring tests/test_py310_lint.py's contract for the
+regex lint it grew out of:
+
+- the REPO IS CLEAN: a full run over the first-party tree reports zero
+  unsuppressed findings (suppressions carry justifications by
+  construction — an unjustified pragma does not suppress);
+- the DETECTORS WORK: a fixture corpus (tests/fixtures/graftlint/) pins
+  at least one true positive AND one pragma-suppressed case per rule,
+  including the two flagship rules catching the repo-lineage pre-fix
+  sites (the breaker's unguarded `_state` write, the seed's 3.11-only
+  asyncio timeout calls, the replica-client lock-across-await shape, the
+  wave-path host syncs);
+- the RUNNER CONTRACT holds: exit 0 clean / 1 findings / 2 bad usage,
+  JSONL output, rule selectors, and a <10s wall-clock budget for the
+  full-tree run so the fast tier can afford it.
+"""
+
+import json
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from tools.graftlint.core import (
+    REPO_ROOT,
+    RuleViolationError,
+    iter_repo_files,
+    lint_file,
+    lint_text,
+    run_repo,
+)
+from tools.graftlint.rules import RULES, rules_by_selector
+
+FIXTURES = Path(__file__).resolve().parent / "fixtures" / "graftlint"
+BAD_FIXTURES = sorted(FIXTURES.glob("bad_*.py"))
+
+
+def _corpus_report():
+    return run_repo(RULES, paths=sorted(FIXTURES.glob("*.py")))
+
+
+# ONE timed full-repo scan shared by the clean-gate and the wall-clock
+# budget tests — each scan costs ~3s and the fast tier should not pay it
+# twice for the same tree (the subprocess test below still exercises the
+# end-to-end CLI contract independently).
+_repo_scan_cache: list = []
+
+
+def _timed_repo_scan():
+    if not _repo_scan_cache:
+        t0 = time.perf_counter()
+        report = run_repo(RULES)
+        _repo_scan_cache.append((report, time.perf_counter() - t0))
+    return _repo_scan_cache[0]
+
+
+class TestRepoIsClean:
+    def test_repo_zero_unsuppressed_findings(self):
+        report, _elapsed = _timed_repo_scan()
+        assert report.findings == [], "\n".join(
+            f.human() for f in report.findings
+        )
+
+    def test_scans_a_meaningful_file_set(self):
+        files = {str(p.relative_to(REPO_ROOT)) for p in iter_repo_files()}
+        # the lock-heavy modules the concurrency rules exist for
+        assert "k8s_llm_scheduler_tpu/engine/local.py" in files
+        assert "k8s_llm_scheduler_tpu/sched/replica.py" in files
+        assert "k8s_llm_scheduler_tpu/rollout/hotswap.py" in files
+        assert "k8s_llm_scheduler_tpu/observability/spans.py" in files
+        # the jit-heavy modules the JAX rules exist for
+        assert "k8s_llm_scheduler_tpu/engine/engine.py" in files
+        assert "k8s_llm_scheduler_tpu/models/llama.py" in files
+        assert "k8s_llm_scheduler_tpu/spec/decoder.py" in files
+        # the lint never lints its own pattern tables or fixture corpus
+        assert not any(f.startswith("tools/graftlint") for f in files)
+        assert not any(f.startswith("tests/fixtures/graftlint") for f in files)
+        assert "tools/py310_lint.py" not in files
+
+    def test_full_repo_run_stays_under_10s(self):
+        # the fast-tier budget: the whole point of an AST lint is that it
+        # can run on every change — CPU wall clock, whole tree, all rules
+        _report, elapsed = _timed_repo_scan()
+        assert elapsed < 10.0, f"full-repo graftlint took {elapsed:.1f}s"
+
+
+class TestFixtureCorpus:
+    def test_every_rule_has_true_positive_and_suppressed_case(self):
+        report = _corpus_report()
+        found = {f.rule for f in report.findings}
+        suppressed = {f.rule for f in report.suppressed}
+        for rule in RULES:
+            assert rule.id in found, f"no true-positive fixture for {rule.id}"
+            assert rule.id in suppressed, (
+                f"no pragma-suppressed fixture for {rule.id}"
+            )
+
+    def test_good_file_is_clean(self):
+        report = lint_file(FIXTURES / "good_clean.py", RULES)
+        assert report.findings == [], "\n".join(
+            f.human() for f in report.findings
+        )
+        assert report.suppressed == []
+
+    def test_lock_across_await_catches_replica_client_shape(self):
+        """Flagship rule #1 against the pre-discipline form of
+        sched/replica.py's async decision path."""
+        report = lint_file(FIXTURES / "bad_lock_across_await.py", RULES)
+        hits = [f for f in report.findings if f.rule == "lock-across-await"]
+        # exactly two — the await shape AND the async-generator yield
+        # shape; the suppressed variant is filtered and the shipped
+        # (await-then-lock) good_variant in the same file is clean
+        assert len(hits) == 2
+        assert all("_pending_lock" in h.message for h in hits)
+
+    def test_jit_host_sync_catches_wave_harvest_shape(self):
+        """Flagship rule #2 against the pre-discipline form of
+        engine/engine.py's wave path (syncs inside _wave_impl instead of
+        at harvest)."""
+        report = lint_file(FIXTURES / "bad_jit_host_sync.py", RULES)
+        hits = {f.message.split(" inside ")[0] for f in report.findings
+                if f.rule == "jit-host-sync"}
+        assert any(".item()" in h for h in hits)
+        assert any("device_get" in h for h in hits)
+        # host-side harvest (good_harvest, unreachable from a jit root)
+        # must NOT be flagged
+        assert all("good_harvest" not in f.message for f in report.findings)
+
+    def test_partial_wrapped_static_default_is_caught(self):
+        """jax.jit(functools.partial(fn, bound), static_argnums=...) — the
+        engine's own idiom: static positions are in the partial's shifted
+        signature, and the mutable-default check must see through it."""
+        report = lint_file(FIXTURES / "bad_jit_static_hashable.py", RULES)
+        assert any(
+            f.rule == "jit-static-hashable" and "forward_partial" in f.message
+            and "buckets" in f.message
+            for f in report.findings
+        )
+
+    def test_seed_py310_site_is_caught(self):
+        """The seed's entire tier-1 failure class, as a fixture."""
+        report = lint_file(FIXTURES / "bad_py310.py", RULES)
+        assert any(f.rule == "py310-asyncio-timeout" for f in report.findings)
+        assert any(f.rule == "py310-exception-group" for f in report.findings)
+
+    def test_breaker_unguarded_write_site_is_caught(self):
+        """The REAL pre-fix site this PR's sweep found and fixed
+        (core/breaker.py _effective_state)."""
+        report = lint_file(FIXTURES / "bad_unguarded_attr_write.py", RULES)
+        hits = [f for f in report.findings if f.rule == "unguarded-attr-write"]
+        assert len(hits) == 1 and "_effective_state" in hits[0].message
+
+    def test_parse_error_is_a_finding_not_a_crash(self):
+        report = lint_file(FIXTURES / "bad_syntax.py", RULES)
+        assert any(f.rule == "parse-error" for f in report.findings)
+
+    def test_line_rules_survive_unparseable_files(self):
+        report = lint_file(FIXTURES / "bad_py310_except_star.py", RULES)
+        assert any(f.rule == "py310-except-star" for f in report.findings)
+        assert any(f.rule == "py310-except-star" for f in report.suppressed)
+
+
+class TestPragmas:
+    def test_unjustified_pragma_does_not_suppress(self):
+        snippet = (
+            "import asyncio\n"
+            "loop = asyncio.get_event_loop()  # graftlint: ok[event-loop-in-thread]\n"
+        )
+        report = lint_text(snippet, "x.py", RULES)
+        assert len(report.findings) == 1
+        assert "missing a justification" in report.findings[0].message
+        assert report.suppressed == []
+
+    def test_justified_pragma_suppresses(self):
+        snippet = (
+            "import asyncio\n"
+            "loop = asyncio.get_event_loop()  "
+            "# graftlint: ok[event-loop-in-thread] — thread-side handoff\n"
+        )
+        report = lint_text(snippet, "x.py", RULES)
+        assert report.findings == []
+        assert len(report.suppressed) == 1
+
+    def test_family_pragma_covers_member_rules(self):
+        snippet = (
+            "import asyncio\n"
+            "loop = asyncio.get_event_loop()  "
+            "# graftlint: ok[concurrency] — fixture\n"
+        )
+        report = lint_text(snippet, "x.py", RULES)
+        assert report.findings == []
+
+    def test_pragma_on_other_rule_does_not_suppress(self):
+        snippet = (
+            "import asyncio\n"
+            "loop = asyncio.get_event_loop()  "
+            "# graftlint: ok[jit-host-sync] — wrong rule\n"
+        )
+        report = lint_text(snippet, "x.py", RULES)
+        assert len(report.findings) == 1
+
+
+class TestRunnerContract:
+    def test_selectors_filter_rules(self):
+        rules = rules_by_selector(["py310"])
+        assert rules and all(r.family == "py310" for r in rules)
+        rules = rules_by_selector(["lock-across-await"])
+        assert [r.id for r in rules] == ["lock-across-await"]
+
+    def test_unknown_selector_is_loud(self):
+        try:
+            rules_by_selector(["no-such-rule"])
+        except RuleViolationError as exc:
+            assert "no-such-rule" in str(exc)
+        else:
+            raise AssertionError("unknown selector silently accepted")
+
+    def test_cli_exit_codes_and_jsonl(self):
+        # exit 1 + one JSON object per finding on the bad corpus
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.graftlint", "--format", "jsonl",
+             *map(str, BAD_FIXTURES)],
+            cwd=REPO_ROOT, capture_output=True, text=True, timeout=120,
+        )
+        assert proc.returncode == 1, proc.stderr
+        rows = [json.loads(line) for line in proc.stdout.splitlines()]
+        assert rows and {"rule", "path", "line", "message"} <= set(rows[0])
+        # exit 2 on a bad selector
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.graftlint", "--rules", "bogus"],
+            cwd=REPO_ROOT, capture_output=True, text=True, timeout=120,
+        )
+        assert proc.returncode == 2
+
+    def test_cli_exit_zero_on_repo(self):
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.graftlint"],
+            cwd=REPO_ROOT, capture_output=True, text=True, timeout=300,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "OK" in proc.stdout

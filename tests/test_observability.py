@@ -71,6 +71,12 @@ class TestSpans:
         decide = tree["children"][0]
         assert [c["name"] for c in decide["children"]] == ["backend"]
         assert decide["attrs"]["attempt"] == 0
+        # serialized attrs are a COPY, never an alias of the live dict: a
+        # producer mutating span attrs after the ring recorded the trace
+        # must not reach (or race) an already-serialized entry
+        live_decide = next(s for s in trace.spans if s.name == "decide")
+        live_decide.attrs["attempt"] = 99
+        assert decide["attrs"]["attempt"] == 0
         assert trace.root.dur_ms is not None
         # every child's wall time fits inside the root's
         assert sum(

@@ -1,0 +1,40 @@
+"""graftlint — the repo's pluggable AST static-analysis framework.
+
+Where tools/py310_lint.py guards one regression class (3.11+-only APIs on
+a 3.10 floor) with regexes, graftlint guards the two hazard classes the
+test suite can only catch probabilistically:
+
+- **concurrency** discipline across the 18+ threading/asyncio lock sites
+  (locks held across ``await``, blocking calls inside coroutines, writes
+  to lock-guarded attributes that skip the lock) — the exact failure
+  modes PRs 2-4 kept fixing post-hoc (prewarm advisory races, the
+  PhaseRecorder snapshot race);
+- **JAX purity** in the jit'd inference path (host syncs inside traced
+  code, Python-side mutation under a trace, donated buffers reused after
+  donation) — each one a silent per-call device round trip or a
+  corrupted buffer.
+
+Design: rules are AST visitors registered in RULES (rules/ package); the
+runner parses each file once and hands the tree to every selected rule.
+Suppress a single finding with a trailing
+
+    # graftlint: ok[rule-id] — one-line justification
+
+pragma (the justification is REQUIRED by the repo-sweep test). The py310
+family keeps its historical ``# py310-ok`` pragma as an alias.
+
+Entry points: ``python -m tools.graftlint`` (exit 0 clean / 1 findings /
+2 internal error), ``cli lint``, and tests/test_graftlint.py which pins a
+fixture corpus per rule plus a repo-wide clean run.
+"""
+
+from tools.graftlint.core import (  # noqa: F401
+    Finding,
+    LintRule,
+    RuleViolationError,
+    iter_repo_files,
+    lint_file,
+    lint_text,
+    run_repo,
+)
+from tools.graftlint.rules import RULES, rules_by_selector  # noqa: F401

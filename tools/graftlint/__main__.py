@@ -1,0 +1,89 @@
+"""``python -m tools.graftlint [paths...] [--rules a,b] [--format jsonl]``
+
+Exit codes: 0 = clean (suppressed findings allowed), 1 = unsuppressed
+findings, 2 = usage or internal error. The same runner backs ``cli lint``
+and the pytest gate (tests/test_graftlint.py::test_repo_is_clean).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+# Allow `python tools/graftlint` and `python -m tools.graftlint` from the
+# repo root even when the root is not on sys.path.
+_ROOT = Path(__file__).resolve().parent.parent.parent
+if str(_ROOT) not in sys.path:
+    sys.path.insert(0, str(_ROOT))
+
+from tools.graftlint.core import RuleViolationError, run_repo  # noqa: E402
+from tools.graftlint.rules import RULES, rules_by_selector  # noqa: E402
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="graftlint",
+        description="AST concurrency & JAX-purity analyzer for this repo",
+    )
+    parser.add_argument(
+        "paths", nargs="*", type=Path,
+        help="files to lint (default: the whole first-party tree)",
+    )
+    parser.add_argument(
+        "--rules", default=None,
+        help="comma-separated rule ids or families (default: all)",
+    )
+    parser.add_argument(
+        "--format", choices=("human", "jsonl"), default="human",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true", help="print the rule catalog",
+    )
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule in RULES:
+            print(f"{rule.id:24s} [{rule.family}] {rule.description}")
+        return 0
+
+    try:
+        selectors = (
+            [s.strip() for s in args.rules.split(",") if s.strip()]
+            if args.rules else None
+        )
+        rules = rules_by_selector(selectors)
+        paths = args.paths or None
+        if paths:
+            missing = [p for p in paths if not p.is_file()]
+            if missing:
+                print(f"graftlint: no such file(s): {missing}", file=sys.stderr)
+                return 2
+        report = run_repo(rules, paths=paths)
+    except RuleViolationError as exc:
+        print(f"graftlint: {exc}", file=sys.stderr)
+        return 2
+
+    if args.format == "jsonl":
+        for f in report.findings:
+            print(f.to_json())
+    else:
+        for f in report.findings:
+            print(f.human(), file=sys.stderr)
+        if report.findings:
+            print(
+                f"graftlint: {len(report.findings)} finding(s) in "
+                f"{report.files_scanned} file(s) "
+                f"({len(report.suppressed)} suppressed)",
+                file=sys.stderr,
+            )
+        else:
+            print(
+                f"graftlint: OK ({report.files_scanned} files, "
+                f"{len(report.suppressed)} suppressed finding(s))"
+            )
+    return 1 if report.findings else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,302 @@
+"""graftlint framework core: file iteration, pragma handling, the rule
+base class, and the runner.
+
+A rule is one hazard class with a stable kebab-case id (``lock-across-
+await``). The runner parses each file ONCE, builds a FileContext (source
+lines + per-line pragma table + AST), and hands it to every selected
+rule; findings whose line carries a matching pragma are filtered into
+the report's ``suppressed`` list instead of ``findings``.
+
+Pragma grammar (one comment, end of the offending line)::
+
+    # graftlint: ok[rule-id] — justification text
+    # graftlint: ok[rule-a, rule-b] — one pragma may cover several rules
+
+The justification is mandatory: a bare ``ok[rule-id]`` does NOT
+suppress (the finding survives, annotated) — a silenced checker with no
+recorded reason is how suppressions rot. The py310 family additionally
+honors the historical ``# py310-ok`` pragma (with or without a reason)
+so every existing call site keeps working.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import re
+from pathlib import Path
+from typing import Iterable, Iterator
+
+REPO_ROOT = Path(__file__).resolve().parent.parent.parent
+
+# Directories holding first-party Python (same set tools/py310_lint.py
+# established), minus the lint machinery itself and its fixture corpus:
+# rule pattern tables and deliberately-bad fixtures must not trip the
+# repo-wide clean gate.
+SCAN_DIRS = ("k8s_llm_scheduler_tpu", "tests", "tools")
+SCAN_FILES = ("bench.py", "__graft_entry__.py")
+EXCLUDE_PARTS = (
+    ("tools", "graftlint"),
+    ("tests", "fixtures", "graftlint"),
+)
+EXCLUDE_FILES = (("tools", "py310_lint.py"),)
+
+PRAGMA_RE = re.compile(
+    r"#\s*graftlint:\s*ok\[(?P<ids>[a-z0-9_,\-\s]+)\]\s*(?P<why>\S.*)?$"
+)
+PY310_PRAGMA = "# py310-ok"
+
+
+class RuleViolationError(Exception):
+    """Internal graftlint failure (bad selector, broken rule) — exit 2."""
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+    snippet: str = ""
+
+    def human(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: [{self.rule}] {self.message}"
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self), sort_keys=True)
+
+
+@dataclasses.dataclass
+class Pragma:
+    ids: frozenset[str]
+    justified: bool
+
+
+class FileContext:
+    """Everything a rule needs about one file, computed once."""
+
+    def __init__(self, name: str, text: str) -> None:
+        self.name = name
+        self.text = text
+        self.lines = text.splitlines()
+        self.pragmas: dict[int, Pragma] = {}
+        for lineno, line in enumerate(self.lines, start=1):
+            m = PRAGMA_RE.search(line)
+            if m:
+                ids = frozenset(
+                    t.strip() for t in m.group("ids").split(",") if t.strip()
+                )
+                self.pragmas[lineno] = Pragma(ids, bool(m.group("why")))
+            elif PY310_PRAGMA in line:
+                # historical alias: suppresses the whole py310 family
+                self.pragmas[lineno] = Pragma(frozenset(("py310",)), True)
+        self.tree: ast.Module | None = None
+        self.parse_error: SyntaxError | None = None
+        try:
+            self.tree = ast.parse(text)
+        except SyntaxError as exc:
+            self.parse_error = exc
+        # memoized whole-tree traversals: every rule iterates the same
+        # nodes, and N rules x M files of repeated ast.walk/iter_funcs
+        # dominated the full-repo wall clock (the <10s fast-tier budget)
+        self._all_nodes: list[ast.AST] | None = None
+        self._functions: list | None = None
+
+    def all_nodes(self) -> list[ast.AST]:
+        """Flat ast.walk of the whole tree, computed once per file."""
+        if self._all_nodes is None:
+            self._all_nodes = list(ast.walk(self.tree))
+        return self._all_nodes
+
+    def functions(self) -> list:
+        """[(func def, owning class | None), ...], computed once per file."""
+        if self._functions is None:
+            self._functions = list(iter_funcs(self.tree))
+        return self._functions
+
+    def finding(
+        self, rule: "LintRule", node: ast.AST | int, message: str
+    ) -> Finding:
+        if isinstance(node, int):
+            line, col = node, 0
+        else:
+            line, col = node.lineno, node.col_offset
+        snippet = self.lines[line - 1].strip() if 0 < line <= len(self.lines) else ""
+        return Finding(rule.id, self.name, line, col, message, snippet)
+
+
+class LintRule:
+    """One hazard class. Subclasses set `id`, `family`, `description` and
+    implement check(ctx) -> Iterable[Finding]. AST rules may assume
+    ctx.tree is not None (the runner reports parse errors itself and
+    skips AST rules for broken files)."""
+
+    id: str = ""
+    family: str = ""
+    description: str = ""
+    needs_ast: bool = True
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:  # pragma: no cover
+        raise NotImplementedError
+
+
+@dataclasses.dataclass
+class LintReport:
+    findings: list[Finding]
+    suppressed: list[Finding]
+    files_scanned: int
+
+    @property
+    def clean(self) -> bool:
+        return not self.findings
+
+
+def iter_repo_files(root: Path | None = None) -> list[Path]:
+    root = root or REPO_ROOT
+    out: list[Path] = []
+    for d in SCAN_DIRS:
+        base = root / d
+        if base.is_dir():
+            out.extend(sorted(base.rglob("*.py")))
+    for f in SCAN_FILES:
+        p = root / f
+        if p.is_file():
+            out.append(p)
+
+    def excluded(p: Path) -> bool:
+        rel = p.relative_to(root).parts
+        for parts in EXCLUDE_PARTS:
+            if rel[: len(parts)] == parts:
+                return True
+        return rel in EXCLUDE_FILES
+
+    return [p for p in out if not excluded(p)]
+
+
+def _apply_pragmas(
+    ctx: FileContext, raw: list[Finding]
+) -> tuple[list[Finding], list[Finding]]:
+    findings: list[Finding] = []
+    suppressed: list[Finding] = []
+    for f in raw:
+        pragma = ctx.pragmas.get(f.line)
+        hit = pragma is not None and (
+            f.rule in pragma.ids or _family_of(f.rule) in pragma.ids
+        )
+        if hit and pragma.justified:
+            suppressed.append(f)
+        elif hit:
+            f.message += " (pragma present but missing a justification)"
+            findings.append(f)
+        else:
+            findings.append(f)
+    return findings, suppressed
+
+
+_FAMILIES: dict[str, str] = {}
+
+
+def _family_of(rule_id: str) -> str:
+    return _FAMILIES.get(rule_id, "")
+
+
+def lint_text(
+    text: str, name: str, rules: Iterable[LintRule]
+) -> LintReport:
+    ctx = FileContext(name, text)
+    raw: list[Finding] = []
+    rules = list(rules)
+    for rule in rules:
+        _FAMILIES.setdefault(rule.id, rule.family)
+    if ctx.parse_error is not None:
+        err = ctx.parse_error
+        raw.append(
+            Finding(
+                "parse-error", name, err.lineno or 1, (err.offset or 1) - 1,
+                f"file does not parse: {err.msg}",
+            )
+        )
+        rules = [r for r in rules if not r.needs_ast]
+    for rule in rules:
+        try:
+            raw.extend(rule.check(ctx))
+        except Exception as exc:  # a broken rule must be loud, not silent
+            raise RuleViolationError(
+                f"rule {rule.id} crashed on {name}: {exc!r}"
+            ) from exc
+    raw.sort(key=lambda f: (f.line, f.col, f.rule))
+    findings, suppressed = _apply_pragmas(ctx, raw)
+    return LintReport(findings, suppressed, files_scanned=1)
+
+
+def lint_file(path: Path, rules: Iterable[LintRule], root: Path | None = None) -> LintReport:
+    root = root or REPO_ROOT
+    try:
+        name = str(path.resolve().relative_to(root))
+    except ValueError:
+        name = str(path)
+    return lint_text(path.read_text(), name, rules)
+
+
+def run_repo(
+    rules: Iterable[LintRule],
+    root: Path | None = None,
+    paths: Iterable[Path] | None = None,
+) -> LintReport:
+    """Lint explicit `paths`, or the whole first-party tree."""
+    rules = list(rules)
+    files = list(paths) if paths is not None else iter_repo_files(root)
+    findings: list[Finding] = []
+    suppressed: list[Finding] = []
+    for path in files:
+        rep = lint_file(path, rules, root=root)
+        findings.extend(rep.findings)
+        suppressed.extend(rep.suppressed)
+    return LintReport(findings, suppressed, files_scanned=len(files))
+
+
+# ---------------------------------------------------------------- AST utils
+def dotted_name(node: ast.AST) -> str:
+    """'a.b.c' for Name/Attribute chains, '' for anything else."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def iter_funcs(
+    tree: ast.Module,
+) -> Iterator[tuple[ast.FunctionDef | ast.AsyncFunctionDef, ast.ClassDef | None]]:
+    """Every function/method in the module with its owning class (None for
+    module-level and nested functions)."""
+
+    def walk(node: ast.AST, cls: ast.ClassDef | None) -> Iterator:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield child, cls
+                yield from walk(child, cls)
+            elif isinstance(child, ast.ClassDef):
+                yield from walk(child, child)
+            else:
+                yield from walk(child, cls)
+
+    yield from walk(tree, None)
+
+
+def body_walk(func: ast.AST) -> Iterator[ast.AST]:
+    """ast.walk over a function body WITHOUT descending into nested
+    function/class definitions (their hazards are their own scope's)."""
+    stack = list(ast.iter_child_nodes(func))
+    while stack:
+        node = stack.pop()
+        yield node
+        if not isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef, ast.Lambda)
+        ):
+            stack.extend(ast.iter_child_nodes(node))

@@ -1,0 +1,25 @@
+"""Rule registry. A selector is a rule id (``lock-across-await``) or a
+family name (``concurrency``, ``jax``, ``py310``)."""
+
+from __future__ import annotations
+
+from tools.graftlint.core import LintRule, RuleViolationError
+from tools.graftlint.rules.concurrency import CONCURRENCY_RULES
+from tools.graftlint.rules.jaxpurity import JAX_RULES
+from tools.graftlint.rules.py310 import PY310_RULES
+
+RULES: list[LintRule] = [*CONCURRENCY_RULES, *JAX_RULES, *PY310_RULES]
+
+
+def rules_by_selector(selectors: list[str] | None) -> list[LintRule]:
+    if not selectors:
+        return list(RULES)
+    known_ids = {r.id for r in RULES}
+    known_families = {r.family for r in RULES}
+    bad = [s for s in selectors if s not in known_ids | known_families]
+    if bad:
+        raise RuleViolationError(
+            f"unknown rule selector(s) {bad}; known rules: "
+            f"{sorted(known_ids)}, families: {sorted(known_families)}"
+        )
+    return [r for r in RULES if r.id in selectors or r.family in selectors]

@@ -1,0 +1,316 @@
+"""Concurrency rule family: thread/asyncio discipline.
+
+The scheduler mixes one asyncio control loop with thread-world producers
+(engine worker, replica server pool, metrics server, samplers) that meet
+at ~18 lock sites. Every rule here encodes a discipline the codebase
+already follows by convention; the rules make the conventions
+unlandable to break:
+
+- a THREADING lock may be held inside a coroutine only for a straight-
+  line critical section — never across an ``await`` (the event loop runs
+  other tasks while the lock is held; any of them touching the same lock
+  deadlocks the loop);
+- coroutines must not make blocking calls (``time.sleep``, requests,
+  subprocess, socket/file I/O) — one blocked coroutine stalls every
+  in-flight decision on the loop;
+- attributes guarded by ``with self._lock`` in one method are guarded
+  everywhere (a single unguarded write is the PhaseRecorder-snapshot
+  race class all over again);
+- ``asyncio.get_event_loop`` is banned: on a non-loop thread it creates
+  a NEW loop silently (the bug class `FakeCluster._deliver` dances
+  around); inside a coroutine ``get_running_loop`` is the correct spelling.
+
+Lock-ish detection is by name: the final path segment matching
+``lock|mutex|cond|condition`` (``self._lock``, ``send_lock``,
+``_ID_LOCK``, ``self._inf_lock``). Name-based is deliberate — the
+codebase's locks all follow it, and it needs no type inference.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable, Iterator
+
+from tools.graftlint.core import (
+    FileContext,
+    Finding,
+    LintRule,
+    body_walk,
+    dotted_name,
+)
+
+_LOCKISH = re.compile(r"(^|_)(lock|mutex|cond|condition|rlock)$", re.IGNORECASE)
+
+
+def lockish_name(node: ast.AST) -> str | None:
+    """The dotted name of a lock-looking expression, else None."""
+    name = dotted_name(node)
+    if name and _LOCKISH.search(name.rsplit(".", 1)[-1]):
+        return name
+    return None
+
+
+def _async_funcs(ctx: FileContext) -> Iterator[ast.AsyncFunctionDef]:
+    for func, _cls in ctx.functions():
+        if isinstance(func, ast.AsyncFunctionDef):
+            yield func
+
+
+def _awaits_in(node: ast.AST) -> Iterator[ast.AST]:
+    """Suspension points under `node`, not descending into nested defs.
+    `yield` counts: inside an async def it makes an ASYNC GENERATOR, and
+    each yield suspends to the consumer — the loop runs arbitrary code
+    while the with-block's lock stays held (cluster/*.watch_pending_pods
+    is exactly this shape, and keeps its yields outside the lock)."""
+    for child in body_walk(node):
+        if isinstance(child, (ast.Await, ast.AsyncFor, ast.AsyncWith, ast.Yield)):
+            yield child
+
+
+class LockAcrossAwait(LintRule):
+    id = "lock-across-await"
+    family = "concurrency"
+    description = (
+        "a threading lock (plain `with <lock>:`) held across an await — "
+        "the event loop runs arbitrary other tasks while the lock is held"
+    )
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        for func in _async_funcs(ctx):
+            for node in body_walk(func):
+                # plain `with` only: `async with` takes asyncio primitives,
+                # which are designed to be held across suspension points
+                if not isinstance(node, ast.With):
+                    continue
+                held = [
+                    lockish_name(item.context_expr)
+                    for item in node.items
+                    if lockish_name(item.context_expr)
+                ]
+                if not held:
+                    continue
+                for sus in _awaits_in(node):
+                    yield ctx.finding(
+                        self, sus,
+                        f"`{held[0]}` is held across this suspension point "
+                        f"(with-block opened at line {node.lineno}); release "
+                        f"the lock before awaiting or use asyncio.Lock",
+                    )
+
+
+# Fully-qualified call prefixes that block the calling thread. The value
+# is the hint shown to the author. Statically resolvable names only:
+# method calls on socket/file OBJECTS (`sock.recv`, `f.read`) can't be
+# typed without inference, so the entry points that create them (`open`,
+# `socket.create_connection`, `urllib.request.urlopen`) are the guard.
+_BLOCKING_CALLS: dict[str, str] = {
+    "time.sleep": "use `await asyncio.sleep(...)`",
+    "requests": "use a thread via asyncio.to_thread, or an async client",
+    "subprocess": "use `await asyncio.create_subprocess_exec(...)`",
+    "socket.create_connection": "use `await asyncio.open_connection(...)`",
+    "urllib.request.urlopen": "run it in a thread via asyncio.to_thread",
+    "os.system": "use `await asyncio.create_subprocess_shell(...)`",
+    "open": "do file I/O via `await asyncio.to_thread(...)`",
+}
+
+
+class BlockingCallInAsync(LintRule):
+    id = "blocking-call-in-async"
+    family = "concurrency"
+    description = (
+        "a blocking call (time.sleep, requests.*, subprocess.*, "
+        "socket.create_connection, urllib urlopen, os.system, open()) "
+        "inside `async def` stalls the whole event loop"
+    )
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        for func in _async_funcs(ctx):
+            for node in body_walk(func):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = dotted_name(node.func)
+                if not name:
+                    continue
+                for prefix, hint in _BLOCKING_CALLS.items():
+                    if name == prefix or name.startswith(prefix + "."):
+                        yield ctx.finding(
+                            self, node,
+                            f"blocking call `{name}(...)` inside async def "
+                            f"`{func.name}` — {hint}",
+                        )
+                        break
+
+
+class SyncLockAcquireInAsync(LintRule):
+    id = "lock-acquire-in-async"
+    family = "concurrency"
+    description = (
+        "threading.Lock.acquire() called in a coroutine — the default "
+        "blocking acquire parks the event loop thread"
+    )
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        for func in _async_funcs(ctx):
+            for node in body_walk(func):
+                if not (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "acquire"
+                ):
+                    continue
+                lock = lockish_name(node.func.value)
+                if lock is None:
+                    continue
+                if self._nonblocking(node):
+                    continue
+                yield ctx.finding(
+                    self, node,
+                    f"blocking `{lock}.acquire()` inside async def "
+                    f"`{func.name}` parks the event loop thread; use a "
+                    f"short `with {lock}:` critical section (no awaits) "
+                    f"or an asyncio.Lock",
+                )
+
+    @staticmethod
+    def _nonblocking(call: ast.Call) -> bool:
+        """acquire(False) / acquire(blocking=False) / acquire(timeout=0)
+        can't park the loop indefinitely."""
+        for arg in call.args[:1]:
+            if isinstance(arg, ast.Constant) and arg.value is False:
+                return True
+        for kw in call.keywords:
+            if kw.arg == "blocking" and isinstance(kw.value, ast.Constant) \
+                    and kw.value.value is False:
+                return True
+            if kw.arg == "timeout" and isinstance(kw.value, ast.Constant) \
+                    and kw.value.value == 0:
+                return True
+        return False
+
+
+class UnguardedAttrWrite(LintRule):
+    id = "unguarded-attr-write"
+    family = "concurrency"
+    description = (
+        "an attribute written under `with self.<lock>` in one method of a "
+        "class but written WITHOUT the lock elsewhere in the same class"
+    )
+
+    # Methods that run before/after any concurrent access exists.
+    _EXEMPT = {"__init__", "__new__", "__post_init__"}
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        for cls in [n for n in ctx.all_nodes() if isinstance(n, ast.ClassDef)]:
+            yield from self._check_class(ctx, cls)
+
+    def _check_class(self, ctx: FileContext, cls: ast.ClassDef) -> Iterator[Finding]:
+        methods = [
+            n for n in cls.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+        guarded: dict[str, str] = {}   # attr -> lock name that guards it
+        writes: list[tuple[str, ast.AST, ast.FunctionDef | ast.AsyncFunctionDef, bool]] = []
+        for m in methods:
+            self_name = self._self_param(m)
+            if self_name is None:
+                continue
+            for attr, node, under in self._attr_writes(m, self_name):
+                if under is not None:
+                    guarded.setdefault(attr, under)
+                writes.append((attr, node, m, under is not None))
+        for attr, node, m, under_lock in writes:
+            if under_lock or attr not in guarded:
+                continue
+            if m.name in self._EXEMPT or m.name.endswith("_locked"):
+                # __init__ predates concurrency; *_locked methods are the
+                # repo's called-with-lock-held convention (cluster/kube.py)
+                continue
+            yield ctx.finding(
+                self, node,
+                f"`self.{attr}` is written under `with self.{guarded[attr]}` "
+                f"elsewhere in class {cls.name} but unguarded here in "
+                f"`{m.name}`",
+            )
+
+    @staticmethod
+    def _self_param(m: ast.FunctionDef | ast.AsyncFunctionDef) -> str | None:
+        args = m.args.posonlyargs + m.args.args
+        return args[0].arg if args else None
+
+    def _attr_writes(
+        self, m: ast.AST, self_name: str
+    ) -> Iterator[tuple[str, ast.AST, str | None]]:
+        """(attr, node, guarding-lock-or-None) for every `self.x = ...` /
+        `self.x += ...` / `self.x[k] = ...` in the method body."""
+
+        def walk(node: ast.AST, lock: str | None) -> Iterator:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                      ast.ClassDef, ast.Lambda)):
+                    continue
+                inner = lock
+                if isinstance(child, ast.With):
+                    for item in child.items:
+                        name = lockish_name(item.context_expr)
+                        if name and name.startswith(self_name + "."):
+                            inner = name.split(".", 1)[1]
+                targets: list[ast.AST] = []
+                if isinstance(child, ast.Assign):
+                    targets = list(child.targets)
+                elif isinstance(child, (ast.AugAssign, ast.AnnAssign)):
+                    targets = [child.target]
+                for t in targets:
+                    if isinstance(t, ast.Subscript):
+                        t = t.value
+                    if isinstance(t, ast.Tuple):
+                        for el in t.elts:
+                            yield from _target(el, child, inner)
+                        continue
+                    yield from _target(t, child, inner)
+                yield from walk(child, inner)
+
+        def _target(t: ast.AST, stmt: ast.AST, lock: str | None) -> Iterator:
+            if (
+                isinstance(t, ast.Attribute)
+                and isinstance(t.value, ast.Name)
+                and t.value.id == self_name
+                and lockish_name(t) is None  # assigning the lock itself is setup
+            ):
+                yield t.attr, stmt, lock
+
+        yield from walk(m, None)
+
+
+class EventLoopInThread(LintRule):
+    id = "event-loop-in-thread"
+    family = "concurrency"
+    description = (
+        "asyncio.get_event_loop() is banned: inside a coroutine use "
+        "get_running_loop(); on a worker thread it silently creates a new, "
+        "never-running loop"
+    )
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        for node in ctx.all_nodes():
+            if (
+                isinstance(node, ast.Call)
+                and dotted_name(node.func) in (
+                    "asyncio.get_event_loop", "get_event_loop",
+                )
+            ):
+                yield ctx.finding(
+                    self, node,
+                    "asyncio.get_event_loop() — use asyncio.get_running_loop() "
+                    "in async code, or pass the loop in explicitly for "
+                    "thread-side call_soon_threadsafe handoffs",
+                )
+
+
+CONCURRENCY_RULES: list[LintRule] = [
+    LockAcrossAwait(),
+    BlockingCallInAsync(),
+    SyncLockAcquireInAsync(),
+    UnguardedAttrWrite(),
+    EventLoopInThread(),
+]

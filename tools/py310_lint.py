@@ -2,87 +2,57 @@
 
 The seed's entire tier-1 failure set (20 tests) traced to one root cause:
 tests calling ``asyncio.timeout(...)``, which does not exist before 3.11,
-on a 3.10 interpreter. This check makes that regression class impossible to
-land silently again: it greps every tracked source/test file for
+on a 3.10 interpreter. This check makes that regression class impossible
+to land silently again.
 
-- direct ``asyncio.timeout(`` calls  -> use
-  k8s_llm_scheduler_tpu.testing.async_deadline() instead;
-- ``ExceptionGroup`` / ``BaseExceptionGroup`` bare use (the builtins are
-  3.11+; 3.10 needs the exceptiongroup backport, which this repo does not
-  vendor);
-- ``except*`` clauses (3.11+ syntax — a SyntaxError at import time on
-  3.10, but the lint catches it in files that are only imported lazily).
-
-Suppress a genuinely-safe line (e.g. a feature-detect on the 3.11 branch)
-with a trailing ``# py310-ok`` pragma. Comment-only lines are skipped so
-prose ABOUT these APIs stays lintable.
-
-Runs standalone (``python tools/py310_lint.py`` — exit 1 on violations)
-and under pytest (tests/test_py310_lint.py).
+NOW A THIN SHIM: the four checks live in tools/graftlint (the AST
+static-analysis framework) as the ``py310`` rule family — run
+``python -m tools.graftlint --rules py310`` for the same scan with the
+framework's output options, or ``python -m tools.graftlint`` for the full
+rule set (concurrency + JAX purity + py310). This module keeps the
+historical entry points — ``python tools/py310_lint.py``, and the
+``run()`` / ``scan_text()`` / ``iter_py_files()`` API that
+tests/test_py310_lint.py pins — with identical messages, exit codes, and
+``# py310-ok`` pragma semantics.
 """
 
 from __future__ import annotations
 
-import re
 import sys
 from pathlib import Path
 
 REPO_ROOT = Path(__file__).resolve().parent.parent
+if str(REPO_ROOT) not in sys.path:
+    sys.path.insert(0, str(REPO_ROOT))
 
-# Directories that hold first-party Python (skip caches, assets, deploy).
-SCAN_DIRS = ("k8s_llm_scheduler_tpu", "tests", "tools")
-SCAN_FILES = ("bench.py", "__graft_entry__.py")
+from tools.graftlint.core import iter_repo_files, lint_text  # noqa: E402
+from tools.graftlint.rules.py310 import (  # noqa: E402,F401  (CHECKS: compat)
+    PY310_CHECKS as CHECKS,
+    PY310_RULES,
+)
 
 PRAGMA = "# py310-ok"
 
-CHECKS: tuple[tuple[re.Pattern[str], str], ...] = (
-    (
-        re.compile(r"\basyncio\s*\.\s*timeout\s*\("),
-        "asyncio.timeout() is 3.11+; use "
-        "k8s_llm_scheduler_tpu.testing.async_deadline()",
-    ),
-    (
-        # the from-import spelling evades the dotted pattern above
-        re.compile(r"from\s+asyncio\s+import\s+[^\n]*\btimeout\b"),
-        "asyncio.timeout is 3.11+; use "
-        "k8s_llm_scheduler_tpu.testing.async_deadline()",
-    ),
-    (
-        re.compile(r"\b(?:Base)?ExceptionGroup\b"),
-        "ExceptionGroup builtins are 3.11+; the package floor is 3.10",
-    ),
-    (
-        re.compile(r"\bexcept\s*\*"),
-        "except* syntax is 3.11+; the package floor is 3.10",
-    ),
-)
-
 
 def iter_py_files() -> list[Path]:
-    out: list[Path] = []
-    for d in SCAN_DIRS:
-        root = REPO_ROOT / d
-        if root.is_dir():
-            out.extend(sorted(root.rglob("*.py")))
-    for f in SCAN_FILES:
-        p = REPO_ROOT / f
-        if p.is_file():
-            out.append(p)
-    self_path = Path(__file__).resolve()
-    return [p for p in out if p.resolve() != self_path]
+    """The first-party file set (shared with graftlint; excludes the lint
+    machinery's own pattern tables and fixture corpus)."""
+    return iter_repo_files(REPO_ROOT)
 
 
 def scan_text(text: str, name: str) -> list[str]:
-    """Violations in one file's text as 'name:lineno: message' strings."""
-    violations: list[str] = []
-    for lineno, line in enumerate(text.splitlines(), start=1):
-        stripped = line.lstrip()
-        if stripped.startswith("#") or PRAGMA in line:
-            continue
-        for pattern, message in CHECKS:
-            if pattern.search(line):
-                violations.append(f"{name}:{lineno}: {message}")
-    return violations
+    """Violations in one file's text as 'name:lineno: message' strings.
+
+    The framework injects a `parse-error` finding for unparseable input;
+    the historical scanner was regex-only and reported exactly the py310
+    messages (the except* check EXISTS for files that don't parse), so
+    that companion finding is filtered here to keep the pinned contract."""
+    report = lint_text(text, name, PY310_RULES)
+    return [
+        f"{f.path}:{f.line}: {f.message}"
+        for f in report.findings
+        if f.rule != "parse-error"
+    ]
 
 
 def run() -> list[str]:
