@@ -164,6 +164,19 @@ PRESETS = {
     # order; asserts the traced p50 is < 2% over the untraced one.
     "obs-overhead": {"pods": 300, "nodes": 32, "shapes": 32, "rounds": 3,
                      "arrival_rate": 100.0},
+    # fleet-scale serving (fleet/): N sharded scheduler replicas over one
+    # in-memory cluster, each replica backed by its OWN simulated TPU
+    # decision service (decisions serialize per replica — the device),
+    # tiered decision caches over one fleet-shared L2. Pods are all
+    # distinct shapes (every decision is a leader): the measurement is
+    # exactly what replica count multiplies — model compute — not host
+    # drain speed. The sim device time (20 ms/decision, serialized per
+    # replica) dominates per-pod host work the way a real engine does;
+    # at 16 replicas the shared host loop becomes the bottleneck and the
+    # curve flattens — reported, not hidden. Reports decisions/s and
+    # bind p50/p99 at replica counts 1/4/16; acceptance bar: 4 replicas
+    # >= 2.5x the decisions/s of 1.
+    "fleet": {"pods": 600, "nodes": 500, "shapes": 0, "rounds": 1},
     # burst AFTER a cluster-state change: every round perturbs node usage
     # (so the cluster prefix differs from the engine's resident group),
     # idles perturb_idle seconds, then bursts — the production shape
@@ -729,6 +742,176 @@ def arena_bench(args) -> dict:
 
 
 # ------------------------------------------------------- model throughput/MFU
+class _FleetSimBackend:
+    """One simulated TPU decision service per fleet replica: decisions
+    SERIALIZE behind an asyncio lock (the device only runs one wave at a
+    time) and cost `service_s` each; the pick itself is the stub's
+    resource-balanced choice so placements stay legal. The sim backend
+    is the point of the preset: decisions/s must scale with replica
+    count because each replica brings its own device, not because the
+    host got lucky."""
+
+    def __init__(self, service_s: float = 0.02) -> None:
+        import itertools
+
+        self.service_s = service_s
+        self._rr = itertools.count()
+        self._ready_memo: tuple[int, list] | None = None
+        # created lazily ON the running loop (the backend is constructed
+        # before the bench's asyncio.run); only loop-thread coroutines
+        # touch it afterwards
+        self._alock: "asyncio.Lock | None" = None
+
+    def _pick(self, pod, nodes):
+        """O(1) round-robin over ready nodes. NOT the stub's 500-node
+        feasibility scan: that scan is host compute the real engine
+        doesn't pay per decision, and at fleet scale it serialized on
+        the shared event loop and masked the device-time scaling this
+        preset measures (the preset's pods are unconstrained, so every
+        ready node is legal). The ready list is memoized per snapshot
+        object — one scan per burst, not per pod."""
+        from k8s_llm_scheduler_tpu.types import (
+            DecisionSource,
+            SchedulingDecision,
+        )
+
+        memo = self._ready_memo
+        if memo is None or memo[0] != id(nodes):
+            memo = (id(nodes), [n for n in nodes if n.is_ready])
+            self._ready_memo = memo
+        ready = memo[1]
+        node = ready[next(self._rr) % len(ready)]
+        return SchedulingDecision(
+            selected_node=node.name,
+            confidence=0.9,
+            reasoning="fleet-sim round robin",
+            source=DecisionSource.LLM,
+            latency_ms=self.service_s * 1000.0,
+        )
+
+    async def get_scheduling_decision_async(self, pod, nodes, work="prefill"):
+        if self._alock is None:
+            self._alock = asyncio.Lock()
+        async with self._alock:
+            await asyncio.sleep(self.service_s)
+        return self._pick(pod, nodes)
+
+    def get_scheduling_decision(self, pod, nodes, work="prefill"):
+        time.sleep(self.service_s)
+        return self._pick(pod, nodes)
+
+
+async def _fleet_round(
+    n_replicas: int, n_pods: int, n_nodes: int, service_s: float,
+    timeout_s: float = 300.0,
+) -> dict:
+    """One replica-count data point: burst n_pods distinct-shape pods at
+    a fresh fleet, measure decisions/s and release->bind latency."""
+    from k8s_llm_scheduler_tpu.cluster.fake import FakeCluster
+    from k8s_llm_scheduler_tpu.cluster.interface import RawPod
+    from k8s_llm_scheduler_tpu.fleet import Fleet
+
+    scheduler_name = "ai-llama-scheduler"
+    cluster = FakeCluster()
+    cluster.add_nodes(n_nodes, prefix="fleet-node")
+    # every pod its own resource shape -> every decision is a leader
+    # (the cache key digests the shape, not the name — core/cache.py)
+    for i in range(n_pods):
+        cluster.add_pod(RawPod(
+            name=f"fleet-pod-{i:05d}",
+            namespace="default",
+            scheduler_name=scheduler_name,
+            container_requests=(
+                {"cpu": f"{100 + i}m", "memory": "128Mi"},
+            ),
+        ))
+    fleet = Fleet(
+        cluster, cluster, lambda i: _FleetSimBackend(service_s),
+        n_replicas=n_replicas,
+        n_shards=32,
+        scheduler_name=scheduler_name,
+        lease_ttl_s=3600.0,       # no failover here: pure throughput
+        snapshot_ttl_s=1e9,       # one burst, one snapshot per replica
+        list_pending=lambda: cluster.pending_pods(scheduler_name),
+    )
+    bind_times: list[float] = []
+    for replica in fleet.replicas:
+        orig = replica.scheduler._note_bind
+
+        def tagging_note(ok, pod, decision, _orig=orig):
+            if ok:
+                bind_times.append(time.perf_counter())
+            _orig(ok, pod, decision)
+
+        replica.scheduler._note_bind = tagging_note
+
+    t0 = time.perf_counter()
+    await fleet.start(lease_threads=False)
+    deadline = t0 + timeout_s
+    try:
+        while time.perf_counter() < deadline:
+            if fleet.get_stats()["total_scheduled"] >= n_pods:
+                break
+            await asyncio.sleep(0.02)
+        stats = fleet.get_stats()
+    finally:
+        await fleet.stop()
+    if stats["total_scheduled"] < n_pods:
+        raise RuntimeError(
+            f"fleet round ({n_replicas} replicas) bound only "
+            f"{stats['total_scheduled']}/{n_pods} pods in {timeout_s}s"
+        )
+    if cluster.bind_count != n_pods or stats["failed_bindings"]:
+        raise RuntimeError(
+            f"fleet round bind accounting broken: bind_count="
+            f"{cluster.bind_count}, failed={stats['failed_bindings']}"
+        )
+    wall_s = max(bind_times) - t0
+    lat = sorted((t - t0) * 1000.0 for t in bind_times)
+    return {
+        "replicas": n_replicas,
+        "decisions_per_s": round(n_pods / wall_s, 1),
+        "wall_s": round(wall_s, 3),
+        "bind_p50_ms": round(lat[len(lat) // 2], 3),
+        "bind_p99_ms": round(lat[min(len(lat) - 1, int(len(lat) * 0.99))], 3),
+        "fenced_binds": stats["fenced_binds"],
+        "l2": {
+            k: stats["l2"][k] for k in ("hits", "misses", "generation")
+        },
+    }
+
+
+async def fleet_bench(args) -> dict:
+    """`--preset fleet`: decisions/s scaling across sharded scheduler
+    replicas (fleet/frontend.py) over the sim backend. Acceptance bar
+    (ISSUE 6): 4 replicas >= 2.5x the decisions/s of 1 replica, zero
+    failed/double binds at every count."""
+    service_s = 0.02
+    points = {}
+    for n in (1, 4, 16):
+        points[str(n)] = await _fleet_round(
+            n, args.pods, args.nodes, service_s
+        )
+    d1 = points["1"]["decisions_per_s"]
+    d4 = points["4"]["decisions_per_s"]
+    d16 = points["16"]["decisions_per_s"]
+    speedup_4v1 = round(d4 / d1, 2)
+    return {
+        "metric": "fleet_decisions_per_s",
+        "value": d4,
+        "unit": "decisions/s@4replicas",
+        "extra": {
+            "pods": args.pods,
+            "nodes": args.nodes,
+            "sim_service_ms": service_s * 1000.0,
+            "replica_points": points,
+            "speedup_4v1": speedup_4v1,
+            "speedup_16v1": round(d16 / d1, 2),
+            "meets_bar_4v1_ge_2.5x": speedup_4v1 >= 2.5,
+        },
+    }
+
+
 def _synthetic_text(seed: int, n_tokens: int) -> str:
     """Deterministic ASCII filler, distinct per seed from the first byte
     (so prefix prefills never LCP-seed off each other)."""
@@ -1317,6 +1500,9 @@ def main() -> None:
         return
     if args.preset == "obs-overhead":
         _emit(asyncio.run(obs_overhead_bench(args)))
+        return
+    if args.preset == "fleet":
+        _emit(asyncio.run(fleet_bench(args)))
         return
     result = asyncio.run(bench_preset(args))
     result["extra"]["dispatch_rtt_ms"] = measure_dispatch_rtt_ms()

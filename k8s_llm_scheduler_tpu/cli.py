@@ -20,6 +20,7 @@ import importlib
 import json
 import logging
 import sys
+import time
 from pathlib import Path
 from typing import Any
 
@@ -96,6 +97,10 @@ def _build_stack(cfg: Config, cluster) -> Any:
     # (distributed.replica_addrs; sched/replica.py). Sits below the cache/
     # single-flight stack so only leader decisions cross hosts.
     backend = _maybe_fanout(backend, cfg)
+    # Disaggregated prefill/decode pools, when configured (fleet.*;
+    # fleet/pools.py). Wraps the (possibly fanned-out) backend so
+    # admission and continuation route to distinct worker pools.
+    backend = _maybe_disaggregate(backend, cfg)
 
     cache = (
         DecisionCache(
@@ -309,82 +314,120 @@ def _run_worker_replica(
     return 0
 
 
+def _parse_replica_addr(
+    text: str, default_port: int, key: str
+) -> tuple[str, int]:
+    """Parse one replica-address config entry into (host, port)."""
+    if text.isdigit():
+        # bare port (pre-round-4 configs used '9901' for
+        # localhost:9901 — keep that meaning rather than dialing a
+        # hostname made of digits)
+        return "localhost", int(text)
+    if text.startswith("["):
+        # bracketed IPv6: '[::1]:9901' or '[::1]' (default port)
+        bracket_end = text.find("]")
+        if bracket_end < 0:
+            raise ValueError(
+                f"{key} entry {text!r}: unterminated "
+                f"'[' (expected '[v6-addr]:port')"
+            )
+        host = text[1:bracket_end]
+        rest = text[bracket_end + 1 :]
+        if rest.startswith(":"):
+            try:
+                port = int(rest[1:])
+            except ValueError:
+                raise ValueError(
+                    f"{key} entry {text!r}: port "
+                    f"{rest[1:]!r} is not an integer"
+                ) from None
+        elif rest:
+            raise ValueError(
+                f"{key} entry {text!r}: trailing "
+                f"{rest!r} after ']' (expected '[v6-addr]:port')"
+            )
+        else:
+            port = default_port
+        return host, port
+    if text.count(":") > 1:
+        # bare IPv6 literal: rpartition(':') would misparse '::1' as
+        # host ':' port 1 — demand brackets instead of guessing
+        raise ValueError(
+            f"{key} entry {text!r} looks like a bare "
+            f"IPv6 literal; write it bracketed ('[{text}]:port')"
+        )
+    host, sep, port_s = text.rpartition(":")
+    if sep:
+        try:
+            port = int(port_s)
+        except ValueError:
+            raise ValueError(
+                f"{key} entry {text!r}: port "
+                f"{port_s!r} is not an integer (expected 'host:port' "
+                f"or bare 'host')"
+            ) from None
+    else:
+        host, port = text, default_port  # bare host: default port
+    return host or "localhost", port
+
+
+def _replica_clients(cfg: Config, addrs, key: str) -> list:
+    from k8s_llm_scheduler_tpu.sched.replica import ReplicaClient
+
+    default_port = int(cfg.get("distributed.replica_port"))
+    timeout_s = float(cfg.get("llm.timeout"))
+    return [
+        ReplicaClient(
+            *_parse_replica_addr(str(addr), default_port, key),
+            request_timeout_s=timeout_s,
+        )
+        for addr in addrs
+    ]
+
+
 def _maybe_fanout(backend, cfg: Config):
     """Wrap the coordinator's backend in a FanoutBackend when worker
     replica addresses are configured."""
     addrs = cfg.get("distributed.replica_addrs") or []
     if not addrs:
         return backend
-    from k8s_llm_scheduler_tpu.sched.replica import FanoutBackend, ReplicaClient
+    from k8s_llm_scheduler_tpu.sched.replica import FanoutBackend
 
-    replicas = [backend]
-    default_port = int(cfg.get("distributed.replica_port"))
-    for addr in addrs:
-        text = str(addr)
-        if text.isdigit():
-            # bare port (pre-round-4 configs used '9901' for
-            # localhost:9901 — keep that meaning rather than dialing a
-            # hostname made of digits)
-            replicas.append(
-                ReplicaClient(
-                    "localhost", int(text),
-                    request_timeout_s=float(cfg.get("llm.timeout")),
-                )
-            )
-            continue
-        if text.startswith("["):
-            # bracketed IPv6: '[::1]:9901' or '[::1]' (default port)
-            bracket_end = text.find("]")
-            if bracket_end < 0:
-                raise ValueError(
-                    f"distributed.replica_addrs entry {text!r}: unterminated "
-                    f"'[' (expected '[v6-addr]:port')"
-                )
-            host = text[1:bracket_end]
-            rest = text[bracket_end + 1 :]
-            if rest.startswith(":"):
-                try:
-                    port = int(rest[1:])
-                except ValueError:
-                    raise ValueError(
-                        f"distributed.replica_addrs entry {text!r}: port "
-                        f"{rest[1:]!r} is not an integer"
-                    ) from None
-            elif rest:
-                raise ValueError(
-                    f"distributed.replica_addrs entry {text!r}: trailing "
-                    f"{rest!r} after ']' (expected '[v6-addr]:port')"
-                )
-            else:
-                port = default_port
-        elif text.count(":") > 1:
-            # bare IPv6 literal: rpartition(':') would misparse '::1' as
-            # host ':' port 1 — demand brackets instead of guessing
-            raise ValueError(
-                f"distributed.replica_addrs entry {text!r} looks like a bare "
-                f"IPv6 literal; write it bracketed ('[{text}]:port')"
-            )
-        else:
-            host, sep, port_s = text.rpartition(":")
-            if sep:
-                try:
-                    port = int(port_s)
-                except ValueError:
-                    raise ValueError(
-                        f"distributed.replica_addrs entry {text!r}: port "
-                        f"{port_s!r} is not an integer (expected 'host:port' "
-                        f"or bare 'host')"
-                    ) from None
-            else:
-                host, port = text, default_port  # bare host: default port
-        replicas.append(
-            ReplicaClient(
-                host or "localhost", port,
-                request_timeout_s=float(cfg.get("llm.timeout")),
-            )
-        )
+    replicas = [backend] + _replica_clients(
+        cfg, addrs, "distributed.replica_addrs"
+    )
     logger.info("fanning decisions out over %d replicas", len(replicas))
     return FanoutBackend(replicas)
+
+
+def _maybe_disaggregate(backend, cfg: Config):
+    """Wrap the backend in a DisaggregatedBackend when fleet pools are
+    configured: prefill workers absorb admission bursts (prepacked),
+    decode workers keep continuation latency flat. The local backend
+    always serves in the prefill pool; with no pool addresses (or
+    fleet.enabled off) this is a no-op."""
+    if not cfg.get("fleet.enabled"):
+        return backend
+    prefill_addrs = cfg.get("fleet.prefill_addrs") or []
+    decode_addrs = cfg.get("fleet.decode_addrs") or []
+    if not prefill_addrs and not decode_addrs:
+        return backend
+    from k8s_llm_scheduler_tpu.fleet import DisaggregatedBackend
+
+    prefill_pool = [backend] + _replica_clients(
+        cfg, prefill_addrs, "fleet.prefill_addrs"
+    )
+    decode_pool = _replica_clients(cfg, decode_addrs, "fleet.decode_addrs")
+    logger.info(
+        "disaggregated pools: %d prefill / %d decode worker(s)",
+        len(prefill_pool), len(decode_pool),
+    )
+    return DisaggregatedBackend(
+        prefill_pool,
+        decode_pool,
+        prepack_max_batch=int(cfg.get("fleet.prepack_max_batch")),
+        prepack_window_s=float(cfg.get("fleet.prepack_window_ms")) / 1000.0,
+    )
 
 
 def cmd_demo(args: argparse.Namespace, cfg: Config) -> int:
@@ -1189,6 +1232,11 @@ def cmd_trace(args: argparse.Namespace, cfg: Config) -> int:
             f"{(f'{dur:.1f}ms' if dur is not None else 'open'):>10} "
             f"{meta.get('source', '-'):<9} "
             f"{meta.get('selected_node', '-'):<20} "
+            # fleet attribution (fleet/): which watch-space shard decided
+            # this pod, and which cache tier answered (l1_hit/l2_hit/
+            # miss/coalesced)
+            f"{str(meta.get('shard_id', '-')):>5} "
+            f"{meta.get('cache_tier', '-'):<9} "
             f"{meta.get('outcome', meta.get('fallback_reason', '-'))}"
         )
 
@@ -1199,7 +1247,8 @@ def cmd_trace(args: argparse.Namespace, cfg: Config) -> int:
             ))
             print(
                 f"{'trace_id':<16} {'name':<10} {'duration':>10} "
-                f"{'source':<9} {'node':<20} outcome"
+                f"{'source':<9} {'node':<20} {'shard':>5} {'tier':<9} "
+                f"outcome"
             )
             for entry in data["traces"]:
                 print(summarize(entry))
@@ -1271,6 +1320,107 @@ def cmd_trace(args: argparse.Namespace, cfg: Config) -> int:
         )
         return 2
     raise SystemExit(f"unknown trace command {args.trace_cmd!r}")
+
+
+def cmd_fleet(args: argparse.Namespace, cfg: Config) -> int:
+    """Fleet-scale serving tools (fleet/):
+
+        cli fleet demo    # in-process sharded fleet over a fake cluster
+        cli fleet shard <namespace/name>   # a pod's watch-space shard
+    """
+    from k8s_llm_scheduler_tpu.fleet import shard_of
+
+    if args.fleet_cmd == "shard":
+        n_shards = (
+            args.n_shards if args.n_shards is not None
+            else int(cfg.get("fleet.n_shards"))
+        )
+        if "/" in args.pod:
+            namespace, name = args.pod.split("/", 1)
+        else:
+            namespace, name = "default", args.pod
+        print(shard_of(namespace, name, n_shards))
+        return 0
+
+    if args.fleet_cmd == "demo":
+        from k8s_llm_scheduler_tpu.engine.backend import StubBackend
+        from k8s_llm_scheduler_tpu.fleet import Fleet
+        from k8s_llm_scheduler_tpu.testing import (
+            pod_burst,
+            synthetic_cluster,
+        )
+
+        replicas = (
+            args.replicas if args.replicas is not None
+            else int(cfg.get("fleet.replicas"))
+        )
+        scheduler_name = cfg.get("scheduler.name")
+
+        async def demo() -> dict:
+            cluster = synthetic_cluster(args.nodes)
+            for raw in pod_burst(
+                args.pods, scheduler_name=scheduler_name,
+                distinct_shapes=args.shapes,
+            ):
+                cluster.add_pod(raw)
+            fleet = Fleet(
+                cluster, cluster, lambda i: StubBackend(),
+                n_replicas=replicas,
+                n_shards=int(cfg.get("fleet.n_shards")),
+                scheduler_name=scheduler_name,
+                lease_ttl_s=float(cfg.get("fleet.lease_ttl_s")),
+                renew_interval_s=float(cfg.get("fleet.renew_interval_s")),
+                l1_size=int(cfg.get("fleet.l1_size")),
+                l2_size=int(cfg.get("fleet.l2_size")),
+                list_pending=lambda: cluster.pending_pods(scheduler_name),
+            )
+            t0 = time.perf_counter()
+            await fleet.start()
+            deadline = t0 + 60.0
+            while time.perf_counter() < deadline:
+                if fleet.get_stats()["total_scheduled"] >= args.pods:
+                    break
+                await asyncio.sleep(0.02)
+            wall_s = time.perf_counter() - t0
+            stats = fleet.get_stats()
+            await fleet.stop()
+            stats["wall_s"] = round(wall_s, 3)
+            stats["decisions_per_s"] = round(
+                stats["total_scheduled"] / wall_s, 1
+            ) if wall_s else 0.0
+            stats["bind_count"] = cluster.bind_count
+            return stats
+
+        stats = asyncio.run(demo())
+        if args.json:
+            print(json.dumps(stats))
+            return 0
+        print(
+            f"fleet demo: {replicas} replica(s), {stats['n_shards']} shards, "
+            f"{args.pods} pods over {args.nodes} nodes"
+        )
+        for r in stats["replicas"]:
+            print(
+                f"  replica-{r['replica_id']}: shards {r['owned_shards']}  "
+                f"bound {r['total_scheduled']}  "
+                f"(llm {r['llm_decisions']}, cache {r['cache_decisions']})  "
+                f"fenced {r['fenced_binds']}"
+            )
+        l2 = stats["l2"]
+        print(
+            f"  shared L2: {l2['hits']} hits / {l2['misses']} misses "
+            f"(generation {l2['generation']})"
+        )
+        print(
+            f"  {stats['total_scheduled']} bound "
+            f"({stats['decisions_per_s']}/s), "
+            f"{stats['failed_bindings']} failed, "
+            f"{stats['fenced_binds']} fenced; "
+            f"cluster bind_count={stats['bind_count']}"
+        )
+        return 0 if stats["total_scheduled"] >= args.pods else 1
+
+    raise SystemExit(f"unknown fleet command {args.fleet_cmd!r}")
 
 
 def cmd_lint(args: argparse.Namespace, cfg: Config) -> int:
@@ -1683,6 +1833,39 @@ def main(argv: list[str] | None = None) -> int:
         help="files to lint (default: the whole first-party tree)",
     )
 
+    p_fleet = sub.add_parser(
+        "fleet",
+        help="fleet-scale serving (fleet/): sharded-replica demo + shard "
+             "mapping",
+    )
+    fsub = p_fleet.add_subparsers(dest="fleet_cmd", required=True)
+    p_fdemo = fsub.add_parser(
+        "demo",
+        help="run an in-process sharded fleet over a fake cluster and "
+             "print shard ownership, decision mix, and tier hits",
+    )
+    p_fdemo.add_argument(
+        "--replicas", type=int, default=None,
+        help="scheduler replicas (default: fleet.replicas config)",
+    )
+    p_fdemo.add_argument("--pods", type=int, default=200)
+    p_fdemo.add_argument("--nodes", type=int, default=12)
+    p_fdemo.add_argument(
+        "--shapes", type=int, default=16,
+        help="distinct pod resource shapes (cache-coherence groups)",
+    )
+    p_fdemo.add_argument("--json", action="store_true")
+    p_fshard = fsub.add_parser(
+        "shard", help="print a pod's watch-space shard id"
+    )
+    p_fshard.add_argument(
+        "pod", help="namespace/name (bare name = default namespace)"
+    )
+    p_fshard.add_argument(
+        "--n-shards", type=int, default=None,
+        help="shard count (default: fleet.n_shards config)",
+    )
+
     p_complete = sub.add_parser(
         "complete",
         help="free-form text completion (paged continuous-batching path)",
@@ -1728,6 +1911,7 @@ def main(argv: list[str] | None = None) -> int:
         "eval": cmd_eval,
         "sim": cmd_sim,
         "rollout": cmd_rollout,
+        "fleet": cmd_fleet,
         "trace": cmd_trace,
         "lint": cmd_lint,
         "complete": cmd_complete,

@@ -178,6 +178,31 @@ DEFAULTS: dict[str, Any] = {
         # registry poll period for `cli rollout watch`
         "poll_seconds": 5.0,
     },
+    # Fleet-scale serving (fleet/): leased watch-space sharding, tiered
+    # decision cache, disaggregated prefill/decode pools. `replicas`/
+    # `n_shards` size the sharded frontend; lease TTL + renew interval
+    # follow the classic rule (renew at most every ttl/3).
+    "fleet": {
+        "enabled": False,
+        "replicas": 1,
+        "n_shards": 16,
+        "lease_ttl_s": 5.0,
+        "renew_interval_s": 1.5,
+        # tiered decision cache (fleet/cache.py): private-L1 entries per
+        # replica, shared generation-stamped L2 entries fleet-wide
+        "l1_size": 256,
+        "l2_size": 4096,
+        # disaggregated pools (fleet/pools.py): replica addrs
+        # ("host:port") per role; both empty = no disaggregation (all
+        # work on the local/mixed backend)
+        "prefill_addrs": [],
+        "decode_addrs": [],
+        # prepacked admission: batch up to this many same-snapshot
+        # decisions into one decide_batch frame, flushing after the
+        # window elapses
+        "prepack_max_batch": 16,
+        "prepack_window_ms": 2.0,
+    },
     # Multi-host JAX (parallel/distributed.py). On TPU pods the launcher
     # auto-detects coordinator/count/id (leave them null); set them
     # explicitly for manual/CPU launches. The control plane (watch/bind)
@@ -236,6 +261,17 @@ ENV_OVERRIDES: dict[str, str] = {
     "OBS_SAMPLER_INTERVAL_S": "observability.sampler_interval_s",
     "OBS_SAMPLER_WINDOW": "observability.sampler_window",
     "FALLBACK_STRATEGY": "fallback.strategy",
+    "FLEET_ENABLED": "fleet.enabled",
+    "FLEET_REPLICAS": "fleet.replicas",
+    "FLEET_N_SHARDS": "fleet.n_shards",
+    "FLEET_LEASE_TTL_S": "fleet.lease_ttl_s",
+    "FLEET_RENEW_INTERVAL_S": "fleet.renew_interval_s",
+    "FLEET_L1_SIZE": "fleet.l1_size",
+    "FLEET_L2_SIZE": "fleet.l2_size",
+    "FLEET_PREPACK_MAX_BATCH": "fleet.prepack_max_batch",
+    "FLEET_PREPACK_WINDOW_MS": "fleet.prepack_window_ms",
+    "FLEET_PREFILL_ADDRS": "fleet.prefill_addrs",
+    "FLEET_DECODE_ADDRS": "fleet.decode_addrs",
     "ROLLOUT_REGISTRY_DIR": "rollout.registry_dir",
     "ROLLOUT_SHADOW_FRACTION": "rollout.shadow_fraction",
     "ROLLOUT_SWAP_MODE": "rollout.swap_mode",
@@ -251,6 +287,10 @@ def _coerce(value: str, template: Any) -> Any:
         return int(value)
     if isinstance(template, float):
         return float(value)
+    if isinstance(template, list):
+        # comma-separated ("host:9901,host:9902"); empty string = []
+        return [part for part in
+                (piece.strip() for piece in value.split(",")) if part]
     return value
 
 

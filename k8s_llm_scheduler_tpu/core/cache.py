@@ -62,7 +62,11 @@ def _nodes_digest(nodes: Sequence[NodeMetrics]) -> bytes:
     digest = h.digest()
     with _NODES_DIGEST_LOCK:
         _NODES_DIGEST_MEMO[key] = (nodes, digest)
-        while len(_NODES_DIGEST_MEMO) > 8:
+        # 32, not 8: a fleet of sharded replicas (fleet/frontend.py) pins
+        # one live snapshot PER REPLICA — at the bench's 16 replicas a
+        # cap of 8 thrashed the memo and re-digested a 500-node snapshot
+        # on every decision's hot path.
+        while len(_NODES_DIGEST_MEMO) > 32:
             _NODES_DIGEST_MEMO.popitem(last=False)
     return digest
 
@@ -99,6 +103,13 @@ class DecisionCache:
         self._lock = threading.Lock()
         self.hits = 0
         self.misses = 0
+        # Per-thread outcome of the LAST get(): "l1_hit" | "miss" (a
+        # single-tier cache is its own L1; fleet/cache.TieredDecisionCache
+        # overrides with l1_hit/l2_hit/miss). Thread-local because the
+        # cache is shared across the watch loop and replica threads —
+        # the flight recorder stamps THIS thread's lookup, not the
+        # latest lookup fleet-wide.
+        self._tier_local = threading.local()
         # Policy generation/epoch. decision_cache_key digests only (pod,
         # cluster) state, so after a weight swap (rollout/hotswap.py) every
         # pre-swap entry would still hit — decisions from the RETIRED
@@ -115,6 +126,23 @@ class DecisionCache:
         with self._lock:
             self.generation += 1
             return self.generation
+
+    def set_generation(self, generation: int) -> int:
+        """Catch this cache up to a FOREIGN generation authority (the
+        fleet's shared L2: a replica's private L1 must treat an L2 bump —
+        another replica's hot swap — exactly like its own). Monotonic:
+        a stale/lower value never rolls the epoch back. Returns the
+        resulting generation."""
+        with self._lock:
+            if generation > self.generation:
+                self.generation = generation
+            return self.generation
+
+    @property
+    def last_tier(self) -> str | None:
+        """This thread's last get() outcome ("l1_hit"/"miss"), for the
+        flight recorder's cache_tier stamp. None before any lookup."""
+        return getattr(self._tier_local, "value", None)
 
     def _stored_key(self, key: str, generation: int | None = None) -> str:
         # caller holds self._lock
@@ -135,13 +163,16 @@ class DecisionCache:
             entry = self._entries.get(key)
             if entry is None:
                 self.misses += 1
+                self._tier_local.value = "miss"
                 return None
             stored_at, decision = entry
             if now - stored_at > self.ttl_seconds:  # expire on read (scheduler.py:278-282)
                 del self._entries[key]
                 self.misses += 1
+                self._tier_local.value = "miss"
                 return None
             self.hits += 1
+            self._tier_local.value = "l1_hit"
             return decision
 
     def set(

@@ -68,14 +68,30 @@ class StubBackend:
     (to exercise retry/breaker paths); `latency_s` simulates decode time.
     """
 
-    def __init__(self, latency_s: float = 0.0) -> None:
+    def __init__(self, latency_s: float = 0.0, pool_role: str = "mixed") -> None:
         self.latency_s = latency_s
         self.fail_next = 0
         self.calls = 0
+        # Disaggregated-pool role parity with LocalLLMBackend
+        # (fleet/pools.py): lets pool-routing tests and benches run with
+        # zero model weights.
+        if pool_role not in ("prefill", "decode", "mixed"):
+            raise ValueError(
+                f"pool_role {pool_role!r} not in ('prefill', 'decode', 'mixed')"
+            )
+        self.pool_role = pool_role
+        self.role_refusals = 0
+        self.batch_calls = 0
 
     def get_scheduling_decision(
-        self, pod: PodSpec, nodes: Sequence[NodeMetrics]
+        self, pod: PodSpec, nodes: Sequence[NodeMetrics],
+        work: str = "prefill",
     ) -> SchedulingDecision:
+        if self.pool_role == "decode" and work == "prefill":
+            self.role_refusals += 1
+            raise BackendError(
+                "pool role 'decode' refuses admission (prefill) work"
+            )
         self.calls += 1
         if self.fail_next > 0:
             self.fail_next -= 1
@@ -95,3 +111,18 @@ class StubBackend:
             source=DecisionSource.LLM,
             latency_ms=(time.perf_counter() - start) * 1000.0,
         )
+
+    def get_scheduling_decisions_batch(
+        self, pods: Sequence[PodSpec], nodes: Sequence[NodeMetrics],
+        work: str = "prefill",
+    ) -> list["SchedulingDecision | Exception"]:
+        """Prepacked-admission surface parity with LocalLLMBackend:
+        positional per-pod outcomes, one bad pod never fails the batch."""
+        self.batch_calls += 1
+        out: list[SchedulingDecision | Exception] = []
+        for pod in pods:
+            try:
+                out.append(self.get_scheduling_decision(pod, nodes, work=work))
+            except Exception as exc:
+                out.append(exc)
+        return out

@@ -141,8 +141,19 @@ class LocalLLMBackend:
         prewarm_idle_delay_s: float = 0.5,
         answer_style: str = "direct",
         max_reason_tokens: int = 320,
+        pool_role: str = "mixed",
     ) -> None:
         self.engine = engine
+        # Disaggregated-pool role (fleet/pools.py): "decode" workers
+        # refuse admission (work="prefill") so a fleet routing bug fails
+        # loudly instead of letting admission bursts evict the decode
+        # pool's throughput; "prefill"/"mixed" accept everything.
+        if pool_role not in ("prefill", "decode", "mixed"):
+            raise ValueError(
+                f"pool_role {pool_role!r} not in ('prefill', 'decode', 'mixed')"
+            )
+        self.pool_role = pool_role
+        self.role_refusals = 0  # GIL-atomic counter (stats only)
         # Decision JSON field order: "direct" (reference serialization) or
         # "cot" (reasoning emitted BEFORE the constrained node choice —
         # engine/constrained.py). The parsed object is identical.
@@ -275,9 +286,22 @@ class LocalLLMBackend:
         item = _WorkItem(prefix_ids, None, group_key)
         return item
 
+    def _check_role(self, work: str) -> None:
+        """Pool-role admission gate (fleet/pools.check_pool_role
+        semantics, inlined to keep engine imports fleet-free): a
+        decode-role worker refuses prefill (admission) work."""
+        if self.pool_role == "decode" and work == "prefill":
+            self.role_refusals += 1
+            raise BackendError(
+                "pool role 'decode' refuses admission (prefill) work — "
+                "route new-snapshot decisions to the prefill pool"
+            )
+
     def get_scheduling_decision(
-        self, pod: PodSpec, nodes: Sequence[NodeMetrics]
+        self, pod: PodSpec, nodes: Sequence[NodeMetrics],
+        work: str = "prefill",
     ) -> SchedulingDecision:
+        self._check_role(work)
         item = self._prepare_item(pod, nodes)
         self._queue.put(item)
         try:
@@ -288,8 +312,45 @@ class LocalLLMBackend:
             raise BackendError(f"decision timed out after {self.request_timeout_s}s") from exc
         return self._parse(text, pod)
 
+    def get_scheduling_decisions_batch(
+        self, pods: Sequence[PodSpec], nodes: Sequence[NodeMetrics],
+        work: str = "prefill",
+    ) -> list["SchedulingDecision | Exception"]:
+        """Prepacked admission (fleet/pools.py): enqueue the WHOLE pack
+        before waiting on any future, so the engine worker admits the
+        batch together and coalesces it into one prefill wave (many
+        short scheduler prompts, one shared cluster prefix — the
+        Prepacking economics). Per-pod outcomes are returned
+        positionally (decision or exception); one infeasible pod never
+        fails its batchmates."""
+        self._check_role(work)
+        staged: list[tuple[int, "_WorkItem"]] = []
+        out: list[SchedulingDecision | Exception] = [
+            BackendError("batch slot unresolved")
+        ] * len(pods)
+        for i, pod in enumerate(pods):
+            try:
+                item = self._prepare_item(pod, nodes)
+            except Exception as exc:  # NoFeasibleNodeError, tokenizer...
+                out[i] = exc
+                continue
+            staged.append((i, item))
+            self._queue.put(item)
+        for i, item in staged:
+            try:
+                text = item.future.result(timeout=self.request_timeout_s)
+                out[i] = self._parse(text, pods[i])
+            except FuturesTimeout:
+                out[i] = BackendError(
+                    f"decision timed out after {self.request_timeout_s}s"
+                )
+            except Exception as exc:
+                out[i] = exc
+        return out
+
     async def get_scheduling_decision_async(
-        self, pod: PodSpec, nodes: Sequence[NodeMetrics]
+        self, pod: PodSpec, nodes: Sequence[NodeMetrics],
+        work: str = "prefill",
     ) -> SchedulingDecision:
         """Natively-async decision: awaits the engine future WITHOUT holding
         a worker thread. With the sync path, every in-flight pod pins one
@@ -297,6 +358,7 @@ class LocalLLMBackend:
         burst with more distinct pod shapes than pool threads
         (min(32, cpus+4) by default) deadlocks the burst into serial waves.
         DecisionClient prefers this method when present."""
+        self._check_role(work)
         item = self._prepare_item(pod, nodes)
         self._queue.put(item)
         try:
@@ -811,6 +873,9 @@ class LocalLLMBackend:
         out = self.engine.get_stats()
         if self.swap_stats["quiesce_runs"]:
             out["swap"] = dict(self.swap_stats)
+        if self.pool_role != "mixed":
+            out["pool_role"] = self.pool_role
+            out["role_refusals"] = self.role_refusals
         return out
 
 

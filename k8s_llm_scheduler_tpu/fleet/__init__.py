@@ -1,0 +1,50 @@
+"""Fleet-scale serving: leased watch-space sharding, tiered decision
+cache, disaggregated prefill/decode pools (ROADMAP open item 4).
+
+- `fleet/lease.py` — shard hashing + renewable TTL leases (failover
+  without double-binding);
+- `fleet/cache.py` — per-replica L1 over a fleet-shared,
+  generation-stamped L2 (hot swaps invalidate both tiers coherently);
+- `fleet/pools.py` — admission routed to a prefill pool (prepacked:
+  many short scheduler prompts per prefill wave), warm continuation to
+  a decode pool;
+- `fleet/frontend.py` — N sharded scheduler replicas composed over one
+  cluster.
+"""
+
+from k8s_llm_scheduler_tpu.fleet.cache import TieredDecisionCache
+from k8s_llm_scheduler_tpu.fleet.frontend import Fleet, FleetReplica
+from k8s_llm_scheduler_tpu.fleet.lease import (
+    Lease,
+    LeaseExpired,
+    LeaseManager,
+    LeaseStore,
+    assign_initial,
+    shard_of,
+)
+from k8s_llm_scheduler_tpu.fleet.pools import (
+    DECODE,
+    MIXED,
+    POOL_ROLES,
+    PREFILL,
+    DisaggregatedBackend,
+    check_pool_role,
+)
+
+__all__ = [
+    "DECODE",
+    "DisaggregatedBackend",
+    "Fleet",
+    "FleetReplica",
+    "Lease",
+    "LeaseExpired",
+    "LeaseManager",
+    "LeaseStore",
+    "MIXED",
+    "POOL_ROLES",
+    "PREFILL",
+    "TieredDecisionCache",
+    "assign_initial",
+    "check_pool_role",
+    "shard_of",
+]

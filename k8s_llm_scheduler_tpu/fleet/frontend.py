@@ -1,0 +1,338 @@
+"""Fleet frontend: N sharded scheduler replicas over one cluster.
+
+This is the composition layer the rest of `fleet/` exists for. Each
+`FleetReplica` is a complete serving stack — Scheduler loop,
+DecisionClient with a TieredDecisionCache (private L1 over the fleet's
+shared L2), its own DecisionBackend — whose watch space is filtered to
+the shards its LeaseManager currently holds. The `Fleet` object wires
+replicas to the shared pieces (LeaseStore, L2, cluster) and runs them
+as tasks on one event loop: the honest in-process twin of a
+one-process-per-replica deployment, and the shape `bench.py --preset
+fleet` and the failover tests drive.
+
+Correctness story for failover (the part that must be exact):
+
+1. a pod's shard never changes (hash of namespace/name);
+2. each replica's watch filter drops pods of shards it does not hold —
+   at most one replica SCHEDULES a pod at a time;
+3. the fenced binder re-checks shard ownership against the lease
+   manager at bind time, so a decision computed under a lease that
+   expired mid-flight is discarded, not bound;
+4. the cluster is the source of truth: bind of an already-bound pod
+   fails at the apiserver (and at cluster/fake.py), so even a fencing
+   race cannot double-bind — it can only waste one bind attempt;
+5. when a replica gains a shard (initial claim or failover), it
+   re-lists the cluster's still-pending pods for that shard and
+   schedules them — pods the dead replica already bound are no longer
+   pending, so the rebind pass is naturally exactly-once.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from collections.abc import Sequence
+from typing import Any, Callable
+
+from k8s_llm_scheduler_tpu.cluster.interface import Binder, ClusterState, RawPod
+from k8s_llm_scheduler_tpu.core.breaker import CircuitBreaker
+from k8s_llm_scheduler_tpu.core.cache import DecisionCache
+from k8s_llm_scheduler_tpu.fleet.cache import TieredDecisionCache
+from k8s_llm_scheduler_tpu.fleet.lease import (
+    LeaseManager,
+    LeaseStore,
+    assign_initial,
+    shard_of,
+)
+from k8s_llm_scheduler_tpu.sched.client import DecisionClient
+from k8s_llm_scheduler_tpu.sched.loop import Scheduler
+
+logger = logging.getLogger(__name__)
+
+
+class _ShardView:
+    """ClusterState filtered to one replica's live shard set. Node reads
+    pass through untouched (every replica needs the full snapshot — the
+    decision prompt is cluster-wide); only the pending-pod stream is
+    partitioned."""
+
+    def __init__(
+        self, inner: ClusterState, owns: Callable[[int], bool],
+        n_shards: int,
+    ) -> None:
+        self._inner = inner
+        self._owns = owns
+        self._n_shards = n_shards
+
+    def get_node_metrics(self):
+        return self._inner.get_node_metrics()
+
+    async def watch_pending_pods(self, scheduler_name: str):
+        async for raw in self._inner.watch_pending_pods(scheduler_name):
+            if self._owns(shard_of(raw.namespace, raw.name, self._n_shards)):
+                yield raw
+            # else: not ours — the shard's holder sees its own copy of
+            # the event; an UNHELD shard's pods are picked up by the
+            # rebind pass when some replica claims the shard
+
+
+class _FencedBinder:
+    """Bind-time lease fencing (correctness point 3 above)."""
+
+    def __init__(
+        self, inner: Binder, owns: Callable[[int], bool], n_shards: int,
+        on_fenced: Callable[[], None] | None = None,
+    ) -> None:
+        self._inner = inner
+        self._owns = owns
+        self._n_shards = n_shards
+        self._on_fenced = on_fenced
+        # preserve the loop's inline-bind fast path for in-memory binders
+        self.bind_is_nonblocking = getattr(inner, "bind_is_nonblocking", False)
+
+    def bind_pod_to_node(
+        self, pod_name: str, namespace: str, node_name: str
+    ) -> bool:
+        if not self._owns(shard_of(namespace, pod_name, self._n_shards)):
+            logger.warning(
+                "fenced bind dropped: %s/%s -> %s (lease no longer held)",
+                namespace, pod_name, node_name,
+            )
+            if self._on_fenced is not None:
+                self._on_fenced()
+            return False
+        return self._inner.bind_pod_to_node(pod_name, namespace, node_name)
+
+
+class FleetReplica:
+    """One sharded scheduler replica (see module docstring)."""
+
+    def __init__(
+        self,
+        replica_id: int,
+        *,
+        cluster: ClusterState,
+        binder: Binder,
+        backend: Any,
+        store: LeaseStore,
+        l2: DecisionCache,
+        scheduler_name: str,
+        l1_size: int = 256,
+        renew_interval_s: float = 1.5,
+        max_concurrency: int = 64,
+        snapshot_ttl_s: float = 1.0,
+        list_pending: Callable[[], Sequence[RawPod]] | None = None,
+    ) -> None:
+        self.replica_id = replica_id
+        self.holder = f"replica-{replica_id}"
+        self._list_pending = list_pending
+        self._loop: asyncio.AbstractEventLoop | None = None
+        self.fenced_binds = 0
+        self.manager = LeaseManager(
+            store, self.holder,
+            renew_interval_s=renew_interval_s,
+            on_gain=self._on_gain,
+        )
+        self.cache = TieredDecisionCache(l2, l1_size=l1_size)
+        self.client = DecisionClient(
+            backend,
+            cache=self.cache,
+            breaker=CircuitBreaker(),
+            retry_delay=0.05,
+        )
+        n_shards = store.n_shards
+        self.scheduler = Scheduler(
+            _ShardView(cluster, self.manager.owns, n_shards),
+            _FencedBinder(
+                binder, self.manager.owns, n_shards, self._note_fenced
+            ),
+            self.client,
+            scheduler_name=scheduler_name,
+            max_concurrency=max_concurrency,
+            snapshot_ttl_s=snapshot_ttl_s,
+            prefix_prewarm_s=0.0,  # the fleet router owns prewarm policy
+        )
+        # flight-recorder shard attribution (sched/loop stamps this on
+        # every decision trace this replica records)
+        self.scheduler.shard_fn = (
+            lambda ns, name: shard_of(ns, name, n_shards)
+        )
+        self._task: asyncio.Task | None = None
+
+    def _note_fenced(self) -> None:
+        self.fenced_binds += 1  # GIL-atomic int bump; stats-only
+
+    # ------------------------------------------------------------- lifecycle
+    async def start(self, lease_thread: bool = True) -> None:
+        self._loop = asyncio.get_running_loop()
+        self._task = asyncio.create_task(self.scheduler.run())
+        if lease_thread:
+            self.manager.start()
+
+    async def stop(self, release_leases: bool = True) -> None:
+        """Clean shutdown (releases leases) or simulated crash
+        (`release_leases=False`: leases linger until TTL — failover
+        tests kill replicas this way). Leases are held until the
+        scheduler has drained: releasing first would fence our own
+        in-flight binds and report them as failed."""
+        self.scheduler.stop()
+        if self._task is not None:
+            try:
+                await asyncio.wait_for(self._task, timeout=30)
+            except asyncio.TimeoutError:
+                self._task.cancel()
+            self._task = None
+        self.manager.stop(release=release_leases)
+
+    # -------------------------------------------------------------- rebind
+    def _on_gain(self, shards: frozenset[int]) -> None:
+        """Lease-manager callback (manager tick thread OR the event loop
+        in manual-tick tests): schedule a rebind scan for the gained
+        shards on the replica's loop."""
+        loop = self._loop
+        if loop is None or loop.is_closed():
+            return
+        try:
+            running = asyncio.get_running_loop()
+        except RuntimeError:
+            running = None
+        if running is loop:
+            task = asyncio.ensure_future(self._rebind(shards))
+            self.scheduler._tasks.add(task)
+            task.add_done_callback(self.scheduler._tasks.discard)
+        else:
+            asyncio.run_coroutine_threadsafe(self._rebind(shards), loop)
+
+    async def _rebind(self, shards: frozenset[int]) -> None:
+        """Re-list still-pending pods of the gained shards and schedule
+        them (correctness point 5). Without a lister (live KubeCluster:
+        the watch's periodic re-list re-delivers pending pods anyway)
+        this is a no-op and convergence rides the watch."""
+        if self._list_pending is None:
+            return
+        try:
+            pending = await asyncio.to_thread(self._list_pending)
+        except Exception:
+            logger.exception("rebind re-list failed (%s)", self.holder)
+            return
+        n_shards = self.manager.store.n_shards
+        todo = [
+            raw for raw in pending
+            if shard_of(raw.namespace, raw.name, n_shards) in shards
+        ]
+        if not todo:
+            return
+        logger.info(
+            "%s: rebinding %d pending pod(s) from gained shards %s",
+            self.holder, len(todo), sorted(shards),
+        )
+        await asyncio.gather(
+            *(self.scheduler.schedule_pod(raw) for raw in todo),
+            return_exceptions=True,
+        )
+
+    def get_stats(self) -> dict:
+        return {
+            "replica_id": self.replica_id,
+            "owned_shards": sorted(self.manager.owned()),
+            "fenced_binds": self.fenced_binds,
+            **self.scheduler.get_stats(),
+        }
+
+
+class Fleet:
+    """N replicas + the shared pieces, run on the current event loop."""
+
+    def __init__(
+        self,
+        cluster: ClusterState,
+        binder: Binder,
+        backend_factory: Callable[[int], Any],
+        *,
+        n_replicas: int,
+        n_shards: int | None = None,
+        scheduler_name: str = "ai-llama-scheduler",
+        lease_ttl_s: float = 5.0,
+        renew_interval_s: float = 1.5,
+        l1_size: int = 256,
+        l2_size: int = 4096,
+        l2_ttl_s: float = 300.0,
+        max_concurrency: int = 64,
+        snapshot_ttl_s: float = 1.0,
+        clock=None,
+        list_pending: Callable[[], Sequence[RawPod]] | None = None,
+    ) -> None:
+        if n_replicas < 1:
+            raise ValueError("n_replicas must be >= 1")
+        if n_shards is None:
+            # enough shards that failover redistributes in pieces, few
+            # enough that the per-shard lease traffic stays trivial
+            n_shards = max(2 * n_replicas, 8)
+        self.n_shards = n_shards
+        kwargs = {} if clock is None else {"clock": clock}
+        self.store = LeaseStore(n_shards, ttl_s=lease_ttl_s, **kwargs)
+        self.l2 = DecisionCache(ttl_seconds=l2_ttl_s, max_size=l2_size)
+        self.replicas = [
+            FleetReplica(
+                i,
+                cluster=cluster,
+                binder=binder,
+                backend=backend_factory(i),
+                store=self.store,
+                l2=self.l2,
+                scheduler_name=scheduler_name,
+                l1_size=l1_size,
+                renew_interval_s=renew_interval_s,
+                max_concurrency=max_concurrency,
+                snapshot_ttl_s=snapshot_ttl_s,
+                list_pending=list_pending,
+            )
+            for i in range(n_replicas)
+        ]
+
+    async def start(self, lease_threads: bool = True) -> None:
+        """Bootstrap ownership deterministically (every shard held
+        before the first pod event), then start the replica loops. With
+        `lease_threads=False` tests drive `tick_leases()` manually."""
+        assigned = assign_initial(
+            self.store, [r.holder for r in self.replicas]
+        )
+        by_holder = {r.holder: r for r in self.replicas}
+        for holder, leases in assigned.items():
+            replica = by_holder[holder]
+            for lease in leases:
+                replica.manager.adopt(lease)
+        for replica in self.replicas:
+            await replica.start(lease_thread=lease_threads)
+
+    def tick_leases(self) -> None:
+        for replica in self.replicas:
+            replica.manager.tick()
+
+    async def stop(self) -> None:
+        await asyncio.gather(*(r.stop() for r in self.replicas))
+
+    async def kill_replica(self, index: int) -> None:
+        """Simulated crash: the scheduler stops, leases are NOT
+        released — failover happens via TTL expiry."""
+        await self.replicas[index].stop(release_leases=False)
+
+    def get_stats(self) -> dict:
+        totals = {
+            "total_scheduled": 0,
+            "failed_bindings": 0,
+            "fenced_binds": 0,
+        }
+        per_replica = []
+        for replica in self.replicas:
+            stats = replica.get_stats()
+            per_replica.append(stats)
+            totals["total_scheduled"] += stats.get("total_scheduled", 0)
+            totals["failed_bindings"] += stats.get("failed_bindings", 0)
+            totals["fenced_binds"] += stats.get("fenced_binds", 0)
+        return {
+            **totals,
+            "n_shards": self.n_shards,
+            "l2": self.l2.stats(),
+            "replicas": per_replica,
+        }

@@ -113,13 +113,27 @@ def run_gate(
 def staggered_swap(
     swap_fns: Sequence[Callable[[], Any]],
     verify: Callable[[int, Any], bool] | None = None,
+    decision_cache: Any = None,
 ) -> list[Any]:
-    """Run per-replica swap callables ONE AT A TIME (fanout deployments:
-    the FanoutBackend must always keep a serving majority on a consistent
-    version). `verify(index, result)` returning False — or any raise —
-    stops the stagger; replicas not yet swapped stay on the incumbent.
+    """Run per-replica swap callables ONE AT A TIME (fanout and fleet
+    deployments: the dispatch layer must always keep a serving majority
+    on a consistent version). `verify(index, result)` returning False —
+    or any raise — stops the stagger; replicas not yet swapped stay on
+    the incumbent.
+
+    `decision_cache` is the fleet's decision cache (typically
+    fleet/cache.TieredDecisionCache over the shared L2): when every
+    replica swapped successfully, its generation is bumped ONCE — one
+    fleet-wide epoch, invalidating every replica's L1 and the shared L2
+    coherently — instead of per-replica bumps that would leave windows
+    where a not-yet-swapped replica refills the shared tier with
+    old-policy decisions under the new epoch. On a stopped stagger the
+    bump is withheld: the fleet is still serving the incumbent majority,
+    and incumbent decisions remain valid.
+
     Returns the per-replica results up to the stop point."""
     results: list[Any] = []
+    completed = True
     for i, fn in enumerate(swap_fns):
         result = fn()
         results.append(result)
@@ -128,7 +142,14 @@ def staggered_swap(
                 "staggered swap stopped at replica %d/%d (verify failed)",
                 i + 1, len(swap_fns),
             )
+            completed = False
             break
+    if completed and decision_cache is not None:
+        generation = decision_cache.bump_generation()
+        logger.info(
+            "staggered swap complete across %d replica(s); decision-cache "
+            "generation bumped to %d", len(results), generation,
+        )
     return results
 
 

@@ -192,6 +192,14 @@ class DecisionClient:
                 # same equivalence class the prompt prefix is keyed by
                 trace.set_meta(cache_key=key[:16], cache_generation=generation)
             cached = self.cache.get(pod, nodes, key=key)
+            if trace is not None:
+                # which tier answered (or "miss"): l1_hit / l2_hit come
+                # from the cache's thread-local lookup record — the fleet
+                # tiering attribute (fleet/cache.TieredDecisionCache); a
+                # flat DecisionCache reports l1_hit/miss.
+                tier = getattr(self.cache, "last_tier", None)
+                if tier is not None:
+                    trace.set_meta(cache_tier=tier)
             if cached is not None:
                 self.stats["cached_requests"] += 1
                 return dataclasses.replace(cached, source=DecisionSource.CACHE)
@@ -205,6 +213,8 @@ class DecisionClient:
                 if leader is not None:
                     self.stats["coalesced_requests"] += 1
                     self.stats["cached_requests"] += 1
+                    if trace is not None:
+                        trace.set_meta(cache_tier="coalesced")
                     return dataclasses.replace(leader, source=DecisionSource.CACHE)
                 # Leader failed or fell back — compute independently below.
             fut = asyncio.get_running_loop().create_future()

@@ -93,6 +93,15 @@ class Scheduler:
         self._tasks: set[asyncio.Task] = set()
         # follower fan-out batches parked on in-flight leader futures
         self._followers: dict[asyncio.Future, list] = {}
+        # pods currently in the pipeline, keyed (namespace, name): the
+        # same pod can reach the scheduler twice concurrently — a watch
+        # event racing a fleet rebind re-list (fleet/frontend._rebind),
+        # or a kube relist re-delivering a still-in-flight pod. The
+        # second copy is suppressed, not double-decided (the loser would
+        # waste a model call and fail its bind at the apiserver). All
+        # mutations happen on the event loop; completed pods leave the
+        # set, so a genuinely re-pending pod (failed bind) retries.
+        self._inflight_pods: set[tuple[str, str]] = set()
         self._stop_event = asyncio.Event()
         self.running = False
         # Per-phase wall time of the decision pipeline (SURVEY §5 tracing:
@@ -102,6 +111,11 @@ class Scheduler:
         # fraction of decided pods through a candidate backend, non-binding
         # and off the hot path. Attached by the rollout wiring.
         self.shadow = None
+        # Optional shard attribution (fleet/frontend.py): maps a pod's
+        # (namespace, name) to its watch-space shard id; when set, every
+        # decision trace carries shard_id in its meta so /debug/decisions
+        # and `cli trace` answer "which replica's shard was this?".
+        self.shard_fn = None
         self.stats = {
             "total_scheduled": 0,
             "llm_decisions": 0,
@@ -139,12 +153,30 @@ class Scheduler:
         snapshot/decide/bind child spans here, backend/admission/prefill/
         decode spans attached downstream (sched/client, engine/local), so
         "why was THIS placement slow?" is answerable from /debug/trace."""
-        if pod is None:
-            pod = raw_pod_to_spec(raw)
-        with spans.start_trace(
-            "decision", pod=f"{pod.namespace}/{pod.name}", path="full"
-        ) as trace:
-            return await self._schedule_pod_inner(pod, trace)
+        key = (raw.namespace, raw.name)
+        if key in self._inflight_pods:
+            logger.debug(
+                "duplicate schedule suppressed: %s/%s (already in flight)",
+                raw.namespace, raw.name,
+            )
+            return False
+        self._inflight_pods.add(key)
+        try:
+            if pod is None:
+                pod = raw_pod_to_spec(raw)
+            with spans.start_trace(
+                "decision", pod=f"{pod.namespace}/{pod.name}", path="full"
+            ) as trace:
+                self._stamp_shard(trace, pod)
+                return await self._schedule_pod_inner(pod, trace)
+        finally:
+            self._inflight_pods.discard(key)
+
+    def _stamp_shard(self, trace, pod) -> None:
+        """Shard attribution on the decision trace (all three paths —
+        full, fast, follower — call this right after the trace opens)."""
+        if trace is not None and self.shard_fn is not None:
+            trace.set_meta(shard_id=self.shard_fn(pod.namespace, pod.name))
 
     async def _schedule_pod_inner(self, pod, trace) -> bool:
         with self.phases.phase("snapshot"), spans.span("snapshot"):
@@ -242,6 +274,8 @@ class Scheduler:
         loop stays idle while the wave is in flight (the pod's latency is
         then one wave round trip, not host scheduling).
         """
+        if (raw.namespace, raw.name) in self._inflight_pods:
+            return True, None  # duplicate of an in-flight pod: drop it
         if not getattr(self.binder, "bind_is_nonblocking", False):
             return False, None  # blocking binders need the executor path
         snap = self._snapshot
@@ -272,6 +306,13 @@ class Scheduler:
                         "decide", start_unix=t0_wall,
                         dur_ms=decide_s * 1000.0, cache_hit=True,
                     )
+                    # the cache recorded which tier answered (thread-local
+                    # on this loop thread, set by the fast_decision lookup
+                    # just above): l1_hit, or l2_hit via a fleet-shared L2
+                    tier = getattr(self.client.cache, "last_tier", None)
+                    if tier is not None:
+                        trace.set_meta(cache_tier=tier)
+                self._stamp_shard(trace, pod)
                 _stamp_decision(trace, decision)
                 try:
                     ok = self._bind_now(pod, decision)
@@ -293,6 +334,8 @@ class Scheduler:
             if batch is None:
                 self._followers[fut] = batch = []
                 fut.add_done_callback(self._flush_followers)
+            # parked followers are in flight until the flush binds them
+            self._inflight_pods.add((raw.namespace, raw.name))
             batch.append((raw, pod, t0, t0_wall))
             return True, pod
         return False, pod
@@ -352,6 +395,10 @@ class Scheduler:
                                 dur_ms=(now - parked_at) * 1000.0,
                                 coalesced=True,
                             )
+                            # a follower never consulted the cache: its
+                            # decision is the leader's, reused in flight
+                            trace.set_meta(cache_tier="coalesced")
+                        self._stamp_shard(trace, pod)
                         _stamp_decision(trace, decision)
                         ok = self._bind_now(pod, decision)
                         _stamp_outcome(trace, "bound" if ok else "bind_failed")
@@ -360,10 +407,15 @@ class Scheduler:
                     logger.exception(
                         "follower bind failed: %s/%s", pod.namespace, pod.name
                     )
+                finally:
+                    self._inflight_pods.discard((_raw.namespace, _raw.name))
         else:
             # leader failed or fell back: each follower decides on the full
-            # path (which records its own decide phase)
+            # path (which records its own decide phase). Release the park
+            # key first — schedule_pod re-adds it (and would otherwise
+            # suppress its own retry as a duplicate).
             for raw, pod, _t0, _t0w in batch:
+                self._inflight_pods.discard((raw.namespace, raw.name))
                 task = asyncio.create_task(self._spawn(raw, pod))
                 self._tasks.add(task)
                 task.add_done_callback(self._tasks.discard)

@@ -38,10 +38,12 @@ tests/test_replica.py.
 from __future__ import annotations
 
 import dataclasses
+import inspect
 import itertools
 import json
 import logging
 import math
+import random
 import socket
 import struct
 import threading
@@ -153,10 +155,34 @@ class ReplicaServer:
     def __init__(self, backend: DecisionBackend, host: str = "localhost",
                  port: int = 9901, max_inflight: int = 64,
                  max_connections: int = 16,
-                 swap_fn: Callable[[int], dict] | None = None) -> None:
+                 swap_fn: Callable[[int], dict] | None = None,
+                 pool_role: str = "mixed") -> None:
         from concurrent.futures import ThreadPoolExecutor
 
+        from k8s_llm_scheduler_tpu.fleet.pools import POOL_ROLES
+
         self.backend = backend
+        # Disaggregated-pool role (fleet/pools.py): a "decode" worker
+        # refuses admission (work="prefill") frames so a misrouting fleet
+        # frontend fails loudly instead of silently evicting decode
+        # throughput. "mixed" (default) accepts everything — single-pool
+        # deployments are unchanged.
+        if pool_role not in POOL_ROLES:
+            raise ValueError(
+                f"pool_role {pool_role!r} not in {POOL_ROLES}"
+            )
+        self.pool_role = pool_role
+        # capability probes, ONCE (not per request): does the backend
+        # understand the work tag / the prepacked batch surface?
+        try:
+            self._backend_accepts_work = "work" in inspect.signature(
+                backend.get_scheduling_decision
+            ).parameters
+        except (TypeError, ValueError):
+            self._backend_accepts_work = False
+        self._backend_batch = getattr(
+            backend, "get_scheduling_decisions_batch", None
+        )
         # Optional rollout hook: `swap_fn(version) -> dict` hot-swaps THIS
         # worker's backend to a registry version (rollout/hotswap.py
         # HotSwapper.swap_to over a registry the worker can read). The
@@ -217,10 +243,11 @@ class ReplicaServer:
                 req = _recv_frame(conn)
                 if req is None:
                     return
+                cost = self._frame_cost(req)
                 with self._inflight_lock:
                     admitted = self._inflight < self.max_inflight
                     if admitted:
-                        self._inflight += 1
+                        self._inflight += cost
                 if not admitted:
                     # fail fast instead of queueing unbounded: the
                     # coordinator's retry/fallback stack absorbs this
@@ -240,7 +267,7 @@ class ReplicaServer:
                     self._pool.submit(self._serve_one, conn, send_lock, req)
                 except RuntimeError:
                     with self._inflight_lock:
-                        self._inflight -= 1
+                        self._inflight -= cost
                     return  # pool shut down by close()
         except Exception as exc:
             # broad on purpose: _recv_frame's frame-size guard raises
@@ -282,9 +309,17 @@ class ReplicaServer:
                 # answers ok=False.
                 self._serve_prewarm(conn, send_lock, req)
                 return
+            elif req.get("op") == "decide_batch":
+                # Prepacked admission (fleet/pools.py): many pods, ONE
+                # nodes snapshot, one frame — per-pod outcomes ride back
+                # positionally so one infeasible pod doesn't fail its
+                # batchmates.
+                resp = self._serve_batch(rid, req)
             else:
                 pod = pod_from_wire(req["pod"])
                 nodes = [node_from_wire(n) for n in req["nodes"]]
+                work = req.get("work", "prefill")
+                self._check_role(work)
                 wire_trace = req.get("trace")
                 if wire_trace and spans.enabled():
                     # Continue the COORDINATOR's trace on this side: same
@@ -299,9 +334,7 @@ class ReplicaServer:
                         parent_id=str(wire_trace.get("span_id")),
                         pod=f"{pod.namespace}/{pod.name}",
                     ) as rtrace:
-                        decision = self.backend.get_scheduling_decision(
-                            pod, nodes
-                        )
+                        decision = self._decide(pod, nodes, work)
                     resp = {
                         "id": rid,
                         "decision": decision_to_wire(decision),
@@ -310,7 +343,7 @@ class ReplicaServer:
                         else [],
                     }
                 else:
-                    decision = self.backend.get_scheduling_decision(pod, nodes)
+                    decision = self._decide(pod, nodes, work)
                     resp = {"id": rid, "decision": decision_to_wire(decision)}
             with self._served_lock:
                 self.served += 1
@@ -320,12 +353,67 @@ class ReplicaServer:
             resp = {"id": rid, "error": str(exc), "kind": "backend"}
         finally:
             with self._inflight_lock:
-                self._inflight -= 1
+                self._inflight -= self._frame_cost(req)
         try:
             with send_lock:
                 _send_frame(conn, resp)
         except OSError:
             pass  # client gone; nothing to deliver to
+
+    @staticmethod
+    def _frame_cost(req: dict) -> int:
+        """Admission weight of a frame against max_inflight. A
+        decide_batch carries up to prepack_max_batch decisions — counting
+        it as 1 would let an admission burst admit max_inflight*batch
+        concurrent backend decisions, defeating the overload fail-fast
+        exactly when prepacking concentrates load. A frame with headroom
+        always admits (the predicate checks before adding), so a batch
+        larger than max_inflight is still servable, one at a time."""
+        if req.get("op") == "decide_batch":
+            pods = req.get("pods")
+            return max(1, len(pods)) if isinstance(pods, list) else 1
+        return 1
+
+    def _check_role(self, work: str) -> None:
+        from k8s_llm_scheduler_tpu.fleet.pools import check_pool_role
+
+        check_pool_role(self.pool_role, work)
+
+    def _decide(
+        self, pod: PodSpec, nodes: list[NodeMetrics], work: str
+    ) -> SchedulingDecision:
+        if self._backend_accepts_work:
+            return self.backend.get_scheduling_decision(
+                pod, nodes, work=work
+            )
+        return self.backend.get_scheduling_decision(pod, nodes)
+
+    def _serve_batch(self, rid, req: dict) -> dict:
+        nodes = [node_from_wire(n) for n in req["nodes"]]
+        work = req.get("work", "prefill")
+        self._check_role(work)
+        pods = [pod_from_wire(p) for p in req["pods"]]
+        results: list[dict] = []
+        if self._backend_batch is not None:
+            # the backend's own batch surface (LocalLLMBackend enqueues
+            # the whole pack before waiting — the engine admits it as
+            # one prefill wave, which is the point of prepacking)
+            outcomes = self._backend_batch(pods, nodes, work=work)
+        else:
+            outcomes = []
+            for pod in pods:
+                try:
+                    outcomes.append(self._decide(pod, nodes, work))
+                except Exception as exc:
+                    outcomes.append(exc)
+        for outcome in outcomes:
+            if isinstance(outcome, SchedulingDecision):
+                results.append({"decision": decision_to_wire(outcome)})
+            elif isinstance(outcome, NoFeasibleNodeError):
+                results.append({"error": str(outcome), "kind": "infeasible"})
+            else:
+                results.append({"error": str(outcome), "kind": "backend"})
+        return {"id": rid, "results": results}
 
     def _serve_prewarm(self, conn, send_lock, req: dict) -> None:
         rid = req.get("id")
@@ -412,11 +500,28 @@ class ReplicaClient:
     coordinator."""
 
     def __init__(self, host: str, port: int, connect_timeout_s: float = 10.0,
-                 request_timeout_s: float = 60.0) -> None:
+                 request_timeout_s: float = 60.0,
+                 reconnect_base_s: float = 0.05,
+                 reconnect_cap_s: float = 2.0) -> None:
         self.addr = f"{host}:{port}"
         self._host, self._port = host, port
         self.connect_timeout_s = connect_timeout_s
         self.request_timeout_s = request_timeout_s
+        # Reconnect discipline: exponential backoff with jitter. Without
+        # it, a worker restarting mid-stream eats one blocking dial
+        # (connect_timeout_s each) PER in-flight decision retry — a
+        # coordinator-side stall storm — and a fleet of coordinators
+        # re-dialing in lockstep thundering-herds the worker the moment
+        # it binds its socket. Failed dials open a fail-fast window
+        # (decisions during it raise immediately and ride the upstream
+        # retry/fallback stack); the window doubles per consecutive
+        # failure up to reconnect_cap_s, jittered to ~U[0.5, 1.0)x so
+        # herds decorrelate.
+        self.reconnect_base_s = float(reconnect_base_s)
+        self.reconnect_cap_s = float(reconnect_cap_s)
+        self._dial_failures = 0
+        self._next_dial_at = 0.0
+        self._rng = random.Random()
         self._sock: socket.socket | None = None
         self._reader: threading.Thread | None = None
         self._conn_lock = threading.Lock()
@@ -443,14 +548,41 @@ class ReplicaClient:
                 except OSError:
                     pass
                 self._sock = None
+            now = time.monotonic()
+            if self._dial_failures and now < self._next_dial_at:
+                # fail-fast window after a failed dial: don't pay another
+                # blocking connect (or hammer a restarting worker) until
+                # the backoff expires
+                raise BackendError(
+                    f"replica {self.addr} unreachable "
+                    f"(reconnect backing off "
+                    f"{self._next_dial_at - now:.2f}s after "
+                    f"{self._dial_failures} failed dial(s))"
+                )
             try:
                 sock = socket.create_connection(
                     (self._host, self._port), self.connect_timeout_s
                 )
             except OSError as exc:
+                self._dial_failures += 1
+                if self._dial_failures >= 2:
+                    # the FIRST failure keeps the historical contract (the
+                    # very next submit may re-dial immediately — a worker
+                    # that just finished binding its socket heals with
+                    # zero added latency); only repetition opens a window
+                    delay = min(
+                        self.reconnect_cap_s,
+                        self.reconnect_base_s
+                        * (2 ** min(self._dial_failures - 2, 16)),
+                    )
+                    self._next_dial_at = now + delay * (
+                        0.5 + 0.5 * self._rng.random()
+                    )
                 raise BackendError(
                     f"replica {self.addr} unreachable: {exc}"
                 ) from exc
+            self._dial_failures = 0
+            self._next_dial_at = 0.0
             # create_connection leaves its timeout ON THE SOCKET: the
             # reader would then die on any response slower than
             # connect_timeout_s (e.g. a first decision paying a jit
@@ -559,12 +691,17 @@ class ReplicaClient:
         return rid, fut, sock
 
     def _submit(
-        self, pod: PodSpec, nodes: Sequence[NodeMetrics]
+        self, pod: PodSpec, nodes: Sequence[NodeMetrics],
+        work: str | None = None,
     ) -> tuple[int, Future, socket.socket]:
         payload = {
             "pod": pod_to_wire(pod),
             "nodes": [node_to_wire(n) for n in nodes],
         }
+        if work is not None:
+            # disaggregated-pool tag (fleet/pools.py): lets a decode-role
+            # worker refuse misrouted admission work
+            payload["work"] = work
         # Trace propagation: the ambient decision trace's (trace_id,
         # span_id) rides the frame so the worker's spans stitch into ONE
         # cross-host tree (ReplicaServer returns them in the response).
@@ -668,10 +805,86 @@ class ReplicaClient:
         with self._pending_lock:
             self._pending.pop(rid, None)
 
+    def _resolve_batch(
+        self, resp: dict
+    ) -> list["SchedulingDecision | Exception"]:
+        """Positional per-pod outcomes of a decide_batch: a decision, a
+        NoFeasibleNodeError, or a BackendError — returned, not raised,
+        so one bad pod never fails its batchmates."""
+        if "results" not in resp:
+            raise BackendError(
+                f"replica {self.addr}: {resp.get('error', 'malformed batch response')}"
+            )
+        out: list[SchedulingDecision | Exception] = []
+        for entry in resp["results"]:
+            if "decision" in entry:
+                out.append(decision_from_wire(entry["decision"]))
+            elif entry.get("kind") == "infeasible":
+                out.append(NoFeasibleNodeError(entry.get("error", "")))
+            else:
+                out.append(BackendError(
+                    f"replica {self.addr}: "
+                    f"{entry.get('error', 'unknown failure')}"
+                ))
+        return out
+
+    def _submit_batch(
+        self, pods: Sequence[PodSpec], nodes: Sequence[NodeMetrics],
+        work: str | None,
+    ) -> tuple[int, Future, socket.socket]:
+        payload = {
+            "op": "decide_batch",
+            "pods": [pod_to_wire(p) for p in pods],
+            "nodes": [node_to_wire(n) for n in nodes],
+        }
+        if work is not None:
+            payload["work"] = work
+        return self._submit_frame(payload)
+
+    def get_scheduling_decisions_batch(
+        self, pods: Sequence[PodSpec], nodes: Sequence[NodeMetrics],
+        work: str | None = None,
+    ) -> list["SchedulingDecision | Exception"]:
+        """Prepacked admission: ship `pods` (sharing ONE snapshot) as a
+        single decide_batch frame; the worker's engine admits them
+        together and coalesces them into one prefill wave."""
+        rid, fut, sock = self._submit_batch(pods, nodes, work)
+        try:
+            resp = fut.result(timeout=self.request_timeout_s)
+        except FuturesTimeout as exc:
+            self._drop(rid)
+            self._mark_suspect(sock)
+            raise BackendError(
+                f"replica {self.addr} batch timed out after "
+                f"{self.request_timeout_s}s"
+            ) from exc
+        return self._resolve_batch(resp)
+
+    async def get_scheduling_decisions_batch_async(
+        self, pods: Sequence[PodSpec], nodes: Sequence[NodeMetrics],
+        work: str | None = None,
+    ) -> list["SchedulingDecision | Exception"]:
+        import asyncio
+
+        rid, fut, sock = self._submit_batch(pods, nodes, work)
+        try:
+            resp = await asyncio.wait_for(
+                asyncio.wrap_future(fut), timeout=self.request_timeout_s
+            )
+        except (TimeoutError, asyncio.TimeoutError) as exc:
+            self._drop(rid)
+            self._mark_suspect(sock)
+            raise BackendError(
+                f"replica {self.addr} batch timed out after "
+                f"{self.request_timeout_s}s"
+            ) from exc
+        return self._resolve_batch(resp)
+
     def get_scheduling_decision(
-        self, pod: PodSpec, nodes: Sequence[NodeMetrics]
+        self, pod: PodSpec, nodes: Sequence[NodeMetrics],
+        work: str | None = None,
     ) -> SchedulingDecision:
-        rid, fut, sock = self._submit(pod, nodes)
+        rid, fut, sock = self._submit(pod, nodes, work)
         try:
             resp = fut.result(timeout=self.request_timeout_s)
         except FuturesTimeout as exc:
@@ -687,14 +900,15 @@ class ReplicaClient:
         return self._resolve(resp)
 
     async def get_scheduling_decision_async(
-        self, pod: PodSpec, nodes: Sequence[NodeMetrics]
+        self, pod: PodSpec, nodes: Sequence[NodeMetrics],
+        work: str | None = None,
     ) -> SchedulingDecision:
         """Natively-async variant (DecisionClient prefers it): awaits the
         wire future without holding a worker thread, so a burst's leaders
         fan out to replicas without being capped by the to_thread pool."""
         import asyncio
 
-        rid, fut, sock = self._submit(pod, nodes)
+        rid, fut, sock = self._submit(pod, nodes, work)
         try:
             resp = await asyncio.wait_for(
                 asyncio.wrap_future(fut), timeout=self.request_timeout_s

@@ -127,10 +127,16 @@ class ScenarioSpec:
     n_nodes: int = 16
     n_pods: int = 64
     shapes: int = 8
-    arrival: str = "burst"        # burst | poisson | waves
-    arrival_rate: float = 500.0   # pods/sec (poisson)
+    arrival: str = "burst"        # burst | poisson | waves | multitenant
+    arrival_rate: float = 500.0   # pods/sec (poisson / multitenant aggregate)
     wave_window_s: float = 0.1    # arrival quantization window (poisson)
     n_waves: int = 4              # explicit wave count (arrival="waves")
+    # multitenant arrival shaping: number of independent tenant sources.
+    # Each tenant is an on/off Poisson stream whose share of the
+    # aggregate rate is drawn lognormal (heavy-tailed) — a few heavy
+    # tenants dominate and their on-periods overlap into the bursty,
+    # long-tailed superposition a fleet frontend actually sees.
+    tenants: int = 1
     hetero: bool = True           # draw node SKUs from the ladder
     zones: int = 4
     taint_frac: float = 0.0       # fraction of nodes carrying NoSchedule
@@ -267,6 +273,31 @@ def generate_scenario(spec: ScenarioSpec) -> Scenario:
             np.arange(spec.n_pods) * n_waves // max(1, spec.n_pods),
             n_waves - 1,
         )
+    elif spec.arrival == "multitenant":
+        # Superposition of per-tenant on/off Poisson sources. Tenant
+        # shares are lognormal (heavy-tailed: a handful of tenants carry
+        # most of the traffic — the millions-of-users shape, where "user
+        # demand" reaches the scheduler as deployments scaling replicas);
+        # each tenant's stream starts at its own offset so bursts overlap
+        # instead of aligning at t0.
+        tenants = max(1, spec.tenants)
+        weights = rng.lognormal(mean=0.0, sigma=1.5, size=tenants)
+        weights = weights / weights.sum()
+        counts = rng.multinomial(spec.n_pods, weights)
+        horizon = spec.n_pods / max(spec.arrival_rate, 1e-9)
+        streams = []
+        for t in range(tenants):
+            if counts[t] == 0:
+                continue
+            # tenant rate ~ its share of the aggregate; the on-period
+            # offset spreads tenants over the first half of the horizon
+            rate = max(spec.arrival_rate * float(weights[t]), 1e-9)
+            start = float(rng.uniform(0.0, horizon * 0.5))
+            gaps = rng.exponential(1.0 / rate, int(counts[t]))
+            streams.append(start + np.cumsum(gaps))
+        arrivals = np.sort(np.concatenate(streams)) if streams else np.zeros(0)
+        wave_of = (arrivals // max(spec.wave_window_s, 1e-9)).astype(int)
+        _, wave_of = np.unique(wave_of, return_inverse=True)
     elif spec.arrival == "burst":
         arrivals = np.zeros(spec.n_pods)
         wave_of = np.zeros(spec.n_pods, dtype=int)
@@ -299,6 +330,61 @@ def generate_scenario(spec: ScenarioSpec) -> Scenario:
             )
         )
     return Scenario(spec=spec, nodes=nodes, waves=waves)
+
+
+# ---------------------------------------------------------- fleet scenarios
+# Named fleet-scale scenario classes (ROADMAP open item 4): arrival
+# traces shaped like heavy multi-tenant traffic against large hetero
+# topologies. `fleet-500` is the fast-tier variant (CI, bench.py
+# --preset fleet); `fleet-10k` is the 10k-node / 100k-pod class (slow
+# tier — generation is seconds, driving it through a live stack is a
+# deliberate soak). Specs are returned by value: callers may mutate
+# their copy (seed sweeps, pod-count overrides) without corrupting the
+# registry.
+FLEET_SCENARIOS: dict[str, ScenarioSpec] = {
+    "fleet-500": ScenarioSpec(
+        name="fleet-500",
+        seed=7,
+        n_nodes=500,
+        n_pods=5_000,
+        shapes=64,
+        arrival="multitenant",
+        tenants=24,
+        arrival_rate=5_000.0,
+        wave_window_s=0.05,
+        hetero=True,
+        zones=8,
+        taint_frac=0.02,
+        constraint_mix=("uniform", "selector", "uniform", "tainted"),
+    ),
+    "fleet-10k": ScenarioSpec(
+        name="fleet-10k",
+        seed=7,
+        n_nodes=10_000,
+        n_pods=100_000,
+        shapes=512,
+        arrival="multitenant",
+        tenants=200,
+        arrival_rate=50_000.0,
+        wave_window_s=0.05,
+        hetero=True,
+        zones=16,
+        taint_frac=0.02,
+        constraint_mix=("uniform", "selector", "uniform", "tainted"),
+    ),
+}
+
+
+def fleet_scenario(name: str) -> ScenarioSpec:
+    """A copy of a named fleet scenario spec (see FLEET_SCENARIOS)."""
+    try:
+        spec = FLEET_SCENARIOS[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown fleet scenario {name!r} "
+            f"(known: {sorted(FLEET_SCENARIOS)})"
+        ) from None
+    return dataclasses.replace(spec)
 
 
 # --------------------------------------------------------------- twin model

@@ -1,0 +1,849 @@
+"""Fleet-scale serving (fleet/): shard leases + failover, tiered
+decision cache coherence across hot swaps, disaggregated prefill/decode
+pools with prepacked admission, and the sharded-replica frontend end to
+end over the in-memory cluster."""
+
+import asyncio
+import dataclasses
+import time
+
+import pytest
+
+from k8s_llm_scheduler_tpu.cluster.fake import FakeCluster
+from k8s_llm_scheduler_tpu.cluster.interface import RawPod
+from k8s_llm_scheduler_tpu.core.cache import DecisionCache, decision_cache_key
+from k8s_llm_scheduler_tpu.engine.backend import (
+    BackendError,
+    NoFeasibleNodeError,
+    StubBackend,
+)
+from k8s_llm_scheduler_tpu.fleet import (
+    DisaggregatedBackend,
+    Fleet,
+    LeaseExpired,
+    LeaseManager,
+    LeaseStore,
+    TieredDecisionCache,
+    assign_initial,
+    check_pool_role,
+    shard_of,
+)
+from k8s_llm_scheduler_tpu.observability import spans
+from k8s_llm_scheduler_tpu.testing import pod_burst, synthetic_cluster
+from k8s_llm_scheduler_tpu.types import (
+    DecisionSource,
+    NodeMetrics,
+    PodSpec,
+    SchedulingDecision,
+)
+
+SCHEDULER_NAME = "ai-llama-scheduler"
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0) -> None:
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def make_nodes(n=3):
+    return [
+        NodeMetrics(
+            name=f"node-{i}", cpu_usage_percent=10.0 * (i + 1),
+            memory_usage_percent=10.0 * (i + 1), available_cpu_cores=8.0,
+            available_memory_gb=32.0, pod_count=i, max_pods=110,
+            labels={"zone": "z1"}, taints=(),
+            conditions={"Ready": "True"},
+        )
+        for i in range(n)
+    ]
+
+
+def make_pod(i=0, cpu=0.1):
+    return PodSpec(
+        name=f"p{i}", namespace="default", cpu_request=cpu,
+        memory_request=0.125, node_selector={}, tolerations=(),
+        priority=0,
+    )
+
+
+def make_decision(node="node-0"):
+    return SchedulingDecision(
+        selected_node=node, confidence=0.9, reasoning="t",
+        source=DecisionSource.LLM,
+    )
+
+
+# ------------------------------------------------------------------ leases
+class TestShardOf:
+    def test_deterministic_and_in_range(self):
+        seen = set()
+        for i in range(200):
+            s = shard_of("default", f"pod-{i}", 16)
+            assert 0 <= s < 16
+            assert s == shard_of("default", f"pod-{i}", 16)
+            seen.add(s)
+        # 200 pods over 16 shards: the hash actually spreads
+        assert len(seen) == 16
+
+    def test_single_shard_fleet(self):
+        assert shard_of("ns", "name", 1) == 0
+
+    def test_namespace_is_part_of_identity(self):
+        shards = {shard_of(f"ns-{i}", "same-name", 64) for i in range(64)}
+        assert len(shards) > 1
+
+
+class TestLeaseStore:
+    def test_acquire_renew_expire_cycle(self):
+        clock = FakeClock()
+        store = LeaseStore(4, ttl_s=5.0, clock=clock)
+        lease = store.try_acquire(0, "a")
+        assert lease.epoch == 1 and store.holder_of(0) == "a"
+        # a live lease blocks other holders but renews for its own
+        assert store.try_acquire(0, "b") is None
+        clock.advance(3.0)
+        renewed = store.renew(0, "a", lease.epoch)
+        assert renewed.expires_at == clock() + 5.0
+        # expiry: the shard reads free and a new acquisition BUMPS the
+        # epoch (fencing: the old holder's token is now stale)
+        clock.advance(6.0)
+        assert store.holder_of(0) is None
+        lease_b = store.try_acquire(0, "b")
+        assert lease_b.epoch == 2
+        with pytest.raises(LeaseExpired):
+            store.renew(0, "a", lease.epoch)
+
+    def test_release_frees_immediately(self):
+        store = LeaseStore(2, ttl_s=60.0, clock=FakeClock())
+        store.try_acquire(1, "a")
+        assert store.release(1, "a") is True
+        assert store.holder_of(1) is None
+        assert store.try_acquire(1, "b").epoch == 2
+
+    def test_out_of_range_shard_rejected(self):
+        store = LeaseStore(2, ttl_s=1.0)
+        with pytest.raises(ValueError):
+            store.try_acquire(2, "a")
+
+
+class TestLeaseManager:
+    def test_fair_share_split_converges_on_scale_up(self):
+        """A replica joining an already-claimed space must not starve:
+        the incumbent sheds one over-target shard per tick and the
+        newcomer claims what is freed, converging to ceil(n/holders)
+        each without ever co-owning a shard."""
+        clock = FakeClock()
+        store = LeaseStore(8, ttl_s=60.0, clock=clock)
+        m1 = LeaseManager(store, "r1")
+        m2 = LeaseManager(store, "r2")
+        m1.tick()  # r1 alone: claims ceil(8/1)=8
+        assert len(m1.owned()) == 8
+        m2.tick()  # r2 makes itself visible (claims nothing yet)
+        for _ in range(8):  # alternate renew/shed/claim rounds
+            m1.tick()
+            m2.tick()
+            assert not (m1.owned() & m2.owned())
+        assert len(m1.owned()) == 4
+        assert len(m2.owned()) == 4
+        assert m1.owned() | m2.owned() == frozenset(range(8))
+
+    def test_newcomer_not_starved_when_holdings_equal_ceil(self):
+        """Regression: with 16 shards at 4 replicas, everyone holds
+        exactly ceil(16/5)=4 when a 5th joins — a ceil-only shed rule
+        never fires and the newcomer owns zero shards forever. The
+        floor rule (shed above floor while a live peer sits below it)
+        must hand it a fair share."""
+        clock = FakeClock()
+        store = LeaseStore(16, ttl_s=60.0, clock=clock)
+        incumbents = [LeaseManager(store, f"r{i}") for i in range(4)]
+        by_holder = {m.holder: m for m in incumbents}
+        for holder, leases in assign_initial(
+            store, [m.holder for m in incumbents]
+        ).items():
+            for lease in leases:
+                by_holder[holder].adopt(lease)
+        for m in incumbents:
+            m.tick()  # heartbeat + renew; already balanced at 4 each
+        assert sorted(len(m.owned()) for m in incumbents) == [4, 4, 4, 4]
+
+        newcomer = LeaseManager(store, "r4")
+        newcomer.tick()  # visible, but everything still leased
+        assert newcomer.owned() == frozenset()
+        for _ in range(8):
+            for m in incumbents:
+                m.tick()
+            newcomer.tick()
+        counts = sorted(
+            len(m.owned()) for m in incumbents + [newcomer]
+        )
+        # balanced: everyone within [floor, ceil] = [3, 4], disjoint cover
+        assert counts == [3, 3, 3, 3, 4], counts
+        all_owned = [m.owned() for m in incumbents + [newcomer]]
+        assert frozenset().union(*all_owned) == frozenset(range(16))
+        assert sum(len(o) for o in all_owned) == 16  # disjoint
+
+    def test_failover_reassigns_expired_shards(self):
+        clock = FakeClock()
+        store = LeaseStore(4, ttl_s=5.0, clock=clock)
+        gained, lost = [], []
+        dead = LeaseManager(store, "dead")
+        dead.tick()
+        assert len(dead.owned()) == 4
+        survivor = LeaseManager(
+            store, "live",
+            on_gain=lambda s: gained.append(s),
+            on_loss=lambda s: lost.append(s),
+        )
+        survivor.tick()
+        assert survivor.owned() == frozenset()  # all still leased
+        clock.advance(6.0)  # dead stops renewing; TTL passes
+        survivor.tick()
+        assert survivor.owned() == frozenset({0, 1, 2, 3})
+        assert gained and gained[0] == frozenset({0, 1, 2, 3})
+        # the dead replica coming back discovers the loss on ITS tick
+        dead_gained, dead_lost = dead.tick()
+        assert dead_lost == frozenset({0, 1, 2, 3})
+        assert dead.owned() == frozenset()
+
+
+# ------------------------------------------------------------- tiered cache
+class TestTieredCache:
+    def test_l1_l2_hit_ladder(self):
+        l2 = DecisionCache(max_size=64)
+        a = TieredDecisionCache(l2, l1_size=16)
+        pod, nodes = make_pod(), make_nodes()
+        assert a.get(pod, nodes) is None
+        assert a.last_tier == "miss"
+        a.set(pod, nodes, make_decision())
+        assert a.get(pod, nodes) is not None
+        assert a.last_tier == "l1_hit"
+        # a SECOND replica over the same L2: first lookup is an L2 hit
+        # (the fleet economics), promoted so the next one is L1
+        b = TieredDecisionCache(l2, l1_size=16)
+        assert b.get(pod, nodes) is not None
+        assert b.last_tier == "l2_hit"
+        assert b.get(pod, nodes) is not None
+        assert b.last_tier == "l1_hit"
+        assert b.stats()["l2_hits"] == 1 and b.stats()["l1_hits"] == 1
+
+    def test_foreign_bump_invalidates_both_tiers(self):
+        l2 = DecisionCache(max_size=64)
+        a = TieredDecisionCache(l2, l1_size=16)
+        b = TieredDecisionCache(l2, l1_size=16)
+        pod, nodes = make_pod(), make_nodes()
+        a.set(pod, nodes, make_decision())
+        assert b.get(pod, nodes) is not None       # warm both replicas
+        assert a.get(pod, nodes) is not None
+        hits_before = a.stats()["l1_hits"]
+        # replica B hot-swaps: bumps the SHARED generation once
+        b.bump_generation()
+        # replica A's next lookup syncs its L1 to the new epoch — the
+        # pre-swap entry is unreachable in BOTH tiers, counters survive
+        assert a.get(pod, nodes) is None
+        assert a.last_tier == "miss"
+        assert a.stats()["l1_hits"] == hits_before  # not flushed
+        assert a.generation == b.generation == l2.generation
+
+    def test_straggler_files_under_its_compute_epoch(self):
+        l2 = DecisionCache(max_size=64)
+        cache = TieredDecisionCache(l2, l1_size=16)
+        pod, nodes = make_pod(), make_nodes()
+        key = decision_cache_key(pod, nodes)
+        generation = cache.generation       # captured pre-backend-call
+        cache.bump_generation()             # hot swap lands mid-flight
+        cache.set(pod, nodes, make_decision(), key=key,
+                  generation=generation)    # straggler decision arrives
+        # stored under the OLD epoch in both tiers: unservable
+        assert cache.get(pod, nodes, key=key) is None
+
+    def test_clear_is_private(self):
+        l2 = DecisionCache(max_size=64)
+        a = TieredDecisionCache(l2, l1_size=16)
+        a.set(make_pod(), make_nodes(), make_decision())
+        a.clear()
+        assert len(l2) == 1  # the fleet's shared tier survives
+
+
+class TestHotSwapInvalidation:
+    async def test_live_staggered_swap_invalidates_fleet_wide(self):
+        """The satellite scenario: a staggered hot swap across fleet
+        replicas bumps the shared L2 generation exactly once, decisions
+        computed under pre-swap weights (in flight during the stagger)
+        file under the old epoch, and every replica's next lookup
+        misses both tiers."""
+        from k8s_llm_scheduler_tpu.rollout.canary import staggered_swap
+        from k8s_llm_scheduler_tpu.sched.client import DecisionClient
+
+        l2 = DecisionCache(max_size=64)
+        cache_a = TieredDecisionCache(l2, l1_size=16)
+        cache_b = TieredDecisionCache(l2, l1_size=16)
+
+        release = asyncio.Event()
+
+        class BlockingBackend(StubBackend):
+            async def get_scheduling_decision_async(
+                self, pod, nodes, work="prefill"
+            ):
+                await release.wait()
+                return self.get_scheduling_decision(pod, nodes, work=work)
+
+        backend = BlockingBackend()
+        client_a = DecisionClient(backend, cache=cache_a)
+        client_b = DecisionClient(StubBackend(), cache=cache_b)
+        pod, nodes = make_pod(), make_nodes()
+
+        # decision in flight on replica A under the OLD policy
+        task = asyncio.create_task(
+            client_a.get_scheduling_decision(pod, nodes)
+        )
+        await asyncio.sleep(0.02)
+
+        # live staggered swap over both replicas; the fleet cache is
+        # bumped ONCE after the full stagger
+        swapped = []
+        results = staggered_swap(
+            [lambda: swapped.append("a"), lambda: swapped.append("b")],
+            decision_cache=cache_a,
+        )
+        assert len(results) == 2 and l2.generation == 1
+
+        release.set()
+        decision = await task
+        assert decision is not None
+        # the straggler is NOT servable anywhere in the fleet
+        assert cache_a.get(pod, nodes) is None
+        assert cache_b.get(pod, nodes) is None
+        # a post-swap decision caches normally under the new epoch
+        d2 = await client_b.get_scheduling_decision(pod, nodes)
+        assert d2 is not None
+        assert cache_a.get(pod, nodes) is not None
+        assert cache_a.last_tier == "l2_hit"
+
+    def test_stopped_stagger_withholds_the_bump(self):
+        from k8s_llm_scheduler_tpu.rollout.canary import staggered_swap
+
+        l2 = DecisionCache(max_size=8)
+        cache = TieredDecisionCache(l2)
+        results = staggered_swap(
+            [lambda: "ok", lambda: "bad", lambda: "never"],
+            verify=lambda i, r: r == "ok",
+            decision_cache=cache,
+        )
+        assert results == ["ok", "bad"]
+        assert l2.generation == 0  # incumbent majority still serving
+
+
+# ------------------------------------------------------------------- pools
+class TestPoolRoles:
+    def test_check_pool_role(self):
+        check_pool_role("prefill", "prefill")
+        check_pool_role("prefill", "decode")
+        check_pool_role("mixed", "prefill")
+        check_pool_role("decode", "decode")
+        with pytest.raises(BackendError, match="refuses admission"):
+            check_pool_role("decode", "prefill")
+
+    def test_stub_backend_role_gate_and_batch(self):
+        b = StubBackend(pool_role="decode")
+        with pytest.raises(BackendError, match="refuses admission"):
+            b.get_scheduling_decision(make_pod(), make_nodes())
+        assert b.role_refusals == 1
+        d = b.get_scheduling_decision(make_pod(), make_nodes(), work="decode")
+        assert d.selected_node.startswith("node-")
+
+        mixed = StubBackend()
+        infeasible = dataclasses.replace(
+            make_pod(1), node_selector={"no": "where"}
+        )
+        out = mixed.get_scheduling_decisions_batch(
+            [make_pod(0), infeasible, make_pod(2)], make_nodes()
+        )
+        assert isinstance(out[0], SchedulingDecision)
+        assert isinstance(out[1], NoFeasibleNodeError)
+        assert isinstance(out[2], SchedulingDecision)
+
+
+class TestDisaggregatedBackend:
+    def test_no_decode_pool_routes_everything_prefill(self):
+        pre = StubBackend()
+        router = DisaggregatedBackend([pre])
+        for i in range(3):
+            router.get_scheduling_decision(make_pod(i), make_nodes())
+        assert router.get_stats()["pools_prefill_routed"] == 3
+        assert router.get_stats()["pools_decode_routed"] == 0
+
+    def test_snapshot_warmth_shifts_continuation_to_decode_pool(self):
+        from concurrent.futures import Future
+
+        class PrewarmableStub(StubBackend):
+            def __init__(self):
+                super().__init__()
+                self.prewarms = 0
+
+            def prewarm_prefix(self, nodes):
+                self.prewarms += 1
+                f = Future()
+                f.set_result(True)
+                return f
+
+        pre, dec = StubBackend(), PrewarmableStub()
+        router = DisaggregatedBackend([pre], [dec])
+        nodes = make_nodes()
+        # cold snapshot: admission -> prefill pool, decode pool prewarmed
+        router.get_scheduling_decision(make_pod(0), nodes)
+        assert pre.calls == 1 and dec.calls == 0
+        assert dec.prewarms == 1
+        # prewarm confirmed -> continuation decisions route decode
+        router.get_scheduling_decision(make_pod(1), nodes)
+        assert dec.calls == 1 and pre.calls == 1
+        stats = router.get_stats()
+        assert stats["pools_prefill_routed"] == 1
+        assert stats["pools_decode_routed"] == 1
+        # a NEW snapshot is admission again
+        router.get_scheduling_decision(make_pod(2), make_nodes(5))
+        assert pre.calls == 2
+
+    async def test_prepacked_admission_batches_one_snapshot(self):
+        pre = StubBackend()
+        router = DisaggregatedBackend(
+            [pre], prepack_max_batch=8, prepack_window_s=0.02
+        )
+        nodes = make_nodes()
+        decisions = await asyncio.gather(*[
+            router.get_scheduling_decision_async(make_pod(i), nodes)
+            for i in range(6)
+        ])
+        assert all(
+            d.selected_node.startswith("node-") for d in decisions
+        )
+        # ONE decide_batch reached the member, carrying all six pods
+        assert pre.batch_calls == 1
+        stats = router.get_stats()
+        assert stats["pools_packs_flushed"] == 1
+        assert stats["pools_packed_decisions"] == 6
+
+    async def test_prepack_max_batch_flushes_early(self):
+        pre = StubBackend()
+        router = DisaggregatedBackend(
+            [pre], prepack_max_batch=2, prepack_window_s=10.0
+        )
+        nodes = make_nodes()
+        t0 = time.perf_counter()
+        await asyncio.gather(*[
+            router.get_scheduling_decision_async(make_pod(i), nodes)
+            for i in range(4)
+        ])
+        # two full packs, flushed by COUNT (the 10s window never waited)
+        assert time.perf_counter() - t0 < 5.0
+        assert pre.batch_calls == 2
+
+    async def test_prepack_joins_equal_content_snapshot_objects(self):
+        """Regression: two snapshot OBJECTS with identical content (same
+        digest — e.g. a snapshot-TTL refresh on an unchanged cluster)
+        arriving within the window must JOIN one pack. Replacing the
+        forming pack abandoned the first caller's future forever."""
+        pre = StubBackend()
+        router = DisaggregatedBackend(
+            [pre], prepack_max_batch=8, prepack_window_s=0.05
+        )
+        decisions = await asyncio.wait_for(
+            asyncio.gather(
+                router.get_scheduling_decision_async(
+                    make_pod(0), make_nodes()
+                ),
+                router.get_scheduling_decision_async(
+                    make_pod(1), make_nodes()  # fresh, equal-content list
+                ),
+            ),
+            timeout=5.0,
+        )
+        assert all(
+            d.selected_node.startswith("node-") for d in decisions
+        )
+        assert pre.batch_calls == 1  # one pack, both pods
+
+    async def test_prepack_isolates_infeasible_pods(self):
+        pre = StubBackend()
+        router = DisaggregatedBackend(
+            [pre], prepack_max_batch=4, prepack_window_s=0.02
+        )
+        nodes = make_nodes()
+        bad = dataclasses.replace(make_pod(1), node_selector={"no": "way"})
+        results = await asyncio.gather(
+            router.get_scheduling_decision_async(make_pod(0), nodes),
+            router.get_scheduling_decision_async(bad, nodes),
+            return_exceptions=True,
+        )
+        assert isinstance(results[0], SchedulingDecision)
+        assert isinstance(results[1], NoFeasibleNodeError)
+
+
+class TestPoolsOverTheWire:
+    def test_decode_role_server_refuses_admission(self):
+        from k8s_llm_scheduler_tpu.sched.replica import (
+            ReplicaClient,
+            ReplicaServer,
+        )
+
+        srv = ReplicaServer(
+            StubBackend(), host="127.0.0.1", port=0, pool_role="decode"
+        )
+        client = ReplicaClient("127.0.0.1", srv.port)
+        try:
+            with pytest.raises(BackendError, match="refuses admission"):
+                client.get_scheduling_decision(
+                    make_pod(), make_nodes(), work="prefill"
+                )
+            d = client.get_scheduling_decision(
+                make_pod(), make_nodes(), work="decode"
+            )
+            assert d.selected_node.startswith("node-")
+        finally:
+            client.close()
+            srv.close()
+
+    def test_decide_batch_round_trip(self):
+        from k8s_llm_scheduler_tpu.sched.replica import (
+            ReplicaClient,
+            ReplicaServer,
+        )
+
+        backend = StubBackend()
+        srv = ReplicaServer(backend, host="127.0.0.1", port=0)
+        client = ReplicaClient("127.0.0.1", srv.port)
+        try:
+            nodes = make_nodes()
+            bad = dataclasses.replace(
+                make_pod(1), node_selector={"no": "way"}
+            )
+            out = client.get_scheduling_decisions_batch(
+                [make_pod(0), bad, make_pod(2)], nodes, work="prefill"
+            )
+            assert isinstance(out[0], SchedulingDecision)
+            assert isinstance(out[1], NoFeasibleNodeError)
+            assert isinstance(out[2], SchedulingDecision)
+            # the batch hit the backend's batch surface, not N singles
+            assert backend.batch_calls == 1
+        finally:
+            client.close()
+            srv.close()
+
+    async def test_decide_batch_async_round_trip(self):
+        from k8s_llm_scheduler_tpu.sched.replica import (
+            ReplicaClient,
+            ReplicaServer,
+        )
+
+        srv = ReplicaServer(StubBackend(), host="127.0.0.1", port=0)
+        client = ReplicaClient("127.0.0.1", srv.port)
+        try:
+            out = await client.get_scheduling_decisions_batch_async(
+                [make_pod(i) for i in range(4)], make_nodes()
+            )
+            assert len(out) == 4
+            assert all(isinstance(d, SchedulingDecision) for d in out)
+        finally:
+            client.close()
+            srv.close()
+
+
+# ---------------------------------------------------------------- frontend
+def _add_burst(cluster, n, shapes=8):
+    pods = pod_burst(n, scheduler_name=SCHEDULER_NAME,
+                     distinct_shapes=shapes)
+    for raw in pods:
+        cluster.add_pod(raw)
+    return pods
+
+
+async def _drain(fleet, want, timeout_s=30.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if fleet.get_stats()["total_scheduled"] >= want:
+            return
+        await asyncio.sleep(0.01)
+    raise AssertionError(
+        f"fleet drained only {fleet.get_stats()['total_scheduled']}/{want}"
+    )
+
+
+class TestFleetFrontend:
+    async def test_sharded_fleet_binds_every_pod_exactly_once(self):
+        cluster = synthetic_cluster(8)
+        fleet = Fleet(
+            cluster, cluster, lambda i: StubBackend(),
+            n_replicas=4, lease_ttl_s=60.0,
+            list_pending=lambda: cluster.pending_pods(SCHEDULER_NAME),
+        )
+        _add_burst(cluster, 120, shapes=12)
+        await fleet.start(lease_threads=False)
+        try:
+            await _drain(fleet, 120)
+            # stats BEFORE stop: a clean stop releases the leases, which
+            # empties owned_shards by design
+            stats = fleet.get_stats()
+        finally:
+            await fleet.stop()
+        assert cluster.bind_count == 120
+        assert stats["failed_bindings"] == 0
+        assert stats["fenced_binds"] == 0
+        # exactly-once: no pod appears twice in the bind log
+        bound_names = [name for _ns, name, _node in cluster.bindings]
+        assert len(bound_names) == len(set(bound_names)) == 120
+        # the work was actually sharded: every replica bound something
+        assert all(
+            r["total_scheduled"] > 0 for r in stats["replicas"]
+        ), stats["replicas"]
+        # shard sets are disjoint and cover the space
+        owned = [set(r["owned_shards"]) for r in stats["replicas"]]
+        assert not set.intersection(*owned)
+        assert set.union(*owned) == set(range(fleet.n_shards))
+        # the shared L2 served cross-replica hits (12 shapes, 4 replicas:
+        # without L2 each replica pays its own leaders)
+        assert stats["l2"]["hits"] > 0
+
+    async def test_lease_failover_rebinds_exactly_once(self):
+        """THE acceptance-bar scenario: a replica dies holding shards
+        with pending pods; after TTL expiry the survivor claims the
+        shards and rebinds the pods — every pod bound exactly once,
+        zero double-binds, zero failed bindings."""
+        clock = FakeClock()
+        cluster = synthetic_cluster(8)
+        fleet = Fleet(
+            cluster, cluster, lambda i: StubBackend(),
+            n_replicas=2, n_shards=8, lease_ttl_s=5.0, clock=clock,
+            list_pending=lambda: cluster.pending_pods(SCHEDULER_NAME),
+        )
+        await fleet.start(lease_threads=False)
+        try:
+            # replica 0 dies WITHOUT releasing its leases
+            dead_shards = set(fleet.replicas[0].manager.owned())
+            assert dead_shards
+            await fleet.kill_replica(0)
+
+            # pods arrive for every shard; the survivor's watch filter
+            # drops the dead replica's share (leases still live)
+            pods = _add_burst(cluster, 60, shapes=6)
+            orphans = [
+                p for p in pods
+                if shard_of(p.namespace, p.name, 8) in dead_shards
+            ]
+            assert orphans  # the scenario is non-trivial
+            await _drain(fleet, 60 - len(orphans))
+            stats = fleet.get_stats()
+            assert stats["total_scheduled"] == 60 - len(orphans)
+            assert cluster.bind_count == 60 - len(orphans)  # orphans untouched
+
+            # the survivor keeps renewing while the dead replica's TTL
+            # runs down: mid-way its renewal holds, nothing changes hands
+            clock.advance(3.0)
+            gained, lost = fleet.replicas[1].manager.tick()
+            assert gained == frozenset() and lost == frozenset()
+
+            # TTL passes; the survivor's tick claims exactly the dead
+            # shards and the rebind pass schedules the orphans
+            clock.advance(3.0)
+            gained, lost = fleet.replicas[1].manager.tick()
+            assert gained == frozenset(dead_shards)
+            assert lost == frozenset()
+            await _drain(fleet, 60)
+        finally:
+            await fleet.stop()
+
+        assert cluster.bind_count == 60
+        bound_names = [name for _ns, name, _node in cluster.bindings]
+        assert len(bound_names) == len(set(bound_names)) == 60
+        stats = fleet.get_stats()
+        assert stats["failed_bindings"] == 0
+        assert fleet.replicas[1].get_stats()["total_scheduled"] >= len(orphans)
+
+    async def test_fencing_rejects_binds_after_lease_loss(self):
+        """A replica that lost its leases (paused past TTL) must refuse
+        to bind once it discovers the loss — decisions computed under
+        the stale lease are discarded, not bound."""
+        clock = FakeClock()
+        cluster = synthetic_cluster(4)
+        fleet = Fleet(
+            cluster, cluster, lambda i: StubBackend(),
+            n_replicas=2, n_shards=4, lease_ttl_s=5.0, clock=clock,
+            list_pending=lambda: cluster.pending_pods(SCHEDULER_NAME),
+        )
+        await fleet.start(lease_threads=False)
+        try:
+            zombie = fleet.replicas[0]
+            a_shard = sorted(zombie.manager.owned())[0]
+            # the zombie pauses past TTL; the peer claims everything
+            clock.advance(6.0)
+            fleet.replicas[1].manager.tick()
+            assert a_shard in fleet.replicas[1].manager.owned()
+            # the zombie's renewal discovers the loss...
+            zombie.manager.tick()
+            assert a_shard not in zombie.manager.owned()
+            # ...and its in-flight decision is fenced at bind time
+            pod = next(
+                p for p in pod_burst(64, scheduler_name=SCHEDULER_NAME)
+                if shard_of(p.namespace, p.name, 4) == a_shard
+            )
+            cluster.add_pod(pod)
+            ok = zombie.scheduler.binder.bind_pod_to_node(
+                pod.name, pod.namespace, "node-0"
+            )
+            assert ok is False
+            assert zombie.fenced_binds == 1
+            assert cluster.bind_count == 0  # nothing reached the cluster
+        finally:
+            await fleet.stop()
+
+
+class TestFleetTracing:
+    async def test_decision_traces_carry_shard_and_tier(self):
+        """Satellite: shard_id and cache_tier ride every decision trace
+        (the /debug/decisions + `cli trace` surfaces render meta
+        as-is)."""
+        old_flight = spans.flight
+        spans.flight = rec = spans.FlightRecorder(capacity=256)
+        spans.configure(enabled=True)
+        try:
+            cluster = synthetic_cluster(4)
+            fleet = Fleet(
+                cluster, cluster, lambda i: StubBackend(),
+                n_replicas=2, n_shards=4, lease_ttl_s=60.0,
+                list_pending=lambda: cluster.pending_pods(SCHEDULER_NAME),
+            )
+            _add_burst(cluster, 24, shapes=4)
+            await fleet.start(lease_threads=False)
+            try:
+                await _drain(fleet, 24)
+            finally:
+                await fleet.stop()
+            entries = rec.list(n=256)
+            decisions = [e for e in entries if e["name"] == "decision"]
+            assert len(decisions) >= 24
+            for entry in decisions:
+                meta = entry["meta"]
+                assert "shard_id" in meta, meta
+                assert 0 <= meta["shard_id"] < 4
+                assert meta.get("cache_tier") in (
+                    "l1_hit", "l2_hit", "miss", "coalesced"
+                ), meta
+            tiers = {e["meta"]["cache_tier"] for e in decisions}
+            assert "miss" in tiers          # leaders
+            assert tiers & {"l1_hit", "l2_hit", "coalesced"}  # reuse
+        finally:
+            spans.flight = old_flight
+
+
+# --------------------------------------------------------- fleet scenarios
+class TestFleetScenarios:
+    def test_fleet_500_fast_variant(self):
+        from k8s_llm_scheduler_tpu.sim.scenarios import (
+            fleet_scenario,
+            generate_scenario,
+        )
+
+        spec = fleet_scenario("fleet-500")
+        scenario = generate_scenario(spec)
+        assert len(scenario.nodes) == 500
+        assert scenario.n_pods == 5000
+        assert len(scenario.waves) > 4  # multitenant arrivals spread out
+        # heavy-tailed burstiness: the biggest wave well above the median
+        sizes = sorted(len(w) for w in scenario.waves)
+        assert sizes[-1] >= 1.5 * max(sizes[len(sizes) // 2], 1)
+        # determinism (the arena/replay contract)
+        again = generate_scenario(fleet_scenario("fleet-500"))
+        assert [len(w) for w in again.waves] == [
+            len(w) for w in scenario.waves
+        ]
+        assert [p.name for p in again.waves[0]] == [
+            p.name for p in scenario.waves[0]
+        ]
+
+    def test_multitenant_preserves_pod_count_and_round_trips(self):
+        from k8s_llm_scheduler_tpu.sim.scenarios import (
+            ScenarioSpec,
+            generate_scenario,
+        )
+
+        spec = ScenarioSpec(
+            n_nodes=16, n_pods=200, shapes=8, arrival="multitenant",
+            tenants=6, arrival_rate=500.0, wave_window_s=0.05,
+        )
+        scenario = generate_scenario(spec)
+        assert scenario.n_pods == 200
+        # spec round-trips through dict (trace replay needs this)
+        again = ScenarioSpec.from_dict(spec.to_dict())
+        assert again == spec
+
+    def test_unknown_fleet_scenario_rejected(self):
+        from k8s_llm_scheduler_tpu.sim.scenarios import fleet_scenario
+
+        with pytest.raises(ValueError, match="unknown fleet scenario"):
+            fleet_scenario("fleet-nope")
+
+    @pytest.mark.slow
+    def test_fleet_10k_class_generates(self):
+        from k8s_llm_scheduler_tpu.sim.scenarios import (
+            fleet_scenario,
+            generate_scenario,
+        )
+
+        spec = fleet_scenario("fleet-10k")
+        scenario = generate_scenario(spec)
+        assert len(scenario.nodes) == 10_000
+        assert scenario.n_pods == 100_000
+        assert len(scenario.waves) > 10
+        # the full shard space stays addressable at this scale
+        pods = [p for wave in scenario.waves for p in wave]
+        shards = {
+            shard_of("default", p.name, 256) for p in pods[:5000]
+        }
+        assert len(shards) == 256
+
+    @pytest.mark.slow
+    async def test_fleet_scale_burst_through_sharded_fleet(self):
+        """Drive a fleet-shaped burst (500-node topology, 2000 pods of
+        the fleet-500 shape mix) through 4 sharded replicas end to end
+        on the in-memory cluster: every pod bound exactly once."""
+        from k8s_llm_scheduler_tpu.cluster.fake import FakeNode
+        from k8s_llm_scheduler_tpu.sim.scenarios import (
+            fleet_scenario,
+            generate_scenario,
+        )
+
+        spec = fleet_scenario("fleet-500")
+        spec.n_pods = 2000
+        spec.taint_frac = 0.0
+        spec.constraint_mix = ("uniform",)
+        scenario = generate_scenario(spec)
+        cluster = FakeCluster()
+        for n in scenario.nodes:
+            cluster.add_node(FakeNode(
+                name=n.name, cpu_capacity_cores=n.cpu_cores,
+                memory_capacity_gb=n.memory_gb, max_pods=n.max_pods,
+                labels=dict(n.labels), taints=n.taints,
+            ))
+        n_pods = 0
+        for wave in scenario.waves:
+            for pod in wave:
+                cluster.add_pod(pod.to_raw_pod())
+                n_pods += 1
+        fleet = Fleet(
+            cluster, cluster, lambda i: StubBackend(),
+            n_replicas=4, n_shards=16, lease_ttl_s=600.0,
+            snapshot_ttl_s=1e9,
+            list_pending=lambda: cluster.pending_pods(SCHEDULER_NAME),
+        )
+        await fleet.start(lease_threads=False)
+        try:
+            await _drain(fleet, n_pods, timeout_s=120.0)
+        finally:
+            await fleet.stop()
+        assert cluster.bind_count == n_pods
+        bound = [name for _ns, name, _node in cluster.bindings]
+        assert len(bound) == len(set(bound)) == n_pods

@@ -268,3 +268,60 @@ class TestPartialHoldDeadline:
             assert rows_before == 8, eng.submits
         finally:
             backend.close()
+
+
+class TestPoolRoleAndBatch:
+    def test_decode_role_refuses_admission(self):
+        from k8s_llm_scheduler_tpu.engine.backend import BackendError
+
+        eng = FakeEngine(wave_s=0.05)
+        backend = LocalLLMBackend(
+            eng, tokenizer=ByteTokenizer(), pool_role="decode",
+        )
+        try:
+            import pytest
+
+            with pytest.raises(BackendError, match="refuses admission"):
+                backend.get_scheduling_decision(make_pod(0), make_nodes())
+            assert backend.role_refusals == 1
+            # continuation (decode) work is served normally
+            d = backend.get_scheduling_decision(
+                make_pod(0), make_nodes(), work="decode"
+            )
+            assert d.selected_node == "node-1"
+            assert backend.get_stats()["pool_role"] == "decode"
+        finally:
+            backend.close()
+
+    def test_prepacked_batch_coalesces_and_isolates_failures(self):
+        """get_scheduling_decisions_batch enqueues the WHOLE pack before
+        waiting (the engine sees it together and coalesces it into full
+        waves), returns outcomes positionally, and an infeasible pod
+        fails alone."""
+        import dataclasses
+
+        from k8s_llm_scheduler_tpu.engine.backend import NoFeasibleNodeError
+
+        eng = FakeEngine(wave_s=0.05)
+        backend = LocalLLMBackend(
+            eng, tokenizer=ByteTokenizer(), admit_wait_s=0.01,
+        )
+        try:
+            nodes = make_nodes()
+            pods = [make_pod(i) for i in range(4)]
+            pods[2] = dataclasses.replace(
+                pods[2], node_selector={"no": "where"}
+            )
+            out = backend.get_scheduling_decisions_batch(pods, nodes)
+            assert len(out) == 4
+            assert out[0].selected_node == "node-1"
+            assert out[1].selected_node == "node-1"
+            assert isinstance(out[2], NoFeasibleNodeError)
+            assert out[3].selected_node == "node-1"
+            # the 3 feasible pods rode at most one full wave each at the
+            # stub's 4 slots — enqueue-before-wait means they were NOT
+            # serialized into one wave per pod
+            assert len(eng.submits) <= 2
+            assert sum(n for _t, n in eng.submits) == 3
+        finally:
+            backend.close()

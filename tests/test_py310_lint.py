@@ -41,6 +41,13 @@ class TestRepoIsClean:
         assert "k8s_llm_scheduler_tpu/observability/sampler.py" in files
         assert "k8s_llm_scheduler_tpu/observability/metrics.py" in files
         assert "tests/test_observability.py" in files
+        # fleet round: sharded frontend + pools are asyncio-heavy (the
+        # same 3.11+-API risk class as the scheduler loop)
+        assert "k8s_llm_scheduler_tpu/fleet/lease.py" in files
+        assert "k8s_llm_scheduler_tpu/fleet/cache.py" in files
+        assert "k8s_llm_scheduler_tpu/fleet/pools.py" in files
+        assert "k8s_llm_scheduler_tpu/fleet/frontend.py" in files
+        assert "tests/test_fleet.py" in files
         # the lint never lints its own pattern table
         assert "tools/py310_lint.py" not in files
 

@@ -375,6 +375,104 @@ class TestConnectionLifecycle:
             client.close()
 
 
+class TestReconnectBackoff:
+    def _free_port(self) -> int:
+        import socket as socket_mod
+
+        with socket_mod.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    def test_repeated_dial_failures_open_failfast_window(self):
+        """The first failed dial keeps the historical immediate-retry
+        contract; from the SECOND consecutive failure on, submits fail
+        fast inside a jittered exponential window instead of paying a
+        blocking connect each (a restarting worker must not eat one
+        connect_timeout_s stall per in-flight decision)."""
+        port = self._free_port()
+        client = ReplicaClient(
+            "127.0.0.1", port, connect_timeout_s=0.5,
+            reconnect_base_s=5.0, reconnect_cap_s=30.0,
+        )
+        try:
+            # failures 1 and 2 both really dial (window opens on #2)
+            for _ in range(2):
+                with pytest.raises(BackendError, match="unreachable"):
+                    client.get_scheduling_decision(make_pod(), make_nodes())
+            assert client._dial_failures == 2
+            # inside the window: immediate failure, no dial attempt
+            t0 = time.monotonic()
+            with pytest.raises(BackendError, match="backing off"):
+                client.get_scheduling_decision(make_pod(), make_nodes())
+            assert time.monotonic() - t0 < 0.2
+            assert client._dial_failures == 2  # fail-fast is not a dial
+        finally:
+            client.close()
+
+    def test_restart_under_inflight_decisions_heals(self):
+        """Kill and restart a ReplicaServer UNDER in-flight decisions:
+        every in-flight call resolves (decision or BackendError — no
+        hangs), and after the restart the same client heals through the
+        backoff and serves again."""
+        backend = StubBackend(latency_s=0.15)
+        srv1 = ReplicaServer(backend, host="127.0.0.1", port=0)
+        port = srv1.port
+        client = ReplicaClient(
+            "127.0.0.1", port,
+            reconnect_base_s=0.05, reconnect_cap_s=0.2,
+        )
+        srv2 = None
+        try:
+            # warm the connection so the kill lands mid-stream
+            client.get_scheduling_decision(make_pod(), make_nodes())
+
+            with ThreadPoolExecutor(max_workers=8) as pool:
+                futs = [
+                    pool.submit(
+                        client.get_scheduling_decision,
+                        make_pod(i), make_nodes(),
+                    )
+                    for i in range(8)
+                ]
+                time.sleep(0.05)   # decisions are in flight (0.15s each)
+                srv1.close()       # worker dies mid-stream
+                outcomes = []
+                for fut in futs:
+                    try:
+                        outcomes.append(fut.result(timeout=10))
+                    except BackendError as exc:
+                        outcomes.append(exc)
+            # nothing hung; the kill surfaced as BackendError for the
+            # requests it caught in flight
+            assert len(outcomes) == 8
+            assert any(isinstance(o, BackendError) for o in outcomes)
+
+            # restart on the same port; the client heals through the
+            # jittered backoff without being rebuilt
+            srv2 = ReplicaServer(StubBackend(), host="127.0.0.1", port=port)
+            deadline = time.monotonic() + 10
+            last = None
+            while time.monotonic() < deadline:
+                try:
+                    d = client.get_scheduling_decision(
+                        make_pod(), make_nodes()
+                    )
+                    break
+                except BackendError as exc:
+                    last = exc
+                    time.sleep(0.05)
+            else:
+                pytest.fail(f"never healed: {last}")
+            assert d.selected_node.startswith("node-")
+            assert srv2.served >= 1
+            assert client._dial_failures == 0  # reset on success
+        finally:
+            client.close()
+            srv1.close()
+            if srv2 is not None:
+                srv2.close()
+
+
 class TestAsyncPath:
     async def test_async_decision_and_fanout(self, server):
         """The natively-async client path resolves without a worker

@@ -165,6 +165,31 @@ class TestE2E:
         assert stats["client"]["total_requests"] == 3
 
 
+class TestInflightDedup:
+    @pytest.mark.asyncio
+    async def test_concurrent_same_pod_schedules_once(self):
+        """Regression (fleet rebind race): a pod reaching the scheduler
+        twice concurrently — watch event racing a rebind re-list, or a
+        kube relist re-delivering an in-flight pod — must be decided and
+        bound ONCE; the duplicate is suppressed, not double-bound."""
+        cluster = synthetic_cluster(3)
+        backend = StubBackend(latency_s=0.1)  # hold the first in flight
+        scheduler = make_scheduler(cluster, backend=backend)
+        pod = fixture_pods()[0]
+        cluster.add_pod(pod)
+        raw = cluster.pending_pods(SCHEDULER_NAME)[0]
+        first = asyncio.create_task(scheduler.schedule_pod(raw))
+        await asyncio.sleep(0.02)  # first is parked on the backend
+        assert await scheduler.schedule_pod(raw) is False  # suppressed
+        assert await first is True
+        assert cluster.bind_count == 1
+        assert scheduler.stats["failed_bindings"] == 0
+        assert backend.calls == 1
+        # the pod left the in-flight set: a genuine retry would proceed
+        assert scheduler._inflight_pods == set()
+        cluster.close()
+
+
 class TestPrefixPrewarm:
     """Advisory prefix prewarming: the idle loop keeps the engine's
     cluster-state prefix pointed at the live snapshot (VERDICT r4 #3 —
