@@ -41,16 +41,18 @@ sys.path.insert(0, str(Path(__file__).resolve().parent))
 
 TARGET_P50_MS = 200.0
 
-# Peak dense bf16 TFLOP/s by device_kind (public spec sheets). Used for MFU;
-# overridable with --peak-tflops for unlisted hardware.
-PEAK_BF16_TFLOPS = {
-    "TPU v4": 275.0,
-    "TPU v5 lite": 197.0,
-    "TPU v5e": 197.0,
-    "TPU v5p": 459.0,
-    "TPU v6 lite": 918.0,
-    "TPU v6e": 918.0,
-}
+# FLOP accounting + peak-TFLOPs table now live in observability/profiler.py
+# (the continuous profiler's MFU loss decomposition must share one set of
+# books with the bench headline); re-exported here so bench callers and
+# tools keep their import path.
+from k8s_llm_scheduler_tpu.observability.profiler import (  # noqa: E402
+    PEAK_BF16_TFLOPS,
+    attn_flops_per_token,
+    detect_peak_tflops,
+    matmul_flops_per_token,
+)
+
+_ = PEAK_BF16_TFLOPS  # re-export (unused-name guard)
 
 
 def build_cfg(name: str):
@@ -71,21 +73,7 @@ def build_cfg(name: str):
     return get_config(name)
 
 
-# ----------------------------------------------------------- FLOP accounting
-def matmul_flops_per_token(cfg) -> float:
-    """Dense matmul FLOPs for one token's forward pass (2*MACs)."""
-    d, hd = cfg.d_model, cfg.head_dim
-    attn_proj = d * cfg.n_heads * hd + 2 * d * cfg.n_kv_heads * hd + cfg.n_heads * hd * d
-    mlp = 3 * d * cfg.d_ff
-    lm_head = d * cfg.vocab_size
-    return 2.0 * (cfg.n_layers * (attn_proj + mlp) + lm_head)
-
-
-def attn_flops_per_token(cfg, ctx: float) -> float:
-    """Attention score+value FLOPs for one token attending to `ctx` keys."""
-    return 4.0 * cfg.n_layers * cfg.n_heads * cfg.head_dim * ctx
-
-
+# --------------------------------------------------- FLOP accounting (cont.)
 def param_count(cfg) -> int:
     d, hd = cfg.d_model, cfg.head_dim
     per_layer = (
@@ -95,15 +83,6 @@ def param_count(cfg) -> int:
     embed = cfg.vocab_size * d
     head = 0 if cfg.tie_embeddings else cfg.vocab_size * d
     return int(cfg.n_layers * per_layer + embed + head + d)
-
-
-def detect_peak_tflops(override: float | None) -> tuple[float | None, str]:
-    import jax
-
-    kind = jax.devices()[0].device_kind
-    if override is not None:
-        return override, kind
-    return PEAK_BF16_TFLOPS.get(kind), kind
 
 
 def measure_dispatch_rtt_ms(samples: int = 5) -> float:
@@ -563,16 +542,27 @@ async def obs_overhead_bench(args) -> dict:
 
     The SAME scheduler stack (full path: snapshot -> decide -> bind, no
     decision cache so every pod pays a real backend call) runs arrival-
-    paced rounds alternating flight-recorder tracing OFF and ON. The stub
-    backend carries a fixed 10 ms decision cost — 20-50x BELOW a real
-    model wave, so the measured overhead percentage is an upper bound on
-    what production serving would see. Per-arm p50 is the min of round
-    medians (host-noise filter applied identically to both arms); asserts
-    the tracing layer costs < 2% of decision p50."""
+    paced rounds alternating the observability layer OFF and ON. ON now
+    means the FULL plane: flight-recorder tracing plus a live SLO
+    burn-rate engine (observability/slo.py — a latency + an error-rate
+    objective evaluating at 20 Hz, 200x the production 10 s cadence, so
+    the measurement over-states the steady-state cost on purpose). The
+    stub backend carries a fixed 10 ms decision cost — 20-50x BELOW a
+    real model wave, so the measured overhead percentage is an upper
+    bound on what production serving would see. Per-arm p50 is the min of
+    round medians (host-noise filter applied identically to both arms);
+    asserts the observability layer costs < 2% of decision p50. The wave
+    profiler's per-record cost is measured directly (it hooks waves, not
+    decisions — the stub path has none) and reported beside the span
+    micro-cost."""
     import dataclasses as _dc
 
     from k8s_llm_scheduler_tpu.engine.backend import StubBackend
     from k8s_llm_scheduler_tpu.observability import spans
+    from k8s_llm_scheduler_tpu.observability.slo import (
+        SloEngine,
+        SloObjective,
+    )
     from k8s_llm_scheduler_tpu.sched.client import DecisionClient
     from k8s_llm_scheduler_tpu.sched.loop import Scheduler
     from k8s_llm_scheduler_tpu.testing import (
@@ -598,6 +588,23 @@ async def obs_overhead_bench(args) -> dict:
             scheduler_name=SCHEDULER_NAME, snapshot_ttl_s=300.0,
             max_concurrency=256, prefix_prewarm_s=0.0,
         )
+        slo = None
+        if enabled:
+            slo = SloEngine(
+                [
+                    SloObjective(
+                        name="decide_latency", kind="latency",
+                        phase="decide", threshold_ms=5000.0, budget=0.01,
+                    ),
+                    SloObjective(
+                        name="bind_errors", kind="error_rate",
+                        numerator="failed_bindings",
+                        denominator="total_scheduled", budget=0.05,
+                    ),
+                ],
+                scheduler.get_stats,
+            )
+            slo.start(interval_s=0.05)  # 200x the production cadence
         task = asyncio.create_task(scheduler.run())
         pods = [
             _dc.replace(p, name=f"{tag}-{p.name}")
@@ -612,6 +619,8 @@ async def obs_overhead_bench(args) -> dict:
             scheduler.stop()
             cluster.close()
             await asyncio.wait_for(task, timeout=30)
+            if slo is not None:
+                slo.stop()
         return statistics.median(latencies.values())
 
     was_enabled = spans.enabled()
@@ -633,6 +642,38 @@ async def obs_overhead_bench(args) -> dict:
                 with spans.span("x"):
                     pass
             span_us = (time.perf_counter() - t0) / n_micro * 1e6
+
+        # per-WAVE profiler record cost, measured directly: the profiler
+        # hooks the engine's wave path (one record per ~8-16 decisions),
+        # so its budget share is profiler_wave_us / (decisions-per-wave *
+        # decision p50) — report the raw figure
+        from k8s_llm_scheduler_tpu.observability.profiler import (
+            EngineProfiler,
+        )
+
+        prof = EngineProfiler(cfg=None, window=256)
+        n_waves_micro = 2000
+
+        class _H:  # stand-in handle: the profiler keys on identity only
+            pass
+
+        t0 = time.perf_counter()
+        for _ in range(n_waves_micro):
+            h = _H()
+            tp = time.perf_counter()
+            prof.on_submit(
+                h, tp, tp, suffix_tokens=250, n_requests=8,
+                prefix_len=1000, cold_compile=False,
+            )
+            prof.note_admission(h, tp)
+            prof.note_ready(h)
+            prof.on_harvest(
+                h, tp, tp, tp, decode_tokens=70, model_calls=9,
+                ready_at_entry=True,
+            )
+        profiler_wave_us = (
+            (time.perf_counter() - t0) / n_waves_micro * 1e6
+        )
     finally:
         spans.configure(enabled=was_enabled)
 
@@ -640,8 +681,8 @@ async def obs_overhead_bench(args) -> dict:
     p50_on = min(p50s[True])
     overhead_pct = (p50_on - p50_off) / p50_off * 100.0
     assert overhead_pct < 2.0, (
-        f"tracing overhead {overhead_pct:.2f}% >= 2% of decision p50 "
-        f"(on {p50_on:.3f}ms vs off {p50_off:.3f}ms)"
+        f"observability overhead {overhead_pct:.2f}% >= 2% of decision "
+        f"p50 (on {p50_on:.3f}ms vs off {p50_off:.3f}ms)"
     )
     return {
         "metric": "obs_overhead_pct",
@@ -653,14 +694,18 @@ async def obs_overhead_bench(args) -> dict:
             "round_p50s_off_ms": [round(v, 3) for v in p50s[False]],
             "round_p50s_on_ms": [round(v, 3) for v in p50s[True]],
             "span_overhead_us": round(span_us, 2),
+            "profiler_wave_us": round(profiler_wave_us, 2),
             "pods": args.pods,
             "nodes": args.nodes,
             "arrival_rate": args.arrival_rate,
             "stub_latency_ms": stub_latency_s * 1000.0,
             "threshold_pct": 2.0,
+            "on_arm": "tracing + slo engine @20Hz (200x prod cadence)",
             "note": (
                 "stub backend at 10ms/decision — ~20-50x below a real "
-                "wave, so this percentage upper-bounds production overhead"
+                "wave, so this percentage upper-bounds production "
+                "overhead; profiler cost is per WAVE (~8-16 decisions), "
+                "measured as its own micro figure"
             ),
         },
     }
@@ -848,12 +893,31 @@ async def _fleet_round(
     t0 = time.perf_counter()
     await fleet.start(lease_threads=False)
     deadline = t0 + timeout_s
+    telemetry = None
     try:
         while time.perf_counter() < deadline:
             if fleet.get_stats()["total_scheduled"] >= n_pods:
                 break
             await asyncio.sleep(0.02)
         stats = fleet.get_stats()
+        # Merged-telemetry extras (observability/fleetview.py): fleet p99
+        # from MERGED histogram buckets vs the max per-replica p99 — the
+        # aggregation the 16-replica production view rests on, exercised
+        # on every bench run.
+        agg = fleet.aggregator(include_traces=False)
+        agg.pull_all()
+        fleet_pct = agg.fleet_percentiles("decide")
+        per_replica_p99 = [
+            (r.get("phases", {}).get("decide") or {}).get("p99_ms", 0.0)
+            for r in stats["replicas"]
+        ]
+        if fleet_pct is not None:
+            telemetry = {
+                "fleet_decide_p50_ms": fleet_pct["p50_ms"],
+                "fleet_decide_p99_ms": fleet_pct["p99_ms"],
+                "fleet_decide_count": fleet_pct["count"],
+                "max_replica_decide_p99_ms": max(per_replica_p99),
+            }
     finally:
         await fleet.stop()
     if stats["total_scheduled"] < n_pods:
@@ -868,7 +932,7 @@ async def _fleet_round(
         )
     wall_s = max(bind_times) - t0
     lat = sorted((t - t0) * 1000.0 for t in bind_times)
-    return {
+    out = {
         "replicas": n_replicas,
         "decisions_per_s": round(n_pods / wall_s, 1),
         "wall_s": round(wall_s, 3),
@@ -879,6 +943,16 @@ async def _fleet_round(
             k: stats["l2"][k] for k in ("hits", "misses", "generation")
         },
     }
+    if telemetry is not None:
+        # sanity: a mixture's p-quantile never exceeds the max component
+        # p-quantile, and the shared bucket ladder preserves that in
+        # bucket space — a violation means the merge mixed ladders
+        assert (
+            telemetry["fleet_decide_p99_ms"]
+            <= telemetry["max_replica_decide_p99_ms"] * 1.0001
+        ), telemetry
+        out["merged_telemetry"] = telemetry
+    return out
 
 
 async def fleet_bench(args) -> dict:

@@ -142,13 +142,43 @@ def _build_stack(cfg: Config, cluster) -> Any:
 async def _run_scheduler(cfg: Config, cluster, demo_pods: bool = False) -> int:
     scheduler, backend = _build_stack(cfg, cluster)
 
+    engine = getattr(backend, "engine", None)
+    profiler = None
+    if engine is not None and cfg.get("observability.profiler", True):
+        # Continuous wave profiler (observability/profiler.py): per-wave
+        # dispatch/sync fencing + MFU loss decomposition, served at
+        # /debug/profile and as llm_scheduler_engine_profile_* gauges.
+        from k8s_llm_scheduler_tpu.observability.profiler import (
+            EngineProfiler,
+        )
+
+        profiler = EngineProfiler(
+            cfg=engine.cfg,
+            window=int(cfg.get("observability.profiler_window", 256)),
+        )
+        engine.attach_profiler(profiler)
+
+    # SLO burn-rate engine (observability/slo.py): declarative objectives
+    # from the `slo` config block evaluated over multi-window burn rates;
+    # trips surface at /debug/slo, as gauges, and as an ADVISORY into the
+    # circuit breaker (never a forced state change).
+    from k8s_llm_scheduler_tpu.observability import slo as slo_mod
+
+    slo_engine = slo_mod.from_config(cfg.section("slo"), scheduler.get_stats)
+    if slo_engine is not None:
+        breaker = scheduler.client.breaker
+        if breaker is not None:
+            slo_engine.on_trip.append(
+                lambda name, _detail: breaker.slo_advisory(name)
+            )
+        slo_engine.start(interval_s=float(cfg.get("slo.interval_s", 10.0)))
+
     metrics_server = None
     sampler = None
     if cfg.get("metrics.enabled"):
         from k8s_llm_scheduler_tpu.observability.metrics import MetricsServer
 
         stats_provider = scheduler.get_stats
-        engine = getattr(backend, "engine", None)
         if engine is not None:
             # Background engine telemetry (observability/sampler.py): ring
             # series of occupancy / KV utilization / prefix hit rate /
@@ -178,6 +208,8 @@ async def _run_scheduler(cfg: Config, cluster, demo_pods: bool = False) -> int:
             port=cfg.get("metrics.port"),
             is_alive=lambda: scheduler.running,
             engine_sampler=sampler,
+            engine_profiler=profiler,
+            slo_engine=slo_engine,
         )
         metrics_server.start()
 
@@ -206,8 +238,15 @@ async def _run_scheduler(cfg: Config, cluster, demo_pods: bool = False) -> int:
             close()
         await asyncio.wait_for(task, timeout=30)
     finally:
+        # Shutdown ordering (lifecycle contract, tests/test_profiler.py):
+        # background samplers/evaluators stop-and-join FIRST (no thread
+        # may sample an engine mid-teardown), then the metrics server
+        # (whose stop also covers both — idempotent), then the backend
+        # close flushes the profiler's in-flight fences.
         if sampler is not None:
             sampler.stop()
+        if slo_engine is not None:
+            slo_engine.stop()
         if metrics_server:
             metrics_server.stop()
         close_backend = getattr(backend, "close", None)
@@ -1040,6 +1079,20 @@ def _rollout_watch(args: argparse.Namespace, cfg: Config, registry) -> int:
         scheduler_name=cfg.get("scheduler.name"),
     )
 
+    # SLO burn-rate engine over the serving stats: config.yaml documents
+    # the `slo` block as a canary burn-in rollback input, so the watch
+    # loop must build it too (not just `cli run`) — a latency regression
+    # during an open burn-in then rolls back early instead of waiting for
+    # the decision-count window to fill.
+    from k8s_llm_scheduler_tpu.observability import slo as slo_mod
+
+    slo_engine = slo_mod.from_config(cfg.section("slo"), scheduler.get_stats)
+    if slo_engine is not None:
+        slo_engine.on_trip.append(
+            lambda name, _detail: client.breaker.slo_advisory(name)
+        )
+        slo_engine.start(interval_s=float(cfg.get("slo.interval_s", 10.0)))
+
     swapper = HotSwapper(
         backend, registry, get_config(model),
         # restore onto the SERVING mesh with the serving quantization —
@@ -1076,6 +1129,7 @@ def _rollout_watch(args: argparse.Namespace, cfg: Config, registry) -> int:
             cfg.get("rollout.trip_bind_failure_rate", 0.05)
         ),
         trip_decide_p99_ms=cfg.get("rollout.trip_decide_p99_ms", None),
+        slo_engine=slo_engine,
     )
     shadow_frac = (
         args.shadow_frac
@@ -1143,6 +1197,7 @@ def _rollout_watch(args: argparse.Namespace, cfg: Config, registry) -> int:
             lambda: {**scheduler.get_stats(), "rollout": controller.stats()},
             port=cfg.get("metrics.port"),
             is_alive=lambda: scheduler.running,
+            slo_engine=slo_engine,
         )
         metrics_server.start()
 
@@ -1170,6 +1225,8 @@ def _rollout_watch(args: argparse.Namespace, cfg: Config, registry) -> int:
     finally:
         stop.set()
         ctl_thread.join(timeout=10)
+        if slo_engine is not None:
+            slo_engine.stop()
         if metrics_server:
             metrics_server.stop()
         if shadow is not None:
@@ -1291,13 +1348,42 @@ def cmd_trace(args: argparse.Namespace, cfg: Config) -> int:
                 _time.sleep(args.interval)
 
         if args.trace_cmd == "export":
-            body = _debug_get(host, port, "/debug/export", timeout=30.0)
+            # /debug/export caps each response (EXPORT_MAX_BYTES) and ends
+            # a capped body with a {"truncated": true, "next_cursor": N}
+            # trailer line. The export file is documented as replayable
+            # records, so follow the cursor until the ring is drained and
+            # keep the trailer lines OUT of the output.
+            lines: list[str] = []
+            since = 0
+            while True:
+                body = _debug_get(
+                    host, port, f"/debug/export?since={since}", timeout=30.0
+                )
+                chunk = [ln for ln in body.splitlines() if ln.strip()]
+                trailer = None
+                if chunk:
+                    try:
+                        last = json.loads(chunk[-1])
+                    except ValueError:
+                        last = None
+                    if (
+                        isinstance(last, dict)
+                        and last.get("truncated") is True
+                        and set(last) == {"truncated", "next_cursor"}
+                    ):
+                        trailer = last
+                        chunk = chunk[:-1]
+                lines.extend(chunk)
+                if trailer is None:
+                    break
+                since = int(trailer["next_cursor"])
+            out_body = "".join(line + "\n" for line in lines)
             if args.out:
                 with open(args.out, "w", encoding="utf-8") as fh:
-                    fh.write(body)
-                print(f"wrote {body.count(chr(10))} trace(s) to {args.out}")
+                    fh.write(out_body)
+                print(f"wrote {len(lines)} trace(s) to {args.out}")
             else:
-                sys.stdout.write(body)
+                sys.stdout.write(out_body)
             return 0
     except KeyboardInterrupt:
         return 0
@@ -1419,6 +1505,45 @@ def cmd_fleet(args: argparse.Namespace, cfg: Config) -> int:
             f"cluster bind_count={stats['bind_count']}"
         )
         return 0 if stats["total_scheduled"] >= args.pods else 1
+
+    if args.fleet_cmd == "top":
+        from k8s_llm_scheduler_tpu.observability.fleetview import (
+            FleetAggregator,
+            render_top,
+        )
+
+        addrs = (
+            [a for a in args.replicas.split(",") if a.strip()]
+            if args.replicas
+            else list(cfg.get("distributed.replica_addrs") or [])
+        )
+        if not addrs:
+            print(
+                "fleet top needs replica addresses (--replicas host:port,"
+                "... or distributed.replica_addrs config)",
+                file=sys.stderr,
+            )
+            return 2
+        clients = _replica_clients(cfg, addrs, "--replicas")
+        agg = FleetAggregator()
+        for client in clients:
+            agg.add_replica_client(client.addr, client)
+        try:
+            while True:
+                round_info = agg.pull_all()
+                if args.format == "prom":
+                    print(agg.render_prometheus(), flush=True)
+                else:
+                    print(render_top(agg), flush=True)
+                if args.once:
+                    return 0 if round_info["ok"] else 2
+                print()
+                time.sleep(args.interval)
+        except KeyboardInterrupt:
+            return 0
+        finally:
+            for client in clients:
+                client.close()
 
     raise SystemExit(f"unknown fleet command {args.fleet_cmd!r}")
 
@@ -1864,6 +1989,29 @@ def main(argv: list[str] | None = None) -> int:
     p_fshard.add_argument(
         "--n-shards", type=int, default=None,
         help="shard count (default: fleet.n_shards config)",
+    )
+    p_ftop = fsub.add_parser(
+        "top",
+        help="live merged fleet telemetry: pull every replica's stats/"
+             "trace slices over the wire (telemetry_pull) and render one "
+             "fleet-wide view with merged-bucket percentiles",
+    )
+    p_ftop.add_argument(
+        "--replicas", default=None,
+        help="comma-separated replica addrs host:port (default: "
+             "distributed.replica_addrs config)",
+    )
+    p_ftop.add_argument(
+        "--interval", type=float, default=2.0,
+        help="refresh period in seconds",
+    )
+    p_ftop.add_argument(
+        "--once", action="store_true",
+        help="render one frame and exit (scripting/tests)",
+    )
+    p_ftop.add_argument(
+        "--format", choices=("text", "prom"), default="text",
+        help="text frame or one merged Prometheus exposition",
     )
 
     p_complete = sub.add_parser(

@@ -127,6 +127,27 @@ DEFAULTS: dict[str, Any] = {
         # engine telemetry sampling period + ring length (per series)
         "sampler_interval_s": 1.0,
         "sampler_window": 600,
+        # continuous wave profiler (observability/profiler.py): per-wave
+        # dispatch/sync segment fencing + MFU loss decomposition, served
+        # at /debug/profile. Per-wave cost is a handful of perf_counter
+        # reads (bench.py --preset obs-overhead re-measures the budget).
+        "profiler": True,
+        "profiler_window": 256,
+    },
+    # SLO burn-rate engine (observability/slo.py): declarative objectives
+    # evaluated over multi-window (fast 5m / slow 1h) burn rates from the
+    # windowed histogram deltas. Trips surface at /debug/slo, as
+    # llm_scheduler_slo_* gauges, as a canary burn-in rollback input, and
+    # as a circuit-breaker ADVISORY. Disabled by default; see config.yaml
+    # for objective examples.
+    "slo": {
+        "enabled": False,
+        "fast_window_s": 300.0,
+        "slow_window_s": 3600.0,
+        "interval_s": 10.0,
+        # each: {name, kind: latency|error_rate|throughput, ...} —
+        # observability/slo.SloObjective fields
+        "objectives": [],
     },
     "fallback": {
         "enabled": True,
@@ -260,6 +281,12 @@ ENV_OVERRIDES: dict[str, str] = {
     "OBS_FLIGHT_RECORDER_SIZE": "observability.flight_recorder_size",
     "OBS_SAMPLER_INTERVAL_S": "observability.sampler_interval_s",
     "OBS_SAMPLER_WINDOW": "observability.sampler_window",
+    "OBS_PROFILER": "observability.profiler",
+    "OBS_PROFILER_WINDOW": "observability.profiler_window",
+    "SLO_ENABLED": "slo.enabled",
+    "SLO_FAST_WINDOW_S": "slo.fast_window_s",
+    "SLO_SLOW_WINDOW_S": "slo.slow_window_s",
+    "SLO_INTERVAL_S": "slo.interval_s",
     "FALLBACK_STRATEGY": "fallback.strategy",
     "FLEET_ENABLED": "fleet.enabled",
     "FLEET_REPLICAS": "fleet.replicas",

@@ -58,6 +58,10 @@ class CircuitBreaker:
         self._half_open_inflight = 0
         self._lock = threading.Lock()
         self.trip_count = 0
+        # Advisory SLO-trip bookkeeping (observability/slo.py): evidence
+        # surfaced beside breaker state, never a state transition.
+        self._slo_advisories = 0
+        self._last_slo_trip: str | None = None
 
     @property
     def state(self) -> CircuitState:
@@ -164,10 +168,27 @@ class CircuitBreaker:
             self._state = CircuitState.CLOSED
             self._failure_count = 0
 
+    def slo_advisory(self, objective: str) -> None:
+        """ADVISORY input from the SLO burn-rate engine (observability/
+        slo.py on_trip hooks): a burning latency/error SLO is evidence of
+        — not proof of — backend ill health, so this records and surfaces
+        the trip beside the breaker's own state WITHOUT driving the state
+        machine (decisions keep flowing; record_failure stays the only
+        path to OPEN). Operators correlate `slo_advisories` with `trips`
+        in /metrics: advisories without trips means the latency burn is
+        not a backend fault (look at admission/queueing instead)."""
+        with self._lock:
+            self._slo_advisories += 1
+            self._last_slo_trip = objective
+
     def stats(self) -> dict[str, Any]:
         with self._lock:
-            return {
+            out = {
                 "state": self._effective_state_locked().value,
                 "failure_count": self._failure_count,
                 "trips": self.trip_count,
             }
+            if self._slo_advisories:
+                out["slo_advisories"] = self._slo_advisories
+                out["last_slo_trip"] = self._last_slo_trip
+            return out

@@ -675,6 +675,12 @@ class InferenceEngine:
         # present. None = plain decode only.
         self.spec = None
 
+        # Continuous wave profiler (observability/profiler.py), attached
+        # via attach_profiler(): submit_wave/harvest_wave fence their
+        # dispatch and sync boundaries into it. None = one per-wave None
+        # check, nothing else.
+        self.profiler = None
+
         self._rng = jax.random.PRNGKey(rng_seed)
         self._req_counter = 0
         self._by_slot: dict[int, _Request] = {}
@@ -1263,6 +1269,10 @@ class InferenceEngine:
             )
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
+        prof = self.profiler
+        # Dispatch fence OPENS before prompt packing: padding/copy work is
+        # part of what the host pays per dispatch boundary.
+        t_dispatch0 = time.perf_counter() if prof is not None else 0.0
         prefix = self._prefix or self._get_empty_prefix()
         self._prefix = prefix
 
@@ -1312,7 +1322,7 @@ class InferenceEngine:
         self.stats["prefills"] += 1
         self.stats["prefill_tokens"] += int(suffix_lens.sum())
         self.stats["requests"] += len(prompts)
-        return WaveHandle(
+        handle = WaveHandle(
             toks_d=toks_d,
             iters_d=iters_d,
             n=len(prompts),
@@ -1321,13 +1331,30 @@ class InferenceEngine:
             cold_compile=cold_compile,
             geo_key=geo_key,
         )
+        if prof is not None:
+            # dispatch fence CLOSES here: packing + jit enqueue + D2H kick
+            prof.on_submit(
+                handle, t_dispatch0, time.perf_counter(),
+                suffix_tokens=int(suffix_lens.sum()),
+                n_requests=len(prompts),
+                prefix_len=prefix.length,
+                cold_compile=cold_compile,
+            )
+        return handle
 
     def harvest_wave(self, handle: WaveHandle) -> list[Finished]:
         """Sync one wave's results (blocks until the device program ran)."""
+        prof = self.profiler
+        if prof is not None:
+            t_harvest0 = time.perf_counter()
+            ready_at_entry = handle.is_ready()
         # ONE device_get for both results: on a tunneled backend each fetch
         # can be its own round trip, and the wave sync is the per-decision
         # critical path.
         toks_np, iters_np = jax.device_get((handle.toks_d, handle.iters_d))
+        if prof is not None:
+            # the block_until_ready boundary just closed
+            t_sync = time.perf_counter()
         # Actual model calls this wave ran: the while-loop's early exit means
         # this is <= the compiled n_iters bound (no phantom iterations are
         # ever counted — or executed).
@@ -1338,11 +1365,13 @@ class InferenceEngine:
         pad = self.tokenizer.pad_id
         latency_ms = (time.perf_counter() - handle.submitted_at) * 1000.0
         out: list[Finished] = []
+        wave_decode_tokens = 0
         for row in range(handle.n):
             ids = [int(t) for t in toks_np[row] if t != pad]
             ids = ids[: handle.max_new_tokens]
             self.stats["completed"] += 1
             self.stats["decode_tokens"] += len(ids)
+            wave_decode_tokens += len(ids)
             out.append(
                 Finished(
                     req_id=handle.req_ids[row],
@@ -1350,6 +1379,13 @@ class InferenceEngine:
                     text=self.tokenizer.decode(ids),
                     latency_ms=latency_ms,
                 )
+            )
+        if prof is not None:
+            prof.on_harvest(
+                handle, t_harvest0, t_sync, time.perf_counter(),
+                decode_tokens=wave_decode_tokens,
+                model_calls=int(iters_np),
+                ready_at_entry=ready_at_entry,
             )
         return out
 
@@ -1519,6 +1555,13 @@ class InferenceEngine:
         fallback (unsupported prompts, auto-disable) and the multi-slot
         add_requests/step surface is unchanged."""
         self.spec = decoder
+
+    def attach_profiler(self, profiler) -> None:
+        """Attach a continuous wave profiler (observability/profiler.py
+        EngineProfiler). submit_wave/harvest_wave then fence their
+        dispatch/sync boundaries into it; engine/local.py contributes the
+        queue-stall and ready-edge fences. None detaches."""
+        self.profiler = profiler
 
     def generate(
         self,

@@ -496,6 +496,15 @@ class LocalLLMBackend:
                 for item in batch:
                     item.fail(BackendError(str(exc)))
             else:
+                # getattr: engine test doubles don't carry the attribute
+                prof = getattr(self.engine, "profiler", None)
+                if prof is not None:
+                    # queue-stall fence: the oldest batch item's enqueue is
+                    # the wave's timeline anchor (admission wait + coalesce
+                    # window + group-switch fairness holds all land here)
+                    prof.note_admission(
+                        handle, min(i.enqueued_at for i in batch)
+                    )
                 waves.append((handle, batch))
 
         def run_group(items: list[_WorkItem]) -> None:
@@ -730,6 +739,12 @@ class LocalLLMBackend:
                 pending.append(got)
                 self._drain_queue(pending, block=False)
                 pending = self._submit_waves(pending, waves)
+            prof = getattr(self.engine, "profiler", None)
+            if prof is not None and handle.is_ready():
+                # ready edge observed by the poll (or already ready when
+                # the poll deadline expired): the profiler's device-compute
+                # estimate ends here, not at the blocking device_get
+                prof.note_ready(handle)
             waves.popleft()
             try:
                 fins = self.engine.harvest_wave(handle)
@@ -868,6 +883,13 @@ class LocalLLMBackend:
         self._stopped.set()
         self._queue.put(None)
         self._worker.join(timeout=5)
+        prof = getattr(self.engine, "profiler", None)
+        if prof is not None:
+            # flush half-open wave fences AFTER the worker joined: in-flight
+            # waves were failed upstream and will never harvest, and a
+            # leaked fence map is exactly the shutdown residue the
+            # lifecycle tests pin (tests/test_profiler.py)
+            prof.close()
 
     def get_stats(self) -> dict[str, Any]:
         out = self.engine.get_stats()

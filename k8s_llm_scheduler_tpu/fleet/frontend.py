@@ -239,6 +239,23 @@ class FleetReplica:
             **self.scheduler.get_stats(),
         }
 
+    def telemetry(
+        self, since_seq: int = 0, recorder: Any = None,
+        sampler: Any = None, **caps: Any,
+    ) -> dict:
+        """This replica's pullable telemetry payload (observability/
+        fleetview.build_telemetry shape): its stats tree — per-replica
+        phase histograms ride along as bucket dicts — plus an optional
+        flight-recorder slice. In-process fleets share ONE process-global
+        flight recorder, so `recorder` defaults to None here and
+        Fleet.aggregator() attaches the shared ring to exactly one
+        source; a one-process-per-replica deployment passes its own."""
+        from k8s_llm_scheduler_tpu.observability import fleetview
+
+        return fleetview.build_telemetry(
+            self.get_stats(), recorder, sampler, since_seq=since_seq, **caps
+        )
+
 
 class Fleet:
     """N replicas + the shared pieces, run on the current event loop."""
@@ -316,6 +333,28 @@ class Fleet:
         """Simulated crash: the scheduler stops, leases are NOT
         released — failover happens via TTL expiry."""
         await self.replicas[index].stop(release_leases=False)
+
+    def aggregator(self, include_traces: bool = True):
+        """A FleetAggregator over this fleet's replicas (observability/
+        fleetview.py): per-replica stats sources (histograms merge
+        bucket-wise into fleet percentiles) plus — because an in-process
+        fleet shares one process-global flight recorder — the shared
+        trace ring attached to replica 0's source only, so traces are
+        pulled once, not N times."""
+        from k8s_llm_scheduler_tpu.observability import spans
+        from k8s_llm_scheduler_tpu.observability.fleetview import (
+            FleetAggregator,
+        )
+
+        agg = FleetAggregator()
+        for i, replica in enumerate(self.replicas):
+            recorder = spans.flight if include_traces and i == 0 else None
+            agg.add_source(
+                replica.holder,
+                lambda since, r=replica, rec=recorder:
+                    r.telemetry(since_seq=since, recorder=rec),
+            )
+        return agg
 
     def get_stats(self) -> dict:
         totals = {

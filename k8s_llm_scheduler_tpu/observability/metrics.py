@@ -12,13 +12,25 @@ makes the endpoint real: a stdlib ThreadingHTTPServer serving
     /healthz           liveness (200 when the loop is running)
     /stats             the full merged stats dict as JSON
     /debug/decisions   flight-recorder trace summaries (observability/spans;
-                       ?n= limit, ?since= seq cursor for `cli trace tail`)
+                       ?n= limit, ?since= seq cursor for `cli trace tail`,
+                       ?max_bytes= hard size cap -> truncated/next_cursor)
     /debug/trace/<id>  one complete decision trace (span tree + metadata)
-    /debug/export      every held trace as JSONL (replayable records)
+    /debug/export      held traces as JSONL (replayable records; ?since= +
+                       ?max_bytes= paginate — a trailer line carries
+                       {"truncated": true, "next_cursor": N} on a capped
+                       response so a resume never re-ships the prefix)
     /debug/engine      engine telemetry ring series (observability/sampler)
+    /debug/profile     continuous wave profiler: per-wave step timeline,
+                       segment fractions, MFU loss decomposition
+                       (observability/profiler)
+    /debug/slo         SLO burn-rate engine state: per-objective fast/slow
+                       burn + trips (observability/slo)
 
 Stats are pulled from a provider callable at scrape time — no push path,
-no extra locks on the hot path.
+no extra locks on the hot path. When an engine sampler / profiler / SLO
+engine is attached, their latest readings merge into the /metrics
+exposition as gauges HERE (not in caller wiring), so they are visible to
+scrapers regardless of which stats provider the server was built with.
 """
 
 from __future__ import annotations
@@ -147,7 +159,15 @@ class MetricsServer:
 
     `flight_recorder` (default: the global spans.flight) backs the
     /debug/decisions + /debug/trace surfaces; `engine_sampler` (optional)
-    backs /debug/engine."""
+    backs /debug/engine; `engine_profiler` (optional) backs /debug/profile;
+    `slo_engine` (optional) backs /debug/slo. All three also contribute
+    gauges to /metrics at scrape time."""
+
+    # Default hard byte caps on the paginated debug surfaces: a
+    # 16-replica telemetry_pull round must never ship unbounded JSONL in
+    # one frame (?max_bytes= overrides per request).
+    DECISIONS_MAX_BYTES = 1 << 20
+    EXPORT_MAX_BYTES = 4 << 20
 
     def __init__(
         self,
@@ -157,6 +177,8 @@ class MetricsServer:
         is_alive: Callable[[], bool] = lambda: True,
         flight_recorder: Any | None = None,
         engine_sampler: Any | None = None,
+        engine_profiler: Any | None = None,
+        slo_engine: Any | None = None,
     ) -> None:
         from k8s_llm_scheduler_tpu.observability import spans
 
@@ -166,6 +188,8 @@ class MetricsServer:
             flight_recorder if flight_recorder is not None else spans.flight
         )
         self.engine_sampler = engine_sampler
+        self.engine_profiler = engine_profiler
+        self.slo_engine = slo_engine
 
         server = self
 
@@ -223,10 +247,25 @@ class MetricsServer:
         except (ValueError, TypeError):
             return default
 
+    def _scrape_stats(self) -> dict[str, Any]:
+        """Provider stats + attached-component gauges. The merge lives in
+        the server (not caller wiring) so EngineSampler ring series /
+        profiler segments / SLO burns are real Prometheus gauges whenever
+        the component is attached — previously the sampler was visible to
+        scrapers only when one specific CLI path wrapped the provider."""
+        stats = dict(self.stats_provider())
+        if self.engine_sampler is not None:
+            stats["engine_telemetry"] = self.engine_sampler.latest()
+        if self.engine_profiler is not None:
+            stats["engine_profile"] = self.engine_profiler.gauges()
+        if self.slo_engine is not None:
+            stats["slo"] = self.slo_engine.gauges()
+        return stats
+
     def _route(self, path: str) -> tuple[bytes, str, int]:
         if path.startswith("/metrics"):
             return (
-                render_prometheus(self.stats_provider()).encode(),
+                render_prometheus(self._scrape_stats()).encode(),
                 "text/plain; version=0.0.4",
                 200,
             )
@@ -242,12 +281,41 @@ class MetricsServer:
                 200,
             )
         if path.startswith("/debug/decisions"):
+            from k8s_llm_scheduler_tpu.observability.spans import (
+                budget_slice,
+            )
+
+            n = self._query_int(path, "n", 50)
+            since = self._query_int(path, "since", -1)
+            max_bytes = self._query_int(
+                path, "max_bytes", self.DECISIONS_MAX_BYTES
+            )
+            if since >= 0:
+                # Forward-pagination walk (`cli trace tail`, resume after
+                # a truncated response): oldest-first past the cursor,
+                # with BOTH the n cut and the byte cap surfacing as
+                # truncated/next_cursor — a newest-n cut here would skip
+                # older entries without the client ever knowing.
+                summaries = self.flight_recorder.list(
+                    n=None, since_seq=since,
+                )
+                kept, next_cursor, truncated = budget_slice(
+                    summaries, since_seq=since,
+                    max_traces=n, max_bytes=max_bytes,
+                )
+            else:
+                # No cursor: the recent-traces view (`cli trace list`) —
+                # newest n, byte cap keeping the oldest of that window so
+                # a resume via next_cursor still walks forward.
+                summaries = self.flight_recorder.list(n=n)
+                kept, next_cursor, truncated = budget_slice(
+                    summaries, max_bytes=max_bytes,
+                )
             body = json.dumps({
                 "recorder": self.flight_recorder.stats(),
-                "traces": self.flight_recorder.list(
-                    n=self._query_int(path, "n", 50),
-                    since_seq=self._query_int(path, "since", 0),
-                ),
+                "traces": kept,
+                "truncated": truncated,
+                "next_cursor": next_cursor,
             }).encode()
             return body, "application/json", 200
         if path.startswith("/debug/trace/"):
@@ -261,11 +329,28 @@ class MetricsServer:
                 ), 404
             return json.dumps(entry).encode(), "application/json", 200
         if path.startswith("/debug/export"):
-            return (
-                self.flight_recorder.export_jsonl().encode(),
-                "application/x-ndjson",
-                200,
+            since = self._query_int(path, "since", 0)
+            entries, next_cursor, truncated = (
+                self.flight_recorder.export_slices(
+                    since_seq=since,
+                    max_bytes=self._query_int(
+                        path, "max_bytes", self.EXPORT_MAX_BYTES
+                    ),
+                )
             )
+            lines = [
+                json.dumps(e, sort_keys=True, separators=(",", ":"))
+                for e in entries
+            ]
+            if truncated:
+                # trailer line, still valid JSONL: consumers resume from
+                # next_cursor without re-shipping the prefix
+                lines.append(json.dumps(
+                    {"truncated": True, "next_cursor": next_cursor},
+                    sort_keys=True, separators=(",", ":"),
+                ))
+            body = ("".join(line + "\n" for line in lines)).encode()
+            return body, "application/x-ndjson", 200
         if path.startswith("/debug/engine"):
             if self.engine_sampler is None:
                 return b"no engine sampler attached", "text/plain", 404
@@ -282,6 +367,22 @@ class MetricsServer:
                 "application/json",
                 200,
             )
+        if path.startswith("/debug/profile"):
+            if self.engine_profiler is None:
+                return b"no engine profiler attached", "text/plain", 404
+            return (
+                json.dumps(self.engine_profiler.snapshot()).encode(),
+                "application/json",
+                200,
+            )
+        if path.startswith("/debug/slo"):
+            if self.slo_engine is None:
+                return b"no slo engine attached", "text/plain", 404
+            return (
+                json.dumps(self.slo_engine.snapshot()).encode(),
+                "application/json",
+                200,
+            )
         return b"not found", "text/plain", 404
 
     def start(self) -> None:
@@ -294,3 +395,11 @@ class MetricsServer:
     def stop(self) -> None:
         self._server.shutdown()
         self._server.server_close()
+        # Attached background components stop WITH the server (idempotent
+        # — callers that own them may stop them again): `cli run` exits
+        # and tests previously leaked the sampler's daemon thread when a
+        # teardown path missed its own stop call.
+        if self.engine_sampler is not None:
+            self.engine_sampler.stop()
+        if self.slo_engine is not None and hasattr(self.slo_engine, "stop"):
+            self.slo_engine.stop()

@@ -1,0 +1,457 @@
+"""Continuous engine profiler: per-wave step timelines + MFU loss terms.
+
+Decode MFU at 1B measures 0.072 and the bench attributes 114 ms of p50 to
+`dispatch_rtt_ms` — but until this module nothing in the repo could say
+WHAT FRACTION of a decode wave's wall time is dispatch-boundary sync
+versus host round-trip versus genuine matmul. That is the
+synchronization-boundary accounting *Kernel Looping* (PAPERS.md) argues
+dominates decode, and it is an attribution problem before it is an
+optimization problem: ROADMAP items 1-2 (fused decode loop, dispatch-RTT
+kill) need a measurement substrate that names the losses they exist to
+remove.
+
+This profiler fences every decision wave with perf_counter reads at each
+jax.jit dispatch and block_until_ready boundary (engine/engine.py
+submit_wave / harvest_wave; engine/local.py contributes the queue-side
+fences) and buckets the wave's wall time into NAMED SEGMENTS that
+telescope exactly:
+
+    queue_stall    oldest item enqueued -> submit entered (admission wait,
+                   coalescing window, group-switch fairness holds)
+    dispatch       submit entered -> jit program enqueued + D2H started
+                   (host-side tracing/enqueue cost — the dispatch boundary)
+    dispatch_gap   dispatch done -> harvest entered (pipelining overlap:
+                   the worker polls the queue / feeds later waves here)
+    host_sync      harvest entered -> device_get returned (the
+                   block_until_ready boundary: device tail + transfer +
+                   host round trip)
+    harvest        device_get returned -> results decoded on host
+    unattributed   wall - sum(above): clock-fence residue, reported as its
+                   own segment so coverage is verifiable (>= 95% of wave
+                   wall by construction; the acceptance test pins it)
+
+Overlapping those host segments, `device_compute` estimates when the
+device was actually busy on this wave (dispatch end -> result ready; the
+ready edge comes from the worker's is_ready() poll, or the device_get
+return on a blocking harvest). From token counts and the model config the
+profiler computes per-wave achieved FLOPs, so `mfu_decode` decomposes:
+
+    mfu_decode + sum(mfu_loss[segment]) ~= mfu_device
+
+where mfu_device is what the device-busy time alone would achieve and
+each loss term charges a named idle segment its share of the gap. The
+bounded ring exports at /debug/profile (observability/metrics.py) and the
+windowed means surface as Prometheus gauges — this is the layer every
+subsequent perf PR proves itself against.
+
+Cost discipline: all fencing is perf_counter reads on the PER-WAVE path
+(waves run at ~10-60/s, never per token); with no profiler attached the
+engine pays one None check per wave. bench.py --preset obs-overhead
+re-measures the budget with the profiler on.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import deque
+from typing import Any
+
+logger = logging.getLogger(__name__)
+
+# Telescoping host-side segments, in timeline order. `device_compute` is
+# NOT in this list: it overlaps dispatch_gap/host_sync and is reported as
+# its own (estimated) figure beside them.
+SEGMENTS = (
+    "queue_stall",
+    "dispatch",
+    "dispatch_gap",
+    "host_sync",
+    "harvest",
+    "unattributed",
+)
+
+# Peak dense bf16 TFLOP/s by jax device_kind (public spec sheets). Shared
+# with bench.py's MFU figures so the profiler's decomposition and the
+# bench headline always normalize against the same peak.
+PEAK_BF16_TFLOPS = {
+    "TPU v4": 275.0,
+    "TPU v5 lite": 197.0,
+    "TPU v5e": 197.0,
+    "TPU v5p": 459.0,
+    "TPU v6 lite": 918.0,
+    "TPU v6e": 918.0,
+}
+
+
+def matmul_flops_per_token(cfg) -> float:
+    """Dense matmul FLOPs for one token's forward pass (2*MACs).
+    Formerly bench.py's accounting — moved here so the profiler's MFU
+    decomposition and the bench headline share one set of books."""
+    d, hd = cfg.d_model, cfg.head_dim
+    attn_proj = (
+        d * cfg.n_heads * hd + 2 * d * cfg.n_kv_heads * hd
+        + cfg.n_heads * hd * d
+    )
+    mlp = 3 * d * cfg.d_ff
+    lm_head = d * cfg.vocab_size
+    return 2.0 * (cfg.n_layers * (attn_proj + mlp) + lm_head)
+
+
+def attn_flops_per_token(cfg, ctx: float) -> float:
+    """Attention score+value FLOPs for one token attending to `ctx` keys."""
+    return 4.0 * cfg.n_layers * cfg.n_heads * cfg.head_dim * ctx
+
+
+def detect_peak_tflops(override: float | None = None) -> tuple[float | None, str]:
+    """(peak bf16 TFLOP/s or None if unknown, device kind)."""
+    try:
+        import jax
+
+        kind = jax.devices()[0].device_kind
+    except Exception:  # pragma: no cover - no backend at all
+        kind = "unknown"
+    if override is not None:
+        return override, kind
+    return PEAK_BF16_TFLOPS.get(kind), kind
+
+
+class EngineProfiler:
+    """Per-wave step-timeline recorder for one InferenceEngine.
+
+    The engine owns the fences (it is the only code that knows where its
+    dispatch and sync boundaries are); this class owns the bookkeeping:
+    in-flight wave state keyed by handle identity, a bounded ring of
+    completed wave records, and the derived segment/MFU aggregates.
+
+    Thread model: on_submit/note_ready/on_harvest run on the engine-owner
+    thread; note_admission runs there too (engine/local._submit_waves).
+    snapshot()/gauges() are called from metrics-server handler threads —
+    ring and totals are guarded by one lock, acquired once per wave and
+    once per scrape.
+    """
+
+    def __init__(
+        self,
+        cfg: Any = None,
+        *,
+        window: int = 256,
+        peak_tflops: float | None = None,
+        clock=time.perf_counter,
+    ) -> None:
+        self.cfg = cfg
+        self.window = max(1, int(window))
+        self._clock = clock
+        peak, kind = detect_peak_tflops(peak_tflops)
+        self.peak_flops = peak * 1e12 if peak else None
+        self.device_kind = kind
+        self._lock = threading.Lock()
+        self._ring: deque[dict] = deque(maxlen=self.window)
+        # in-flight fence state, keyed by id(handle): a handle is submitted
+        # and harvested exactly once, and the engine-owner thread does both
+        self._open: dict[int, dict] = {}
+        self._wave_counter = 0
+        self._totals = {name: 0.0 for name in SEGMENTS}
+        self._totals["device_compute"] = 0.0
+        self._totals["wall"] = 0.0
+        self._flops_total = 0.0
+        self._tokens_total = 0
+        self.waves_profiled = 0
+        self.closed = False
+
+    # ------------------------------------------------------------- fences
+    def on_submit(
+        self,
+        handle: Any,
+        t_enter: float,
+        t_exit: float,
+        *,
+        suffix_tokens: int,
+        n_requests: int,
+        prefix_len: int,
+        cold_compile: bool,
+    ) -> None:
+        """submit_wave fencing: t_enter/t_exit bracket the jit dispatch
+        (prompt packing + program enqueue + D2H kick)."""
+        with self._lock:
+            if len(self._open) > 64:
+                # a leaked handle (harvest raised before reaching the
+                # profiler fence) must not grow this map forever
+                self._open.clear()
+            self._open[id(handle)] = {
+                "submit_enter": t_enter,
+                "submit_exit": t_exit,
+                "enqueued_at": None,
+                "ready_at": None,
+                "suffix_tokens": int(suffix_tokens),
+                "n_requests": int(n_requests),
+                "prefix_len": int(prefix_len),
+                "cold_compile": bool(cold_compile),
+            }
+
+    def note_admission(self, handle: Any, oldest_enqueued_at: float) -> None:
+        """Queue-side fence from engine/local.py: the oldest batch item's
+        enqueue time (perf_counter) — the wave's queue_stall anchor."""
+        with self._lock:
+            st = self._open.get(id(handle))
+            if st is not None:
+                st["enqueued_at"] = float(oldest_enqueued_at)
+
+    def note_ready(self, handle: Any) -> None:
+        """The worker's is_ready() poll observed the device result landing
+        (first call wins); on a blocking harvest the device_get return
+        stands in for this edge."""
+        now = self._clock()
+        with self._lock:
+            st = self._open.get(id(handle))
+            if st is not None and st["ready_at"] is None:
+                st["ready_at"] = now
+
+    def on_harvest(
+        self,
+        handle: Any,
+        t_enter: float,
+        t_sync: float,
+        t_exit: float,
+        *,
+        decode_tokens: int,
+        model_calls: int,
+        ready_at_entry: bool,
+    ) -> None:
+        """harvest_wave fencing: t_enter -> t_sync brackets the device_get
+        (the block_until_ready boundary), t_sync -> t_exit the host-side
+        token decode. Completes the wave record."""
+        with self._lock:
+            st = self._open.pop(id(handle), None)
+        if st is None:
+            return  # submitted before the profiler attached
+        ready_at = st["ready_at"]
+        if ready_at is None:
+            # never observed by a poll: the result landed either before
+            # harvest entry (charge the gap) or at the device_get return
+            ready_at = t_enter if ready_at_entry else t_sync
+        start = st["enqueued_at"]
+        if start is None or start > st["submit_enter"]:
+            start = st["submit_enter"]
+        seg = {
+            "queue_stall": max(st["submit_enter"] - start, 0.0),
+            "dispatch": max(st["submit_exit"] - st["submit_enter"], 0.0),
+            "dispatch_gap": max(t_enter - st["submit_exit"], 0.0),
+            "host_sync": max(t_sync - t_enter, 0.0),
+            "harvest": max(t_exit - t_sync, 0.0),
+        }
+        wall = max(t_exit - start, 0.0)
+        seg["unattributed"] = max(wall - sum(seg.values()), 0.0)
+        device = min(max(ready_at - st["submit_exit"], 0.0), wall)
+        suffix_tokens = st["suffix_tokens"]
+        tokens = suffix_tokens + int(decode_tokens)
+        flops = self._wave_flops(
+            st["prefix_len"], suffix_tokens, int(decode_tokens),
+            st["n_requests"],
+        )
+        record = {
+            "wave": 0,  # stamped under the lock below
+            "n_requests": st["n_requests"],
+            "cold_compile": st["cold_compile"],
+            "wall_ms": wall * 1000.0,
+            "segments_ms": {k: v * 1000.0 for k, v in seg.items()},
+            "device_compute_ms": device * 1000.0,
+            "suffix_tokens": suffix_tokens,
+            "decode_tokens": int(decode_tokens),
+            "model_calls": int(model_calls),
+            "flops": flops,
+        }
+        with self._lock:
+            self._wave_counter += 1
+            record["wave"] = self._wave_counter
+            # the aggregates are WINDOWED over the ring: an evicted wave's
+            # contribution leaves the books, so segment_frac / mfu gauges
+            # track the last `window` waves and a fresh regression moves
+            # them immediately instead of drowning in lifetime history
+            if len(self._ring) == self._ring.maxlen:
+                old = self._ring[0]
+                if not old["cold_compile"]:
+                    for name in SEGMENTS:
+                        self._totals[name] = max(
+                            self._totals[name]
+                            - old["segments_ms"].get(name, 0.0) / 1000.0,
+                            0.0,
+                        )
+                    self._totals["device_compute"] = max(
+                        self._totals["device_compute"]
+                        - old["device_compute_ms"] / 1000.0,
+                        0.0,
+                    )
+                    self._totals["wall"] = max(
+                        self._totals["wall"] - old["wall_ms"] / 1000.0, 0.0
+                    )
+                    self._flops_total = max(
+                        self._flops_total - old["flops"], 0.0
+                    )
+                    self._tokens_total = max(
+                        self._tokens_total
+                        - (old["suffix_tokens"] + old["decode_tokens"]),
+                        0,
+                    )
+            self._ring.append(record)
+            self.waves_profiled += 1
+            # cold-compile waves hit the ring (they are real wall time the
+            # operator should see) but stay out of the MFU aggregates —
+            # jit time would poison the loss attribution exactly the way
+            # it poisons the service-time EMA (engine/local.py)
+            if not st["cold_compile"]:
+                for name in SEGMENTS:
+                    self._totals[name] += seg.get(name, 0.0)
+                self._totals["device_compute"] += device
+                self._totals["wall"] += wall
+                self._flops_total += flops
+                self._tokens_total += tokens
+
+    # -------------------------------------------------------------- flops
+    def _wave_flops(
+        self,
+        prefix_len: int,
+        suffix_tokens: int,
+        decode_tokens: int,
+        n_requests: int = 1,
+    ) -> float:
+        """Achieved FLOPs of one wave: suffix prefill + block decode, both
+        attending to the shared prefix (mean PER-REQUEST context ~ prefix +
+        half that request's suffix+emission — same estimator bench.py's
+        MFU uses; the wave total must be apportioned or a batched wave's
+        attention term is overstated n_requests-fold)."""
+        if self.cfg is None:
+            return 0.0
+        n = suffix_tokens + decode_tokens
+        if n <= 0:
+            return 0.0
+        per_req = n / max(int(n_requests), 1)
+        ctx = prefix_len + (per_req / 2.0)
+        return n * (
+            matmul_flops_per_token(self.cfg)
+            + attn_flops_per_token(self.cfg, ctx)
+        )
+
+    # ------------------------------------------------------------- exports
+    def _mfu(
+        self, flops: float, wall: float, device: float, totals: dict
+    ) -> dict | None:
+        """The decomposition: mfu_decode + sum(loss terms) ~= mfu_device.
+
+        The device is busy during [dispatch end, ready], which overlaps
+        dispatch_gap and host_sync; each segment's loss term charges its
+        NON-OVERLAPPED (device-idle) share, so the identity holds by
+        construction: loss[s] = mfu_device * idle_s / wall and
+        sum(idle) + device = wall. `totals` is the caller's copy taken
+        under ONE lock acquisition together with flops/wall/device — a
+        re-read here could include a wave the other figures don't."""
+        if not self.peak_flops or wall <= 0 or flops <= 0:
+            return None
+        mfu = flops / wall / self.peak_flops
+        if device <= 0:
+            return {"decode": round(mfu, 5)}
+        mfu_device = flops / device / self.peak_flops
+        seg = {name: totals[name] for name in SEGMENTS}
+        # device busy overlaps the gap first, then the sync window
+        overlap_gap = min(seg["dispatch_gap"], device)
+        overlap_sync = min(seg["host_sync"], device - overlap_gap)
+        idle = dict(seg)
+        idle["dispatch_gap"] = max(seg["dispatch_gap"] - overlap_gap, 0.0)
+        idle["host_sync"] = max(seg["host_sync"] - overlap_sync, 0.0)
+        loss = {
+            name: round(mfu_device * idle_s / wall, 5)
+            for name, idle_s in idle.items()
+            if idle_s > 0
+        }
+        return {
+            "decode": round(mfu, 5),
+            "device": round(mfu_device, 5),
+            "busy_frac": round(device / wall, 4),
+            "loss": loss,
+        }
+
+    def snapshot(self) -> dict:
+        """The /debug/profile payload: windowed segment totals/means, the
+        MFU decomposition, and the per-wave ring."""
+        with self._lock:
+            ring = list(self._ring)
+            totals = dict(self._totals)
+            flops = self._flops_total
+            tokens = self._tokens_total
+            waves = self.waves_profiled
+        wall = totals["wall"]
+        n_warm = sum(1 for r in ring if not r["cold_compile"])
+        out: dict[str, Any] = {
+            "waves_profiled": waves,
+            "window": self.window,
+            "device_kind": self.device_kind,
+            "peak_bf16_tflops": (
+                self.peak_flops / 1e12 if self.peak_flops else None
+            ),
+            "wall_ms_total": round(wall * 1000.0, 3),
+            "segments_ms_total": {
+                name: round(totals[name] * 1000.0, 3) for name in SEGMENTS
+            },
+            "device_compute_ms_total": round(
+                totals["device_compute"] * 1000.0, 3
+            ),
+            "segment_frac": {
+                name: round(totals[name] / wall, 4) if wall > 0 else 0.0
+                for name in SEGMENTS
+            },
+            "coverage_frac": (
+                round(
+                    sum(totals[n] for n in SEGMENTS if n != "unattributed")
+                    / wall,
+                    4,
+                )
+                if wall > 0
+                else 0.0
+            ),
+            "tokens": tokens,
+            "achieved_tflops": (
+                round(flops / wall / 1e12, 4) if wall > 0 else 0.0
+            ),
+            "warm_waves_in_window": n_warm,
+            "ring": ring,
+        }
+        mfu = self._mfu(flops, wall, totals["device_compute"], totals)
+        if mfu is not None:
+            out["mfu"] = mfu
+        return out
+
+    def gauges(self) -> dict[str, float]:
+        """Flat numeric view for /metrics (observability/metrics._flatten
+        renders each as a llm_scheduler_engine_profile_* gauge)."""
+        with self._lock:
+            totals = dict(self._totals)
+            flops = self._flops_total
+            waves = self.waves_profiled
+        wall = totals["wall"]
+        out: dict[str, float] = {"waves_profiled": float(waves)}
+        for name in SEGMENTS:
+            out[f"{name}_frac"] = (
+                round(totals[name] / wall, 4) if wall > 0 else 0.0
+            )
+        out["device_compute_frac"] = (
+            round(totals["device_compute"] / wall, 4) if wall > 0 else 0.0
+        )
+        if wall > 0:
+            out["achieved_tflops"] = round(flops / wall / 1e12, 4)
+        mfu = self._mfu(flops, wall, totals["device_compute"], totals)
+        if mfu is not None:
+            out["mfu_decode"] = mfu["decode"]
+            if "device" in mfu:
+                out["mfu_device"] = mfu["device"]
+            for name, value in (mfu.get("loss") or {}).items():
+                out[f"mfu_loss_{name}"] = value
+        return out
+
+    def close(self) -> None:
+        """Flush any in-flight fence state (waves that will never harvest —
+        backend shutdown fails them upstream) so shutdown leaves no
+        half-open records; idempotent."""
+        with self._lock:
+            self._open.clear()
+            self.closed = True

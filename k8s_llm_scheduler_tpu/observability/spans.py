@@ -293,6 +293,36 @@ class _NullCtx:
 _NULL = _NullCtx()
 
 
+def budget_slice(
+    matched: list[dict],
+    since_seq: int = 0,
+    max_traces: int | None = None,
+    max_bytes: int | None = None,
+) -> tuple[list[dict], int, bool]:
+    """Apply trace-count + byte caps to cursor-ordered entries (each
+    carrying a `seq`). Returns (kept, next_cursor, truncated) — the one
+    budget loop behind FlightRecorder.export_slices (/debug/export,
+    telemetry_pull) and /debug/decisions' summary pagination. At least
+    one entry is always kept when any matched, so a single oversized
+    entry cannot wedge the cursor."""
+    entries: list[dict] = []
+    next_cursor = since_seq
+    spent = 0
+    truncated = False
+    for e in matched:
+        if max_traces is not None and len(entries) >= max_traces:
+            truncated = True
+            break
+        size = len(json.dumps(e, separators=(",", ":")))
+        if max_bytes is not None and entries and spent + size > max_bytes:
+            truncated = True
+            break
+        entries.append(e)
+        spent += size
+        next_cursor = e["seq"]
+    return entries, next_cursor, truncated
+
+
 class FlightRecorder:
     """Bounded ring of the last N complete decision traces.
 
@@ -337,11 +367,18 @@ class FlightRecorder:
                     self._ring[i] = entry
                     return
 
-    def list(self, n: int = 50, since_seq: int = 0) -> list[dict]:
+    def list(
+        self, n: int | None = 50, since_seq: int = 0,
+    ) -> list[dict]:
         """Newest-last summaries (cheap fields only — the list endpoint
-        must stay small at ring capacity)."""
+        must stay small at ring capacity). `n` keeps the NEWEST n (the
+        recent-traces view); pass None for every match past the cursor —
+        what a forward-pagination walk needs, since a newest-n cut would
+        silently skip older entries without marking truncation."""
         with self._lock:
-            entries = [e for e in self._ring if e["seq"] > since_seq][-n:]
+            entries = [e for e in self._ring if e["seq"] > since_seq]
+        if n is not None:
+            entries = entries[-n:]
         return [
             {
                 "seq": e["seq"],
@@ -371,6 +408,32 @@ class FlightRecorder:
         return "".join(
             json.dumps(e, sort_keys=True, separators=(",", ":")) + "\n"
             for e in entries
+        )
+
+    def export_slices(
+        self,
+        since_seq: int = 0,
+        max_traces: int | None = None,
+        max_bytes: int | None = None,
+    ) -> tuple[list[dict], int, bool]:
+        """Since-cursor trace slices with a HARD response-size cap.
+
+        Returns (entries, next_cursor, truncated). `next_cursor` is the
+        last included entry's seq (or `since_seq` when nothing fit) — pass
+        it back as `since_seq` to resume; `truncated` is True when more
+        entries matched the cursor than the caps allowed. This is the
+        shape a 16-replica `telemetry_pull` fans in: without the cap one
+        frame could ship the whole ring per replica per scrape
+        (observability/fleetview.py; /debug/export routes through it too,
+        and /debug/decisions applies the same `budget_slice` to its
+        summaries). The byte budget counts each entry's canonical-JSON
+        size; at least one entry is always shipped when any matches, so a
+        single oversized trace cannot wedge the cursor."""
+        with self._lock:
+            matched = [e for e in self._ring if e["seq"] > since_seq]
+        return budget_slice(
+            matched, since_seq=since_seq,
+            max_traces=max_traces, max_bytes=max_bytes,
         )
 
     def stats(self) -> dict[str, int]:

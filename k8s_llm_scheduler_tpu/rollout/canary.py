@@ -176,6 +176,7 @@ class CanaryController:
         trip_invalid_rate: float = 0.05,
         trip_bind_failure_rate: float = 0.05,
         trip_decide_p99_ms: float | None = None,
+        slo_engine: Any = None,
         clock: Callable[[], float] = time.monotonic,
     ) -> None:
         self.registry = registry
@@ -206,6 +207,12 @@ class CanaryController:
         self.trip_decide_p99_ms = (
             None if trip_decide_p99_ms is None else float(trip_decide_p99_ms)
         )
+        # Optional SLO burn-rate input (observability/slo.SloEngine): a
+        # tripped objective during an OPEN burn-in rolls back immediately
+        # — the multiwindow burn rate is a stronger regression signal than
+        # the window-count rates, and waiting out the decision count would
+        # serve a burning SLO for the rest of the window.
+        self.slo_engine = slo_engine
         self.clock = clock
         self.rejected: set[int] = set()
         self._burn: dict | None = None
@@ -308,6 +315,16 @@ class CanaryController:
         if baseline is None:
             self._burn = None
             return "ok"
+        if self.slo_engine is not None:
+            # SLO burn-rate trip during an open burn-in: roll back NOW —
+            # no waiting for the decision-count window to fill while a
+            # declared objective burns (observability/slo.py).
+            slo_tripped = self.slo_engine.tripped()
+            if slo_tripped:
+                return self._roll_back(
+                    tripped=[f"slo:{name}" for name in slo_tripped],
+                    rates={"slo_tripped": slo_tripped},
+                )
         now_stats = self.stats_provider()
         now_sig = self._signals(now_stats)
         delta_n = now_sig["decisions"] - baseline["decisions"]
@@ -358,29 +375,39 @@ class CanaryController:
             trips["decide_p99_ms"] = (
                 rates["decide_p99_ms"] / 2.0 > self.trip_decide_p99_ms
             )
-        version = self._burn["version"]
-        prior = self._burn["prior"]
-        self._burn = None
         if any(trips.values()):
-            tripped = sorted(k for k, v in trips.items() if v)
-            logger.warning(
-                "burn-in TRIPPED for version %d (%s; rates %s) — rolling "
-                "back to %s", version, tripped, rates, prior,
+            return self._roll_back(
+                tripped=sorted(k for k, v in trips.items() if v),
+                rates=rates,
             )
-            self.rejected.add(version)
-            self.registry.record_scores(
-                version, {"burn_in": {"tripped": tripped, "rates": rates}}
-            )
-            if prior is not None:
-                self.swapper.swap_to(prior)
-                self.registry.set_active(prior)
-            self.counters["rollbacks"] += 1
-            return "rolled_back"
+        version = self._burn["version"]
+        self._burn = None
         self.registry.record_scores(
             version, {"burn_in": {"tripped": [], "rates": rates}}
         )
         logger.info("burn-in OK for version %d (rates %s)", version, rates)
         return "ok"
+
+    def _roll_back(self, tripped: list, rates: dict) -> str:
+        """Close the open burn-in as TRIPPED: reject the candidate, swap
+        back to the prior version, bump counters. Shared by the window
+        rate trips and the SLO burn-rate early trip."""
+        version = self._burn["version"]
+        prior = self._burn["prior"]
+        self._burn = None
+        logger.warning(
+            "burn-in TRIPPED for version %d (%s; rates %s) — rolling "
+            "back to %s", version, tripped, rates, prior,
+        )
+        self.rejected.add(version)
+        self.registry.record_scores(
+            version, {"burn_in": {"tripped": tripped, "rates": rates}}
+        )
+        if prior is not None:
+            self.swapper.swap_to(prior)
+            self.registry.set_active(prior)
+        self.counters["rollbacks"] += 1
+        return "rolled_back"
 
     # ----------------------------------------------------------------- tick
     def tick(self) -> dict | str | None:

@@ -156,7 +156,8 @@ class ReplicaServer:
                  port: int = 9901, max_inflight: int = 64,
                  max_connections: int = 16,
                  swap_fn: Callable[[int], dict] | None = None,
-                 pool_role: str = "mixed") -> None:
+                 pool_role: str = "mixed",
+                 telemetry_fn: Callable[[dict], dict] | None = None) -> None:
         from concurrent.futures import ThreadPoolExecutor
 
         from k8s_llm_scheduler_tpu.fleet.pools import POOL_ROLES
@@ -190,6 +191,13 @@ class ReplicaServer:
         # time (rollout/canary.staggered_swap) so the fanout always keeps
         # a serving majority. None = the op answers ok=False.
         self.swap_fn = swap_fn
+        # Fleet telemetry hook (observability/fleetview.py): the
+        # `telemetry_pull` op ships this worker's stats tree, a
+        # since-cursor flight-recorder slice, and its sampler ring to the
+        # aggregator. `telemetry_fn(request) -> payload` overrides the
+        # default (backend stats + the process-global flight recorder) for
+        # deployments that wire a scheduler-level stats provider.
+        self.telemetry_fn = telemetry_fn
         self.max_inflight = max_inflight
         self.max_connections = max_connections
         self._pool = ThreadPoolExecutor(
@@ -309,6 +317,11 @@ class ReplicaServer:
                 # answers ok=False.
                 self._serve_prewarm(conn, send_lock, req)
                 return
+            elif req.get("op") == "telemetry_pull":
+                # Fleet telemetry fan-in (observability/fleetview.py):
+                # stats + since-cursor trace slices + sampler ring, size-
+                # capped so 16 replicas can't ship unbounded JSONL.
+                resp = {"id": rid, **self._serve_telemetry(req)}
             elif req.get("op") == "decide_batch":
                 # Prepacked admission (fleet/pools.py): many pods, ONE
                 # nodes snapshot, one frame — per-pod outcomes ride back
@@ -387,6 +400,27 @@ class ReplicaServer:
                 pod, nodes, work=work
             )
         return self.backend.get_scheduling_decision(pod, nodes)
+
+    def _serve_telemetry(self, req: dict) -> dict:
+        from k8s_llm_scheduler_tpu.observability import fleetview, spans
+
+        if self.telemetry_fn is not None:
+            return self.telemetry_fn(req)
+        get_stats = getattr(self.backend, "get_stats", None)
+        stats = get_stats() if get_stats is not None else {}
+        return fleetview.build_telemetry(
+            stats,
+            spans.flight,
+            since_seq=int(req.get("since", 0)),
+            max_traces=min(
+                int(req.get("max_traces", fleetview.DEFAULT_MAX_TRACES)),
+                4 * fleetview.DEFAULT_MAX_TRACES,
+            ),
+            max_bytes=min(
+                int(req.get("max_bytes", fleetview.DEFAULT_MAX_BYTES)),
+                4 * fleetview.DEFAULT_MAX_BYTES,
+            ),
+        )
 
     def _serve_batch(self, rid, req: dict) -> dict:
         nodes = [node_from_wire(n) for n in req["nodes"]]
@@ -783,6 +817,44 @@ class ReplicaClient:
             raise BackendError(
                 f"replica {self.addr} swap timed out"
             ) from exc
+        return {k: v for k, v in resp.items() if k != "id"}
+
+    def telemetry_pull(
+        self,
+        since_seq: int = 0,
+        max_traces: int | None = None,
+        max_bytes: int | None = None,
+        timeout_s: float | None = None,
+    ) -> dict:
+        """Pull this worker's telemetry payload (stats tree with embedded
+        histogram buckets, flight-recorder slice since `since_seq`,
+        sampler ring — observability/fleetview.build_telemetry shape).
+        BLOCKING, like rollout_swap: the aggregator drives one bounded
+        pull per source per round, and a dead worker must surface as a
+        BackendError the aggregator can mark stale on, not a hang."""
+        payload: dict[str, Any] = {
+            "op": "telemetry_pull", "since": int(since_seq),
+        }
+        if max_traces is not None:
+            payload["max_traces"] = int(max_traces)
+        if max_bytes is not None:
+            payload["max_bytes"] = int(max_bytes)
+        rid, fut, sock = self._submit_frame(payload)
+        try:
+            resp = fut.result(
+                timeout=self.request_timeout_s if timeout_s is None else timeout_s
+            )
+        except FuturesTimeout as exc:
+            self._drop(rid)
+            self._mark_suspect(sock)
+            raise BackendError(
+                f"replica {self.addr} telemetry pull timed out"
+            ) from exc
+        if "stats" not in resp:
+            raise BackendError(
+                f"replica {self.addr}: "
+                f"{resp.get('error', 'malformed telemetry response')}"
+            )
         return {k: v for k, v in resp.items() if k != "id"}
 
     def _resolve(self, resp: dict) -> SchedulingDecision:

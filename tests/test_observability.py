@@ -418,6 +418,43 @@ class TestDebugEndpoints:
         finally:
             server.stop()
 
+    def test_debug_decisions_n_cut_surfaces_as_truncated(self, recorder):
+        """The documented resume contract: a cursor walk (since= present)
+        must reach EVERY held trace even when each page's n cut engages —
+        the cut is oldest-first with truncated=true, never a silent
+        newest-n skip. Without a cursor the endpoint keeps its
+        recent-traces view (newest n)."""
+        for i in range(12):
+            with spans.start_trace("decision", pod=f"ns/p{i}"):
+                pass
+        server = MetricsServer(
+            lambda: {}, port=0, host="127.0.0.1",
+            flight_recorder=recorder,
+        )
+        server.start()
+        try:
+            base = f"http://127.0.0.1:{server.port}"
+            walked, cursor, pages = [], 0, 0
+            while True:
+                page = json.loads(urllib.request.urlopen(
+                    f"{base}/debug/decisions?n=5&since={cursor}"
+                ).read())
+                walked.extend(t["seq"] for t in page["traces"])
+                pages += 1
+                if not page["truncated"]:
+                    break
+                assert page["next_cursor"] > cursor
+                cursor = page["next_cursor"]
+            assert walked == list(range(1, 13))
+            assert pages == 3
+            # no cursor: newest n, oldest-first within the window
+            recent = json.loads(urllib.request.urlopen(
+                f"{base}/debug/decisions?n=5"
+            ).read())
+            assert [t["seq"] for t in recent["traces"]] == [8, 9, 10, 11, 12]
+        finally:
+            server.stop()
+
     def test_debug_engine_endpoint(self, recorder):
         class FakeEngine:
             max_slots = 8

@@ -48,6 +48,15 @@ class TestRepoIsClean:
         assert "k8s_llm_scheduler_tpu/fleet/pools.py" in files
         assert "k8s_llm_scheduler_tpu/fleet/frontend.py" in files
         assert "tests/test_fleet.py" in files
+        # fleet-telemetry round: profiler / aggregator / SLO engine (the
+        # SLO ticker and aggregator pulls are thread+deque-heavy code of
+        # the same 3.11+-API risk class as the sampler)
+        assert "k8s_llm_scheduler_tpu/observability/profiler.py" in files
+        assert "k8s_llm_scheduler_tpu/observability/fleetview.py" in files
+        assert "k8s_llm_scheduler_tpu/observability/slo.py" in files
+        assert "tests/test_profiler.py" in files
+        assert "tests/test_fleetview.py" in files
+        assert "tests/test_slo.py" in files
         # the lint never lints its own pattern table
         assert "tools/py310_lint.py" not in files
 
