@@ -166,6 +166,10 @@ PRESETS = {
     # before the burst lands.
     "restate": {"pods": 1000, "nodes": 64, "shapes": 32,
                 "perturb_idle": 1.0, "rounds": 3},
+    # deterministic chaos plane (chaos/): every fault regime through its
+    # harness stack, zero invariant violations required; publishes
+    # recovery time, degraded-decision fraction, quality-vs-teacher
+    "chaos": {"pods": 48, "nodes": 10, "rounds": 1},
 }
 
 
@@ -782,6 +786,69 @@ def arena_bench(args) -> dict:
                 }
                 for name, arm in report["arms"].items()
             },
+        },
+    }
+
+
+def chaos_bench(args) -> dict:
+    """`--preset chaos`: every chaos regime (chaos/faults.REGIMES) runs
+    seeded through its harness stack, and the preset FAILS unless every
+    run finishes with zero invariant violations. Published per regime:
+    recovery time (waves + ms after the last fault wave until a clean
+    wave), degraded-decision fraction (the ladder's engagement meter —
+    asserted >0 for the brownout regime, or the run was fault-free and
+    proved nothing), and placement quality vs the fault-free teacher
+    policy. `value` is the worst recovery time in waves across regimes."""
+    from k8s_llm_scheduler_tpu.chaos import REGIMES, run_chaos
+
+    seed = args.seed if args.seed is not None else 0
+    regimes = {}
+    violations = 0
+    worst_recovery = 0
+    for regime in sorted(REGIMES):
+        # geometry comes from PRESETS["chaos"] via the merged args —
+        # the mechanism every other preset tunes through
+        report = run_chaos(
+            regime, seed=seed, n_waves=6,
+            n_nodes=args.nodes, n_pods=args.pods,
+        )
+        inv = report["invariants"]
+        violations += len(inv["violations"])
+        recovery = report["recovery"]["recovery_waves"]
+        if recovery is None:
+            recovery = 99  # never recovered inside the run: loud
+        worst_recovery = max(worst_recovery, recovery)
+        regimes[regime] = {
+            "mode": report["mode"],
+            "clean": inv["clean"],
+            "checks": inv["checks"],
+            "plan_digest": report["plan_digest"],
+            "injections": report["injections"],
+            "recovery_waves": report["recovery"]["recovery_waves"],
+            "recovery_ms": report["recovery"]["recovery_ms"],
+            "degraded_fraction": report["degraded_fraction"],
+            "bound_frac": report["scores"]["bound_frac"],
+            "quality": report.get("quality"),
+            "wall_ms": report["wall_ms"],
+        }
+    assert violations == 0, (
+        f"{violations} invariant violation(s) across chaos regimes: "
+        + json.dumps({r: v for r, v in regimes.items() if not v["clean"]})
+    )
+    # the ladder must have actually engaged somewhere, or the brownout
+    # regime was fault-free and the preset proved nothing
+    assert regimes["brownout"]["degraded_fraction"] > 0, (
+        "brownout regime shed no decisions — the degradation ladder "
+        "never engaged"
+    )
+    return {
+        "metric": "chaos",
+        "value": worst_recovery,
+        "unit": "worst_recovery_waves",
+        "extra": {
+            "seed": seed,
+            "regimes": regimes,
+            "invariant_violations": violations,
         },
     }
 
@@ -1577,6 +1644,9 @@ def main() -> None:
         return
     if args.preset == "fleet":
         _emit(asyncio.run(fleet_bench(args)))
+        return
+    if args.preset == "chaos":
+        _emit(chaos_bench(args))
         return
     result = asyncio.run(bench_preset(args))
     result["extra"]["dispatch_rtt_ms"] = measure_dispatch_rtt_ms()

@@ -115,10 +115,24 @@ def _build_stack(cfg: Config, cluster) -> Any:
             failure_threshold=cfg.get("circuit_breaker.failure_threshold"),
             timeout_seconds=cfg.get("circuit_breaker.timeout"),
             half_open_max_calls=cfg.get("circuit_breaker.half_open_max_calls"),
+            cooldown_jitter=float(
+                cfg.get("circuit_breaker.cooldown_jitter", 0.1)
+            ),
         )
         if cfg.get("circuit_breaker.enabled")
         else None
     )
+    # deadline-budgeted degradation ladder (sched/deadline.py). The env
+    # override arrives as a STRING (the default is null, so _coerce has
+    # no type template): normalize through float FIRST, then apply the
+    # documented "null / <=0 disables" semantics — `in (None, 0)` would
+    # let SCHED_DECISION_DEADLINE_MS=0 slip through as a 0ms deadline
+    # that sheds every decision fleet-wide.
+    deadline_ms = cfg.get("scheduler.decision_deadline_ms", None)
+    if deadline_ms is not None:
+        deadline_ms = float(deadline_ms)
+        if deadline_ms <= 0:
+            deadline_ms = None
     client = DecisionClient(
         backend,
         cache=cache,
@@ -127,6 +141,10 @@ def _build_stack(cfg: Config, cluster) -> Any:
         retry_delay=cfg.get("llm.retry_delay"),
         fallback_strategy=cfg.get("fallback.strategy"),
         fallback_enabled=cfg.get("fallback.enabled"),
+        deadline_ms=deadline_ms,
+        llm_min_budget_ms=float(
+            cfg.get("scheduler.llm_min_budget_ms", 25.0)
+        ),
     )
     scheduler = Scheduler(
         cluster, cluster, client,
@@ -170,6 +188,18 @@ async def _run_scheduler(cfg: Config, cluster, demo_pods: bool = False) -> int:
         if breaker is not None:
             slo_engine.on_trip.append(
                 lambda name, _detail: breaker.slo_advisory(name)
+            )
+        if cfg.get("slo.brownout", True):
+            # burn-rate brownout (sched/client.py): a sustained burn
+            # sheds the LLM rung fleet-wide until the burn clears — the
+            # falling edge matters as much as the rising one, or one
+            # trip would degrade decisions forever
+            client = scheduler.client
+            slo_engine.on_trip.append(
+                lambda name, _d: client.enter_brownout(f"slo:{name}")
+            )
+            slo_engine.on_clear.append(
+                lambda name, _d: client.exit_brownout(f"slo:{name}")
             )
         slo_engine.start(interval_s=float(cfg.get("slo.interval_s", 10.0)))
 
@@ -855,6 +885,81 @@ def cmd_sim(args: argparse.Namespace, cfg: Config) -> int:
     return 0
 
 
+def cmd_chaos(args: argparse.Namespace, cfg: Config) -> int:
+    """Deterministic chaos plane (chaos/): seeded fault schedules over
+    the real stack, invariant-monitored, replayable byte-for-byte."""
+    from k8s_llm_scheduler_tpu.chaos import (
+        REGIMES,
+        run_chaos,
+        save_chaos_trace,
+        verify_chaos_trace,
+    )
+
+    if args.chaos_cmd == "list":
+        for name in sorted(REGIMES):
+            info = REGIMES[name]
+            print(f"{name:18s} [{info['mode']:6s}] {info['describe']}")
+        return 0
+
+    if args.chaos_cmd == "replay":
+        ok, detail = verify_chaos_trace(args.trace)
+        print(json.dumps({
+            "metric": "chaos_replay", "ok": ok, "trace": args.trace,
+            "detail": detail,
+        }))
+        return 0 if ok else 1
+
+    # run
+    regimes = sorted(REGIMES) if args.regime == "all" else [args.regime]
+    unknown = [r for r in regimes if r not in REGIMES]
+    if unknown:
+        raise SystemExit(
+            f"unknown regime(s) {unknown}; `cli chaos list` shows all"
+        )
+    if args.trace and len(regimes) != 1:
+        raise SystemExit("--trace records exactly one regime's run")
+    deadline_ms = args.deadline_ms
+    if deadline_ms is not None and deadline_ms <= 0:
+        deadline_ms = None
+    exit_code = 0
+    for regime in regimes:
+        report = run_chaos(
+            regime, seed=args.seed,
+            n_waves=args.waves, n_nodes=args.nodes,
+            n_pods=args.pods,
+            wave_timeout_s=args.wave_timeout,
+            deadline_ms=deadline_ms,
+        )
+        if args.trace:
+            save_chaos_trace(report, args.trace)
+        if args.out:
+            mode = "w" if regime == regimes[0] else "a"  # JSONL, one run per line
+            with open(args.out, mode, encoding="utf-8") as fh:
+                json.dump(report, fh, sort_keys=True)
+                fh.write("\n")
+        clean = report["invariants"]["clean"]
+        if not clean:
+            exit_code = 1
+            for v in report["invariants"]["violations"]:
+                line = f"VIOLATION [{v['invariant']}] {v['subject']}: {v['detail']}"
+                if v.get("trace_id"):
+                    line += f" (cli trace show {v['trace_id']})"
+                print(line, flush=True)
+        print(json.dumps({
+            "metric": "chaos",
+            "regime": regime,
+            "seed": args.seed,
+            "mode": report["mode"],
+            "clean": clean,
+            "plan_digest": report["plan_digest"],
+            "bound_frac": report["scores"]["bound_frac"],
+            "degraded_fraction": report["degraded_fraction"],
+            "recovery_waves": report["recovery"]["recovery_waves"],
+            "injections": report["injections"],
+        }), flush=True)
+    return exit_code
+
+
 def _rollout_registry(args: argparse.Namespace, cfg: Config):
     from k8s_llm_scheduler_tpu.rollout import CheckpointRegistry
 
@@ -1091,6 +1196,14 @@ def _rollout_watch(args: argparse.Namespace, cfg: Config, registry) -> int:
         slo_engine.on_trip.append(
             lambda name, _detail: client.breaker.slo_advisory(name)
         )
+        if cfg.get("slo.brownout", True):
+            # burn-rate brownout, both edges (see _run_scheduler)
+            slo_engine.on_trip.append(
+                lambda name, _d: client.enter_brownout(f"slo:{name}")
+            )
+            slo_engine.on_clear.append(
+                lambda name, _d: client.exit_brownout(f"slo:{name}")
+            )
         slo_engine.start(interval_s=float(cfg.get("slo.interval_s", 10.0)))
 
     swapper = HotSwapper(
@@ -1233,7 +1346,7 @@ def _rollout_watch(args: argparse.Namespace, cfg: Config, registry) -> int:
             shadow.close()
             shadow.candidate.close()
         backend.close()
-        _time.sleep(0)  # let daemon teardown settle before the stats dump
+        _time.sleep(0)  # graftlint: ok[raw-clock] — zero-length GIL yield for daemon teardown, not a paced wait
         print(json.dumps({
             **scheduler.get_stats(), "rollout": controller.stats(),
         }, indent=2, default=str))
@@ -1345,7 +1458,7 @@ def cmd_trace(args: argparse.Namespace, cfg: Config) -> int:
                 for entry in data["traces"]:
                     print(summarize(entry), flush=True)
                     since = max(since, entry["seq"])
-                _time.sleep(args.interval)
+                _time.sleep(args.interval)  # graftlint: ok[raw-clock] — operator-facing tail interval; wall pacing is the product behavior
 
         if args.trace_cmd == "export":
             # /debug/export caps each response (EXPORT_MAX_BYTES) and ends
@@ -1538,7 +1651,7 @@ def cmd_fleet(args: argparse.Namespace, cfg: Config) -> int:
                 if args.once:
                     return 0 if round_info["ok"] else 2
                 print()
-                time.sleep(args.interval)
+                time.sleep(args.interval)  # graftlint: ok[raw-clock] — operator-facing watch interval; wall pacing is the product behavior
         except KeyboardInterrupt:
             return 0
         finally:
@@ -1836,6 +1949,44 @@ def main(argv: list[str] | None = None) -> int:
         help="serve live arena scores on /metrics while running",
     )
 
+    p_chaos = sub.add_parser(
+        "chaos",
+        help="deterministic chaos plane: seeded fault schedules through "
+             "the real stack, invariant-monitored, replayable (chaos/)",
+    )
+    csub = p_chaos.add_subparsers(dest="chaos_cmd", required=True)
+    p_clist = csub.add_parser("list", help="list regimes")  # noqa: F841
+    p_crun = csub.add_parser(
+        "run", help="run one regime (or all) and print the verdict",
+    )
+    p_crun.add_argument(
+        "--regime", default="all",
+        help="regime name (`cli chaos list`) or 'all'",
+    )
+    p_crun.add_argument("--seed", type=int, default=0)
+    p_crun.add_argument("--waves", type=int, default=8)
+    p_crun.add_argument("--nodes", type=int, default=12)
+    p_crun.add_argument(
+        "--pods", type=int, default=None,
+        help="default: 96 (single/wire regimes) or 64 (fleet regimes)",
+    )
+    p_crun.add_argument("--wave-timeout", type=float, default=30.0)
+    p_crun.add_argument(
+        "--deadline-ms", type=float, default=2000.0,
+        help="per-decision deadline budget riding every frame (<=0 "
+             "disables; loose by default — tight wall-clock deadlines "
+             "would break run-to-run placement determinism)",
+    )
+    p_crun.add_argument(
+        "--trace", default=None,
+        help="record the (single) regime's replayable trace here",
+    )
+    p_crun.add_argument("--out", default=None, help="full JSON report path")
+    p_creplay = csub.add_parser(
+        "replay", help="verify a recorded chaos trace replays byte-identically",
+    )
+    p_creplay.add_argument("trace", help="trace file from `chaos run --trace`")
+
     p_rollout = sub.add_parser(
         "rollout",
         help="live policy rollout: checkpoint registry, canary gate, "
@@ -2058,6 +2209,7 @@ def main(argv: list[str] | None = None) -> int:
         "train": cmd_train,
         "eval": cmd_eval,
         "sim": cmd_sim,
+        "chaos": cmd_chaos,
         "rollout": cmd_rollout,
         "fleet": cmd_fleet,
         "trace": cmd_trace,

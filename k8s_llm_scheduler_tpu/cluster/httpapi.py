@@ -172,6 +172,17 @@ def set_active_config(
     _active = _ClusterConfig(host, token, ca_file, verify_ssl)
 
 
+def clear_active_config() -> None:
+    """Forget an explicit set_active_config (harness/sim teardown).
+
+    The active config is PROCESS-GLOBAL: a harness that pointed it at an
+    ephemeral fake API server and exited without clearing would leave
+    every later client dialing a dead address instead of discovering (or
+    cleanly failing on) the real cluster config."""
+    global _active
+    _active = None
+
+
 def load_incluster_config() -> None:
     """Pod environment: service env vars + mounted serviceaccount creds."""
     host = os.environ.get("KUBERNETES_SERVICE_HOST")

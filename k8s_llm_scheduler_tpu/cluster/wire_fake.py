@@ -138,6 +138,14 @@ class WireFakeK8s:
         self._rv = 100
         self._min_rv = 0
         self.auto_run = auto_run
+        # Chaos seam (chaos/faults.py, seam "watch"): None outside chaos
+        # runs. Injecting HERE — at the wire — drives the REAL client
+        # handling paths in cluster/kube.py + cluster/httpapi.py:
+        # api_5xx answers list/watch GETs with a 500 Status, gone_410
+        # delivers the in-stream 410 ERROR regardless of the resume rv
+        # (mid-burst compaction), stale_event re-delivers the oldest
+        # backlog event (informer idempotency).
+        self.fault_seam = None
         self._nodes: dict[str, dict] = {}
         self._pods: dict[tuple[str, str], dict] = {}
         # (rv, kind in {"nodes","pods"}, event type, object snapshot)
@@ -286,6 +294,13 @@ class WireFakeK8s:
                 {"kind": "Status", "code": 404, "reason": "NotFound"},
             )
             return
+        seam = self.fault_seam
+        if seam is not None and seam.should("api_5xx", key=kind) is not None:
+            self._send_json(handler, 500, {
+                "kind": "Status", "code": 500, "reason": "InternalError",
+                "message": "chaos: injected apiserver failure",
+            })
+            return
         if query.get("watch") in ("true", "1"):
             self._serve_watch(handler, kind, query)
             return
@@ -314,13 +329,26 @@ class WireFakeK8s:
             line = json.dumps({"type": etype, "object": obj}) + "\n"
             self._chunk(handler, line.encode("utf-8"))
 
+        seam = self.fault_seam
         try:
             with self._lock:
                 if rv_param:
                     since = int(rv_param)
-                    if since < self._min_rv:
+                    # consult the seam only when the NATURAL expired-rv
+                    # 410 doesn't already apply — should() consumes one
+                    # of the event's `times` budget per firing, and a
+                    # no-op draw would silently starve the intended
+                    # injections while the report counts them as landed
+                    gone_injected = since >= self._min_rv and (
+                        seam is not None
+                        and seam.should("gone_410", key=kind) is not None
+                    )
+                    if since < self._min_rv or gone_injected:
                         # expired rv: the real server answers 200 and
                         # delivers the 410 as an in-stream ERROR Status
+                        # (chaos gone_410 injects the same mid-burst,
+                        # with a valid rv — the client must take the
+                        # fresh-start + relist path either way)
                         write_event("ERROR", {
                             "kind": "Status",
                             "apiVersion": "v1",
@@ -353,9 +381,33 @@ class WireFakeK8s:
             for rv, etype, obj in backlog:
                 write_event(etype, obj)
                 since = max(since, rv)
+            if backlog and seam is not None and seam.should(
+                "stale_event", key=kind
+            ) is not None:
+                # stale delivery: the oldest backlog event again, rv and
+                # all — the informer must treat it as the no-op it is
+                write_event(backlog[0][1], backlog[0][2])
             deadline = time.monotonic() + timeout_s
             last_bookmark = time.monotonic()
             while time.monotonic() < deadline and not self._closing:
+                if seam is not None and seam.should(
+                    "gone_410", key=kind
+                ) is not None:
+                    # mid-STREAM compaction: the backlog above was
+                    # delivered, then the stream 410s — the client must
+                    # fresh-start (and its re-list may hit api_5xx) with
+                    # those events already consumed, the exact mid-burst
+                    # shape the chaos watch regime exists to drive
+                    write_event("ERROR", {
+                        "kind": "Status",
+                        "apiVersion": "v1",
+                        "status": "Failure",
+                        "reason": "Expired",
+                        "code": 410,
+                        "metadata": {},
+                    })
+                    self._chunk_end(handler)
+                    return
                 with self._lock:
                     fresh = [
                         (rv, et, obj)
@@ -367,6 +419,12 @@ class WireFakeK8s:
                 for rv, etype, obj in fresh:
                     write_event(etype, obj)
                     since = max(since, rv)
+                if fresh and seam is not None and seam.should(
+                    "stale_event", key=kind
+                ) is not None:
+                    # stale re-delivery of an event the stream already
+                    # shipped, rv and all — informer idempotency
+                    write_event(fresh[0][1], fresh[0][2])
                 if bookmarks and time.monotonic() - last_bookmark > 0.2:
                     # bookmark carries the CURRENT rv so a quiet stream's
                     # resume point stays fresh (client-go reflector

@@ -41,6 +41,13 @@ DEFAULTS: dict[str, Any] = {
         # engine's cluster-state prefix KV pointed at the live snapshot so
         # the next burst's first wave skips the prefix prefill
         "prefix_prewarm_seconds": 0.25,
+        # Deadline-budgeted degradation (sched/deadline.py): every
+        # decision gets this much budget; the ladder LLM -> cached ->
+        # heuristic sheds to a fast answer when the remaining budget
+        # can no longer afford the model rung. null = no deadline.
+        "decision_deadline_ms": None,
+        # below this remaining budget the LLM rung is unaffordable
+        "llm_min_budget_ms": 25.0,
     },
     "llm": {
         "model": "llama-3.2-1b-instruct",
@@ -148,6 +155,10 @@ DEFAULTS: dict[str, Any] = {
         # each: {name, kind: latency|error_rate|throughput, ...} —
         # observability/slo.SloObjective fields
         "objectives": [],
+        # burn-rate brownout: an SLO trip puts the decision client into
+        # brownout (sched/client.py — the LLM rung sheds to the heuristic
+        # ladder floor) until the burn clears. Requires slo.enabled.
+        "brownout": True,
     },
     "fallback": {
         "enabled": True,
@@ -158,6 +169,12 @@ DEFAULTS: dict[str, Any] = {
         "failure_threshold": 5,  # config.yaml:41
         "timeout": 60,  # config.yaml:42
         "half_open_max_calls": 1,
+        # OPEN->HALF_OPEN cooldown jitter fraction: each trip draws its
+        # cooldown from [timeout, timeout*(1+jitter)] so N fleet replicas
+        # that tripped on one dying backend don't all probe at the same
+        # instant when the shared cooldown elapses (thundering-herd
+        # half-open). 0 disables.
+        "cooldown_jitter": 0.1,
     },
     # Live policy rollout (rollout/): checkpoint registry + shadow scoring
     # + canary gate + zero-downtime hot weight swap. registry_dir null
@@ -287,6 +304,10 @@ ENV_OVERRIDES: dict[str, str] = {
     "SLO_FAST_WINDOW_S": "slo.fast_window_s",
     "SLO_SLOW_WINDOW_S": "slo.slow_window_s",
     "SLO_INTERVAL_S": "slo.interval_s",
+    "SLO_BROWNOUT": "slo.brownout",
+    "SCHED_DECISION_DEADLINE_MS": "scheduler.decision_deadline_ms",
+    "SCHED_LLM_MIN_BUDGET_MS": "scheduler.llm_min_budget_ms",
+    "BREAKER_COOLDOWN_JITTER": "circuit_breaker.cooldown_jitter",
     "FALLBACK_STRATEGY": "fallback.strategy",
     "FLEET_ENABLED": "fleet.enabled",
     "FLEET_REPLICAS": "fleet.replicas",

@@ -21,6 +21,7 @@ Improvements over the reference:
 from __future__ import annotations
 
 import enum
+import random
 import threading
 import time
 from typing import Any, Callable, TypeVar
@@ -45,6 +46,9 @@ class CircuitBreaker:
         timeout_seconds: float = 60.0,
         half_open_max_calls: int = 1,
         non_failure_exceptions: tuple[type[BaseException], ...] = (),
+        cooldown_jitter: float = 0.1,
+        clock: Callable[[], float] = time.monotonic,
+        jitter_rng: "random.Random | None" = None,
     ) -> None:
         self.failure_threshold = int(failure_threshold)
         self.timeout_seconds = float(timeout_seconds)
@@ -52,16 +56,48 @@ class CircuitBreaker:
         # Exceptions that propagate without counting as backend failures
         # (e.g. "this pod is unschedulable" — a pod property, not ill health).
         self.non_failure_exceptions = non_failure_exceptions
+        # Cooldown jitter: each trip draws its OPEN->HALF_OPEN cooldown
+        # from [timeout, timeout * (1 + jitter)]. N fleet replicas that
+        # tripped on the same dying backend would otherwise all probe at
+        # the same instant when the shared cooldown elapses — a
+        # thundering herd of HALF_OPEN probes onto a backend that just
+        # recovered (or worse, is still recovering). Jitter decorrelates
+        # the probes so the first successful one closes its replica's
+        # breaker while the rest are still waiting. `jitter_rng` is
+        # injectable for deterministic tests; the clock likewise so
+        # failover tests advance time instead of sleeping.
+        self.cooldown_jitter = max(0.0, float(cooldown_jitter))
+        self._clock = clock
+        self._rng = jitter_rng if jitter_rng is not None else random.Random()
+        self._cooldown_s = self.timeout_seconds
         self._state = CircuitState.CLOSED
         self._failure_count = 0
         self._opened_at = 0.0
         self._half_open_inflight = 0
         self._lock = threading.Lock()
         self.trip_count = 0
+        # Optional transition observer (chaos/invariants.py watches the
+        # state machine's legality through it). Called WITH the breaker
+        # lock held: the hook must only record — never call back into
+        # the breaker (the lock is not reentrant).
+        self.on_transition: Callable[[CircuitState, CircuitState], None] | None = None
         # Advisory SLO-trip bookkeeping (observability/slo.py): evidence
         # surfaced beside breaker state, never a state transition.
         self._slo_advisories = 0
         self._last_slo_trip: str | None = None
+
+    def _set_state_locked(self, new: CircuitState) -> None:
+        """THE state write (caller holds self._lock): fires on_transition
+        on every actual edge so an observer sees the full walk."""
+        old = self._state
+        if old is new:
+            return
+        self._state = new
+        if self.on_transition is not None:
+            try:
+                self.on_transition(old, new)
+            except Exception:  # observer bugs must not break serving
+                pass  # graftlint: ok[swallowed-exception] — best-effort observer; breaker state already updated
 
     @property
     def state(self) -> CircuitState:
@@ -69,7 +105,8 @@ class CircuitBreaker:
             return self._effective_state_locked()
 
     def _effective_state_locked(self) -> CircuitState:
-        """OPEN decays to HALF_OPEN after the cooldown (scheduler.py:311-314).
+        """OPEN decays to HALF_OPEN after the (jittered) cooldown
+        (scheduler.py:311-314).
 
         Writes `self._state`; caller holds self._lock — the `*_locked`
         suffix is the repo's called-with-lock-held contract (cluster/
@@ -78,9 +115,9 @@ class CircuitBreaker:
         carrying a lock-guarded write with no visible contract)."""
         if (
             self._state is CircuitState.OPEN
-            and time.monotonic() - self._opened_at >= self.timeout_seconds
+            and self._clock() - self._opened_at >= self._cooldown_s
         ):
-            self._state = CircuitState.HALF_OPEN
+            self._set_state_locked(CircuitState.HALF_OPEN)
         return self._state
 
     def _admit(self) -> bool:
@@ -90,7 +127,7 @@ class CircuitBreaker:
             state = self._effective_state_locked()
             if state is CircuitState.OPEN:
                 raise CircuitOpenError(
-                    f"circuit open for {self.timeout_seconds - (time.monotonic() - self._opened_at):.1f}s more"
+                    f"circuit open for {self._cooldown_s - (self._clock() - self._opened_at):.1f}s more"
                 )
             if state is CircuitState.HALF_OPEN:
                 if self._half_open_inflight >= self.half_open_max_calls:
@@ -150,7 +187,7 @@ class CircuitBreaker:
     def record_success(self) -> None:
         with self._lock:
             if self._effective_state_locked() is CircuitState.HALF_OPEN:
-                self._state = CircuitState.CLOSED
+                self._set_state_locked(CircuitState.CLOSED)
             self._failure_count = 0
 
     def record_failure(self) -> None:
@@ -160,11 +197,20 @@ class CircuitBreaker:
             if state is CircuitState.HALF_OPEN or self._failure_count >= self.failure_threshold:
                 if self._state is not CircuitState.OPEN:
                     self.trip_count += 1
-                self._state = CircuitState.OPEN
-                self._opened_at = time.monotonic()
+                self._set_state_locked(CircuitState.OPEN)
+                self._opened_at = self._clock()
+                # fresh jittered cooldown PER TRIP: re-drawing each time
+                # keeps replicas decorrelated even when they keep
+                # re-tripping on the same backend in lockstep
+                self._cooldown_s = self.timeout_seconds * (
+                    1.0 + self.cooldown_jitter * self._rng.random()
+                )
 
     def reset(self) -> None:
         with self._lock:
+            # administrative reset: deliberately NOT routed through
+            # _set_state_locked — observers judge the state machine's own
+            # edges, and an operator reset is outside the machine
             self._state = CircuitState.CLOSED
             self._failure_count = 0
 
@@ -187,6 +233,10 @@ class CircuitBreaker:
                 "state": self._effective_state_locked().value,
                 "failure_count": self._failure_count,
                 "trips": self.trip_count,
+                # this trip's jittered cooldown (== timeout_seconds until
+                # the first trip): operators correlating probe storms
+                # across replicas read it here
+                "cooldown_s": round(self._cooldown_s, 3),
             }
             if self._slo_advisories:
                 out["slo_advisories"] = self._slo_advisories

@@ -68,8 +68,15 @@ class StubBackend:
     (to exercise retry/breaker paths); `latency_s` simulates decode time.
     """
 
-    def __init__(self, latency_s: float = 0.0, pool_role: str = "mixed") -> None:
+    def __init__(
+        self, latency_s: float = 0.0, pool_role: str = "mixed",
+        sleep=time.sleep,
+    ) -> None:
         self.latency_s = latency_s
+        # injectable so chaos/virtual-time tests simulate a slow device
+        # without wall-clock waits (the repo's injectable-clock rule,
+        # tools/graftlint resilience family)
+        self._sleep = sleep
         self.fail_next = 0
         self.calls = 0
         # Disaggregated-pool role parity with LocalLLMBackend
@@ -97,7 +104,7 @@ class StubBackend:
             self.fail_next -= 1
             raise BackendError("injected stub failure")
         if self.latency_s:
-            time.sleep(self.latency_s)
+            self._sleep(self.latency_s)
         start = time.perf_counter()
         candidates = feasible_nodes(pod, nodes)
         if not candidates:

@@ -86,7 +86,7 @@ class _WorkItem:
         # wall-clock twin of enqueued_at: retroactive flight-recorder spans
         # are wall-anchored (observability/spans), while all durations stay
         # perf_counter deltas
-        self.enqueued_wall = time.time()
+        self.enqueued_wall = time.time()  # graftlint: ok[raw-clock] — wall anchor for cross-process span stitching, not a judgment
         # (Trace, SpanContext) captured on the SUBMITTING thread — the
         # engine worker attaches admission-wait/prefill/decode spans to it
         # at harvest. None when no trace is ambient (tracing off, prewarms).
@@ -687,7 +687,7 @@ class LocalLLMBackend:
             # of a wide wave plus straggler waves serialized behind it.
             for _ in range(5):
                 before = len(pending)
-                time.sleep(self.admit_wait_s)
+                time.sleep(self.admit_wait_s)  # graftlint: ok[raw-clock] — engine-owner thread paces REAL device admission; virtual-time runs stub the backend above this layer
                 self._drain_queue(pending, block=False)
                 if len(pending) == before or len(pending) >= self.engine.max_slots:
                     break

@@ -19,6 +19,7 @@ from k8s_llm_scheduler_tpu.fleet.lease import (
     LeaseExpired,
     LeaseManager,
     LeaseStore,
+    LeaseStoreUnavailable,
     assign_initial,
     shard_of,
 )
@@ -40,6 +41,7 @@ __all__ = [
     "LeaseExpired",
     "LeaseManager",
     "LeaseStore",
+    "LeaseStoreUnavailable",
     "MIXED",
     "POOL_ROLES",
     "PREFILL",

@@ -56,12 +56,36 @@ class TieredDecisionCache:
         self.l1_hits = 0
         self.l2_hits = 0
         self.misses = 0
+        # Chaos seam (chaos/faults.py, seam "cache", kind "l2_down"):
+        # None in production. While the shared tier is down, reads serve
+        # from the private L1 only, writes are L1-only (nothing to share
+        # with a dead tier), and generation sync pauses — the replica
+        # keeps deciding on its last-known epoch and re-syncs on the
+        # first lookup after recovery.
+        self.fault_seam = None
+        self.l2_unavailable = 0
 
     # ------------------------------------------------------------ coherence
-    def _sync(self) -> int:
+    def _l2_up(self) -> bool:
+        seam = self.fault_seam
+        if seam is not None and seam.should("l2_down") is not None:
+            with self._lock:
+                self.l2_unavailable += 1
+            return False
+        return True
+
+    def _sync(self, l2_up: bool | None = None) -> int:
         """Catch L1 up to the L2 epoch (monotonic; a no-op in the steady
         state). Called on every lookup/store so an L2 bump by ANOTHER
-        replica invalidates this replica's L1 on its very next use."""
+        replica invalidates this replica's L1 on its very next use.
+        With the L2 unreachable, L1 keeps its last-known epoch — a
+        bounded staleness window the first post-recovery sync closes.
+        get/set pass their own `_l2_up()` reading so one operation
+        consults the seam (and counts an outage) exactly once."""
+        if l2_up is None:
+            l2_up = self._l2_up()
+        if not l2_up:
+            return self.l1.generation
         return self.l1.set_generation(self.l2.generation)
 
     @property
@@ -86,13 +110,19 @@ class TieredDecisionCache:
     ) -> SchedulingDecision | None:
         if key is None:
             key = decision_cache_key(pod, nodes)
-        self._sync()
+        l2_up = self._l2_up()
+        self._sync(l2_up)
         decision = self.l1.get(pod, nodes, key=key)
         if decision is not None:
             with self._lock:
                 self.l1_hits += 1
             self._tier_local.value = "l1_hit"
             return decision
+        if not l2_up:
+            with self._lock:
+                self.misses += 1
+            self._tier_local.value = "l2_down"
+            return None
         decision = self.l2.get(pod, nodes, key=key)
         if decision is not None:
             # promote: the next lookup on this replica is an L1 hit and
@@ -124,9 +154,11 @@ class TieredDecisionCache:
             return
         if key is None:
             key = decision_cache_key(pod, nodes)
-        self._sync()
+        l2_up = self._l2_up()
+        self._sync(l2_up)
         self.l1.set(pod, nodes, decision, key=key, generation=generation)
-        self.l2.set(pod, nodes, decision, key=key, generation=generation)
+        if l2_up:
+            self.l2.set(pod, nodes, decision, key=key, generation=generation)
 
     # ---------------------------------------------------------- bookkeeping
     @property
@@ -155,6 +187,8 @@ class TieredDecisionCache:
                 "l2_hits": self.l2_hits,
                 "misses": self.misses,
             }
+            if self.l2_unavailable:
+                tiers["l2_unavailable"] = self.l2_unavailable
         return {
             **tiers,
             "generation": self.l2.generation,

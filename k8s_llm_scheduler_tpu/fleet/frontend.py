@@ -77,23 +77,45 @@ class _ShardView:
 
 
 class _FencedBinder:
-    """Bind-time lease fencing (correctness point 3 above)."""
+    """Bind-time lease fencing (correctness point 3 above).
+
+    Two checks, cheapest first: the replica's LOCAL lease view (`owns` —
+    lock-free set membership, catches the common case where the manager
+    already processed a loss), then the STORE's fencing token (`verify`,
+    backed by LeaseStore.check_fence with this replica's believed epoch).
+    The store check is what makes the fence exact: a replica partitioned
+    from the store keeps BELIEVING it holds its shards (its local view
+    cannot learn otherwise), and before this check it would keep binding
+    them while a survivor — which already claimed the shards under a new
+    epoch — binds them too; the cluster's 409 made that a wasted bind and
+    a nondeterministic winner. With the store check, a stale or
+    unverifiable fencing token fails CLOSED: the bind is refused, the pod
+    stays pending, and the shard's live holder (per the store) is the
+    only replica that can land it. Cost: one store read per bind (a lock
+    acquisition in-process; the apiserver Lease read a k8s-backed store
+    would do)."""
 
     def __init__(
         self, inner: Binder, owns: Callable[[int], bool], n_shards: int,
         on_fenced: Callable[[], None] | None = None,
+        verify: Callable[[int], bool] | None = None,
     ) -> None:
         self._inner = inner
         self._owns = owns
         self._n_shards = n_shards
         self._on_fenced = on_fenced
+        self._verify = verify
         # preserve the loop's inline-bind fast path for in-memory binders
         self.bind_is_nonblocking = getattr(inner, "bind_is_nonblocking", False)
 
     def bind_pod_to_node(
         self, pod_name: str, namespace: str, node_name: str
     ) -> bool:
-        if not self._owns(shard_of(namespace, pod_name, self._n_shards)):
+        shard = shard_of(namespace, pod_name, self._n_shards)
+        fenced = not self._owns(shard)
+        if not fenced and self._verify is not None:
+            fenced = not self._verify(shard)
+        if fenced:
             logger.warning(
                 "fenced bind dropped: %s/%s -> %s (lease no longer held)",
                 namespace, pod_name, node_name,
@@ -144,7 +166,8 @@ class FleetReplica:
         self.scheduler = Scheduler(
             _ShardView(cluster, self.manager.owns, n_shards),
             _FencedBinder(
-                binder, self.manager.owns, n_shards, self._note_fenced
+                binder, self.manager.owns, n_shards, self._note_fenced,
+                verify=self._store_fence,
             ),
             self.client,
             scheduler_name=scheduler_name,
@@ -161,6 +184,23 @@ class FleetReplica:
 
     def _note_fenced(self) -> None:
         self.fenced_binds += 1  # GIL-atomic int bump; stats-only
+
+    def _store_fence(self, shard: int) -> bool:
+        """Store-side fencing-token verification for _FencedBinder: this
+        replica's believed epoch must still be THE live lease. Any store
+        failure (partition, apiserver outage) fails CLOSED — a bind we
+        cannot verify is a bind we do not land."""
+        epoch = self.manager.epoch_of(shard)
+        if epoch is None:
+            return False
+        try:
+            return self.manager.store.check_fence(shard, self.holder, epoch)
+        except Exception:
+            logger.warning(
+                "%s: lease store unreachable at bind time for shard %d; "
+                "failing closed", self.holder, shard,
+            )
+            return False
 
     # ------------------------------------------------------------- lifecycle
     async def start(self, lease_thread: bool = True) -> None:

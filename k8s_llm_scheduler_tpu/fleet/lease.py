@@ -66,6 +66,13 @@ class LeaseExpired(RuntimeError):
     holds (expired and possibly re-acquired by someone else)."""
 
 
+class LeaseStoreUnavailable(RuntimeError):
+    """The lease store could not be reached (chaos-injected partition; a
+    k8s-backed store maps this to apiserver connectivity errors). The
+    caller must treat it as a MISSED operation — which is exactly the
+    failure mode TTL leases exist to survive."""
+
+
 class LeaseStore:
     """Shard -> lease table with TTL expiry. All judgments use the
     injected clock; nothing here sleeps."""
@@ -81,6 +88,12 @@ class LeaseStore:
         self.n_shards = int(n_shards)
         self.ttl_s = float(ttl_s)
         self._clock = clock
+        # Chaos seam (chaos/faults.py, seam "lease"): None in production.
+        # Interpreted per MUTATING caller identity: partition (the store
+        # is unreachable for that holder), lost_renewal (the renewal is
+        # silently not applied — the holder believes it landed), and
+        # clock_skew (the holder's mutations are judged at now+skew_s).
+        self.fault_seam = None
         self._leases: dict[int, Lease] = {}
         self._epochs: dict[int, int] = {}  # survives expiry: epochs only grow
         # replica presence, independent of shard ownership: a NEWCOMER
@@ -89,6 +102,30 @@ class LeaseStore:
         # k8s-backed store maps this to the replica's own identity Lease.
         self._heartbeats: dict[str, float] = {}
         self._lock = threading.Lock()
+
+    # ----------------------------------------------------------- chaos seam
+    def _chaos_check(self, holder: str) -> None:
+        """Partition gate for mutating ops: a partitioned holder's call
+        never reaches the store (reads stay live — they model OTHER
+        observers, and the invariant monitor uses them as the
+        authority)."""
+        seam = self.fault_seam
+        if seam is not None and seam.should("partition", key=holder) is not None:
+            raise LeaseStoreUnavailable(
+                f"lease store unreachable for {holder} (chaos partition)"
+            )
+
+    def _now_for(self, holder: str) -> float:
+        """The clock a holder's mutations are judged by: the store clock,
+        plus any chaos-injected skew for that holder — the 'two replicas
+        disagree about time' regime lease fencing must survive."""
+        now = self._clock()
+        seam = self.fault_seam
+        if seam is not None:
+            event = seam.should("clock_skew", key=holder)
+            if event is not None:
+                now += float(event.param("skew_s", 0.0))
+        return now
 
     # -------------------------------------------------------------- queries
     def holder_of(self, shard_id: int) -> str | None:
@@ -104,7 +141,8 @@ class LeaseStore:
         """Record replica presence (TTL-expired like a lease). Managers
         heartbeat every tick, so a dead replica drops out of everyone's
         fair-share denominator after ttl_s."""
-        now = self._clock()
+        self._chaos_check(holder)
+        now = self._now_for(holder)
         with self._lock:
             self._heartbeats[holder] = now + self.ttl_s
             # opportunistic purge so the table can't grow unbounded
@@ -138,6 +176,27 @@ class LeaseStore:
                     out[lease.holder] = out.get(lease.holder, 0) + 1
             return out
 
+    def check_fence(self, shard_id: int, holder: str, epoch: int) -> bool:
+        """Bind-time fencing-token check: does the store, NOW, hold an
+        unexpired lease for `shard_id` by `holder` at exactly `epoch`?
+        The fenced binder (fleet/frontend._FencedBinder) asks this before
+        every bind, so a replica whose lease expired or was re-acquired
+        (a stale fencing token) cannot land a bind — and a replica that
+        cannot REACH the store to ask fails CLOSED (the
+        LeaseStoreUnavailable from the partition gate propagates; the
+        caller refuses the bind). Judged on the store's own clock: skew
+        on the asking holder's side must not extend its authority."""
+        self._chaos_check(holder)
+        now = self._clock()
+        with self._lock:
+            lease = self._leases.get(shard_id)
+            return (
+                lease is not None
+                and lease.expires_at > now
+                and lease.holder == holder
+                and lease.epoch == epoch
+            )
+
     def snapshot(self) -> dict[int, Lease]:
         """Copy of all UNEXPIRED leases (for /metrics and cli fleet)."""
         now = self._clock()
@@ -155,7 +214,8 @@ class LeaseStore:
         None when another holder's lease is still live."""
         if not 0 <= shard_id < self.n_shards:
             raise ValueError(f"shard {shard_id} out of range 0..{self.n_shards - 1}")
-        now = self._clock()
+        self._chaos_check(holder)
+        now = self._now_for(holder)
         with self._lock:
             lease = self._leases.get(shard_id)
             if lease is not None and lease.expires_at > now:
@@ -176,7 +236,9 @@ class LeaseStore:
         """Extend a held lease. Raises LeaseExpired when the lease is
         gone, expired, or held under a different epoch — the caller must
         stop acting for this shard (its fencing token is stale)."""
-        now = self._clock()
+        self._chaos_check(holder)
+        now = self._now_for(holder)
+        seam = self.fault_seam
         with self._lock:
             lease = self._leases.get(shard_id)
             if (
@@ -188,12 +250,20 @@ class LeaseStore:
                 raise LeaseExpired(
                     f"shard {shard_id}: lease not held by {holder}@{epoch}"
                 )
+            if seam is not None and seam.should(
+                "lost_renewal", key=holder
+            ) is not None:
+                # chaos: the renewal is silently NOT applied — the holder
+                # walks away believing it renewed while the lease keeps
+                # aging toward TTL expiry (a dropped apiserver write)
+                return dataclasses.replace(lease)
             lease.expires_at = now + self.ttl_s
             return dataclasses.replace(lease)
 
     def release(self, shard_id: int, holder: str) -> bool:
         """Voluntary release (clean shutdown): the shard reads free
         immediately instead of after TTL."""
+        self._chaos_check(holder)
         with self._lock:
             lease = self._leases.get(shard_id)
             if lease is None or lease.holder != holder:
@@ -281,9 +351,36 @@ class LeaseManager:
     def tick(self) -> tuple[frozenset[int], frozenset[int]]:
         """One renew + claim pass. Returns (gained, lost) shard sets and
         fires the callbacks (gains after the claim, losses after the
-        renew sweep)."""
-        self.store.heartbeat(self.holder)
+        renew sweep). An unreachable store (LeaseStoreUnavailable —
+        chaos partition, apiserver outage) aborts the REST of the tick
+        (missed ticks ARE the failure mode TTL leases absorb, and one
+        partitioned replica must not abort a shared tick driver) — but
+        the tick is not atomic: ownership changes already applied before
+        the failure are real, so their callbacks still fire (a gained
+        shard whose on_gain rebind never ran would strand its pending
+        pods forever: no later tick re-reports a shard already held)."""
+        gained: set[int] = set()
         lost: set[int] = set()
+        try:
+            self._tick_inner(gained, lost)
+        except LeaseStoreUnavailable as exc:
+            logger.warning(
+                "lease tick aborted for %s (%s): %d gain(s)/%d loss(es) "
+                "already applied, callbacks firing for those",
+                self.holder, exc, len(gained), len(lost),
+            )
+        lost_f, gained_f = frozenset(lost), frozenset(gained)
+        if lost_f and self.on_loss is not None:
+            self.on_loss(lost_f)
+        if gained_f and self.on_gain is not None:
+            self.on_gain(gained_f)
+        return gained_f, lost_f
+
+    def _tick_inner(self, gained: set, lost: set) -> None:
+        """The store-touching pass: mutates `gained`/`lost` IN PLACE as
+        each ownership change lands, so an abort mid-tick leaves the
+        caller an exact record of what actually changed."""
+        self.store.heartbeat(self.holder)
         with self._lock:
             held = dict(self._held)
         for sid, lease in held.items():
@@ -304,7 +401,6 @@ class LeaseManager:
                 self.holder, sorted(lost),
             )
 
-        gained: set[int] = set()
         holdings = self.store.holdings()
         holdings.setdefault(self.holder, 0)  # we just heartbeated
         n_live = len(holdings)
@@ -326,10 +422,14 @@ class LeaseManager:
             shed = max(self._held) if over and self._held else None
         if shed is not None:
             # one shard per tick: gentle rebalancing toward the fair
-            # share when new replicas join (they claim what we free)
+            # share when new replicas join (they claim what we free).
+            # Release in the STORE first — if the store is unreachable
+            # the local view stays consistent with it (still held on
+            # both sides) instead of locally-dropped-but-store-blocked
+            # until TTL.
+            self.store.release(shed, self.holder)
             with self._lock:
                 self._held.pop(shed, None)
-            self.store.release(shed, self.holder)
             logger.info(
                 "lease manager %s: shed shard %d toward fair share %d",
                 self.holder, shed, target,
@@ -357,13 +457,6 @@ class LeaseManager:
                 "lease manager %s: claimed shards %s",
                 self.holder, sorted(gained),
             )
-
-        lost_f, gained_f = frozenset(lost), frozenset(gained)
-        if lost_f and self.on_loss is not None:
-            self.on_loss(lost_f)
-        if gained_f and self.on_gain is not None:
-            self.on_gain(gained_f)
-        return gained_f, lost_f
 
     # ------------------------------------------------------------ lifecycle
     def start(self) -> None:

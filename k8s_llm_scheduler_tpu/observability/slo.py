@@ -172,6 +172,12 @@ class SloEngine:
         self.evaluations = 0
         # rising-edge callbacks: fn(objective_name, detail_dict)
         self.on_trip: list[Callable[[str, dict], None]] = []
+        # falling-edge callbacks (the burn dropped back under threshold):
+        # fn(objective_name, detail_dict). The consumer that needs both
+        # edges is the decision brownout (sched/client.py): on_trip
+        # enters it, on_clear exits it — without the falling edge a
+        # single burn would shed decisions forever.
+        self.on_clear: list[Callable[[str, dict], None]] = []
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 
@@ -272,6 +278,7 @@ class SloEngine:
         now = self._clock()
         cur = self.stats_provider()
         rising: list[tuple[str, dict]] = []
+        falling: list[tuple[str, dict]] = []
         with self._lock:
             self.evaluations += 1
             results: dict[str, dict] = {}
@@ -304,8 +311,9 @@ class SloEngine:
                     self._tripped.add(obj.name)
                     self.trip_counts[obj.name] += 1
                     rising.append((obj.name, detail))
-                elif not tripped:
+                elif not tripped and obj.name in self._tripped:
                     self._tripped.discard(obj.name)
+                    falling.append((obj.name, detail))
             self._last_eval = results
             self._snaps.append((now, cur))
             self._thin(now)
@@ -321,6 +329,13 @@ class SloEngine:
                     hook(name, detail)
                 except Exception:
                     logger.exception("slo on_trip hook failed for %s", name)
+        for name, detail in falling:
+            logger.info("SLO trip cleared: %s", name)
+            for hook in list(self.on_clear):
+                try:
+                    hook(name, detail)
+                except Exception:
+                    logger.exception("slo on_clear hook failed for %s", name)
         return results
 
     def tripped(self) -> list[str]:

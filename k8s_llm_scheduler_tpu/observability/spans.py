@@ -140,7 +140,7 @@ class Trace:
             trace_id=self.trace_id,
             span_id=_new_id(),
             parent_id=parent_id,
-            start_unix=time.time(),
+            start_unix=time.time(),  # graftlint: ok[raw-clock] — spans are wall-ANCHORED by design so trees stitch across processes
             attrs=dict(attrs),
         )
         self.spans.append(self.root)
@@ -532,7 +532,7 @@ def _span_cm(
         trace_id=trace.trace_id,
         span_id=_new_id(),
         parent_id=parent.span_id,
-        start_unix=time.time(),
+        start_unix=time.time(),  # graftlint: ok[raw-clock] — spans are wall-ANCHORED by design so trees stitch across processes
         attrs=attrs,
     )
     with trace._lock:

@@ -233,9 +233,20 @@ class CanaryController:
             + stats.get("cache_decisions", 0)
             + stats.get("fallback_decisions", 0)
         )
+        # Deadline/brownout sheds (sched/deadline.py ladder) ride the
+        # fallback counter but indict the CALLER's load or an SLO burn,
+        # not the candidate model — counting them would roll back a
+        # healthy candidate the moment a brownout overlaps its burn-in.
+        # degraded_fallbacks counts only the sheds that actually became
+        # fallback DECISIONS (a shed that produced none lands in
+        # `unschedulable`, and subtracting it would mask the candidate's
+        # own fallbacks in the same window).
+        degraded = float(client.get("degraded_fallbacks", 0))
         return {
             "decisions": float(decisions),
-            "fallback": float(stats.get("fallback_decisions", 0)),
+            "fallback": max(
+                float(stats.get("fallback_decisions", 0)) - degraded, 0.0
+            ),
             "invalid": float(client.get("invalid_decisions", 0)),
             "failed_bindings": float(stats.get("failed_bindings", 0)),
         }

@@ -196,7 +196,7 @@ class ShadowScorer:
             with self._lock:
                 if self._pending == 0:
                     return True
-            time.sleep(0.005)
+            time.sleep(0.005)  # graftlint: ok[raw-clock] — bounded drain poll on the scorer pool's own background thread
         return False
 
     def close(self) -> None:

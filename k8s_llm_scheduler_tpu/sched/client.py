@@ -30,6 +30,12 @@ from collections.abc import Sequence
 
 from k8s_llm_scheduler_tpu.core.breaker import CircuitBreaker, CircuitOpenError
 from k8s_llm_scheduler_tpu.observability import spans
+from k8s_llm_scheduler_tpu.sched import deadline
+from k8s_llm_scheduler_tpu.sched.deadline import (
+    LADDER,
+    DeadlineBudget,
+    DeadlineExceededError,
+)
 from k8s_llm_scheduler_tpu.core.cache import DecisionCache, decision_cache_key
 from k8s_llm_scheduler_tpu.core.fallback import fallback_decision
 from k8s_llm_scheduler_tpu.core.validation import validate_decision
@@ -54,21 +60,37 @@ class DecisionClient:
         retry_delay: float = 1.0,
         fallback_strategy: str = "resource_balanced",
         fallback_enabled: bool = True,
+        deadline_ms: float | None = None,
+        llm_min_budget_ms: float = 25.0,
     ) -> None:
         self.backend = backend
         self.cache = cache
         self.breaker = breaker
-        if breaker is not None and NoFeasibleNodeError not in breaker.non_failure_exceptions:
+        if breaker is not None:
             # Unschedulable pods must never open the circuit (pod property,
-            # not device health).
-            breaker.non_failure_exceptions = (
-                *breaker.non_failure_exceptions,
-                NoFeasibleNodeError,
-            )
+            # not device health); neither must a deadline rejection (an
+            # overloaded CALLER is not a sick device).
+            for exc_type in (NoFeasibleNodeError, DeadlineExceededError):
+                if exc_type not in breaker.non_failure_exceptions:
+                    breaker.non_failure_exceptions = (
+                        *breaker.non_failure_exceptions,
+                        exc_type,
+                    )
         self.max_retries = max(1, int(max_retries))
         self.retry_delay = float(retry_delay)
         self.fallback_strategy = fallback_strategy
         self.fallback_enabled = fallback_enabled
+        # Deadline-budgeted degradation (sched/deadline.py): every
+        # decision gets `deadline_ms` of budget (None = unlimited) and
+        # the ladder LLM -> cached -> heuristic is stepped by what
+        # remains: below `llm_min_budget_ms` the model rung is no longer
+        # affordable and the decision sheds to a fast answer instead of
+        # timing out its bind. An SLO burn-rate brownout (enter_brownout,
+        # wired to observability/slo.py on_trip in `cli run`) forces the
+        # shed regardless of budget.
+        self.deadline_ms = None if deadline_ms is None else float(deadline_ms)
+        self.llm_min_budget_ms = float(llm_min_budget_ms)
+        self._brownout: set[str] = set()
         self.stats = {
             "total_requests": 0,
             "successful_requests": 0,
@@ -77,6 +99,10 @@ class DecisionClient:
             "coalesced_requests": 0,
             "fallback_decisions": 0,
             "invalid_decisions": 0,
+            "degraded_decisions": 0,
+            "degraded_fallbacks": 0,
+            "brownout_decisions": 0,
+            "deadline_timeouts": 0,
             "avg_response_time_ms": 0.0,
         }
         # Single-flight: identical (pod shape, cluster state) decisions share
@@ -127,6 +153,52 @@ class DecisionClient:
             self.stats["fallback_decisions"] += 1
         return decision
 
+    # ---------------------------------------------------------- degradation
+    def enter_brownout(self, reason: str = "manual") -> None:
+        """SLO burn-rate brownout: shed the LLM rung for every decision
+        until the burn clears (exit_brownout). Reasons are a SET — two
+        burning objectives require two clears."""
+        self._brownout.add(reason)
+        logger.warning("decision brownout entered (%s)", reason)
+
+    def exit_brownout(self, reason: str = "manual") -> None:
+        if reason not in self._brownout:
+            return  # already clear (or never entered): nothing to log
+        self._brownout.discard(reason)
+        if not self._brownout:
+            logger.info("decision brownout cleared (%s)", reason)
+
+    @property
+    def brownout(self) -> bool:
+        return bool(self._brownout)
+
+    def _degrade(
+        self,
+        nodes: Sequence[NodeMetrics],
+        reason: str,
+        pod: PodSpec | None,
+        rung: str = LADDER[-1],
+    ) -> SchedulingDecision | None:
+        """Step down the ladder (sched/deadline.LADDER): the cached rung
+        was already consulted upstream (it is free and always first), so
+        a degradation here lands on the heuristic floor. Counted apart
+        from ordinary fallbacks — `degraded_decisions` is the ladder's
+        engagement meter (bench --preset chaos asserts it moves in the
+        brownout regime)."""
+        self.stats["degraded_decisions"] += 1
+        trace = spans.current_trace()
+        if trace is not None:
+            trace.set_meta(degraded=rung, degraded_reason=reason)
+        decision = self._fallback(nodes, reason, pod)
+        if decision is not None:
+            # degrades that actually produced a fallback decision — the
+            # counter rollout/canary subtracts from the scheduler-side
+            # fallback count (a shed with fallback disabled or no
+            # feasible node lands in `unschedulable`, not `fallback`,
+            # and must not be subtracted)
+            self.stats["degraded_fallbacks"] += 1
+        return decision
+
     def fast_decision(
         self, pod: PodSpec, nodes: Sequence[NodeMetrics]
     ) -> tuple[SchedulingDecision | None, "asyncio.Future | None"]:
@@ -171,6 +243,13 @@ class DecisionClient:
         a follower that falls through after a failed leader does, so a
         leader failure can't stampede an unbounded herd onto the backend."""
         self.stats["total_requests"] += 1
+        # Deadline budget: adopt the ambient one (a caller that already
+        # started the clock — e.g. a replica server re-installing a wire
+        # deadline) or start this decision's own. Started HERE, before the
+        # cache lookup, so the budget covers the decision end to end.
+        budget = deadline.current_budget()
+        if budget is None and self.deadline_ms is not None:
+            budget = DeadlineBudget.start(self.deadline_ms)
 
         key: str | None = None
         generation: int | None = None
@@ -227,11 +306,13 @@ class DecisionClient:
             if concurrency is not None:
                 async with concurrency:
                     decision = await self._decide_uncached(
-                        pod, nodes, cache_key=key, generation=generation
+                        pod, nodes, cache_key=key, generation=generation,
+                        budget=budget,
                     )
             else:
                 decision = await self._decide_uncached(
-                    pod, nodes, cache_key=key, generation=generation
+                    pod, nodes, cache_key=key, generation=generation,
+                    budget=budget,
                 )
         except BaseException:
             if my_future is not None:
@@ -254,13 +335,50 @@ class DecisionClient:
         nodes: Sequence[NodeMetrics],
         cache_key: str | None = None,
         generation: int | None = None,
+        budget: DeadlineBudget | None = None,
     ) -> SchedulingDecision | None:
+        # Degradation ladder gate (LLM rung affordability). Brownout
+        # first: a burning SLO says the backend's latency is ALREADY
+        # hurting the fleet — keep even affordable decisions off it.
+        if self._brownout:
+            self.stats["brownout_decisions"] += 1
+            return self._degrade(
+                nodes, f"brownout:{','.join(sorted(self._brownout))}", pod
+            )
+        if budget is not None and budget.remaining_ms() < self.llm_min_budget_ms:
+            return self._degrade(nodes, "deadline_budget", pod)
+
         last_error: Exception | None = None
         for attempt in range(self.max_retries):
             start = time.perf_counter()  # per attempt: excludes backoff sleeps
             try:
                 with spans.span("backend", attempt=attempt):
-                    decision = await self._call_backend_async(pod, nodes)
+                    if budget is None:
+                        decision = await self._call_backend_async(pod, nodes)
+                    else:
+                        # the ambient install lets the replica wire stamp
+                        # the REMAINING budget onto the decision frame;
+                        # wait_for is the local enforcement of the same
+                        # deadline (sheds to a fast decision instead of
+                        # letting the bind time out)
+                        with deadline.running(budget):
+                            decision = await asyncio.wait_for(
+                                self._call_backend_async(pod, nodes),
+                                timeout=max(budget.remaining_ms(), 1.0) / 1000.0,
+                            )
+            except asyncio.TimeoutError:
+                self.stats["deadline_timeouts"] += 1
+                logger.warning(
+                    "decision for %s/%s exceeded its %.0fms deadline budget, "
+                    "degrading", pod.namespace, pod.name,
+                    budget.total_ms if budget is not None else 0.0,
+                )
+                return self._degrade(nodes, "deadline_exceeded", pod)
+            except DeadlineExceededError:
+                # the remote end refused an already-expired frame: same
+                # shed, minus a wave of wasted compute on the worker
+                self.stats["deadline_timeouts"] += 1
+                return self._degrade(nodes, "deadline_exceeded", pod)
             except CircuitOpenError as exc:
                 logger.warning("circuit open, using fallback: %s", exc)
                 return self._fallback(nodes, "circuit_open", pod)
@@ -274,8 +392,21 @@ class DecisionClient:
                 logger.warning(
                     "backend attempt %d/%d failed: %s", attempt + 1, self.max_retries, exc
                 )
+                if budget is not None and (
+                    budget.remaining_ms() < self.llm_min_budget_ms
+                ):
+                    # a retry the budget can't afford is a disguised
+                    # timeout — shed now, with the error on record
+                    return self._degrade(
+                        nodes, f"deadline_budget:{last_error}", pod
+                    )
                 if attempt + 1 < self.max_retries:
-                    await asyncio.sleep(self.retry_delay * (2**attempt))
+                    backoff = self.retry_delay * (2**attempt)
+                    if budget is not None:
+                        backoff = min(
+                            backoff, max(budget.remaining_ms(), 0.0) / 1000.0
+                        )
+                    await asyncio.sleep(backoff)
                 continue
 
             if not validate_decision(decision, nodes):
@@ -313,6 +444,8 @@ class DecisionClient:
 
     def get_stats(self) -> dict:
         out = dict(self.stats)
+        if self._brownout:
+            out["brownout"] = sorted(self._brownout)
         if self.cache is not None:
             out["cache"] = self.cache.stats()
         if self.breaker is not None:

@@ -286,7 +286,7 @@ class Scheduler:
             return False, None
         pod = raw_pod_to_spec(raw)
         t0 = time.perf_counter()
-        t0_wall = time.time()
+        t0_wall = time.time()  # graftlint: ok[raw-clock] — wall ANCHOR for span stitching, never a judgment (durations stay perf_counter)
         decision, fut = self.client.fast_decision(pod, nodes)
         if decision is not None:
             # Record the decide phase only when the fast path handles the

@@ -59,6 +59,11 @@ from k8s_llm_scheduler_tpu.engine.backend import (
     NoFeasibleNodeError,
 )
 from k8s_llm_scheduler_tpu.observability import spans
+from k8s_llm_scheduler_tpu.sched import deadline as deadline_mod
+from k8s_llm_scheduler_tpu.sched.deadline import (
+    DeadlineBudget,
+    DeadlineExceededError,
+)
 from k8s_llm_scheduler_tpu.types import (
     DecisionSource,
     NodeMetrics,
@@ -333,6 +338,21 @@ class ReplicaServer:
                 nodes = [node_from_wire(n) for n in req["nodes"]]
                 work = req.get("work", "prefill")
                 self._check_role(work)
+                # Deadline budget riding the frame (sched/deadline.py):
+                # the client stamped its REMAINING ms at send time. An
+                # already-expired frame is refused before it can burn a
+                # wave on a decision nobody is waiting for; otherwise the
+                # budget is re-installed ambiently so a nested backend
+                # (local engine behind this server) sees the same clock.
+                wire_deadline = req.get("deadline_ms")
+                budget = None
+                if wire_deadline is not None:
+                    if float(wire_deadline) <= 0.0:
+                        raise DeadlineExceededError(
+                            f"frame arrived with expired deadline "
+                            f"({float(wire_deadline):.1f}ms remaining)"
+                        )
+                    budget = DeadlineBudget.start(float(wire_deadline))
                 wire_trace = req.get("trace")
                 if wire_trace and spans.enabled():
                     # Continue the COORDINATOR's trace on this side: same
@@ -347,7 +367,8 @@ class ReplicaServer:
                         parent_id=str(wire_trace.get("span_id")),
                         pod=f"{pod.namespace}/{pod.name}",
                     ) as rtrace:
-                        decision = self._decide(pod, nodes, work)
+                        with deadline_mod.running(budget):
+                            decision = self._decide(pod, nodes, work)
                     resp = {
                         "id": rid,
                         "decision": decision_to_wire(decision),
@@ -356,12 +377,15 @@ class ReplicaServer:
                         else [],
                     }
                 else:
-                    decision = self._decide(pod, nodes, work)
+                    with deadline_mod.running(budget):
+                        decision = self._decide(pod, nodes, work)
                     resp = {"id": rid, "decision": decision_to_wire(decision)}
             with self._served_lock:
                 self.served += 1
         except NoFeasibleNodeError as exc:
             resp = {"id": rid, "error": str(exc), "kind": "infeasible"}
+        except DeadlineExceededError as exc:
+            resp = {"id": rid, "error": str(exc), "kind": "deadline"}
         except Exception as exc:
             resp = {"id": rid, "error": str(exc), "kind": "backend"}
         finally:
@@ -427,24 +451,45 @@ class ReplicaServer:
         work = req.get("work", "prefill")
         self._check_role(work)
         pods = [pod_from_wire(p) for p in req["pods"]]
+        # deadline parity with _serve (the single-decision path): an
+        # expired batch frame is refused BEFORE it can burn a prefill
+        # wave, and the remaining budget is re-installed ambiently
+        wire_deadline = req.get("deadline_ms")
+        budget = None
+        if wire_deadline is not None:
+            if float(wire_deadline) <= 0.0:
+                exc = DeadlineExceededError(
+                    f"batch frame arrived with expired deadline "
+                    f"({float(wire_deadline):.1f}ms remaining)"
+                )
+                return {"id": rid, "results": [
+                    {"error": str(exc), "kind": "deadline"} for _ in pods
+                ]}
+            budget = DeadlineBudget.start(float(wire_deadline))
         results: list[dict] = []
-        if self._backend_batch is not None:
-            # the backend's own batch surface (LocalLLMBackend enqueues
-            # the whole pack before waiting — the engine admits it as
-            # one prefill wave, which is the point of prepacking)
-            outcomes = self._backend_batch(pods, nodes, work=work)
-        else:
-            outcomes = []
-            for pod in pods:
-                try:
-                    outcomes.append(self._decide(pod, nodes, work))
-                except Exception as exc:
-                    outcomes.append(exc)
+        with deadline_mod.running(budget):
+            if self._backend_batch is not None:
+                # the backend's own batch surface (LocalLLMBackend
+                # enqueues the whole pack before waiting — the engine
+                # admits it as one prefill wave, which is the point of
+                # prepacking)
+                outcomes = self._backend_batch(pods, nodes, work=work)
+            else:
+                outcomes = []
+                for pod in pods:
+                    try:
+                        outcomes.append(self._decide(pod, nodes, work))
+                    except Exception as exc:
+                        outcomes.append(exc)
         for outcome in outcomes:
             if isinstance(outcome, SchedulingDecision):
                 results.append({"decision": decision_to_wire(outcome)})
             elif isinstance(outcome, NoFeasibleNodeError):
                 results.append({"error": str(outcome), "kind": "infeasible"})
+            elif isinstance(outcome, DeadlineExceededError):
+                # degrade at the caller, don't retry, don't count a
+                # breaker failure (sched/client.py non-failure contract)
+                results.append({"error": str(outcome), "kind": "deadline"})
             else:
                 results.append({"error": str(outcome), "kind": "backend"})
         return {"id": rid, "results": results}
@@ -556,6 +601,11 @@ class ReplicaClient:
         self._dial_failures = 0
         self._next_dial_at = 0.0
         self._rng = random.Random()
+        # Chaos seam (chaos/faults.py, seam "wire"): None in production —
+        # one attribute read per frame. A chaos harness installs a Seam
+        # here to inject resets/drops/dups/delays at the REAL framing
+        # layer, below every retry/reconnect defense.
+        self.fault_seam = None
         self._sock: socket.socket | None = None
         self._reader: threading.Thread | None = None
         self._conn_lock = threading.Lock()
@@ -697,6 +747,17 @@ class ReplicaClient:
         reader-death protocol, shared by decisions and prewarms so a fix
         to its subtleties can never drift between them."""
         sock, reader = self._ensure_connected()
+        fault = None
+        if self.fault_seam is not None:
+            pod = payload.get("pod")
+            key = pod.get("name") if isinstance(pod, dict) else payload.get("op")
+            fault_delay = self.fault_seam.delay_s(key=key)
+            if fault_delay > 0:
+                time.sleep(fault_delay)  # graftlint: ok[raw-clock] — chaos-injected wire latency; inert (seam is None) in production
+            for kind in ("reset", "drop", "dup"):
+                if self.fault_seam.should(kind, key=key) is not None:
+                    fault = kind
+                    break
         rid = next(self._ids)
         fut: Future = Future()
         with self._pending_lock:
@@ -704,12 +765,31 @@ class ReplicaClient:
                 raise BackendError(f"replica {self.addr} client closed")
             self._pending[rid] = fut
         try:
-            with self._send_lock:
-                _send_frame(sock, {"id": rid, **payload})
+            # drop: frame never leaves — the caller times out. reset: the
+            # connection dies before the response could ever land — the
+            # frame is withheld too, because "sent, then reset" would race
+            # the server's reply against the shutdown and the winner would
+            # be thread timing (chaos runs must be deterministic); from
+            # the caller the two shapes are indistinguishable either way.
+            if fault not in ("drop", "reset"):
+                with self._send_lock:
+                    _send_frame(sock, {"id": rid, **payload})
+                    if fault == "dup":
+                        # duplicate frame, same id: the server serves it
+                        # twice and the second response must be a no-op
+                        # at the client (pending entry already popped)
+                        _send_frame(sock, {"id": rid, **payload})
         except OSError as exc:
             with self._pending_lock:
                 self._pending.pop(rid, None)
             raise BackendError(f"replica {self.addr} send failed: {exc}") from exc
+        if fault == "reset":
+            # mid-decision connection reset: the reader's fail-everything
+            # sweep and the next submit's re-dial are the paths under test
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
         if not reader.is_alive():
             # TOCTOU guard: the reader may have died (and run its
             # fail-everything sweep) BETWEEN the liveness check and our
@@ -736,6 +816,12 @@ class ReplicaClient:
             # disaggregated-pool tag (fleet/pools.py): lets a decode-role
             # worker refuse misrouted admission work
             payload["work"] = work
+        # Deadline budget rides the frame (sched/deadline.py): stamp the
+        # REMAINING ms at send time so the worker judges against what the
+        # decision actually has left, wire transit included.
+        remaining = deadline_mod.remaining_ms()
+        if remaining is not None:
+            payload["deadline_ms"] = round(remaining, 3)
         # Trace propagation: the ambient decision trace's (trace_id,
         # span_id) rides the frame so the worker's spans stitch into ONE
         # cross-host tree (ReplicaServer returns them in the response).
@@ -869,6 +955,10 @@ class ReplicaClient:
             return decision_from_wire(resp["decision"])
         if resp.get("kind") == "infeasible":
             raise NoFeasibleNodeError(resp.get("error", ""))
+        if resp.get("kind") == "deadline":
+            # the worker refused an expired frame: degrade, don't retry
+            # (and don't count a breaker failure — sched/client.py)
+            raise DeadlineExceededError(resp.get("error", ""))
         raise BackendError(
             f"replica {self.addr}: {resp.get('error', 'unknown failure')}"
         )
@@ -893,6 +983,8 @@ class ReplicaClient:
                 out.append(decision_from_wire(entry["decision"]))
             elif entry.get("kind") == "infeasible":
                 out.append(NoFeasibleNodeError(entry.get("error", "")))
+            elif entry.get("kind") == "deadline":
+                out.append(DeadlineExceededError(entry.get("error", "")))
             else:
                 out.append(BackendError(
                     f"replica {self.addr}: "
@@ -911,6 +1003,12 @@ class ReplicaClient:
         }
         if work is not None:
             payload["work"] = work
+        # the batch shares one deadline budget, same stamp as _submit —
+        # without it prepacked admission would silently opt out of the
+        # degradation ladder
+        remaining = deadline_mod.remaining_ms()
+        if remaining is not None:
+            payload["deadline_ms"] = round(remaining, 3)
         return self._submit_frame(payload)
 
     def get_scheduling_decisions_batch(

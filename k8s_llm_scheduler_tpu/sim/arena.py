@@ -256,7 +256,10 @@ async def _run_stack_arm(
 ) -> tuple[dict[str, str], list[str], list[dict], dict]:
     """Run one backend arm end to end over the wire fake. Returns
     (placements, unschedulable, per-wave attribution, stats)."""
-    from k8s_llm_scheduler_tpu.cluster.httpapi import set_active_config
+    from k8s_llm_scheduler_tpu.cluster.httpapi import (
+        clear_active_config,
+        set_active_config,
+    )
     from k8s_llm_scheduler_tpu.cluster.kube import KubeCluster
     from k8s_llm_scheduler_tpu.cluster.wire_fake import WireFakeK8s
     from k8s_llm_scheduler_tpu.core.breaker import CircuitBreaker
@@ -415,6 +418,10 @@ async def _run_stack_arm(
         elif cluster is not None:
             cluster.close()
         wire.close()
+        # drop the process-global active config now pointing at a dead
+        # server (same hygiene as the chaos harness): later clients must
+        # fall back to real cluster discovery, not dial this address
+        clear_active_config()
 
 
 def _phase_delta(before: dict, after: dict, name: str) -> float:

@@ -47,7 +47,7 @@ def _decodable_objects(text: str) -> list[dict[str, Any]]:
             obj, end = _DECODER.raw_decode(text, start)
         except (json.JSONDecodeError, ValueError):
             pos = start + 1
-            continue
+            continue  # graftlint: ok[unbounded-retry] — cursor scan, not a retry: pos strictly advances so find() terminates
         if isinstance(obj, dict):
             objects.append(obj)
         pos = end

@@ -1,0 +1,89 @@
+"""Fixture corpus for the resilience family: one true positive AND one
+pragma-suppressed case per rule (tests/test_graftlint.py enforces
+both)."""
+
+import time
+
+
+def swallowed_broad():
+    try:
+        do_work()
+    except Exception:
+        pass  # true positive: broad catch, only pass
+
+
+def swallowed_bare():
+    try:
+        do_work()
+    except:  # noqa: E722
+        ...  # true positive: bare except, only ellipsis
+
+
+def swallowed_suppressed():
+    try:
+        do_work()
+    except Exception:
+        pass  # graftlint: ok[swallowed-exception] — fixture: observer hook, failure recorded upstream
+
+
+def narrow_cleanup_is_fine(sock):
+    try:
+        sock.shutdown()
+    except OSError:
+        pass  # narrow catch: deliberate cleanup, NOT flagged
+
+
+def retry_unbounded():
+    while True:
+        try:
+            return do_work()
+        except Exception:
+            continue  # true positive: busy-spin retry, no backoff
+
+
+def retry_unbounded_suppressed():
+    while True:
+        try:
+            return do_work()
+        except Exception:
+            continue  # graftlint: ok[unbounded-retry] — fixture: inner op has its own backoff
+
+
+def retry_with_backoff_is_fine(sleep):
+    while True:
+        try:
+            return do_work()
+        except Exception:
+            sleep(0.1)
+            continue  # has backoff: NOT flagged
+
+
+def retry_with_escape_is_fine():
+    attempts = 0
+    while True:
+        try:
+            return do_work()
+        except Exception:
+            attempts += 1
+            if attempts > 3:
+                raise
+            continue  # bounded escape: NOT flagged
+
+
+def raw_clock_calls():
+    t = time.time()  # true positive: wall clock in runtime judgment
+    time.sleep(0.5)  # true positive: uninjectable pacing
+    return t
+
+
+def raw_clock_suppressed():
+    time.sleep(0.5)  # graftlint: ok[raw-clock] — fixture: wall pacing is the product behavior here
+
+
+def injectable_default_is_fine(clock=time.monotonic, sleep=time.sleep):
+    # referencing time.* as a default arg is THE sanctioned pattern
+    return clock()
+
+
+def do_work():
+    return 1

@@ -57,6 +57,14 @@ class TestRepoIsClean:
         assert "tests/test_profiler.py" in files
         assert "tests/test_fleetview.py" in files
         assert "tests/test_slo.py" in files
+        # chaos round: the fault plane + deadline ladder are contextvar/
+        # asyncio-heavy (ambient budgets, wave-barriered runners) — the
+        # exact risk class the asyncio.timeout rule exists for
+        assert "k8s_llm_scheduler_tpu/chaos/faults.py" in files
+        assert "k8s_llm_scheduler_tpu/chaos/invariants.py" in files
+        assert "k8s_llm_scheduler_tpu/chaos/harness.py" in files
+        assert "k8s_llm_scheduler_tpu/sched/deadline.py" in files
+        assert "tests/test_chaos_plane.py" in files
         # the lint never lints its own pattern table
         assert "tools/py310_lint.py" not in files
 

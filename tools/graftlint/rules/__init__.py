@@ -7,8 +7,11 @@ from tools.graftlint.core import LintRule, RuleViolationError
 from tools.graftlint.rules.concurrency import CONCURRENCY_RULES
 from tools.graftlint.rules.jaxpurity import JAX_RULES
 from tools.graftlint.rules.py310 import PY310_RULES
+from tools.graftlint.rules.resilience import RESILIENCE_RULES
 
-RULES: list[LintRule] = [*CONCURRENCY_RULES, *JAX_RULES, *PY310_RULES]
+RULES: list[LintRule] = [
+    *CONCURRENCY_RULES, *JAX_RULES, *PY310_RULES, *RESILIENCE_RULES
+]
 
 
 def rules_by_selector(selectors: list[str] | None) -> list[LintRule]:

@@ -1,0 +1,187 @@
+"""resilience rule family — fault-handling paths that fail silently.
+
+Grown alongside the chaos plane (chaos/): every rule here encodes a
+pattern the chaos harness exists to expose at runtime, caught statically
+instead.
+
+- **swallowed-exception**: a BROAD catch (bare ``except:``, ``except
+  Exception``, ``except BaseException``) whose body is only ``pass`` /
+  ``...``. On a fault-handling path this erases the very signal the
+  retry/breaker/fallback stack keys on. Narrow catches (``except
+  OSError: pass`` around a socket close) are deliberate cleanup and stay
+  legal — the hazard is breadth x silence, not silence alone.
+- **unbounded-retry**: a ``while True`` loop whose exception handler
+  ``continue``s straight back into the attempt with no backoff (no
+  sleep-shaped call) and no escape (``break``/``return``/``raise``) in
+  the handler. Retry-forever is often CORRECT for supervision loops —
+  but only with backoff between attempts; without it a dead dependency
+  turns the loop into a busy-spin that hammers whatever it is retrying
+  (the thundering-herd shape the breaker's cooldown jitter exists to
+  break up).
+- **raw-clock**: a direct ``time.time()`` / ``time.sleep()`` CALL in a
+  runtime module (``k8s_llm_scheduler_tpu/``). Runtime time judgments
+  must ride an injectable clock (the ``clock=time.monotonic`` default-
+  arg convention) so chaos and failover tests can advance virtual time
+  instead of sleeping — ``fleet/lease.py`` and ``core/breaker.py`` are
+  the reference shape. Referencing ``time.monotonic``/``time.sleep`` as
+  a DEFAULT ARGUMENT is exactly the sanctioned pattern and is not a
+  call, so it never trips. Tests, tools, and bench.py pace real wall
+  time by design and are out of scope (the fixture corpus under
+  tests/fixtures/graftlint stays in scope so the detectors stay
+  testable).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from tools.graftlint.core import (
+    FileContext,
+    Finding,
+    LintRule,
+    dotted_name,
+)
+
+_BROAD = ("Exception", "BaseException")
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    if handler.type is None:
+        return True
+    if isinstance(handler.type, ast.Name):
+        return handler.type.id in _BROAD
+    if isinstance(handler.type, ast.Tuple):
+        return any(
+            isinstance(e, ast.Name) and e.id in _BROAD
+            for e in handler.type.elts
+        )
+    return False
+
+
+def _is_silent(body: list[ast.stmt]) -> bool:
+    """Only pass / bare `...` — nothing recorded, nothing re-raised."""
+    for stmt in body:
+        if isinstance(stmt, ast.Pass):
+            continue
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Constant) \
+                and stmt.value.value is Ellipsis:
+            continue
+        return False
+    return True
+
+
+class SwallowedException(LintRule):
+    id = "swallowed-exception"
+    family = "resilience"
+    description = (
+        "broad except (bare/Exception/BaseException) whose body is only "
+        "pass — a fault-handling path that erases its own failure signal"
+    )
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        for node in ctx.all_nodes():
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if _is_broad(node) and _is_silent(node.body):
+                caught = (
+                    "bare except" if node.type is None
+                    else dotted_name(node.type) or "broad tuple"
+                )
+                # anchor on the silent statement — the line a pragma
+                # naturally annotates
+                yield ctx.finding(
+                    self, node.body[0],
+                    f"swallowed exception: {caught} handled with only "
+                    f"`pass` — record it, narrow it, or justify via pragma",
+                )
+
+
+def _has_sleepish_call(nodes: list[ast.AST]) -> bool:
+    for n in nodes:
+        if isinstance(n, ast.Call):
+            name = dotted_name(n.func)
+            tail = name.rsplit(".", 1)[-1]
+            if tail in ("sleep", "wait") or "backoff" in name.lower():
+                return True
+    return False
+
+
+class UnboundedRetry(LintRule):
+    id = "unbounded-retry"
+    family = "resilience"
+    description = (
+        "while-True retry loop whose except handler continues with no "
+        "backoff and no escape — a busy-spin against a dead dependency"
+    )
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        for node in ctx.all_nodes():
+            if not isinstance(node, ast.While):
+                continue
+            test = node.test
+            if not (isinstance(test, ast.Constant) and bool(test.value)):
+                continue
+            for handler in (
+                n for n in ast.walk(node) if isinstance(n, ast.ExceptHandler)
+            ):
+                sub = list(ast.walk(handler))
+                cont = next(
+                    (n for n in sub if isinstance(n, ast.Continue)), None
+                )
+                has_escape = any(
+                    isinstance(n, (ast.Break, ast.Return, ast.Raise))
+                    for n in sub
+                )
+                if cont is not None and not has_escape \
+                        and not _has_sleepish_call(sub):
+                    # anchor on the `continue` — the line a pragma
+                    # naturally annotates
+                    yield ctx.finding(
+                        self, cont,
+                        "retry loop without a backoff cap: handler "
+                        "continues the while-True immediately — add "
+                        "backoff (sleep) or a bounded escape",
+                    )
+
+
+# `_time` covers the repo's local-import alias (`import time as _time`)
+# — an alias must not evade the rule
+_RAW_CLOCK_CALLS = ("time.time", "time.sleep", "_time.time", "_time.sleep")
+
+
+def _in_scope(name: str) -> bool:
+    if name.startswith("k8s_llm_scheduler_tpu/"):
+        return True
+    # the fixture corpus must stay lintable or the detector is untestable
+    return "fixtures/graftlint" in name
+
+
+class RawClock(LintRule):
+    id = "raw-clock"
+    family = "resilience"
+    description = (
+        "raw time.time()/time.sleep() call in a runtime module — take an "
+        "injectable clock (clock=time.monotonic default-arg convention) "
+        "so chaos/failover tests can use virtual time"
+    )
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        if not _in_scope(ctx.name):
+            return
+        for node in ctx.all_nodes():
+            if isinstance(node, ast.Call) \
+                    and dotted_name(node.func) in _RAW_CLOCK_CALLS:
+                yield ctx.finding(
+                    self, node,
+                    f"raw {dotted_name(node.func)}() in a runtime module: "
+                    f"inject the clock/sleep instead (or justify via "
+                    f"pragma)",
+                )
+
+
+RESILIENCE_RULES: list[LintRule] = [
+    SwallowedException(),
+    UnboundedRetry(),
+    RawClock(),
+]
