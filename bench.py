@@ -170,6 +170,13 @@ PRESETS = {
     # harness stack, zero invariant violations required; publishes
     # recovery time, degraded-decision fraction, quality-vs-teacher
     "chaos": {"pods": 48, "nodes": 10, "rounds": 1},
+    # closed policy-improvement loop (learn/): the full seeded
+    # mine -> finetune -> publish -> gate -> hot-swap cycle on a micro
+    # REAL engine; asserts the promoted checkpoint strictly improves the
+    # mined-weakness score vs the incumbent without regressing the base
+    # arena, and that the cycle's trace replays byte-identically.
+    # pods/nodes here size the MINING scenarios.
+    "learn": {"pods": 36, "nodes": 6, "shapes": 6, "rounds": 1},
 }
 
 
@@ -851,6 +858,186 @@ def chaos_bench(args) -> dict:
             "invariant_violations": violations,
         },
     }
+
+
+# ------------------------------------------------------------- learn loop
+def learn_bench(args) -> dict:
+    """`--preset learn`: the closed policy-improvement loop end to end on
+    a micro REAL engine (f32, 2 layers — the test_rollout scale that
+    compiles in seconds on CPU).
+
+    The incumbent is a PUBLISHED random-init checkpoint served greedily
+    through the real constrained-decode stack. One LearnLoop cycle mines
+    its losses against the spread-lookahead teacher into the incident
+    corpus, finetunes FROM the incumbent params on the reconstructed
+    incident cases (mixed with base-distribution replay), publishes the
+    candidate with lineage, and gates it two-sided. The preset FAILS
+    unless: the candidate strictly beats the incumbent on the mined
+    weakness cases, the base-arena gate passes within tolerance, the
+    promotion hot-swaps through the live HotSwapper path, and the
+    recorded learn trace replays byte-identically."""
+    import shutil
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from k8s_llm_scheduler_tpu.engine.local import build_local_backend
+    from k8s_llm_scheduler_tpu.engine.tokenizer import build_builtin_tokenizer
+    from k8s_llm_scheduler_tpu.learn import (
+        IncidentCorpus,
+        LearnConfig,
+        LearnLoop,
+        backend_decide,
+        decide_policy_arm,
+        save_learn_trace,
+        verify_learn_trace,
+    )
+    from k8s_llm_scheduler_tpu.models.configs import LlamaConfig
+    from k8s_llm_scheduler_tpu.models.llama import init_params
+    from k8s_llm_scheduler_tpu.models.loader import save_checkpoint
+    from k8s_llm_scheduler_tpu.rollout import (
+        CheckpointRegistry,
+        GateConfig,
+        HotSwapper,
+        run_gate,
+    )
+
+    seed = args.seed if args.seed is not None else 0
+    steps = int(getattr(args, "learn_steps", None) or 300)
+    base_cfg = LlamaConfig(
+        name="learn-micro", vocab_size=512, d_model=64, n_layers=2,
+        n_heads=2, n_kv_heads=1, d_ff=128, max_seq_len=4096,
+        rope_theta=10000.0, dtype=jnp.float32, tie_embeddings=True,
+    )
+    tokenizer_name = "numeric"
+    _tok, model_cfg = build_builtin_tokenizer(tokenizer_name, base_cfg)
+    work = Path(tempfile.mkdtemp(prefix="bench-learn-"))
+
+    def make_backend(checkpoint_path):
+        return build_local_backend(
+            cfg=model_cfg,
+            checkpoint_path=str(checkpoint_path),
+            tokenizer_name=tokenizer_name,
+            temperature=0.0,  # the arena/trace determinism contract
+            max_slots=4, num_pages=128, page_size=64,
+            max_pages_per_seq=32,
+            prefill_buckets=(256, 512, 1024, 2048),
+            chunk_steps=4,
+            compile_cache_dir=str(
+                Path(__file__).resolve().parent / ".xla_cache"
+            ),
+        )
+
+    try:
+        registry = CheckpointRegistry(work / "registry")
+        corpus = IncidentCorpus(work / "corpus")
+        incumbent_dir = work / "incumbent"
+        save_checkpoint(
+            incumbent_dir, init_params(jax.random.PRNGKey(seed + 1), model_cfg)
+        )
+        incumbent = registry.publish(
+            incumbent_dir, cfg=model_cfg, tokenizer=tokenizer_name,
+            note="bench incumbent (random-init)",
+        )
+        registry.set_active(incumbent.version)
+        incumbent_ckpt = registry.get(incumbent.version).checkpoint_path
+
+        incumbent_backend = make_backend(incumbent_ckpt)
+        incumbent_decide = backend_decide(incumbent_backend)
+        gate_cfg = GateConfig(
+            seed=seed, nodes=8, pods=24, shapes=6, waves=2,
+            spread_tolerance=0.05, wave_timeout_s=300.0,
+        )
+        learn_cfg = LearnConfig(
+            seed=seed,
+            mine_seeds=(seed, seed + 1),
+            mine_nodes=args.nodes, mine_pods=args.pods,
+            mine_shapes=args.shapes, mine_waves=3,
+            replay_fraction=0.25,
+            steps=steps, batch_size=8, seq_len=1536, lr=1e-3,
+            weakness_cases=24,
+            gate=gate_cfg,
+        )
+
+        def candidate_decide_factory(checkpoint_dir):
+            backend = make_backend(checkpoint_dir)
+            return backend_decide(backend), backend.close
+
+        loop = LearnLoop(
+            registry, corpus, learn_cfg,
+            # mining + weakness use the greedy real engine as a policy arm
+            # (sequential deterministic replay — the model is the thing
+            # under test, not the wire plumbing the arena preset covers)
+            mine_arm_factory=lambda: decide_policy_arm(
+                "llm", incumbent_decide
+            ),
+            incumbent_decide_factory=lambda: (
+                incumbent_decide, lambda: None
+            ),
+            candidate_decide_factory=candidate_decide_factory,
+            gate_runner=lambda version: run_gate(
+                lambda: make_backend(incumbent_ckpt),
+                lambda: make_backend(
+                    registry.get(version).checkpoint_path
+                ),
+                gate_cfg,
+            ),
+            model_cfg=model_cfg,
+            tokenizer_name=tokenizer_name,
+            swapper=HotSwapper(
+                incumbent_backend, registry, model_cfg,
+                mesh=incumbent_backend.engine.mesh,
+            ),
+        )
+        t0 = time.perf_counter()
+        report = loop.run_cycle(work / "cycle", note="bench learn")
+        cycle_s = time.perf_counter() - t0
+
+        trace_path = work / "learn-trace.json"
+        save_learn_trace(report, trace_path)
+        replay_ok, replay_detail = verify_learn_trace(trace_path)
+        incumbent_backend.close()
+
+        inc_score = report["weakness"]["incumbent"]["score"]
+        cand_score = report["weakness"]["candidate"]["score"]
+        assert report["action"] == "promoted", (
+            f"learn cycle did not promote: weakness {inc_score} -> "
+            f"{cand_score}, gate {report['gate']}"
+        )
+        assert cand_score > inc_score, (
+            f"promoted checkpoint does not strictly improve the mined-"
+            f"weakness score: {inc_score} -> {cand_score}"
+        )
+        assert report["gate"]["pass"], report["gate"]
+        assert replay_ok, f"learn trace replay diverged: {replay_detail}"
+        assert registry.active() == report["candidate_version"]
+
+        return {
+            "metric": "learn_loop",
+            "value": round(cand_score - inc_score, 6),
+            "unit": "weakness_score_gain",
+            "extra": {
+                "seed": seed,
+                "steps": steps,
+                "action": report["action"],
+                "weakness_incumbent": inc_score,
+                "weakness_candidate": cand_score,
+                "per_class": report["per_class"],
+                "corpus_version": report["corpus_version"],
+                "corpus_digest": report["corpus_digest"],
+                "incumbent_version": report["incumbent_version"],
+                "candidate_version": report["candidate_version"],
+                "gate_checks": report["gate"]["checks"],
+                "train_loss": report["train_loss"],
+                "swap_pause_s": report.get("swap", {}).get("pause_s"),
+                "trace_replay": replay_detail,
+                "cycle_s": round(cycle_s, 1),
+                "model": "learn-micro (random-init incumbent)",
+            },
+        }
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
 
 
 # ------------------------------------------------------- model throughput/MFU
@@ -1585,6 +1772,10 @@ def main() -> None:
              "(default 6)",
     )
     parser.add_argument(
+        "--learn-steps", type=int, default=None,
+        help="finetune steps for --preset learn (default 300)",
+    )
+    parser.add_argument(
         "--trace", default=None,
         help="record the --preset arena trace here (replay with "
              "`cli sim --replay`)",
@@ -1599,7 +1790,7 @@ def main() -> None:
                 "pods", "nodes", "shapes", "slots", "model", "chunk_steps",
                 "max_new_tokens", "temperature", "rounds", "arrival_rate",
                 "quantize", "profile_dir", "decode_matmul", "perturb_idle",
-                "prefix_prewarm", "seed", "trace", "swaps",
+                "prefix_prewarm", "seed", "trace", "swaps", "learn_steps",
             )
             if getattr(args, name) is not None
         ]
@@ -1647,6 +1838,9 @@ def main() -> None:
         return
     if args.preset == "chaos":
         _emit(chaos_bench(args))
+        return
+    if args.preset == "learn":
+        _emit(learn_bench(args))
         return
     result = asyncio.run(bench_preset(args))
     result["extra"]["dispatch_rtt_ms"] = measure_dispatch_rtt_ms()

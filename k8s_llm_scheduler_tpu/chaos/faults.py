@@ -59,7 +59,7 @@ import time
 from collections import Counter
 from typing import Any, Callable, Sequence
 
-SEAMS = ("wire", "lease", "watch", "backend", "cache", "slo")
+SEAMS = ("wire", "lease", "watch", "backend", "cache", "slo", "swap")
 
 FAULT_KINDS: dict[str, tuple[str, ...]] = {
     "wire": ("reset", "drop", "delay", "dup"),
@@ -68,6 +68,11 @@ FAULT_KINDS: dict[str, tuple[str, ...]] = {
     "backend": ("error", "slow", "malformed"),
     "cache": ("l2_down",),
     "slo": ("brownout",),
+    # harness-interpreted: an identical-policy hot swap at the window's
+    # first wave boundary (decision-cache generation bump + an OPEN
+    # canary burn-in over the live scheduler stats — the promotion shape
+    # the learn loop performs; chaos/harness.py)
+    "swap": ("hot_swap",),
 }
 
 
@@ -287,6 +292,21 @@ def _regime_cache_outage(rng, n_waves: int, n_nodes: int):
     return [_ev("cache", "l2_down", start, end)], []
 
 
+def _regime_learn_swap(rng, n_waves: int, n_nodes: int):
+    start, end = _mid_windows(n_waves)
+    return [
+        # a hot swap lands at the window boundary and opens a canary
+        # burn-in over the live stats (the learn loop's promotion step)...
+        _ev("swap", "hot_swap", start, start + 1),
+        # ...while an SLO brownout burns THROUGH the burn-in window: the
+        # degradation ladder sheds decisions to the heuristic rung, and
+        # the burn-in's fallback-rate trip must subtract those degraded
+        # sheds (rollout/canary._signals) — a brownout overlapping a
+        # burn-in must never roll back a healthy candidate
+        _ev("slo", "brownout", start, end),
+    ], []
+
+
 REGIMES: dict[str, dict[str, Any]] = {
     # mode: which harness stack the regime drives (chaos/harness.py) —
     # "single" = Scheduler over the wire-fake API server; "wire" =
@@ -336,6 +356,12 @@ REGIMES: dict[str, dict[str, Any]] = {
     "cache-outage": {
         "build": _regime_cache_outage, "mode": "fleet",
         "describe": "shared L2 decision cache unavailable for a window",
+    },
+    "learn-swap": {
+        "build": _regime_learn_swap, "mode": "single",
+        "describe": "hot swap opens a canary burn-in mid-run while an "
+                    "SLO brownout burns through it: the burn-in must "
+                    "close clean, never roll back the healthy candidate",
     },
 }
 

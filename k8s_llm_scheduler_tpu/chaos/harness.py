@@ -152,6 +152,58 @@ def _wave_brownout(injector: FaultInjector, clients: list) -> None:
             client.exit_brownout("chaos")
 
 
+def _open_burn_in(scheduler, swap_cache) -> Any:
+    """Interpret the `swap` seam: perform the cache-visible half of an
+    identical-policy hot swap (generation bump — cached decisions from
+    the 'old policy' become unservable, exactly what HotSwapper does
+    after a real weight swap) and open a REAL CanaryController burn-in
+    over the live scheduler stats. The decider is unchanged (the
+    determinism contract: a swap must not move placements), so a healthy
+    burn-in is the only correct verdict — any rollback the harness
+    observes is a regression in the burn-in's signal math (e.g. the
+    brownout-overlap subtraction in rollout/canary._signals)."""
+    from types import SimpleNamespace
+
+    from k8s_llm_scheduler_tpu.rollout.canary import CanaryController
+
+    class _RegistryDouble:
+        """Just enough registry for a promote + potential rollback."""
+
+        def __init__(self) -> None:
+            self._active = 1
+
+        def active(self):
+            return self._active
+
+        def set_active(self, version) -> None:
+            self._active = version
+
+        def record_scores(self, version, scores) -> None:
+            pass
+
+        def versions(self):
+            return [1, 2]
+
+        def get(self, version):
+            return SimpleNamespace(parent=None if version == 1 else 1)
+
+    controller = CanaryController(
+        _RegistryDouble(),
+        SimpleNamespace(swap_to=lambda version: {"pause_s": 0.0}),
+        stats_provider=scheduler.get_stats,
+        gate_runner=lambda version: {
+            "pass": True, "checks": {}, "candidate": {},
+        },
+        burn_in_decisions=24,
+    )
+    if swap_cache is not None:
+        swap_cache.bump_generation()
+    verdict = controller.consider(2)
+    if verdict.get("action") != "promoted":  # pragma: no cover - defensive
+        raise ChaosError(f"learn-swap promotion failed: {verdict}")
+    return controller
+
+
 _CLIENT_COUNTERS = (
     "total_requests", "fallback_decisions", "degraded_decisions",
     "brownout_decisions", "deadline_timeouts", "invalid_decisions",
@@ -292,9 +344,16 @@ async def _run_wire_stack(
 
         backend_seam = injector.seam("backend")
         wire_seam = injector.seam("wire")
+        swap_seam = injector.seam("swap")
+        canary = None
+        burn_in_result: str | None = None
         for wave_idx, wave in enumerate(scenario.waves):
             injector.begin_wave(wave_idx)
             _wave_brownout(injector, [client])
+            if canary is None and swap_seam.should("hot_swap") is not None:
+                # hot swap at the wave boundary: generation bump + an open
+                # canary burn-in over the live stats (learn-swap regime)
+                canary = _open_burn_in(scheduler, cache)
             tripping = (
                 backend_seam.active("error")
                 or wire_seam.active("reset")
@@ -381,6 +440,11 @@ async def _run_wire_stack(
                     dict(injector.injection_counts()), inj_before
                 ),
             })
+            if canary is not None and burn_in_result is None:
+                # progress the open burn-in at the wave barrier: the
+                # decision-count window fills from settled waves only, so
+                # the verdict is wave-quantized like everything else here
+                burn_in_result = canary.observe_burn_in()
         injector.end_run()
 
         # late recovery scan: the watch re-list may resolve stragglers
@@ -399,7 +463,7 @@ async def _run_wire_stack(
                 ("default", n) for n in unplaced if n not in outcomes
             ],
         )
-        return {
+        out = {
             "placements": dict(sorted(outcomes.items())),
             "unschedulable": sorted(
                 n for n in unplaced if n not in outcomes
@@ -407,6 +471,13 @@ async def _run_wire_stack(
             "waves": waves_out,
             "client": client.get_stats(),
         }
+        if canary is not None:
+            out["canary"] = {
+                "result": burn_in_result,
+                "promotions": canary.counters["promotions"],
+                "rollbacks": canary.counters["rollbacks"],
+            }
+        return out
     finally:
         injector.end_run()
         if task is not None:
@@ -673,6 +744,10 @@ def run_chaos(
         "degraded_fraction": _degraded_fraction(stack["waves"]),
         "wall_ms": round(run_wall_ms, 3),
     }
+    if "canary" in stack:
+        # learn-swap regime: the burn-in verdict (timing-free booleans,
+        # but run-local — stays in the report, not the trace)
+        report["canary"] = stack["canary"]
     if quality:
         report["quality"] = _quality_vs_teacher(scenario, scores)
     return report

@@ -644,6 +644,10 @@ def cmd_train(args: argparse.Namespace, cfg: Config) -> int:
         placement_frac=args.placement_frac,
         diverse_frac=args.diverse_frac,
         seed=args.seed,
+        registry_dir=(
+            None if args.no_publish
+            else args.registry or cfg.get("rollout.registry_dir", None)
+        ),
     )
     print(f"final loss {loss:.4f}; checkpoint at {args.out}")
     if args.eval:
@@ -972,6 +976,20 @@ def _rollout_registry(args: argparse.Namespace, cfg: Config):
     return CheckpointRegistry(root)
 
 
+def _retention_pins(cfg: Config) -> set:
+    """Versions retention must keep beyond the keep-last window: every
+    checkpoint an incident corpus mined against (learn.corpus_dir lineage
+    — evicting one orphans the corpus provenance)."""
+    import os as _os
+
+    corpus_dir = cfg.get("learn.corpus_dir", None)
+    if not corpus_dir or not _os.path.isdir(str(corpus_dir)):
+        return set()
+    from k8s_llm_scheduler_tpu.learn import IncidentCorpus
+
+    return IncidentCorpus(corpus_dir).lineage_versions()
+
+
 def _gate_from_cfg(cfg: Config, seed: int | None = None):
     from k8s_llm_scheduler_tpu.rollout import GateConfig
 
@@ -1010,7 +1028,7 @@ def cmd_rollout(args: argparse.Namespace, cfg: Config) -> int:
         )
         retain = int(cfg.get("rollout.retain", 0))
         if retain:
-            registry.retain(retain)
+            registry.retain(retain, pinned=_retention_pins(cfg))
         print(json.dumps({
             "metric": "rollout_publish",
             "version": manifest.version,
@@ -1351,6 +1369,261 @@ def _rollout_watch(args: argparse.Namespace, cfg: Config, registry) -> int:
             **scheduler.get_stats(), "rollout": controller.stats(),
         }, indent=2, default=str))
     return 0
+
+
+def _learn_corpus(args: argparse.Namespace, cfg: Config):
+    from k8s_llm_scheduler_tpu.learn import IncidentCorpus
+
+    root = getattr(args, "corpus", None) or cfg.get("learn.corpus_dir", None)
+    if not root:
+        raise SystemExit(
+            "no incident corpus: pass --corpus DIR or set learn.corpus_dir "
+            "(LEARN_CORPUS_DIR)"
+        )
+    return IncidentCorpus(root)
+
+
+def _learn_config(args: argparse.Namespace, cfg: Config):
+    from k8s_llm_scheduler_tpu.learn import LearnConfig
+
+    sect = cfg.section("learn")
+    seeds = getattr(args, "seeds", None)
+    if seeds:
+        mine_seeds = tuple(int(s) for s in seeds.split(",") if s.strip())
+    else:
+        mine_seeds = tuple(int(s) for s in sect.get("mine_seeds", [0, 1]))
+    return LearnConfig(
+        seed=int(getattr(args, "seed", 0) or 0),
+        mine_seeds=mine_seeds,
+        mine_nodes=int(sect.get("mine_nodes", 8)),
+        mine_pods=int(sect.get("mine_pods", 48)),
+        mine_waves=int(sect.get("mine_waves", 3)),
+        spread_margin=float(sect.get("spread_margin", 0.005)),
+        replay_fraction=float(
+            getattr(args, "replay_fraction", None)
+            if getattr(args, "replay_fraction", None) is not None
+            else sect.get("replay_fraction", 0.3)
+        ),
+        steps=int(
+            getattr(args, "steps", None) or sect.get("steps", 200)
+        ),
+        batch_size=int(sect.get("batch_size", 4)),
+        seq_len=int(sect.get("seq_len", 1024)),
+        lr=float(sect.get("lr", 3e-4)),
+        weakness_cases=int(sect.get("weakness_cases", 32)),
+        weakness_margin=float(sect.get("weakness_margin", 0.0)),
+        gate=_gate_from_cfg(cfg),
+        retain=int(sect.get("retain", 0)),
+    )
+
+
+def _learn_candidate_arm(cfg: Config, checkpoint_path: str | None):
+    """The serving policy as a STACK arena arm for mining: the configured
+    backend (stub, or the real engine serving `checkpoint_path` greedily
+    — the arena determinism contract)."""
+    from k8s_llm_scheduler_tpu.sim import ArmSpec
+
+    if cfg.get("llm.backend") == "stub":
+        from k8s_llm_scheduler_tpu.engine.backend import StubBackend
+
+        return ArmSpec(name="llm", kind="stack", make=StubBackend)
+
+    def make_llm():
+        from k8s_llm_scheduler_tpu.engine.local import build_local_backend
+
+        return build_local_backend(**_backend_kwargs(
+            cfg, temperature=0.0, checkpoint_path=checkpoint_path,
+        ))
+
+    return ArmSpec(name="llm", kind="stack", make=make_llm)
+
+
+def _learn_active_checkpoint(args, cfg: Config):
+    """(registry | None, active version | None, checkpoint path | None) —
+    the incumbent the loop mines, gates against, and finetunes from.
+    The active VERSION is captured here, once, alongside the path: a
+    promotion landing between this read and a later re-read would let
+    corpus lineage point at a checkpoint that never produced the mined
+    placements."""
+    registry = None
+    if getattr(args, "registry", None) or cfg.get("rollout.registry_dir", None):
+        registry = _rollout_registry(args, cfg)
+    active = registry.active() if registry is not None else None
+    if active is not None:
+        return registry, active, str(registry.get(active).checkpoint_path)
+    return registry, None, cfg.get("llm.checkpoint_path", None)
+
+
+def cmd_learn(args: argparse.Namespace, cfg: Config) -> int:
+    """Closed policy-improvement loop (learn/): mine loss incidents from
+    seeded arena runs of the serving policy vs the spread-lookahead
+    teacher, build replay-mixed finetune batches, run the full
+    mine -> finetune -> publish -> gate -> promote cycle, or inspect /
+    replay its artifacts."""
+    from k8s_llm_scheduler_tpu.learn import (
+        curriculum_summary,
+        mine_scenario,
+        verify_learn_trace,
+    )
+
+    if args.learn_cmd == "replay":
+        ok, detail = verify_learn_trace(args.trace)
+        print(json.dumps({
+            "metric": "learn_replay", "ok": ok, "trace": args.trace,
+            "detail": detail,
+        }))
+        return 0 if ok else 1
+
+    corpus = _learn_corpus(args, cfg)
+
+    if args.learn_cmd == "status":
+        status = corpus.status()
+        if getattr(args, "registry", None) or cfg.get(
+            "rollout.registry_dir", None
+        ):
+            registry = _rollout_registry(args, cfg)
+            status["registry_active"] = registry.active()
+            status["lineage_versions"] = sorted(corpus.lineage_versions())
+        print(json.dumps(status, indent=1, sort_keys=True))
+        return 0
+
+    if args.learn_cmd == "mine":
+        learn_cfg = _learn_config(args, cfg)
+        _registry, active_version, ckpt = _learn_active_checkpoint(args, cfg)
+        sources = [
+            mine_scenario(
+                spec, _learn_candidate_arm(cfg, ckpt),
+                spread_margin=learn_cfg.spread_margin,
+                wave_timeout_s=learn_cfg.gate.wave_timeout_s,
+            )
+            for spec in learn_cfg.mine_specs()
+        ]
+        record = corpus.add_version(
+            sources,
+            # the version captured WITH the checkpoint path, before the
+            # (potentially minutes-long) mining pass — never a re-read
+            checkpoint_version=active_version,
+            note=args.note,
+        )
+        print(json.dumps({
+            "metric": "learn_mine",
+            "corpus_version": record["version"],
+            "n_incidents": record["n_incidents"],
+            "per_class": record["per_class"],
+            "digest": record["digest"],
+            "checkpoint_version": record["checkpoint_version"],
+            "sources": len(sources),
+        }))
+        return 0
+
+    if args.learn_cmd == "build":
+        record = (
+            corpus.get(args.version) if args.version else corpus.latest()
+        )
+        if record is None:
+            print("corpus has no versions — run `cli learn mine` first",
+                  file=sys.stderr)
+            return 2
+        learn_cfg = _learn_config(args, cfg)
+        print(json.dumps({
+            "metric": "learn_build",
+            **curriculum_summary(record, learn_cfg.replay_fraction),
+        }))
+        return 0
+
+    if args.learn_cmd == "run":
+        return _learn_run(args, cfg, corpus)
+
+    raise SystemExit(f"unknown learn command {args.learn_cmd!r}")
+
+
+def _learn_run(args: argparse.Namespace, cfg: Config, corpus) -> int:
+    """One full learn cycle against the configured local model: the
+    production surface of learn/loop.LearnLoop."""
+    from k8s_llm_scheduler_tpu.engine.tokenizer import build_builtin_tokenizer
+    from k8s_llm_scheduler_tpu.learn import (
+        LearnLoop,
+        backend_decide,
+        save_learn_trace,
+    )
+    from k8s_llm_scheduler_tpu.models.configs import get_config
+    from k8s_llm_scheduler_tpu.rollout import run_gate
+
+    if cfg.get("llm.backend") != "local":
+        print("learn run needs llm.backend: local (finetuning requires the "
+              "in-tree model)", file=sys.stderr)
+        return 2
+    if cfg.get("llm.tokenizer_path"):
+        print("learn run finetunes with a builtin tokenizer; unset "
+              "llm.tokenizer_path", file=sys.stderr)
+        return 2
+    registry = _rollout_registry(args, cfg)
+    learn_cfg = _learn_config(args, cfg)
+    tokenizer_name = cfg.get("llm.tokenizer", "byte")
+    # the WIDENED serving config: the fingerprint the registry records
+    # must match what restore/hot-swap will check against
+    _tok, model_cfg = build_builtin_tokenizer(
+        tokenizer_name, get_config(cfg.get("llm.model", "tiny"))
+    )
+    _registry2, _active, incumbent_ckpt = _learn_active_checkpoint(args, cfg)
+
+    def backend_factory(checkpoint_path):
+        from k8s_llm_scheduler_tpu.engine.local import build_local_backend
+
+        return build_local_backend(**_backend_kwargs(
+            cfg, temperature=0.0, checkpoint_path=checkpoint_path,
+        ))
+
+    def decide_factory(checkpoint_path):
+        backend = backend_factory(checkpoint_path)
+        return backend_decide(backend), backend.close
+
+    loop = LearnLoop(
+        registry, corpus, learn_cfg,
+        mine_arm_factory=lambda: _learn_candidate_arm(cfg, incumbent_ckpt),
+        incumbent_decide_factory=lambda: decide_factory(incumbent_ckpt),
+        candidate_decide_factory=decide_factory,
+        gate_runner=lambda version: run_gate(
+            lambda: backend_factory(incumbent_ckpt),
+            lambda: backend_factory(
+                str(registry.get(version).checkpoint_path)
+            ),
+            learn_cfg.gate,
+        ),
+        model_cfg=model_cfg,
+        tokenizer_name=tokenizer_name,
+        answer_style=cfg.get("llm.answer_style", "direct"),
+        mesh_axes=cfg.get("llm.mesh"),
+    )
+
+    metrics_server = None
+    if cfg.get("metrics.enabled"):
+        from k8s_llm_scheduler_tpu.observability.metrics import MetricsServer
+
+        metrics_server = MetricsServer(
+            lambda: {"learn": loop.stats()}, port=cfg.get("metrics.port"),
+        )
+        metrics_server.start()
+    try:
+        report = loop.run_cycle(args.work_dir, note=args.note)
+    finally:
+        if metrics_server is not None:
+            metrics_server.stop()
+    if args.trace:
+        save_learn_trace(report, args.trace)
+    print(json.dumps({
+        "metric": "learn_run",
+        "action": report["action"],
+        "candidate_version": report["candidate_version"],
+        "incumbent_version": report["incumbent_version"],
+        "corpus_version": report["corpus_version"],
+        "per_class": report["per_class"],
+        "weakness_incumbent": report["weakness"]["incumbent"]["score"],
+        "weakness_candidate": report["weakness"]["candidate"]["score"],
+        "gate_pass": report["gate"]["pass"],
+        "train_loss": report["train_loss"],
+    }))
+    return 0 if report["action"] == "promoted" else 1
 
 
 def _debug_get(host: str, port: int, path: str, timeout: float = 5.0):
@@ -1870,6 +2143,17 @@ def main(argv: list[str] | None = None) -> int:
              "for the saved checkpoint",
     )
     p_train.add_argument("--eval-cases", type=int, default=64)
+    p_train.add_argument(
+        "--registry", default=None,
+        help="publish the finished checkpoint into this rollout registry "
+             "(default: rollout.registry_dir when configured; lineage + "
+             "train scores land in the manifest)",
+    )
+    p_train.add_argument(
+        "--no-publish", action="store_true",
+        help="skip registry publication even when a registry is configured "
+             "(bare orbax dir only — the back-compat path)",
+    )
 
     p_eval = sub.add_parser(
         "eval",
@@ -2165,6 +2449,69 @@ def main(argv: list[str] | None = None) -> int:
         help="text frame or one merged Prometheus exposition",
     )
 
+    p_learn = sub.add_parser(
+        "learn",
+        help="closed policy-improvement loop (learn/): mine loss "
+             "incidents, build finetune curricula, run the full "
+             "mine->finetune->gate->promote cycle",
+    )
+    lsub = p_learn.add_subparsers(dest="learn_cmd", required=True)
+
+    def _with_corpus(p):
+        p.add_argument(
+            "--corpus", default=None,
+            help="incident corpus dir (default: learn.corpus_dir / "
+                 "LEARN_CORPUS_DIR)",
+        )
+        return p
+
+    p_lmine = _with_registry(_with_corpus(lsub.add_parser(
+        "mine",
+        help="run the serving policy vs the teacher over seeded arena "
+             "scenarios and write a new incident-corpus version",
+    )))
+    p_lmine.add_argument(
+        "--seeds", default=None,
+        help="comma-separated mining scenario seeds (default: "
+             "learn.mine_seeds)",
+    )
+    p_lmine.add_argument("--note", default="")
+    p_lbuild = _with_corpus(lsub.add_parser(
+        "build",
+        help="reconstruct a corpus version into curriculum cases and "
+             "print the batch mix (dry-run of the finetune input)",
+    ))
+    p_lbuild.add_argument("--version", type=int, default=None)
+    p_lbuild.add_argument("--replay-fraction", type=float, default=None)
+    p_lrun = _with_registry(_with_corpus(lsub.add_parser(
+        "run",
+        help="one full learn cycle: mine -> finetune -> publish -> "
+             "two-sided gate -> promote (exit 1 when rejected)",
+    )))
+    p_lrun.add_argument("--seed", type=int, default=0)
+    p_lrun.add_argument("--seeds", default=None,
+                        help="mining scenario seeds (default learn.mine_seeds)")
+    p_lrun.add_argument("--steps", type=int, default=None)
+    p_lrun.add_argument("--replay-fraction", type=float, default=None)
+    p_lrun.add_argument(
+        "--work-dir", default="learn-work",
+        help="cycle working dir (candidate checkpoint lands here before "
+             "publish)",
+    )
+    p_lrun.add_argument(
+        "--trace", default=None,
+        help="record the cycle's byte-replayable learn trace here",
+    )
+    p_lrun.add_argument("--note", default="")
+    _with_registry(_with_corpus(lsub.add_parser(
+        "status", help="corpus versions, per-class counts, lineage",
+    )))
+    p_lreplay = lsub.add_parser(
+        "replay",
+        help="verify a recorded learn trace replays byte-identically",
+    )
+    p_lreplay.add_argument("trace", help="trace file from `learn run --trace`")
+
     p_complete = sub.add_parser(
         "complete",
         help="free-form text completion (paged continuous-batching path)",
@@ -2211,6 +2558,7 @@ def main(argv: list[str] | None = None) -> int:
         "sim": cmd_sim,
         "chaos": cmd_chaos,
         "rollout": cmd_rollout,
+        "learn": cmd_learn,
         "fleet": cmd_fleet,
         "trace": cmd_trace,
         "lint": cmd_lint,

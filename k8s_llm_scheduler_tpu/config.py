@@ -216,6 +216,38 @@ DEFAULTS: dict[str, Any] = {
         # registry poll period for `cli rollout watch`
         "poll_seconds": 5.0,
     },
+    # Closed policy-improvement loop (learn/): mine arena/chaos losses
+    # into a versioned incident corpus, finetune the decision model on
+    # them (mixed with base-distribution replay), publish to the rollout
+    # registry, and canary-promote. corpus_dir null disables the
+    # subsystem; the registry comes from rollout.registry_dir.
+    "learn": {
+        "corpus_dir": None,
+        # fraction of finetune rows drawn from the BASE training
+        # distribution instead of mined incidents (the anti-catastrophic-
+        # forgetting knob; 1.0 = pure replay, 0.0 = pure incidents)
+        "replay_fraction": 0.3,
+        "steps": 200,
+        "batch_size": 4,
+        "seq_len": 1024,
+        "lr": 3e-4,
+        # one mining arena scenario per seed
+        "mine_seeds": [0, 1],
+        "mine_nodes": 8,
+        "mine_pods": 48,
+        "mine_waves": 3,
+        # per-wave spread margin the reference must win by before a
+        # divergent pod counts as a loss incident
+        "spread_margin": 0.005,
+        # weakness gate: cases evaluated, and how much the candidate must
+        # beat the incumbent by (strictly) on them
+        "weakness_cases": 32,
+        "weakness_margin": 0.0,
+        # registry keep-last retention after a cycle (0 = keep all); the
+        # retention walk always receives the loop's pinned set (open
+        # candidate + incident-corpus lineage)
+        "retain": 0,
+    },
     # Fleet-scale serving (fleet/): leased watch-space sharding, tiered
     # decision cache, disaggregated prefill/decode pools. `replicas`/
     # `n_shards` size the sharded frontend; lease TTL + renew interval
@@ -320,6 +352,11 @@ ENV_OVERRIDES: dict[str, str] = {
     "FLEET_PREPACK_WINDOW_MS": "fleet.prepack_window_ms",
     "FLEET_PREFILL_ADDRS": "fleet.prefill_addrs",
     "FLEET_DECODE_ADDRS": "fleet.decode_addrs",
+    "LEARN_CORPUS_DIR": "learn.corpus_dir",
+    "LEARN_REPLAY_FRACTION": "learn.replay_fraction",
+    "LEARN_STEPS": "learn.steps",
+    "LEARN_MINE_SEEDS": "learn.mine_seeds",
+    "LEARN_WEAKNESS_MARGIN": "learn.weakness_margin",
     "ROLLOUT_REGISTRY_DIR": "rollout.registry_dir",
     "ROLLOUT_SHADOW_FRACTION": "rollout.shadow_fraction",
     "ROLLOUT_SWAP_MODE": "rollout.swap_mode",

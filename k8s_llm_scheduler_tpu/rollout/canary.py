@@ -107,6 +107,19 @@ def run_gate(
         "incumbent": inc,
         "candidate": cand,
         "seed": gate.seed,
+        # the deterministic per-arm record (sim/trace.py shape): what the
+        # learn loop's byte-compared trace embeds so a gate verdict can be
+        # REPLAYED from its own placements instead of re-running two
+        # backends (learn/loop.replay_learn_trace)
+        "scenario_spec": spec.to_dict(),
+        "traces": {
+            name: {
+                "placements": arm_trace["placements"],
+                "unschedulable": arm_trace["unschedulable"],
+                "scores": arm_trace["scores"],
+            }
+            for name, arm_trace in report["_traces"].items()
+        },
     }
 
 
@@ -434,6 +447,21 @@ class CanaryController:
         if not candidates:
             return None
         return self.consider(candidates[-1])
+
+    def pinned_versions(self) -> set[int]:
+        """Versions the registry's retention walk must not evict on this
+        controller's account: the OPEN burn-in candidate and its rollback
+        target. Mid burn-in the candidate IS the active version, but its
+        prior may sit outside the keep-last window — evicting it turns
+        the next rollback into a RegistryError (rollout/registry.retain's
+        pinned set exists for exactly this and the incident-corpus
+        lineage case)."""
+        pinned: set[int] = set()
+        if self._burn is not None:
+            pinned.add(int(self._burn["version"]))
+            if self._burn["prior"] is not None:
+                pinned.add(int(self._burn["prior"]))
+        return pinned
 
     def stats(self) -> dict:
         out = {

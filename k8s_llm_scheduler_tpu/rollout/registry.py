@@ -33,7 +33,7 @@ import os
 import shutil
 import time
 from pathlib import Path
-from typing import Any
+from typing import Any, Iterable
 
 logger = logging.getLogger(__name__)
 
@@ -287,14 +287,22 @@ class CheckpointRegistry:
         return {v: self.verify(v)[1] for v in self.versions()}
 
     # ----------------------------------------------------------- retention
-    def retain(self, keep_last: int) -> list[int]:
+    def retain(self, keep_last: int, pinned: "Iterable[int]" = ()) -> list[int]:
         """Delete all but the newest `keep_last` versions. The active
         version and the active version's parent (the rollback target) are
-        always kept regardless. Returns the deleted version ids."""
+        always kept regardless, as is every version in `pinned` — the
+        caller-supplied protection set for versions the keep-last window
+        cannot see are still referenced: an OPEN canary candidate
+        (CanaryController.pinned_versions — mid burn-in its version may
+        be neither active nor newest) and checkpoints an incident corpus
+        mined against (learn/miner.IncidentCorpus.lineage_versions —
+        deleting them orphans the corpus's provenance and any trace
+        replay that resolves it). Returns the deleted version ids."""
         if keep_last < 1:
             return []
         versions = self.versions()
         keep = set(versions[-keep_last:])
+        keep.update(int(v) for v in pinned)
         active = self.active()
         if active is not None:
             keep.add(active)

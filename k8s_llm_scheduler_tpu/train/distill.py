@@ -442,6 +442,81 @@ def diverse_cases(n_nodes: int = 5, seed: int = 4):
         yield next(gens[int(rng.integers(len(gens)))])
 
 
+def case_to_pair(
+    tokenizer: Tokenizer,
+    pe: PromptEngine,
+    pod,
+    nodes,
+    *,
+    answer_style: str = "direct",
+    name_weight: float = 8.0,
+    cot_weight: float = 1.0,
+) -> tuple[list[int], int, tuple[int, int], np.ndarray] | None:
+    """One (pod, nodes) case -> one training row `(token ids, answer
+    start, name span, loss weights)`, or None when the teacher abstains
+    (no feasible node) or — for answer_style='cot' — the scratchpad's
+    tie rule contradicts the teacher's true argmax (cot_teacher_case's
+    consistency guard).
+
+    THE single case->row construction path: teacher_pairs (the bootstrap
+    corpus) and learn/curriculum.py (mined-incident finetune batches)
+    both build through here, so a format or weighting change can never
+    make the two corpora train different sequences for the same case."""
+    if answer_style == "cot":
+        case = cot_teacher_case(tokenizer, pe, pod, nodes)
+        if case is None:
+            return None
+        prompt, ans_ids, (ns, ne), (cs, ce), kinds = case
+        weights = np.ones(len(prompt) + len(ans_ids), dtype=np.float32)
+        off = len(prompt)
+        weights[off + cs : off + ce] = cot_token_weights(
+            kinds, name_weight, cot_weight
+        )
+        weights[off + ne - 1] = name_weight
+        return prompt + ans_ids, off, (off + ns, off + ne), weights
+    decision = fallback_decision(
+        nodes, reason="teacher", strategy="resource_balanced", pod=pod
+    )
+    if decision is None:
+        return None
+    cluster_part, pod_part = pe.split_prompt(pod, nodes)
+    prompt = tokenizer.chat_prompt(pe.system_prompt, cluster_part + pod_part)
+    answer = json.dumps(
+        {
+            "selected_node": decision.selected_node,
+            "confidence": round(decision.confidence, 2),
+            "reasoning": "resource balanced",
+        }
+    )
+    name_len = len(tokenizer.encode(decision.selected_node))
+    name_start = len(prompt) + len(tokenizer.encode(ANSWER_PREFIX))
+    ids = prompt + tokenizer.encode(answer) + [tokenizer.eos_id]
+    weights = np.ones(len(ids), dtype=np.float32)
+    weights[name_start + name_len - 1] = name_weight
+    return ids, len(prompt), (name_start, name_start + name_len), weights
+
+
+def clip_row(
+    ids: list[int],
+    ans_start: int,
+    weights: np.ndarray,
+    seq_len: int,
+) -> tuple[list[int], int, np.ndarray, bool]:
+    """Fit one row into `seq_len` by truncating from the LEFT (the
+    decision JSON lives at the tail; dropping the answer would train on
+    prompt text only, silently learning nothing). Returns the possibly
+    clipped (ids, ans_start, weights, clipped?)."""
+    if len(ids) <= seq_len:
+        return ids, ans_start, weights, False
+    cut = len(ids) - seq_len
+    return (
+        ids[-seq_len:],
+        max(0, ans_start - cut),
+        weights[-seq_len:],
+        True,
+    )
+
+
 def teacher_pairs(
     tokenizer: Tokenizer,
     n_nodes: int = 5,
@@ -505,41 +580,13 @@ def teacher_pairs(
                 yield next(hard)
 
     for pod, nodes in mixed_cases():
-        if answer_style == "cot":
-            case = cot_teacher_case(tokenizer, pe, pod, nodes)
-            if case is None:
-                continue
-            prompt, ans_ids, (ns, ne), (cs, ce), kinds = case
-            weights = np.ones(len(prompt) + len(ans_ids), dtype=np.float32)
-            off = len(prompt)
-            weights[off + cs : off + ce] = cot_token_weights(
-                kinds, name_weight, cot_weight
-            )
-            weights[off + ne - 1] = name_weight
-            yield prompt + ans_ids, off, (off + ns, off + ne), weights
-            continue
-        decision = fallback_decision(
-            nodes, reason="teacher", strategy="resource_balanced", pod=pod
+        pair = case_to_pair(
+            tokenizer, pe, pod, nodes,
+            answer_style=answer_style,
+            name_weight=name_weight, cot_weight=cot_weight,
         )
-        if decision is None:
-            continue
-        cluster_part, pod_part = pe.split_prompt(pod, nodes)
-        prompt = tokenizer.chat_prompt(
-            pe.system_prompt, cluster_part + pod_part
-        )
-        answer = json.dumps(
-            {
-                "selected_node": decision.selected_node,
-                "confidence": round(decision.confidence, 2),
-                "reasoning": "resource balanced",
-            }
-        )
-        name_len = len(tokenizer.encode(decision.selected_node))
-        name_start = len(prompt) + len(tokenizer.encode(ANSWER_PREFIX))
-        ids = prompt + tokenizer.encode(answer) + [tokenizer.eos_id]
-        weights = np.ones(len(ids), dtype=np.float32)
-        weights[name_start + name_len - 1] = name_weight
-        yield ids, len(prompt), (name_start, name_start + name_len), weights
+        if pair is not None:
+            yield pair
 
 
 def make_batches(
@@ -651,20 +698,15 @@ def make_batches(
                 ids, ans_start, _name_span, w_ids = micro_row(
                     ids[:ans_start]
                 )
-            if len(ids) > seq_len:
-                # Truncate from the LEFT: the decision JSON lives at the
-                # tail, and a distillation batch that drops the answer
-                # trains on prompt text only (silently learning nothing).
-                cut = len(ids) - seq_len
-                ids = ids[-seq_len:]
-                w_ids = w_ids[-seq_len:]
-                ans_start = max(0, ans_start - cut)
-                if not warned:
-                    logger.warning(
-                        "teacher pairs exceed seq_len=%d; truncating prompt "
-                        "context from the left (answers preserved)", seq_len,
-                    )
-                    warned = True
+            ids, ans_start, w_ids, clipped = clip_row(
+                ids, ans_start, w_ids, seq_len
+            )
+            if clipped and not warned:
+                logger.warning(
+                    "teacher pairs exceed seq_len=%d; truncating prompt "
+                    "context from the left (answers preserved)", seq_len,
+                )
+                warned = True
             tokens[b, : len(ids)] = ids
             lens[b] = len(ids)
             starts[b] = ans_start
@@ -730,9 +772,17 @@ def make_agreement_probe(
     seed: int = 30_011,
     seq_len: int = 2048,
     answer_style: str = "direct",
+    cases: "Iterator[tuple] | None" = None,
 ):
     """Build `probe(params) -> agreement` — greedy-serving-equivalent
     teacher agreement, cheap enough to run every few hundred train steps.
+
+    `cases` overrides the case stream (default: the training
+    distribution's random_cases at the probe seed). A FINITE iterator —
+    e.g. learn/curriculum.py's reconstructed incident cases, or one
+    scenario class from train/eval.scenario_cases — yields a probe over
+    however many usable rows it produced (at most n_cases); an exhausted
+    empty stream is an error, not a silent 0-case probe.
 
     Exactness: the decision grammar forces every token of the answer
     except the node-name choice (engine/constrained.py builds a trie over
@@ -765,10 +815,14 @@ def make_agreement_probe(
     from k8s_llm_scheduler_tpu.models.llama import forward_prefill
 
     pe = PromptEngine()
-    cases = random_cases(n_nodes=n_nodes, seed=seed)
+    if cases is None:
+        cases = random_cases(n_nodes=n_nodes, seed=seed)
     rows, row_meta = [], []
     while len(rows) < n_cases:
-        pod, nodes = next(cases)
+        try:
+            pod, nodes = next(cases)
+        except StopIteration:
+            break
         decision = fallback_decision(
             nodes, reason="teacher", strategy="resource_balanced", pod=pod
         )
@@ -814,11 +868,16 @@ def make_agreement_probe(
         )
         rows.append(ids)
         row_meta.append((diverge, target))
+    if not rows:
+        raise ValueError(
+            "agreement probe: the case stream yielded no usable cases"
+        )
+    n_rows = len(rows)
     max_k = max(len(d) for d, _ in row_meta)
-    tokens = np.full((n_cases, seq_len), tokenizer.pad_id, dtype=np.int32)
-    lens = np.zeros(n_cases, dtype=np.int32)
-    cand_toks = np.full((n_cases, max_k), -1, dtype=np.int32)
-    targets = np.zeros(n_cases, dtype=np.int32)
+    tokens = np.full((n_rows, seq_len), tokenizer.pad_id, dtype=np.int32)
+    lens = np.zeros(n_rows, dtype=np.int32)
+    cand_toks = np.full((n_rows, max_k), -1, dtype=np.int32)
+    targets = np.zeros(n_rows, dtype=np.int32)
     for i, (ids, (diverge, target)) in enumerate(zip(rows, row_meta)):
         tokens[i, : len(ids)] = ids
         lens[i] = len(ids)
@@ -977,6 +1036,8 @@ def train_and_save(
     prompt_lm_frac: float = 0.0,
     placement_frac: float = 0.0,
     diverse_frac: float = 0.0,
+    registry_dir: str | None = None,
+    publish_note: str = "",
 ) -> float:
     """Run `steps` of answer-masked fine-tuning on teacher pairs and save
     an orbax checkpoint servable via checkpoint_path. Returns the final
@@ -987,7 +1048,16 @@ def train_and_save(
     `tokenizer_name="numeric"` trains with the single-token-integer vocab
     (serve the result with llm.tokenizer: numeric). `probe_every=N` logs
     greedy held-out teacher agreement every N steps (make_agreement_probe).
-    `lr_schedule="cosine"` adds linear warmup (5%) + cosine decay."""
+    `lr_schedule="cosine"` adds linear warmup (5%) + cosine decay.
+
+    `registry_dir` additionally PUBLISHES the finished checkpoint into
+    the rollout registry (rollout/registry.py) with full provenance: the
+    widened serving config's fingerprint, lineage (parent = the
+    registry's active version), and the train-side scores (final loss,
+    last probe agreement when probing was on). A registry-less call keeps
+    the historical bare-orbax-dir behavior — the thin back-compat path —
+    but every checkpoint that flows onward to promotion should carry a
+    manifest."""
     import jax
     import optax
 
@@ -1090,6 +1160,7 @@ def train_and_save(
         else None
     )
     loss = float("nan")
+    last_probe: float | None = None
     for step in range(1, steps + 1):
         tokens, lens, starts, weights = next(batches)
         tokens, lens, starts, weights = step_fn.place_batch(
@@ -1100,11 +1171,12 @@ def train_and_save(
             loss = float(loss_arr)
             logger.info("step %d/%d loss %.4f", step, steps, loss)
         if probe is not None and (step % probe_every == 0 or step == steps):
+            last_probe = probe(state.params)
             logger.info(
                 "step %d/%d held-out greedy agreement%s %.1f%%",
                 step, steps,
                 " (teacher-forced CoT)" if answer_style == "cot" else "",
-                100.0 * probe(state.params),
+                100.0 * last_probe,
             )
             if diag is not None:
                 d = diag(state.params)
@@ -1134,4 +1206,32 @@ def train_and_save(
         # (replicated-spec) state and must not race the directory write
         save_checkpoint(out_dir, state.params)
         logger.info("checkpoint saved to %s", out_dir)
+        if registry_dir:
+            # provenance path: every trained checkpoint that will flow to
+            # promotion enters the registry with a fingerprint + lineage
+            # + train scores, never as an anonymous orbax dir
+            from k8s_llm_scheduler_tpu.rollout.registry import (
+                CheckpointRegistry,
+            )
+
+            registry = CheckpointRegistry(registry_dir)
+            scores: dict = {"train": {
+                "final_loss": None if loss != loss else round(loss, 6),
+                "steps": steps,
+                "seed": seed,
+                "answer_style": answer_style,
+            }}
+            if last_probe is not None:
+                scores["train"]["probe_agreement"] = round(last_probe, 4)
+            manifest = registry.publish(
+                out_dir,
+                cfg=cfg,  # the WIDENED serving config — what restore needs
+                tokenizer=tokenizer_name,
+                scores=scores,
+                note=publish_note or f"train_and_save steps={steps}",
+            )
+            logger.info(
+                "published checkpoint as registry version %d (parent=%s)",
+                manifest.version, manifest.parent,
+            )
     return loss

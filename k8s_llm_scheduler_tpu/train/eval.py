@@ -65,16 +65,39 @@ SCENARIO_CLASSES = (
     "uniform", "hetero-capacity", "tainted", "selector", "affinity"
 )
 
+# Which PodSpec constraint dimension each scenario class exercises (None:
+# the class varies topology, not pod constraints). THE drift tripwire for
+# the shared taxonomy: tests/test_learn.py pins that every class in
+# SCENARIO_CLASSES has an entry here, that sample_pod_constraints REJECTS
+# anything else, and that each non-None class actually populates its
+# dimension — so neither this module nor sim/scenarios.py can grow a
+# class the other (or the incident miner's per-class counts) doesn't know.
+CLASS_DIMENSION: dict[str, str | None] = {
+    "uniform": None,
+    "hetero-capacity": None,
+    "tainted": "tolerations",
+    "selector": "node_selector",
+    "affinity": "affinity_rules",
+}
+
 
 def sample_pod_constraints(
     kind: str, rng: np.random.Generator
 ) -> tuple[dict, tuple, dict]:
     """One (node_selector, tolerations, affinity_rules) draw for a pod of
     scenario class `kind` — THE constraint taxonomy, shared by the eval's
-    per-class agreement table below and the sim's workload generators
-    (sim/scenarios.py), so arena scores and eval scores speak the same
-    scenario language. rng call ORDER is part of the contract: existing
-    seeded streams (tests/test_eval.py) must not shift."""
+    per-class agreement table below, the sim's workload generators
+    (sim/scenarios.py), and the incident miner's per-class corpus counts
+    (learn/miner.py), so arena scores, eval tables, and mined corpora all
+    speak the same scenario language. rng call ORDER is part of the
+    contract: existing seeded streams (tests/test_eval.py) must not
+    shift. Unknown kinds RAISE instead of silently yielding an
+    unconstrained pod — a class added on one side of the taxonomy must
+    fail loudly everywhere else until both sides know it."""
+    if kind not in SCENARIO_CLASSES:
+        raise ValueError(
+            f"unknown scenario class {kind!r} (known: {SCENARIO_CLASSES})"
+        )
     selector: dict = {}
     tolerations: tuple = ()
     affinity: dict = {}

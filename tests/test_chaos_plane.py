@@ -672,6 +672,53 @@ class TestChaosSmoke:
         assert report["invariants"]["checks"]["breaker_transition"] >= 2
 
 
+class TestLearnSwapRegime:
+    """PR-level loop test for the `_signals` brownout subtraction: a hot
+    swap opens a REAL CanaryController burn-in mid-run while an SLO
+    brownout sheds decisions through the whole window — the burn-in must
+    close clean (a brownout overlapping a burn-in must never roll back a
+    healthy candidate), with the invariant monitor watching the swap's
+    cache-generation bump the whole time."""
+
+    def test_burn_in_survives_brownout_and_stays_clean(self):
+        report = run_chaos(
+            "learn-swap", seed=3, n_waves=6, n_nodes=8, n_pods=48,
+            wave_timeout_s=15.0, quality=False,
+        )
+        assert report["invariants"]["clean"], report["invariants"]
+        canary = report["canary"]
+        assert canary["promotions"] == 1
+        # the healthy candidate SURVIVED: burn-in closed "ok", zero
+        # rollbacks — the brownout's degraded sheds were subtracted from
+        # the fallback-rate trip (rollout/canary._signals)
+        assert canary["result"] == "ok", canary
+        assert canary["rollbacks"] == 0
+        # the brownout genuinely overlapped the open burn-in
+        assert report["degraded_fraction"] > 0
+        assert report["injections"].get("swap.hot_swap", 0) == 1
+        assert report["injections"].get("slo.brownout", 0) >= 1
+        # every pod still bound exactly once under monitor observation
+        # (the swap's generation bump can't strand or double-bind work)
+        assert report["invariants"]["checks"]["exactly_once_bind"] == 48
+        assert report["scores"]["bound_frac"] == 1.0
+
+    def test_regime_trace_replays_byte_identically(self, tmp_path):
+        kwargs = dict(
+            seed=7, n_waves=6, n_nodes=8, n_pods=48,
+            wave_timeout_s=15.0, quality=False,
+        )
+        r1 = run_chaos("learn-swap", **kwargs)
+        r2 = run_chaos("learn-swap", **kwargs)
+        assert (
+            canonical_chaos_bytes(build_chaos_trace(r1))
+            == canonical_chaos_bytes(build_chaos_trace(r2))
+        )
+        path = tmp_path / "learn-swap.trace"
+        save_chaos_trace(r1, path)
+        ok, detail = verify_chaos_trace(path)
+        assert ok, detail
+
+
 def save_and_load(report) -> str:
     return canonical_chaos_bytes(build_chaos_trace(report)).decode()
 

@@ -1,0 +1,651 @@
+"""Closed policy-improvement loop (learn/): miner, corpus, curriculum,
+LearnLoop cycle, trace replay, retention pinning, taxonomy drift.
+
+Fast tier throughout: the loop's seams (decide fns, train_fn doubles,
+heuristic gate arms) make a full mine -> finetune -> publish -> gate ->
+promote cycle run in ~1-2s with zero model compiles. The real-engine end
+to end (finetune actually improving the mined-weakness score) is
+`bench.py --preset learn`'s job.
+"""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+from k8s_llm_scheduler_tpu.core.fallback import score_resource_balanced
+from k8s_llm_scheduler_tpu.core.validation import feasible_nodes
+from k8s_llm_scheduler_tpu.learn import (
+    CorpusError,
+    IncidentCorpus,
+    LearnConfig,
+    LearnLoop,
+    curriculum_summary,
+    decide_policy_arm,
+    incident_cases,
+    mine_chaos_report,
+    mine_scenario,
+    reconstruct_cases,
+    save_learn_trace,
+    verify_learn_trace,
+    weakness_report,
+)
+from k8s_llm_scheduler_tpu.learn.curriculum import curriculum_batches
+from k8s_llm_scheduler_tpu.rollout import (
+    CheckpointRegistry,
+    GateConfig,
+    run_gate,
+)
+from k8s_llm_scheduler_tpu.sim import HeuristicBackend
+from k8s_llm_scheduler_tpu.train.eval import teacher_decide
+
+
+def anti_teacher(pod, nodes):
+    """Deterministically picks the WORST feasible node by the teacher's
+    own score — guaranteed loss incidents, zero model cost."""
+    ok = feasible_nodes(pod, nodes)
+    if not ok:
+        return None
+    return min(ok, key=lambda n: (score_resource_balanced(n), n.name)).name
+
+
+def learn_cfg(**overrides) -> LearnConfig:
+    defaults = dict(
+        seed=3,
+        mine_seeds=(3, 4),
+        mine_nodes=6,
+        mine_pods=24,
+        mine_shapes=6,
+        mine_waves=3,
+        weakness_cases=16,
+        steps=1,
+        gate=GateConfig(
+            seed=3, nodes=6, pods=16, shapes=4, waves=2,
+            spread_tolerance=0.2, wave_timeout_s=60.0,
+        ),
+    )
+    defaults.update(overrides)
+    return LearnConfig(**defaults)
+
+
+def stub_train_fn(record, out_dir):
+    from pathlib import Path
+
+    out = Path(out_dir)
+    out.mkdir(parents=True, exist_ok=True)
+    (out / "weights.bin").write_bytes(b"trained-" * 8)
+    return 0.5
+
+
+def heuristic_gate_runner(gate):
+    def runner(version):
+        return run_gate(
+            lambda: HeuristicBackend("resource_balanced"),
+            lambda: HeuristicBackend("resource_balanced"),
+            gate,
+        )
+
+    return runner
+
+
+def make_loop(tmp_path, cfg=None, *, candidate=teacher_decide,
+              incumbent=anti_teacher, swapper=None):
+    cfg = cfg or learn_cfg()
+    corpus = IncidentCorpus(tmp_path / "corpus")
+    registry = CheckpointRegistry(tmp_path / "registry")
+    src = tmp_path / "incumbent"
+    src.mkdir(exist_ok=True)
+    (src / "weights.bin").write_bytes(b"incumbent" * 4)
+    m = registry.publish(src, note="incumbent")
+    registry.set_active(m.version)
+    loop = LearnLoop(
+        registry, corpus, cfg,
+        mine_arm_factory=lambda: decide_policy_arm("llm", incumbent),
+        incumbent_decide_factory=lambda: (incumbent, lambda: None),
+        candidate_decide_factory=lambda ckpt: (candidate, lambda: None),
+        gate_runner=heuristic_gate_runner(cfg.gate),
+        train_fn=stub_train_fn,
+    )
+    return loop, registry, corpus
+
+
+# -------------------------------------------------------------------- miner
+class TestMiner:
+    def _source(self, seed=3):
+        cfg = learn_cfg()
+        return mine_scenario(
+            cfg.mine_specs()[0], decide_policy_arm("llm", anti_teacher),
+            spread_margin=0.005,
+        )
+
+    def test_anti_teacher_mining_finds_incidents(self):
+        src = self._source()
+        assert src["incidents"], "anti-teacher produced no loss incidents"
+        reasons = {i["reason"] for i in src["incidents"]}
+        assert "divergence" in reasons
+        # every incident names a pod the scenario generated, with a class
+        # from the shared taxonomy
+        from k8s_llm_scheduler_tpu.train.eval import SCENARIO_CLASSES
+
+        for inc in src["incidents"]:
+            assert inc["kind"] in SCENARIO_CLASSES
+            assert inc["pod"].startswith("sim-pod-")
+            assert inc["count"] >= 1
+
+    def test_mining_is_deterministic(self):
+        a, b = self._source(), self._source()
+        assert a["incidents"] == b["incidents"]
+        assert a["trace_digest"] == b["trace_digest"]
+
+    def test_teacher_arm_mines_nothing_against_itself(self):
+        """A candidate identical to the reference has no loss incidents
+        of the divergence/unbound kinds (the 'nothing to learn' floor)."""
+        from k8s_llm_scheduler_tpu.sim.teacher import SpreadLookaheadTeacher
+
+        cfg = learn_cfg()
+        from k8s_llm_scheduler_tpu.sim import ArmSpec
+
+        arm = ArmSpec(name="llm", kind="policy", make=SpreadLookaheadTeacher)
+        src = mine_scenario(cfg.mine_specs()[0], arm)
+        assert src["incidents"] == []
+
+    def test_chaos_report_mines_with_uniform_class(self):
+        from k8s_llm_scheduler_tpu.chaos import run_chaos
+
+        report = run_chaos(
+            "circuit-open", seed=5, n_waves=4, n_nodes=6, n_pods=18,
+            wave_timeout_s=15.0, quality=False,
+        )
+        src = mine_chaos_report(report)
+        # HashPlacement vs teacher diverges somewhere across 18 pods
+        assert all(i["kind"] == "uniform" for i in src["incidents"])
+        assert src["reference"] == "teacher"
+
+    def test_corpus_versioning_digest_and_lineage(self, tmp_path):
+        corpus = IncidentCorpus(tmp_path / "c")
+        src = self._source()
+        r1 = corpus.add_version([src], checkpoint_version=7, note="one")
+        assert r1["version"] == 1
+        assert r1["per_class"]
+        assert r1["n_incidents"] == sum(
+            i["count"] for i in src["incidents"]
+        )
+        r2 = corpus.add_version([src], checkpoint_version=9)
+        assert r2["version"] == 2
+        assert r1["digest"] == r2["digest"]  # same sources, same content
+        assert corpus.lineage_versions() == {7, 9}
+        status = corpus.status()
+        assert [v["version"] for v in status["versions"]] == [1, 2]
+        assert corpus.get(1)["note"] == "one"
+
+    def test_empty_and_incident_free_versions_rejected(self, tmp_path):
+        corpus = IncidentCorpus(tmp_path / "c")
+        with pytest.raises(CorpusError, match="empty"):
+            corpus.add_version([])
+        src = self._source()
+        src = {**src, "incidents": []}
+        with pytest.raises(CorpusError, match="zero incidents"):
+            corpus.add_version([src])
+
+
+# --------------------------------------------------------------- curriculum
+class TestCurriculum:
+    def _record(self, tmp_path):
+        corpus = IncidentCorpus(tmp_path / "c")
+        cfg = learn_cfg()
+        sources = [
+            mine_scenario(spec, decide_policy_arm("llm", anti_teacher))
+            for spec in cfg.mine_specs()
+        ]
+        return corpus.add_version(sources, checkpoint_version=1)
+
+    def test_reconstruction_is_deterministic_and_complete(self, tmp_path):
+        record = self._record(tmp_path)
+        a = incident_cases(record)
+        b = incident_cases(record)
+        assert len(a) == sum(
+            len(s["incidents"]) for s in record["sources"]
+        )
+        for (pa, na, ka), (pb, nb, kb) in zip(a, b):
+            assert pa == pb and ka == kb
+            assert [n.name for n in na] == [n.name for n in nb]
+            assert [n.pod_count for n in na] == [n.pod_count for n in nb]
+        # the reconstructed state is mid-trajectory, not the blank
+        # topology: some placements folded in before later-wave incidents
+        assert any(
+            sum(n.pod_count for n in nodes) > 0 for _p, nodes, _k in a
+        )
+
+    def test_batches_deterministic_and_replay_fraction(self, tmp_path):
+        from k8s_llm_scheduler_tpu.engine.tokenizer import ByteTokenizer
+
+        record = self._record(tmp_path)
+        tok = ByteTokenizer()
+
+        def first_batch(rf, seed=5):
+            it = curriculum_batches(
+                tok, record, batch_size=4, seq_len=1536,
+                replay_fraction=rf, seed=seed,
+            )
+            return next(it)
+
+        t1, l1, s1, w1 = first_batch(0.5)
+        t2, l2, s2, w2 = first_batch(0.5)
+        np.testing.assert_array_equal(t1, t2)
+        np.testing.assert_array_equal(w1, w2)
+
+        # replay_fraction=0: every row is an incident case (sim-node names
+        # in the prompt); =1: every row is the base distribution
+        t0, l0, _, _ = first_batch(0.0)
+        rows0 = [tok.decode([int(x) for x in t0[r][: l0[r]]])
+                 for r in range(4)]
+        assert all("sim-node-" in text for text in rows0)
+        tr, lr, _, _ = first_batch(1.0)
+        rowsr = [tok.decode([int(x) for x in tr[r][: lr[r]]])
+                 for r in range(4)]
+        assert all("sim-node-" not in text for text in rowsr)
+
+    def test_replay_fraction_validated(self, tmp_path):
+        from k8s_llm_scheduler_tpu.engine.tokenizer import ByteTokenizer
+
+        record = self._record(tmp_path)
+        with pytest.raises(ValueError, match="replay_fraction"):
+            next(curriculum_batches(
+                ByteTokenizer(), record, batch_size=2, seq_len=512,
+                replay_fraction=1.5,
+            ))
+
+    def test_summary_counts_match_cases(self, tmp_path):
+        record = self._record(tmp_path)
+        summary = curriculum_summary(record, 0.3)
+        assert summary["incident_cases"] == len(incident_cases(record))
+        assert summary["replay_fraction"] == 0.3
+        assert sum(summary["per_class"].values()) == summary["incident_cases"]
+
+
+# --------------------------------------------------------------------- loop
+class TestLearnLoop:
+    def test_full_cycle_promotes_and_traces(self, tmp_path):
+        t0 = time.perf_counter()
+        loop, registry, corpus = make_loop(tmp_path)
+        report = loop.run_cycle(tmp_path / "work")
+        elapsed = time.perf_counter() - t0
+        assert elapsed < 10.0, f"fast-tier learn cycle took {elapsed:.1f}s"
+
+        assert report["action"] == "promoted"
+        assert registry.active() == report["candidate_version"]
+        # lineage: the corpus points at the incumbent checkpoint version,
+        # the candidate manifest points at the corpus version + digest
+        record = corpus.get(report["corpus_version"])
+        assert record["checkpoint_version"] == report["incumbent_version"]
+        manifest = registry.get(report["candidate_version"])
+        assert manifest.parent == report["incumbent_version"]
+        assert manifest.scores["learn"]["corpus_digest"] == record["digest"]
+        assert manifest.scores["learn_gate"]["action"] == "promoted"
+        # the weakness gate measured a strict improvement
+        weak = report["weakness"]
+        assert weak["candidate"]["score"] > weak["incumbent"]["score"]
+        assert weak["pass"] and report["gate"]["pass"]
+
+        path = tmp_path / "trace.json"
+        save_learn_trace(report, path)
+        ok, detail = verify_learn_trace(path)
+        assert ok, detail
+
+    def test_cycle_rejects_non_improving_candidate(self, tmp_path):
+        # candidate == incumbent: no strict improvement -> rejected, with
+        # rejected-version memory and the active pointer unmoved
+        loop, registry, corpus = make_loop(
+            tmp_path, candidate=anti_teacher
+        )
+        incumbent_version = registry.active()
+        report = loop.run_cycle(tmp_path / "work")
+        assert report["action"] == "rejected"
+        assert registry.active() == incumbent_version
+        assert report["candidate_version"] in loop.rejected
+        # the trace replays for rejected cycles too
+        path = tmp_path / "trace.json"
+        save_learn_trace(report, path)
+        ok, detail = verify_learn_trace(path)
+        assert ok, detail
+
+    def test_swapper_drives_promotion(self, tmp_path):
+        swaps = []
+
+        class Swapper:
+            def swap_to(self, version):
+                swaps.append(version)
+                return {"pause_s": 0.0, "version": version}
+
+        cfg = learn_cfg()
+        loop, registry, _ = make_loop(tmp_path, cfg)
+        loop.swapper = Swapper()
+        report = loop.run_cycle(tmp_path / "work")
+        assert swaps == [report["candidate_version"]]
+        assert report["swap"]["version"] == report["candidate_version"]
+
+    def test_tampered_trace_is_rejected(self, tmp_path):
+        loop, _, _ = make_loop(tmp_path)
+        report = loop.run_cycle(tmp_path / "work")
+        path = tmp_path / "trace.json"
+        save_learn_trace(report, path)
+        # tamper 1: forge the corpus digest — replay recomputes the true
+        # one from the recorded placements and the bytes diverge
+        trace = json.loads(path.read_bytes())
+        trace["mine"]["corpus_digest"] = "0" * 16
+        path.write_bytes(json.dumps(trace).encode())
+        ok, detail = verify_learn_trace(path)
+        assert not ok and "diverged" in detail
+        # tamper 2: move a recorded placement — the re-mined incident set
+        # shifts and the recorded weakness cases no longer reconstruct
+        # (structural rejection, the chaos forged-plan discipline)
+        trace = json.loads(json.dumps(report["_trace"]))
+        src = trace["mine"]["sources"][0]
+        victim = sorted(src["placements"])[0]
+        src["placements"][victim] = (
+            "sim-node-000"
+            if src["placements"][victim] != "sim-node-000"
+            else "sim-node-001"
+        )
+        path.write_bytes(json.dumps(trace).encode())
+        with pytest.raises(Exception, match="does not match|diverged"):
+            ok, detail = verify_learn_trace(path)
+            assert not ok  # pragma: no cover - either outcome rejects
+
+    def test_loop_phase_spans_and_gauges(self, tmp_path):
+        from k8s_llm_scheduler_tpu.observability import spans
+        from k8s_llm_scheduler_tpu.observability.metrics import _flatten
+
+        recorder = spans.FlightRecorder(8)
+        prior = spans.flight
+        spans.configure(enabled=True)
+        spans.flight = recorder
+        try:
+            loop, _, _ = make_loop(tmp_path)
+            loop.run_cycle(tmp_path / "work")
+        finally:
+            spans.flight = prior
+        lines = [json.loads(l) for l in
+                 recorder.export_jsonl().splitlines()]
+        cycle = [t for t in lines if t["name"] == "learn_cycle"]
+        assert cycle, "no learn_cycle trace recorded"
+        names = {s["name"] for s in cycle[0]["spans"]}
+        assert {"learn.mine", "learn.build", "learn.finetune",
+                "learn.publish", "learn.gate", "learn.swap"} <= names
+        flat = _flatten({"learn": loop.stats()})
+        assert flat["learn_promotions"] == 1.0
+        assert flat["learn_cycles"] == 1.0
+        assert "learn_incidents_mined" in flat
+
+    def test_weakness_report_scores_against_teacher(self, tmp_path):
+        loop, _, corpus = make_loop(tmp_path)
+        sources = loop.mine_sources()
+        record = corpus.add_version(sources, checkpoint_version=1)
+        cases = incident_cases(record)[:12]
+        perfect = weakness_report(teacher_decide, cases)
+        bad = weakness_report(anti_teacher, cases)
+        assert perfect["score"] == 1.0
+        assert bad["score"] < perfect["score"]
+        assert sum(v["n"] for v in perfect["per_class"].values()) == \
+            perfect["n_cases"]
+
+
+# --------------------------------------------------------- retention pinning
+class TestRetentionPinning:
+    def _registry_with(self, tmp_path, n):
+        registry = CheckpointRegistry(tmp_path / "reg")
+        for i in range(n):
+            src = tmp_path / f"src-{i}"
+            src.mkdir()
+            (src / "w.bin").write_bytes(bytes([i]) * 32)
+            registry.publish(src, note=f"v{i + 1}")
+        return registry
+
+    def test_pinned_versions_survive_retention(self, tmp_path):
+        registry = self._registry_with(tmp_path, 5)
+        registry.set_active(5)
+        deleted = registry.retain(1, pinned={2, 3})
+        assert deleted == [1, 4]
+        assert registry.versions() == [2, 3, 5]
+
+    def test_corpus_lineage_pins_checkpoints(self, tmp_path):
+        """The regression this PR fixes: keep-last retention evicted
+        checkpoints still referenced as incident-corpus lineage."""
+        registry = self._registry_with(tmp_path, 4)
+        registry.set_active(4)
+        corpus = IncidentCorpus(tmp_path / "corpus")
+        src = mine_scenario(
+            learn_cfg().mine_specs()[0],
+            decide_policy_arm("llm", anti_teacher),
+        )
+        corpus.add_version([src], checkpoint_version=2)
+        deleted = registry.retain(1, pinned=corpus.lineage_versions())
+        assert 2 not in deleted
+        assert 2 in registry.versions()
+        # without the pin the same walk would have evicted v2
+        assert 1 in deleted and 3 in deleted
+
+    def test_open_canary_candidate_is_pinned(self, tmp_path):
+        from k8s_llm_scheduler_tpu.rollout import CanaryController
+
+        registry = self._registry_with(tmp_path, 5)
+        registry.set_active(2)
+
+        class Swapper:
+            def swap_to(self, version):
+                return {"pause_s": 0.0}
+
+        controller = CanaryController(
+            registry, Swapper(),
+            stats_provider=lambda: {
+                "llm_decisions": 0, "cache_decisions": 0,
+                "fallback_decisions": 0, "failed_bindings": 0,
+                "client": {},
+            },
+            gate_runner=lambda v: {"pass": True, "checks": {},
+                                   "candidate": {}},
+            burn_in_decisions=100,
+        )
+        assert controller.pinned_versions() == set()
+        controller.consider(3)  # promote v3, burn-in opens
+        assert controller.pinned_versions() == {2, 3}
+        deleted = registry.retain(1, pinned=controller.pinned_versions())
+        # v3 (open candidate, active) and v2 (rollback target) survive
+        assert registry.versions() == [2, 3, 5]
+        assert deleted == [1, 4]
+
+
+# ------------------------------------------------------------ taxonomy drift
+class TestTaxonomyDrift:
+    """One source of truth for the scenario-class taxonomy: train/eval
+    defines it, sim/scenarios + the miner consume it, and any one-sided
+    addition must fail loudly here."""
+
+    def test_class_dimension_map_covers_exactly_the_taxonomy(self):
+        from k8s_llm_scheduler_tpu.train.eval import (
+            CLASS_DIMENSION,
+            SCENARIO_CLASSES,
+        )
+
+        assert set(CLASS_DIMENSION) == set(SCENARIO_CLASSES)
+
+    def test_sample_pod_constraints_rejects_unknown_class(self):
+        from k8s_llm_scheduler_tpu.train.eval import sample_pod_constraints
+
+        rng = np.random.default_rng(0)
+        with pytest.raises(ValueError, match="unknown scenario class"):
+            sample_pod_constraints("priority-inversion", rng)
+
+    def test_sim_generator_rejects_unknown_class(self):
+        from k8s_llm_scheduler_tpu.sim import ScenarioSpec, generate_scenario
+
+        with pytest.raises(ValueError, match="unknown constraint class"):
+            generate_scenario(
+                ScenarioSpec(constraint_mix=("priority-inversion",))
+            )
+
+    def test_every_class_generates_on_both_sides(self):
+        """Each taxonomy class must (a) generate through sim/scenarios
+        with pods tagged by that class and (b) yield eval cases whose
+        constraint DIMENSION (CLASS_DIMENSION) is actually populated —
+        a dead class on either side is drift."""
+        from k8s_llm_scheduler_tpu.sim import ScenarioSpec, generate_scenario
+        from k8s_llm_scheduler_tpu.train.eval import (
+            CLASS_DIMENSION,
+            SCENARIO_CLASSES,
+            scenario_cases,
+        )
+
+        for kind in SCENARIO_CLASSES:
+            scenario = generate_scenario(ScenarioSpec(
+                seed=1, n_nodes=6, n_pods=12, shapes=4,
+                constraint_mix=(kind,), taint_frac=0.3,
+            ))
+            kinds = {p.kind for wave in scenario.waves for p in wave}
+            assert kinds == {kind}
+
+            dim = CLASS_DIMENSION[kind]
+            if dim is None:
+                continue
+            populated = False
+            cases = scenario_cases(kind, seed=2)
+            for _ in range(40):
+                pod, _nodes = next(cases)
+                if getattr(pod, dim):
+                    populated = True
+                    break
+            assert populated, f"class {kind!r} never populates {dim}"
+
+    def test_sim_pods_only_carry_known_classes(self):
+        from k8s_llm_scheduler_tpu.sim import ScenarioSpec, generate_scenario
+        from k8s_llm_scheduler_tpu.train.eval import SCENARIO_CLASSES
+
+        scenario = generate_scenario(ScenarioSpec(
+            seed=3, n_nodes=6, n_pods=24, shapes=6,
+            constraint_mix=SCENARIO_CLASSES,
+        ))
+        for wave in scenario.waves:
+            for pod in wave:
+                assert pod.kind in SCENARIO_CLASSES
+
+
+# ------------------------------------------------- replay-fraction (slow)
+@pytest.mark.slow
+class TestReplayFractionRegression:
+    def test_single_class_finetune_does_not_degrade_other_classes(
+        self, tmp_path
+    ):
+        """The replay fraction's contract: finetuning on a ONE-class
+        corpus (selector only) with base-distribution replay must not
+        degrade the per-class agreement table (train/eval machinery) on
+        the classes it never trained — the catastrophic-forgetting guard
+        the learn loop's base-arena gate backstops at full scale."""
+        import jax
+        import jax.numpy as jnp
+
+        from k8s_llm_scheduler_tpu.engine.tokenizer import (
+            build_builtin_tokenizer,
+        )
+        from k8s_llm_scheduler_tpu.learn import finetune_on_corpus
+        from k8s_llm_scheduler_tpu.models.configs import LlamaConfig
+        from k8s_llm_scheduler_tpu.models.llama import init_params
+        from k8s_llm_scheduler_tpu.models.loader import restore_checkpoint
+        from k8s_llm_scheduler_tpu.train.distill import make_agreement_probe
+        from k8s_llm_scheduler_tpu.train.eval import scenario_cases
+
+        base = LlamaConfig(
+            name="learn-reg", vocab_size=512, d_model=64, n_layers=2,
+            n_heads=2, n_kv_heads=1, d_ff=128, max_seq_len=4096,
+            rope_theta=10000.0, dtype=jnp.float32, tie_embeddings=True,
+        )
+        tok, cfg = build_builtin_tokenizer("byte", base)
+        lc = learn_cfg(
+            seed=1, mine_seeds=(1, 2), mine_nodes=5,
+            constraint_mix=("selector",),
+        )
+        sources = [
+            mine_scenario(spec, decide_policy_arm("llm", anti_teacher))
+            for spec in lc.mine_specs()
+        ]
+        corpus = IncidentCorpus(tmp_path / "c")
+        record = corpus.add_version(sources, checkpoint_version=1)
+        assert set(record["per_class"]) == {"selector"}
+
+        # per-class agreement probes over the SHARED taxonomy's held-out
+        # case streams (train/eval.scenario_cases), teacher-forced
+        probes = {
+            kind: make_agreement_probe(
+                cfg, tok, n_cases=24, seq_len=1024,
+                cases=scenario_cases(kind, n_nodes=4, seed=777),
+            )
+            for kind in ("selector", "uniform")
+        }
+        init = init_params(jax.random.PRNGKey(1), cfg)
+        pre = {kind: probe(init) for kind, probe in probes.items()}
+        loss = finetune_on_corpus(
+            base, "byte", record, str(tmp_path / "out"),
+            steps=120, batch_size=4, seq_len=1024, lr=1e-3,
+            replay_fraction=0.5, seed=1,
+        )
+        assert loss == loss and loss < 10.0  # finite, actually trained
+        params = restore_checkpoint(str(tmp_path / "out"), cfg)
+        post = {kind: probe(params) for kind, probe in probes.items()}
+        # the trained class must not degrade...
+        assert post["selector"] >= pre["selector"] - 0.1, (pre, post)
+        # ...and neither may the class the corpus never contained — the
+        # replay fraction exists to make this hold
+        assert post["uniform"] >= pre["uniform"] - 0.1, (pre, post)
+
+
+# ---------------------------------------------------------------- cli learn
+class TestCliLearn:
+    def _stub_env(self, tmp_path, monkeypatch):
+        monkeypatch.chdir(tmp_path)  # no config.yaml
+        monkeypatch.setenv("LLM_BACKEND", "stub")
+        monkeypatch.setenv("LEARN_CORPUS_DIR", str(tmp_path / "corpus"))
+        monkeypatch.delenv("ROLLOUT_REGISTRY_DIR", raising=False)
+
+    def test_mine_build_status_round_trip(self, tmp_path, capsys,
+                                          monkeypatch):
+        from k8s_llm_scheduler_tpu.cli import main
+
+        self._stub_env(tmp_path, monkeypatch)
+        rc = main([
+            "learn", "mine", "--seeds", "3", "--note", "smoke",
+        ])
+        assert rc == 0
+        mined = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert mined["metric"] == "learn_mine"
+        assert mined["corpus_version"] == 1
+        assert mined["n_incidents"] > 0
+
+        assert main(["learn", "build"]) == 0
+        built = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert built["metric"] == "learn_build"
+        assert built["incident_cases"] > 0
+
+        assert main(["learn", "status"]) == 0
+        status = json.loads(capsys.readouterr().out)
+        assert [v["version"] for v in status["versions"]] == [1]
+
+    def test_replay_verifies_recorded_trace(self, tmp_path, capsys,
+                                            monkeypatch):
+        from k8s_llm_scheduler_tpu.cli import main
+
+        loop, _, _ = make_loop(tmp_path)
+        report = loop.run_cycle(tmp_path / "work")
+        trace = tmp_path / "learn.trace"
+        save_learn_trace(report, trace)
+        monkeypatch.chdir(tmp_path)
+        assert main(["learn", "replay", str(trace)]) == 0
+        out = json.loads(capsys.readouterr().out)
+        assert out["ok"] is True
+
+    def test_missing_corpus_is_a_clear_error(self, tmp_path, monkeypatch):
+        from k8s_llm_scheduler_tpu.cli import main
+
+        monkeypatch.chdir(tmp_path)
+        monkeypatch.delenv("LEARN_CORPUS_DIR", raising=False)
+        with pytest.raises(SystemExit, match="corpus"):
+            main(["learn", "status"])

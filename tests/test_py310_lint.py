@@ -65,6 +65,13 @@ class TestRepoIsClean:
         assert "k8s_llm_scheduler_tpu/chaos/harness.py" in files
         assert "k8s_llm_scheduler_tpu/sched/deadline.py" in files
         assert "tests/test_chaos_plane.py" in files
+        # learn round: the policy-improvement loop (miner/curriculum/loop
+        # drive asyncio arena runs and thread-adjacent registry code —
+        # same risk class as rollout/)
+        assert "k8s_llm_scheduler_tpu/learn/miner.py" in files
+        assert "k8s_llm_scheduler_tpu/learn/curriculum.py" in files
+        assert "k8s_llm_scheduler_tpu/learn/loop.py" in files
+        assert "tests/test_learn.py" in files
         # the lint never lints its own pattern table
         assert "tools/py310_lint.py" not in files
 

@@ -431,6 +431,7 @@ class TestDistill:
 
     def test_train_and_save_then_serve(self, tmp_path):
         from k8s_llm_scheduler_tpu.engine.local import build_local_backend
+        from k8s_llm_scheduler_tpu.rollout import CheckpointRegistry
         from k8s_llm_scheduler_tpu.train.distill import train_and_save
 
         cfg = LlamaConfig(
@@ -439,8 +440,26 @@ class TestDistill:
             rope_theta=10000.0, dtype=jnp.float32, tie_embeddings=True,
         )
         out = str(tmp_path / "ckpt")
-        loss = train_and_save(cfg, out, steps=2, batch_size=2, seq_len=512)
+        loss = train_and_save(
+            cfg, out, steps=2, batch_size=2, seq_len=512,
+            registry_dir=str(tmp_path / "registry"),
+        )
         assert loss == loss  # finite
+        # provenance satellite: the checkpoint entered the registry with
+        # the WIDENED serving config's fingerprint + train scores (the
+        # same fingerprint a HotSwapper serving this tokenizer checks)
+        registry = CheckpointRegistry(tmp_path / "registry")
+        manifest = registry.get(1)
+        assert manifest.files  # the orbax dir was copied in
+        assert manifest.scores["train"]["steps"] == 2
+        assert manifest.tokenizer == "byte"
+        from k8s_llm_scheduler_tpu.engine.tokenizer import (
+            build_builtin_tokenizer,
+        )
+        from k8s_llm_scheduler_tpu.rollout import config_fingerprint
+
+        _tok, widened = build_builtin_tokenizer("byte", cfg)
+        assert manifest.config_fingerprint == config_fingerprint(widened)
         backend = build_local_backend(
             cfg=cfg, checkpoint_path=out, max_slots=2, num_pages=32,
             page_size=64, prefill_buckets=(512, 1024, 2048),
