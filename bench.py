@@ -177,6 +177,17 @@ PRESETS = {
     # arena, and that the cycle's trace replays byte-identically.
     # pods/nodes here size the MINING scenarios.
     "learn": {"pods": 36, "nodes": 6, "shapes": 6, "rounds": 1},
+    # delta-prefill admission plane (engine/admission/ + sched/delta.py):
+    # burst1000-shaped rounds where every round DRIFTS node usage first
+    # (the production shape — binds mutate state between bursts), A/B'd
+    # delta-encoded vs whole-prompt prompts on the real engine, plus one
+    # steady (arrival-paced) round for the burst-vs-steady ratio, plus a
+    # token-count-exact sublinearity table across 256 -> 10k-node
+    # snapshots. Goal: burst p50 within ~1.5x of steady p50, and delta
+    # prefill tokens/decision flat in node count while whole-prompt grows
+    # linearly.
+    "burst": {"pods": 1000, "nodes": 64, "shapes": 32, "rounds": 2,
+              "perturb_idle": 0.5},
 }
 
 
@@ -233,7 +244,7 @@ BPE_FIXTURE = str(
 )
 
 
-def build_backend(args):
+def build_backend(args, delta_prompts: bool = False):
     from k8s_llm_scheduler_tpu.engine.local import build_local_backend
 
     cfg = build_cfg(args.model)
@@ -259,6 +270,7 @@ def build_backend(args):
         temperature=args.temperature,
         max_new_tokens=args.max_new_tokens,
         quantize=getattr(args, "quantize", None),
+        delta_prompts=delta_prompts,
         # repo-local persistent compile cache: the bench re-runs every
         # round; geometries compiled in ANY earlier run load in ~100ms
         compile_cache_dir=str(Path(__file__).resolve().parent / ".xla_cache"),
@@ -437,6 +449,144 @@ async def bench_preset(args, backend=None) -> dict:
             "preset": args.preset,
             "prefix_prewarm_s": float(getattr(args, "prefix_prewarm", 0.25)),
             "baseline_note": "reference publishes no numbers; target p50<200ms (BASELINE.md)",
+        },
+    }
+
+
+# --------------------------------------------------------- delta admission
+def _snapshot_token_table(node_counts, drift_nodes: int = 8,
+                          decisions_per_burst: int = 32) -> list[dict]:
+    """Prefill tokens per decision, delta-encoded vs whole-prompt, across
+    synthetic snapshot sizes — TOKEN-COUNT-EXACT (tokenizer-level, no
+    model): the figure is a property of the encoding, and counting it
+    directly is both honest and fast enough to include 10k nodes.
+
+    `drift_nodes` is FIXED across cluster sizes on purpose: between two
+    bursts, the nodes that changed are the ones binds touched — a
+    property of the burst, not of the cluster. That is exactly why the
+    delta path is sublinear: its prefill cost follows the drift while the
+    whole-prompt render follows the cluster."""
+    import dataclasses as _dc
+
+    from k8s_llm_scheduler_tpu.engine.tokenizer import HFTokenizerAdapter
+    from k8s_llm_scheduler_tpu.sched.delta import SnapshotDeltaEncoder
+    from k8s_llm_scheduler_tpu.testing import synthetic_cluster
+
+    tok = HFTokenizerAdapter(BPE_FIXTURE)
+    rows = []
+    for n in node_counts:
+        nodes = list(synthetic_cluster(n).get_node_metrics())
+        drifted = list(nodes)
+        for i in range(min(drift_nodes, n)):
+            j = (i * 29) % n  # deterministic spread over the cluster
+            drifted[j] = _dc.replace(
+                drifted[j],
+                cpu_usage_percent=(drifted[j].cpu_usage_percent + 13.0) % 95.0,
+                memory_usage_percent=(drifted[j].memory_usage_percent + 7.0) % 95.0,
+            )
+        enc = SnapshotDeltaEncoder(repin_fraction=1.1)  # never re-pin here
+        pin = enc.encode(nodes)          # burst 1 pins the snapshot
+        dp = enc.encode(drifted)         # burst 2 rides the delta
+        assert not dp.repinned and dp.delta_nodes > 0
+        whole_tokens = len(tok.encode(dp.pin_text))
+        delta_tokens = len(tok.encode(dp.cluster_part)) - whole_tokens
+        rows.append({
+            "nodes": n,
+            "whole_prefix_tokens": whole_tokens,
+            "delta_prefix_tokens": delta_tokens,
+            "whole_tokens_per_decision": round(
+                whole_tokens / decisions_per_burst, 1
+            ),
+            "delta_tokens_per_decision": round(
+                delta_tokens / decisions_per_burst, 1
+            ),
+        })
+        del pin
+    return rows
+
+
+async def burst_bench(args) -> dict:
+    """`--preset burst`: the delta-prefill admission plane under a
+    burst1000-shaped arrival.
+
+    Three measurements in one report:
+    - REAL-ENGINE burst rounds with drift before every round
+      (perturb_idle — binds mutate state between bursts) through the
+      delta-encoded prompt path, and the same rounds whole-prompt, with
+      measured prefill tokens/decision from the engine's own books
+      (prefix prefills count only non-reused tokens);
+    - one STEADY (arrival-paced) round on the delta backend — the
+      burst-vs-steady p50 ratio is the headline (bar: within ~1.5x);
+    - the token-count-exact sublinearity table across 256 -> 10k-node
+      snapshots (fixed drift — see _snapshot_token_table)."""
+    table = _snapshot_token_table((256, 1024, 4096, 10000))
+
+    def _tokens_per_decision(backend) -> float | None:
+        stats = backend.get_stats()
+        return stats.get("prefill_tokens_per_decision")
+
+    # delta arm: drifted bursts + one steady round
+    backend = build_backend(args, delta_prompts=True)
+    try:
+        burst_delta = await bench_preset(args, backend=backend)
+        delta_tpd = _tokens_per_decision(backend)
+        delta_stats = {
+            k: v for k, v in backend.get_stats().items()
+            if k in ("delta", "pins", "prefix_reused_tokens",
+                     "packed_admissions")
+        }
+        steady_args = argparse.Namespace(**vars(args))
+        steady_args.arrival_rate = 100.0
+        steady_args.perturb_idle = 0.0
+        steady_args.pods = min(args.pods, 256)
+        steady_args.rounds = 1
+        steady = await bench_preset(steady_args, backend=backend)
+    finally:
+        backend.close()
+
+    # whole-prompt arm: identical drifted bursts, no delta encoding
+    backend = build_backend(args, delta_prompts=False)
+    try:
+        burst_whole = await bench_preset(args, backend=backend)
+        whole_tpd = _tokens_per_decision(backend)
+    finally:
+        backend.close()
+
+    burst_p50 = burst_delta["value"]
+    steady_p50 = steady["value"]
+    ratio = round(burst_p50 / steady_p50, 3) if steady_p50 else None
+    return {
+        "metric": "burst_p50_over_steady_p50",
+        "value": ratio,
+        "unit": "ratio",
+        "extra": {
+            "model": args.model,
+            "weights": "random-init",
+            "pods": args.pods,
+            "nodes": args.nodes,
+            "shapes": args.shapes,
+            "bar": "burst p50 within ~1.5x of steady p50",
+            "bar_met": bool(ratio is not None and ratio <= 1.5),
+            "burst_p50_ms": burst_p50,
+            "steady_p50_ms": steady_p50,
+            "burst_delta": burst_delta["extra"],
+            "burst_whole_prompt": {
+                "p50_ms": burst_whole["value"],
+                **{k: burst_whole["extra"][k] for k in
+                   ("p99_ms", "p50_cold_ms", "pods_per_sec")},
+            },
+            # measured on the engine's own books (non-reused tokens only)
+            "prefill_tokens_per_decision": {
+                "delta": delta_tpd,
+                "whole_prompt": whole_tpd,
+            },
+            "delta_stats": delta_stats,
+            # token-count-exact sublinearity across snapshot sizes
+            "snapshot_scaling": table,
+            "baseline_note": (
+                "delta prefill tokens/decision must stay ~flat in node "
+                "count while whole-prompt grows linearly (ROADMAP item 2)"
+            ),
         },
     }
 
@@ -768,6 +918,10 @@ def arena_bench(args) -> dict:
     try:
         report = run_arena(scenario, arms, wave_timeout_s=600.0)
     finally:
+        # prefill tokens per finished decision (admission-plane headline;
+        # prefix prefills count only non-reused tokens) — read before the
+        # backend is torn down, off the engine's own books
+        prefill_tpd = backend.get_stats().get("prefill_tokens_per_decision")
         backend.close()
     if getattr(args, "trace", None):
         save_trace(report, args.trace)
@@ -780,6 +934,7 @@ def arena_bench(args) -> dict:
         "extra": {
             "model": args.model,
             "weights": "random-init",
+            "prefill_tokens_per_decision": prefill_tpd,
             "seed": spec.seed,
             "pods": spec.n_pods,
             "nodes": spec.n_nodes,
@@ -1236,6 +1391,14 @@ async def fleet_bench(args) -> dict:
             "speedup_4v1": speedup_4v1,
             "speedup_16v1": round(d16 / d1, 2),
             "meets_bar_4v1_ge_2.5x": speedup_4v1 >= 2.5,
+            # the fleet rounds run on sim decision services (no engine),
+            # so prefill tokens/decision is reported token-count-exact at
+            # this preset's node count: what the delta-encoded admission
+            # plane pays vs a whole-prompt render (see --preset burst for
+            # the measured engine-side figure)
+            "prefill_tokens_per_decision": _snapshot_token_table(
+                (args.nodes,)
+            )[0],
         },
     }
 
@@ -1841,6 +2004,9 @@ def main() -> None:
         return
     if args.preset == "learn":
         _emit(learn_bench(args))
+        return
+    if args.preset == "burst":
+        _emit(asyncio.run(burst_bench(args)))
         return
     result = asyncio.run(bench_preset(args))
     result["extra"]["dispatch_rtt_ms"] = measure_dispatch_rtt_ms()

@@ -65,6 +65,12 @@ def _backend_kwargs(cfg: Config, **overrides) -> dict:
         spec_disable_threshold=float(
             cfg.get("llm.spec_disable_threshold", 0.3)
         ),
+        # delta-prefill admission plane (engine/admission/, sched/delta.py)
+        packed_admission=bool(cfg.get("admission.packed", True)),
+        admission_chunk_tokens=int(cfg.get("admission.chunk_tokens", 256)),
+        delta_prompts=bool(cfg.get("admission.delta_prompts", True)),
+        repin_fraction=float(cfg.get("admission.repin_fraction", 0.25)),
+        max_pins=int(cfg.get("admission.max_pins", 4)),
     )
     if cfg.get("distributed.enabled"):
         # Multi-host: after jax.distributed.initialize, jax.devices() is

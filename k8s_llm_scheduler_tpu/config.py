@@ -108,6 +108,27 @@ DEFAULTS: dict[str, Any] = {
         # disables) — utils/compile_cache.py
         "compile_cache_dir": "auto",
     },
+    # Delta-prefill admission plane (engine/admission/ + sched/delta.py):
+    # packed chunked admission for batch surfaces, and snapshot-delta
+    # prompt encoding over pinned prefix KV so prefill cost scales with
+    # what changed since the pinned snapshot, not cluster size.
+    "admission": {
+        # route decide_batch admission through packed block-diagonal
+        # chunked prefill (engine.admit_packed) instead of wave rows
+        "packed": True,
+        # fixed token width of one packed prefill chunk; in-flight decode
+        # piggybacks between chunks (SARATHI)
+        "chunk_tokens": 256,
+        # render cluster prefixes as pinned snapshot + drift diff
+        # (sched/delta.SnapshotDeltaEncoder); False = whole-prompt render
+        "delta_prompts": True,
+        # re-pin when more than this fraction of nodes drifted (the delta
+        # section is approaching the cost of a fresh render)
+        "repin_fraction": 0.25,
+        # pinned snapshot prefixes kept resident engine-side (eviction-
+        # exempt; LRU beyond this)
+        "max_pins": 4,
+    },
     "cache": {
         "enabled": True,
         "ttl_seconds": 300,  # config.yaml:19
@@ -319,6 +340,11 @@ ENV_OVERRIDES: dict[str, str] = {
     "SPEC_DRAFT_CHECKPOINT": "llm.spec_draft_checkpoint",
     "SPEC_DISABLE_THRESHOLD": "llm.spec_disable_threshold",
     "MAX_RETRIES": "llm.max_retries",
+    "ADMISSION_PACKED": "admission.packed",
+    "ADMISSION_CHUNK_TOKENS": "admission.chunk_tokens",
+    "ADMISSION_DELTA_PROMPTS": "admission.delta_prompts",
+    "ADMISSION_REPIN_FRACTION": "admission.repin_fraction",
+    "ADMISSION_MAX_PINS": "admission.max_pins",
     "CACHE_ENABLED": "cache.enabled",
     "CACHE_TTL": "cache.ttl_seconds",
     "CACHE_MAX_SIZE": "cache.max_size",

@@ -1,0 +1,94 @@
+"""The fused admission-chunk device program.
+
+One dispatch per pack chunk: packed block-diagonal prefill
+(models/llama.forward_prefill_packed) + per-token KV page scatter +
+first-token sampling for every prompt that COMPLETES in this chunk, with
+the sampled state scattered straight into the engine's per-slot decode
+state — the packed analogue of engine/engine._admit_impl. The engine
+(InferenceEngine.admit_packed) dispatches these back-to-back and
+piggybacks in-flight decode chunks between them, so a burst's admission
+never stalls decode (the SARATHI discipline) and the host syncs exactly
+once, at the next step() harvest.
+
+Prompts that end mid-pack start decoding on the very next piggybacked
+decode chunk — continuous batching at chunk granularity rather than
+wave granularity.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from k8s_llm_scheduler_tpu.engine.engine import (
+    _sample_sparse,
+    _sample_unconstrained,
+)
+from k8s_llm_scheduler_tpu.models.llama import forward_prefill_packed
+
+
+def packed_admit_step(
+    params,
+    cfg,  # static
+    tokens,        # [C] packed chunk tokens
+    seg,           # [C] segment id per token (-1 padding)
+    positions,     # [C] ABSOLUTE positions (prefix_len + local)
+    prefix_k, prefix_v,  # [L, Sp, n_kv, hd] shared dense prefix KV
+    prefix_len,    # scalar int32
+    carry_k, carry_v,    # [L, CAP, n_kv, hd] pack carry (donated)
+    carry_seg,     # [CAP] (donated)
+    carry_len,     # scalar int32
+    k_cache, v_cache,    # donated
+    page_ids, offs,      # [C] per-token page-scatter destinations
+    end_idx,       # [E] chunk-local indices of prompt-final tokens
+    end_slots,     # [E] target slot per ending prompt (trash row M on pad)
+    end_valid,     # [E] bool — real entries
+    end_pos,       # [E] absolute position AFTER the prompt (prefix+len)
+    end_budgets,   # [E] decode budget for ending prompts (max_new - 1)
+    tok, pos, act, st, budget, first,  # donated per-slot state [M+1]
+    sp_tokens, sp_next, done_state, eos_id, pad_id,
+    dfa_start,     # scalar int32
+    rng, temperature,
+    constrained: bool,  # static
+    prefix_impl: str | None = None,  # static
+    vocab_limit: int | None = None,  # static
+):
+    """One packed admission chunk, one device program.
+
+    Ending prompts sample their first token from the chunk's end logits
+    and scatter (token, position, active, DFA state, budget) into their
+    slot's decode state exactly as _admit_impl does; padding end rows
+    land in the reserved trash row and never activate.
+    """
+    end_logits, carry_k, carry_v, carry_seg, k_cache, v_cache = (
+        forward_prefill_packed(
+            params, cfg, tokens, seg, positions,
+            prefix_k, prefix_v, prefix_len,
+            carry_k, carry_v, carry_seg, carry_len,
+            k_cache, v_cache, page_ids, offs, end_idx,
+            prefix_impl=prefix_impl,
+        )
+    )
+    E = end_idx.shape[0]
+    start_vec = jnp.full((E,), dfa_start, dtype=jnp.int32)
+    if constrained:
+        first_new, st_new = _sample_sparse(
+            end_logits, sp_tokens[start_vec], sp_next[start_vec],
+            rng, temperature,
+        )
+    else:
+        first_new = _sample_unconstrained(
+            end_logits, pad_id, rng, temperature, vocab_limit
+        )
+        st_new = start_vec
+    finished = (first_new == eos_id) | (st_new == done_state)
+
+    tok = tok.at[end_slots].set(first_new)
+    pos = pos.at[end_slots].set(end_pos)
+    act = act.at[end_slots].set(end_valid & ~finished)
+    st = st.at[end_slots].set(st_new)
+    budget = budget.at[end_slots].set(end_budgets)
+    first = first.at[end_slots].set(first_new)
+    return (
+        carry_k, carry_v, carry_seg, k_cache, v_cache,
+        tok, pos, act, st, budget, first,
+    )

@@ -501,6 +501,7 @@ class InferenceEngine:
         prefix_attn_impl: str | None = None,
         decode_matmul: str = "dense",  # "dense" | "ragged" (single device)
         mesh=None,  # jax.sharding.Mesh | None — set for multi-device serving
+        admission_chunk_tokens: int = 256,
     ) -> None:
         self.cfg = cfg
         self.params = params
@@ -669,6 +670,25 @@ class InferenceEngine:
         self._prefix: _PrefixKV | None = None
         self._prefix_cache: OrderedDict[tuple[int, ...], _PrefixKV] = OrderedDict()
         self._empty_prefix: _PrefixKV | None = None
+        # Pinned prefix entries (admission/pinned.PinnedPrefixManager):
+        # keys the byte-pressure evictor must skip — a pinned cluster
+        # snapshot's KV is the base every delta-encoded prompt LCP-seeds
+        # from, and evicting it between bursts re-pays the full cluster
+        # prefill the pin exists to amortize. `prefix_epoch` stamps pin
+        # handles: swap_params bumps it, so a pin taken under old weights
+        # can NEVER serve a post-swap decision (the manager checks
+        # pin_alive before trusting a handle).
+        self._pinned_prefix_keys: set[tuple[int, ...]] = set()
+        self.prefix_epoch = 0
+
+        # Packed chunked admission (engine/admission/): chunk width for
+        # the block-diagonal packed prefill; the jit is built lazily on
+        # first admit_packed (the impl module imports this one's sampling
+        # helpers). Piggybacked decode emissions dispatched between pack
+        # chunks park here until the next step() harvest syncs them.
+        self.admission_chunk_tokens = int(admission_chunk_tokens)
+        self._packed_admit = None
+        self._pending_emissions: list[jax.Array] = []
 
         # Speculative-decoding subsystem (spec/decoder.py), attached after
         # construction via attach_spec(): generate() routes through it when
@@ -722,6 +742,12 @@ class InferenceEngine:
             "wave_prewarm_failures": 0,
             "prefix_reused_tokens": 0,
             "weight_swaps": 0,
+            "packed_admissions": 0,
+            "packed_prompts": 0,
+            "pack_chunks": 0,
+            "piggyback_chunks": 0,
+            "pinned_prefixes": 0,
+            "pin_evictions": 0,
         }
 
     # ------------------------------------------------------------- grammar
@@ -806,15 +832,20 @@ class InferenceEngine:
         with spans.span("prefix_prefill", tokens=len(prompt_ids)) as _sp:
             self._set_prefix_inner(prompt_ids, _sp)
 
-    def _set_prefix_inner(self, prompt_ids: list[int], _sp) -> None:
+    def _set_prefix_inner(
+        self, prompt_ids: list[int], _sp, activate: bool = True
+    ) -> None:
         key = tuple(prompt_ids)
         cached = self._prefix_cache.get(key)
         if cached is not None:
             self._prefix_cache.move_to_end(key)
-            self._prefix = cached
+            if activate:
+                self._prefix = cached
             self.stats["prefix_hits"] += 1
             if _sp is not None:
                 _sp.attrs["cached"] = True
+            if self.profiler is not None:
+                self.profiler.note_prefix_prefill(0, cached.length)
             return
         n = len(prompt_ids)
         if n > self.cfg.max_seq_len:
@@ -850,12 +881,73 @@ class InferenceEngine:
             return int(p.k.nbytes) + int(p.v.nbytes)
 
         total = sum(nbytes(p) for p in self._prefix_cache.values())
-        while total > self.PREFIX_CACHE_BYTES and len(self._prefix_cache) > 1:
-            _, evicted = self._prefix_cache.popitem(last=False)
-            total -= nbytes(evicted)
-        self._prefix = pfx
+        if total > self.PREFIX_CACHE_BYTES and len(self._prefix_cache) > 1:
+            # Oldest-first, but PINNED entries are skipped: a pinned
+            # snapshot's KV is what every delta-encoded prompt LCP-seeds
+            # from; evicting it between bursts re-pays the full cluster
+            # prefill. If pins alone exceed the budget they are kept —
+            # holding those bytes is exactly what pinning means
+            # (PinnedPrefixManager bounds the pin count).
+            for k in list(self._prefix_cache):
+                if total <= self.PREFIX_CACHE_BYTES or len(self._prefix_cache) <= 1:
+                    break
+                if k == key or k in self._pinned_prefix_keys:
+                    continue
+                evicted = self._prefix_cache.pop(k)
+                total -= nbytes(evicted)
+        if activate:
+            self._prefix = pfx
         self.stats["prefix_prefills"] += 1
         self.stats["prefill_tokens"] += prefilled
+        if self.profiler is not None:
+            self.profiler.note_prefix_prefill(prefilled, n)
+
+    def pin_prefix(self, prompt_ids: list[int]) -> tuple[tuple[int, ...], int]:
+        """Prefill (or cache-hit) `prompt_ids` as a PINNED prefix-cache
+        entry WITHOUT making it the engine's active prefix.
+
+        The pin is the delta-encoding anchor: a pinned cluster-snapshot
+        prefix stays resident on device across bursts, exempt from
+        byte-pressure eviction, so every later delta-extended prompt
+        LCP-seeds from it and prefills only its delta tail
+        (_best_lcp_seed / _prefill_prefix_chunked). Engine-owner thread
+        only, like every dispatch path — but safe with requests in
+        flight (the active prefix pointer is untouched).
+
+        Returns (cache key, prefix_epoch). The epoch stamps the pin's
+        weight generation: swap_params bumps it and clears the pin set,
+        so callers must re-check pin_alive() before trusting a handle.
+        """
+        if not prompt_ids:
+            raise ValueError("cannot pin an empty prefix")
+        key = tuple(prompt_ids)
+        with spans.span("prefix_prefill", tokens=len(prompt_ids), pin=True) as _sp:
+            self._set_prefix_inner(prompt_ids, _sp, activate=False)
+        if key not in self._pinned_prefix_keys:
+            self._pinned_prefix_keys.add(key)
+            self.stats["pinned_prefixes"] = (
+                self.stats.get("pinned_prefixes", 0) + 1
+            )
+        return key, self.prefix_epoch
+
+    def unpin_prefix(self, key: tuple[int, ...]) -> None:
+        """Release a pin (the entry becomes ordinary-evictable; its KV
+        stays cached until byte pressure claims it)."""
+        if key in self._pinned_prefix_keys:
+            self._pinned_prefix_keys.discard(key)
+            self.stats["pin_evictions"] = (
+                self.stats.get("pin_evictions", 0) + 1
+            )
+
+    def pin_alive(self, key: tuple[int, ...], epoch: int) -> bool:
+        """True iff the pin still serves: taken under the CURRENT weights
+        (epoch matches — a hot swap bumps prefix_epoch) and its KV entry
+        is still resident and pinned."""
+        return (
+            epoch == self.prefix_epoch
+            and key in self._pinned_prefix_keys
+            and key in self._prefix_cache
+        )
 
     def _best_lcp_seed(
         self, key: tuple[int, ...]
@@ -1119,6 +1211,223 @@ class InferenceEngine:
         self.stats["requests"] += len(reqs)
         self.stats["prefills"] += 1
         self.stats["prefill_tokens"] += int(suffix_lens.sum())
+        return [r.req_id for r in reqs]
+
+    # -------------------------------------------------- packed admission
+    def admit_packed(
+        self,
+        prompts: list[list[int]],
+        max_new_tokens: int = 200,
+        piggyback_decode: bool = True,
+    ) -> list[int]:
+        """Admit a batch via the ADMISSION PLANE: packed chunked prefill.
+
+        Where add_requests pads every prompt to one shared bucket (R x
+        bucket prefill compute for maybe a fifth that many real tokens),
+        this packs the prompts into ONE token stream cut into fixed
+        `admission_chunk_tokens` chunks with block-diagonal attention
+        (engine/admission/packer.py + models/llama.forward_prefill_packed)
+        — prefill compute scales with the REAL token count. Between
+        chunks, in-flight decode slots advance by one fused decode chunk
+        (SARATHI piggybacking): a long admission burst never stalls
+        decode for its whole prefill, and prompts that complete mid-pack
+        start decoding on the very next piggybacked chunk. Everything
+        dispatches without a host sync; the next step() harvests.
+
+        Decoding is token-identical to admitting the same prompts via
+        add_requests or serially via generate() under greedy decoding —
+        the block-diagonal mask computes exactly the serial attention
+        (test-pinned, tests/test_admission.py).
+        """
+        if not prompts:
+            return []
+        if any(not p for p in prompts):
+            raise ValueError("empty prompt")
+        if len(prompts) > self.free_slots:
+            raise RuntimeError(
+                f"no free slots for {len(prompts)} request(s) "
+                f"({self.free_slots} free) — backpressure the caller"
+            )
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        limit = self.max_suffix_tokens(max_new_tokens)
+        for ids in prompts:
+            if len(ids) > limit:
+                raise ValueError(
+                    f"prompt of {len(ids)} tokens exceeds the paged "
+                    f"admission limit {limit}"
+                )
+        prof = self.profiler
+        t0 = time.perf_counter() if prof is not None else 0.0
+        chunk_prefill_s = 0.0
+        piggyback_s = 0.0
+        prefix = self._prefix or self._get_empty_prefix()
+        self._prefix = prefix
+
+        from k8s_llm_scheduler_tpu.engine.admission.packer import pack_prompts
+
+        if self._packed_admit is None:
+            # Lazy: admission/chunked.py imports this module's sampling
+            # helpers, so the jit is built on first use instead of at
+            # import time (no cycle, no cost for engines that never pack).
+            from k8s_llm_scheduler_tpu.engine.admission.chunked import (
+                packed_admit_step,
+            )
+
+            self._packed_admit = jax.jit(
+                functools.partial(
+                    packed_admit_step,
+                    prefix_impl=self.prefix_attn_impl,
+                    vocab_limit=self._vocab_limit,
+                ),
+                static_argnums=(1, 35),
+                donate_argnums=(8, 9, 10, 12, 13, 21, 22, 23, 24, 25, 26),
+            )
+
+        C = self.admission_chunk_tokens
+        plan = pack_prompts(prompts, C, self.tokenizer.pad_id)
+        # Carry capacity buckets by powers of two over the chunk count so
+        # pack sizes share compiled variants (log2 many, not one per size).
+        cap_chunks = 1
+        while cap_chunks < plan.n_chunks:
+            cap_chunks *= 2
+        CAP = cap_chunks * C
+        E = self.max_slots  # ends-per-chunk bucket (a pack <= max_slots)
+        ps = self.kv.page_size
+        trash = self.max_slots
+
+        carry_k = jnp.zeros(
+            (self.cfg.n_layers, CAP, self.cfg.n_kv_heads, self.cfg.head_dim),
+            dtype=self.cfg.dtype,
+        )
+        carry_v = jnp.zeros_like(carry_k)
+        carry_seg = jnp.full((CAP,), -1, dtype=jnp.int32)
+
+        slots: list[int] = []
+        ended = 0
+        try:
+            slot_pages: list[list[int]] = []
+            for ids in prompts:
+                slot = self.kv.allocate_slot(
+                    len(ids), reserve_decode=max_new_tokens + 1
+                )
+                slots.append(slot)
+                slot_pages.append(self.kv.slot_pages(slot))
+            for ci, chunk in enumerate(plan.chunks):
+                page_ids = np.zeros(C, dtype=np.int32)
+                offs = np.zeros(C, dtype=np.int32)
+                for i in range(chunk.n_tokens):
+                    s = int(chunk.seg[i])
+                    p = int(chunk.positions[i])
+                    page_ids[i] = slot_pages[s][p // ps]
+                    offs[i] = p % ps
+                end_idx = np.zeros(E, dtype=np.int32)
+                end_slots = np.full(E, trash, dtype=np.int32)
+                end_valid = np.zeros(E, dtype=bool)
+                end_pos = np.zeros(E, dtype=np.int32)
+                end_budgets = np.zeros(E, dtype=np.int32)
+                for row, end in enumerate(chunk.ends):
+                    end_idx[row] = end.index
+                    end_slots[row] = slots[end.prompt]
+                    end_valid[row] = True
+                    end_pos[row] = prefix.length + plan.prompt_lens[end.prompt]
+                    end_budgets[row] = max_new_tokens - 1
+                positions = chunk.positions + np.int32(prefix.length)
+                self._rng, sub = jax.random.split(self._rng)
+                t_d = time.perf_counter() if prof is not None else 0.0
+                (
+                    carry_k, carry_v, carry_seg,
+                    self.kv.k, self.kv.v,
+                    self._tok_d, self._pos_d, self._act_d, self._st_d,
+                    self._budget_d, self._first_d,
+                ) = self._packed_admit(
+                    self.params, self.cfg,
+                    jnp.asarray(chunk.tokens), jnp.asarray(chunk.seg),
+                    jnp.asarray(positions),
+                    prefix.k, prefix.v, jnp.int32(prefix.length),
+                    carry_k, carry_v, carry_seg, jnp.int32(ci * C),  # graftlint: ok[jit-donated-reuse] — read and rebound by the SAME multi-line call statement (the tuple-unpack above); each iteration passes the previous dispatch's returned buffers
+                    self.kv.k, self.kv.v,
+                    jnp.asarray(page_ids), jnp.asarray(offs),
+                    jnp.asarray(end_idx), jnp.asarray(end_slots),
+                    jnp.asarray(end_valid), jnp.asarray(end_pos),
+                    jnp.asarray(end_budgets),
+                    self._tok_d, self._pos_d, self._act_d, self._st_d,
+                    self._budget_d, self._first_d,
+                    self._sp_tokens, self._sp_next, self._done_state,
+                    jnp.int32(self.tokenizer.eos_id),
+                    jnp.int32(self.tokenizer.pad_id),
+                    jnp.int32(self._dfa_start),
+                    sub, jnp.float32(self.temperature), self._constrained,
+                )
+                if prof is not None:
+                    chunk_prefill_s += time.perf_counter() - t_d
+                self.stats["pack_chunks"] += 1
+                ended += len(chunk.ends)
+                # SARATHI piggyback: between prefill chunks, every
+                # in-flight decode slot (earlier requests AND pack
+                # prompts that already completed) advances one fused
+                # decode chunk — dispatch only, still no host sync.
+                if piggyback_decode and ci + 1 < plan.n_chunks and (
+                    self._by_slot or ended
+                ):
+                    t_d = time.perf_counter() if prof is not None else 0.0
+                    self._pending_emissions.append(
+                        self._chunk_dispatch(prefix)
+                    )
+                    self.stats["piggyback_chunks"] += 1
+                    if prof is not None:
+                        piggyback_s += time.perf_counter() - t_d
+        except Exception:
+            # Roll back the allocation loop: these slots are not in
+            # _by_slot yet, so no later recovery path could free them.
+            # Device-side decode state must roll back WITH the pages: a
+            # prompt that ended in an already-dispatched chunk scattered
+            # act=True into its slot, and a ghost-active freed slot would
+            # decode garbage into whichever request reuses it next.
+            for s in slots:
+                self.kv.free_slot(s)
+            if slots:
+                idx = jnp.asarray(slots)
+                self._act_d = self._act_d.at[idx].set(False)
+                self._budget_d = self._budget_d.at[idx].set(0)
+                self._act_np[slots] = False
+                self._budget_np[slots] = 0
+            if not self._by_slot:
+                # No pre-existing requests: any piggybacked emissions
+                # belong to the failed pack's freed slots — a future
+                # request reusing a slot must never inherit them. (With
+                # requests in flight they stay: their decode genuinely
+                # advanced and the next step() harvests it.)
+                self._pending_emissions = []
+            raise
+        reqs: list[_Request] = []
+        for ids, slot in zip(prompts, slots):
+            req = _Request(
+                req_id=self._req_counter,
+                slot=slot,
+                prompt_len=len(ids),
+                max_new_tokens=max_new_tokens,
+            )
+            self._req_counter += 1
+            reqs.append(req)
+            self._by_slot[slot] = req
+            # Optimistic mirrors until the next sync tells the truth.
+            self._act_np[slot] = True
+            self._budget_np[slot] = max_new_tokens - 1
+        self.stats["requests"] += len(reqs)
+        self.stats["prefills"] += 1
+        self.stats["prefill_tokens"] += plan.total_tokens
+        self.stats["packed_admissions"] += 1
+        self.stats["packed_prompts"] += len(prompts)
+        if prof is not None:
+            prof.on_pack(
+                wall_s=time.perf_counter() - t0,
+                chunk_prefill_s=chunk_prefill_s,
+                piggyback_s=piggyback_s,
+                n_prompts=len(prompts),
+                tokens=plan.total_tokens,
+                chunks=plan.n_chunks,
+            )
         return [r.req_id for r in reqs]
 
     # ---------------------------------------------------------------- wave
@@ -1409,34 +1718,44 @@ class InferenceEngine:
                 sp.attrs["tokens"] = self.stats["decode_tokens"] - before
         return finished
 
+    def _chunk_dispatch(self, prefix: _PrefixKV) -> jax.Array:
+        """Dispatch ONE fused decode chunk (no host sync); returns the
+        device array of emitted tokens [M+1, chunk_steps]. Shared by
+        step() and the admission plane's piggybacked decode
+        (admit_packed), so both paths run the identical program."""
+        self._rng, sub = jax.random.split(self._rng)
+        (
+            self.kv.k, self.kv.v,
+            self._tok_d, self._pos_d, self._act_d, self._st_d,
+            self._budget_d, toks_d,
+        ) = self._chunk(
+            self.params, self.cfg, self.kv.k, self.kv.v,
+            self._padded_tables(),
+            prefix.k, prefix.v, jnp.int32(prefix.length),
+            self._tok_d, self._pos_d, self._act_d, self._st_d,
+            self._budget_d,
+            self._sp_tokens, self._sp_next, self._done_state,
+            jnp.int32(self.tokenizer.eos_id),
+            jnp.int32(self.tokenizer.pad_id),
+            sub, jnp.float32(self.temperature), self.chunk_steps,
+            self._constrained, self.paged_attn,
+        )
+        self.stats["chunks"] += 1
+        return toks_d
+
     def _step_inner(self, chunks: int) -> list[Finished]:
         prefix = self._prefix or self._get_empty_prefix()
-        n = self.chunk_steps
-        emissions: list[jax.Array] = []
+        # Emissions from decode chunks piggybacked during a packed
+        # admission (admit_packed) were dispatched without a sync; they
+        # harvest here, FIRST (chronological order per slot).
+        emissions: list[jax.Array] = list(self._pending_emissions)
+        self._pending_emissions = []
         any_active = bool(
             (self._act_np & (self._budget_np > 0))[list(self._by_slot)].any()
         )
         if any_active:
             for _ in range(max(1, chunks)):
-                self._rng, sub = jax.random.split(self._rng)
-                (
-                    self.kv.k, self.kv.v,
-                    self._tok_d, self._pos_d, self._act_d, self._st_d,
-                    self._budget_d, toks_d,
-                ) = self._chunk(
-                    self.params, self.cfg, self.kv.k, self.kv.v,
-                    self._padded_tables(),
-                    prefix.k, prefix.v, jnp.int32(prefix.length),
-                    self._tok_d, self._pos_d, self._act_d, self._st_d,
-                    self._budget_d,
-                    self._sp_tokens, self._sp_next, self._done_state,
-                    jnp.int32(self.tokenizer.eos_id),
-                    jnp.int32(self.tokenizer.pad_id),
-                    sub, jnp.float32(self.temperature), n, self._constrained,
-                    self.paged_attn,
-                )
-                emissions.append(toks_d)
-                self.stats["chunks"] += 1
+                emissions.append(self._chunk_dispatch(prefix))
 
         # ONE host sync for everything: emitted tokens + post-chunk state +
         # first tokens of freshly admitted requests.
@@ -1505,6 +1824,9 @@ class InferenceEngine:
         self._budget_np[:] = 0
         self._act_d = jnp.zeros(self.max_slots + 1, dtype=bool)
         self._budget_d = jnp.zeros(self.max_slots + 1, dtype=jnp.int32)
+        # Un-harvested piggybacked emissions belong to the aborted work;
+        # a later request reusing a slot must never inherit their tokens.
+        self._pending_emissions = []
 
     # ---------------------------------------------------------------- swap
     def swap_params(self, params: Params) -> Params:
@@ -1533,6 +1855,13 @@ class InferenceEngine:
         old = self.params
         self.params = params
         self._prefix_cache.clear()
+        # Pinned snapshot-prefix entries are invalidated WITH the cache:
+        # the pin set empties and the epoch bump makes every outstanding
+        # PinHandle stale (pin_alive -> False), so a pin taken under the
+        # old weights can never serve a post-swap decision — the
+        # admission-plane twin of the decision cache's generation bump.
+        self._pinned_prefix_keys.clear()
+        self.prefix_epoch += 1
         if self._by_slot:
             # keep the active prefix for in-flight paged decodes; it is
             # evicted from the cache so no FUTURE request reuses it

@@ -41,11 +41,12 @@ from collections.abc import Sequence
 from concurrent.futures import Future
 from concurrent.futures import TimeoutError as FuturesTimeout
 from pathlib import Path
+from types import SimpleNamespace
 from typing import Any
 
 import jax
 
-from k8s_llm_scheduler_tpu.core.prompt import PromptEngine
+from k8s_llm_scheduler_tpu.core.prompt import PromptEngine, pod_suffix
 from k8s_llm_scheduler_tpu.core.validation import feasible_nodes
 from k8s_llm_scheduler_tpu.observability import spans
 from k8s_llm_scheduler_tpu.engine.backend import BackendError, NoFeasibleNodeError
@@ -74,7 +75,7 @@ logger = logging.getLogger(__name__)
 class _WorkItem:
     __slots__ = (
         "prefix_ids", "suffix_ids", "group_key", "future", "enqueued_at",
-        "enqueued_wall", "trace",
+        "enqueued_wall", "trace", "pack", "pin_spec",
     )
 
     def __init__(self, prefix_ids, suffix_ids, group_key):
@@ -83,6 +84,17 @@ class _WorkItem:
         self.group_key = group_key  # (prefix token tuple, grammar names) pair
         self.future: Future = Future()
         self.enqueued_at = time.perf_counter()
+        # Batch-surface marker (get_scheduling_decisions_batch): items
+        # sharing a pack marker arrived as ONE admission batch and route
+        # through the engine's packed chunked admission
+        # (engine.admit_packed) instead of wave rows — the engine-side
+        # half of the fleet prepack mechanism (fleet/pools.py).
+        self.pack = None
+        # (pin key, pinned-prefix token ids) when the prompt is
+        # delta-encoded (sched/delta.py): the worker pins the snapshot
+        # prefix KV before installing the group so the delta-extended
+        # prefix LCP-seeds from it.
+        self.pin_spec = None
         # wall-clock twin of enqueued_at: retroactive flight-recorder spans
         # are wall-anchored (observability/spans), while all durations stay
         # perf_counter deltas
@@ -142,8 +154,36 @@ class LocalLLMBackend:
         answer_style: str = "direct",
         max_reason_tokens: int = 320,
         pool_role: str = "mixed",
+        packed_admission: bool = True,
+        delta_prompts: bool = False,
+        repin_fraction: float = 0.25,
+        max_pins: int = 4,
     ) -> None:
         self.engine = engine
+        # Admission plane (engine/admission/): batch-surface decisions
+        # admit via packed chunked prefill when the engine supports it;
+        # delta_prompts renders cluster prefixes as pinned snapshot +
+        # drift diff (sched/delta.py) so prefill scales with what changed.
+        self._packed_admission = bool(packed_admission) and hasattr(
+            engine, "admit_packed"
+        )
+        if delta_prompts:
+            from k8s_llm_scheduler_tpu.sched.delta import SnapshotDeltaEncoder
+
+            self._delta = SnapshotDeltaEncoder(repin_fraction=repin_fraction)
+        else:
+            self._delta = None
+        # (pin_key, token ids) of the last pinned snapshot prefix — one
+        # tokenize per pin, not per decision (GIL-atomic tuple swap).
+        self._pin_ids_cache: tuple | None = None
+        if hasattr(engine, "pin_prefix"):
+            from k8s_llm_scheduler_tpu.engine.admission.pinned import (
+                PinnedPrefixManager,
+            )
+
+            self._pin_manager = PinnedPrefixManager(engine, max_pins=max_pins)
+        else:  # engine test doubles
+            self._pin_manager = None
         # Disaggregated-pool role (fleet/pools.py): "decode" workers
         # refuse admission (work="prefill") so a fleet routing bug fails
         # loudly instead of letting admission bursts evict the decode
@@ -220,15 +260,47 @@ class LocalLLMBackend:
         self._worker.start()
 
     # ------------------------------------------------------------- backend
+    def _cluster_part(self, nodes: Sequence[NodeMetrics]):
+        """(cluster_part text, pin_spec | None, delta_nodes) — THE single
+        rendering seam for real decisions and prewarms: with delta
+        encoding on, both land on the identical pinned-snapshot + diff
+        text (one group key); off, both use the plain full render."""
+        if self._delta is None:
+            return self.prompt_engine.cluster_part(nodes), None, 0
+        dp = self._delta.encode(nodes)
+        pin_spec = None
+        if dp.pin_key is not None:
+            cached = self._pin_ids_cache
+            if cached is not None and cached[0] == dp.pin_key:
+                pin_ids = cached[1]
+            else:
+                # The pin's token ids as rendered in chat format (same
+                # stand-in-suffix trick as _prepare_prewarm: the prefix
+                # depends only on (system, cluster_part)).
+                pin_ids, _ = self.tokenizer.chat_prompt_parts(
+                    self.prompt_engine.system_prompt, dp.pin_text, "x"
+                )
+                self._pin_ids_cache = (dp.pin_key, pin_ids)
+            pin_spec = (dp.pin_key, pin_ids)
+        return dp.cluster_part, pin_spec, dp.delta_nodes
+
     def _prepare_item(
-        self, pod: PodSpec, nodes: Sequence[NodeMetrics]
+        self, pod: PodSpec, nodes: Sequence[NodeMetrics],
+        cluster_info: tuple | None = None,
     ) -> _WorkItem:
+        """`cluster_info` is a precomputed _cluster_part result: the batch
+        surface passes one per decide_batch frame so a B-pod pack does ONE
+        cluster render/diff instead of B identical ones."""
         candidates = feasible_nodes(pod, nodes)
         if not candidates:
             raise NoFeasibleNodeError(
                 f"no feasible node for {pod.namespace}/{pod.name}"
             )
-        cluster_part, pod_part = self.prompt_engine.split_prompt(pod, nodes)
+        cluster_part, pin_spec, delta_nodes = (
+            cluster_info if cluster_info is not None
+            else self._cluster_part(nodes)
+        )
+        pod_part = pod_suffix(pod)
         prefix_ids, suffix_ids = self.tokenizer.chat_prompt_parts(
             self.prompt_engine.system_prompt, cluster_part, pod_part
         )
@@ -240,7 +312,15 @@ class LocalLLMBackend:
             ready_names if self.constrained else None,
         )
         item = _WorkItem(prefix_ids, suffix_ids, group_key)
+        item.pin_spec = pin_spec
         item.trace = spans.capture()
+        if self._delta is not None:
+            trace = spans.current_trace()
+            if trace is not None:
+                trace.set_meta(
+                    prompt_encoding="delta" if delta_nodes else "pinned",
+                    delta_nodes=delta_nodes,
+                )
         return item
 
     def prewarm_prefix(self, nodes: Sequence[NodeMetrics]) -> Future:
@@ -271,7 +351,7 @@ class LocalLLMBackend:
         ready_names = tuple(sorted(n.name for n in nodes if n.is_ready))
         if not ready_names:
             return None
-        cluster_part = self.prompt_engine.cluster_part(nodes)
+        cluster_part, pin_spec, _ = self._cluster_part(nodes)
         # Any non-empty stand-in suffix yields the identical prefix ids:
         # chat_prompt_parts splits at the end of the user-prefix string,
         # so the prefix depends only on (system, cluster_part). An EMPTY
@@ -284,6 +364,7 @@ class LocalLLMBackend:
             ready_names if self.constrained else None,
         )
         item = _WorkItem(prefix_ids, None, group_key)
+        item.pin_spec = pin_spec
         return item
 
     def _check_role(self, work: str) -> None:
@@ -328,13 +409,22 @@ class LocalLLMBackend:
         out: list[SchedulingDecision | Exception] = [
             BackendError("batch slot unresolved")
         ] * len(pods)
+        # One marker per batch call: the worker routes marked items of a
+        # group through engine.admit_packed (packed block-diagonal
+        # prefill) instead of wave rows — the wire-level decide_batch
+        # frame (fleet/pools.py prepack) and the engine-level pack are
+        # ONE mechanism, with no second whole-prompt prefill.
+        pack_marker = object() if self._packed_admission else None
+        cluster_info = self._cluster_part(nodes)  # once per frame, not per pod
         for i, pod in enumerate(pods):
             try:
-                item = self._prepare_item(pod, nodes)
+                item = self._prepare_item(pod, nodes, cluster_info=cluster_info)
             except Exception as exc:  # NoFeasibleNodeError, tokenizer...
                 out[i] = exc
                 continue
+            item.pack = pack_marker
             staged.append((i, item))
+        for _, item in staged:
             self._queue.put(item)
         for i, item in staged:
             try:
@@ -425,10 +515,49 @@ class LocalLLMBackend:
             )
         return self._dfa_cache[key]
 
+    def _install_group(self, item: _WorkItem) -> None:
+        """Install item's (prefix, grammar) group on the engine. With a
+        delta-encoded prompt, the snapshot prefix is PINNED first
+        (admission/pinned.py) so set_prefix LCP-seeds from the pin and
+        prefills only the delta tail — the O(changed) admission cost."""
+        if item.pin_spec is not None and self._pin_manager is not None:
+            key, pin_ids = item.pin_spec
+            try:
+                self._pin_manager.ensure(key, pin_ids)
+            except Exception:
+                # unpinned is slower, never wrong — the group install
+                # below still prefills the full prefix
+                logger.exception("snapshot prefix pin failed; continuing")
+        self.engine.set_prefix(item.prefix_ids)
+        names = item.group_key[1]
+        self.engine.set_grammar(
+            self._grammar_for(names) if names is not None else None
+        )
+
+    def _submit_pack(
+        self, batch: list[_WorkItem], packs: "list[dict]"
+    ) -> None:
+        """Admit a marked batch through the engine's packed chunked
+        admission (engine.admit_packed); decode is driven by
+        _drive_packs at each tick."""
+        try:
+            req_ids = self.engine.admit_packed(
+                [i.suffix_ids for i in batch], self.max_new_tokens
+            )
+        except Exception as exc:
+            for item in batch:
+                item.fail(BackendError(str(exc)))
+        else:
+            packs.append({
+                "items": dict(zip(req_ids, batch)),
+                "submitted_at": time.perf_counter(),
+            })
+
     def _submit_waves(
         self,
         pending: list[_WorkItem],
         waves: "deque[tuple[Any, list[_WorkItem]]]",
+        packs: "list[dict]",
     ) -> list[_WorkItem]:
         """Dispatch every admissible pending item as pipelined waves.
 
@@ -470,16 +599,12 @@ class LocalLLMBackend:
             latest = prewarms[-1]
             if latest.group_key == self._current_group:
                 latest.resolve(True)
-            elif pending or waves:
+            elif pending or waves or packs:
                 latest.resolve(False)
             else:
                 self._current_group = None
                 try:
-                    self.engine.set_prefix(latest.prefix_ids)
-                    names = latest.group_key[1]
-                    self.engine.set_grammar(
-                        self._grammar_for(names) if names is not None else None
-                    )
+                    self._install_group(latest)
                     self._current_group = latest.group_key
                     latest.resolve(True)
                 except Exception:
@@ -515,7 +640,38 @@ class LocalLLMBackend:
             waves pipeline on device, so once the tail has waited
             ~hold_max_s it ships as-is — an unbounded hold parks the tail
             for a FULL wave round trip (~230ms measured), pushing its
-            followers past every other pod in the burst."""
+            followers past every other pod in the burst.
+
+            Pack-marked items (a decide_batch admission batch) route
+            through engine.admit_packed instead: one packed
+            block-diagonal prefill for the whole batch, bounded by the
+            engine's free paged slots (leftovers wait for slots to
+            drain). A lone marked straggler just rides a wave."""
+            if self._packed_admission:
+                # The paged pack path is page-table-bounded, tighter than
+                # the wave bound: an oversized suffix rides a wave rather
+                # than failing its pack (or poisoning its batchmates).
+                try:
+                    pack_limit = self.engine.max_suffix_tokens(
+                        self.max_new_tokens
+                    )
+                except AttributeError:  # engine test doubles
+                    pack_limit = self.engine.prefill_buckets[-1]
+                packable = [
+                    i for i in items
+                    if i.pack is not None and len(i.suffix_ids) <= pack_limit
+                ]
+                if len(packable) >= 2:
+                    free = self.engine.free_slots
+                    if free >= 2:
+                        batch = packable[:free]
+                        self._submit_pack(batch, packs)
+                        rest.extend(packable[len(batch):])
+                    else:
+                        # no slots yet: wait for in-flight packs to drain
+                        rest.extend(packable)
+                    handled = set(map(id, packable))
+                    items = [i for i in items if id(i) not in handled]
             batch: list[_WorkItem] = []
             for item in items:
                 batch.append(item)
@@ -553,6 +709,13 @@ class LocalLLMBackend:
         if not others:
             return rest
 
+        if packs:
+            # Paged slots are mid-flight against the CURRENT prefix
+            # pointer — set_prefix requires a drained engine, so a group
+            # switch must wait for the packs to finish decoding (bounded:
+            # the device-side budget guarantees pack completion).
+            rest.extend(others)
+            return rest
         oldest = min(others, key=lambda i: i.enqueued_at)
         waited = time.perf_counter() - oldest.enqueued_at
         if waves and waited < self.group_switch_after_s:
@@ -567,13 +730,7 @@ class LocalLLMBackend:
         # engine.
         self._current_group = None
         try:
-            self.engine.set_prefix(switch_items[0].prefix_ids)
-            grammar_names = target[1]
-            self.engine.set_grammar(
-                self._grammar_for(grammar_names)
-                if grammar_names is not None
-                else None
-            )
+            self._install_group(switch_items[0])
             self._current_group = target
         except Exception as exc:  # prefix too long, grammar build
             for item in switch_items:
@@ -629,8 +786,9 @@ class LocalLLMBackend:
     def _run_worker(self) -> None:
         pending: list[_WorkItem] = []
         waves: deque[tuple[Any, list[_WorkItem]]] = deque()
+        packs: list[dict] = []  # in-flight packed admissions
         while not self._stopped.is_set():
-            block = not pending and not waves
+            block = not pending and not waves and not packs
             if block and self._prewarm_backlog() > 0:
                 # Idle with compiles owed: park only for the grace period;
                 # if still idle after it, compile ONE sibling geometry,
@@ -646,18 +804,33 @@ class LocalLLMBackend:
                     self._try_prewarm()
                 continue
             self._drain_queue(pending, block=block)
-            if self._stopped.is_set() or (not pending and not waves):
+            if self._stopped.is_set() or (
+                not pending and not waves and not packs
+            ):
                 continue
             # Nothing below may kill the engine-owner thread — a dead worker
             # bricks every future request.
             try:
-                pending = self._worker_tick(pending, waves)
+                pending = self._worker_tick(pending, waves, packs)
             except Exception as exc:  # pragma: no cover - last-resort guard
                 logger.exception("engine worker tick failed")
                 for _, items in waves:
                     for item in items:
                         item.fail(BackendError(str(exc)))
                 waves.clear()
+                for pk in packs:
+                    for item in pk["items"].values():
+                        item.fail(BackendError(str(exc)))
+                if packs:
+                    # the failed packs' requests still hold _by_slot
+                    # entries and KV pages — without an abort they leak
+                    # forever (nothing steps an empty packs list) and
+                    # free_slots shrinks until no pack can ever admit
+                    packs.clear()
+                    try:
+                        self.engine.abort_all()
+                    except Exception:  # pragma: no cover - best effort
+                        logger.exception("engine abort after failed tick")
                 for item in pending:
                     item.fail(BackendError(str(exc)))
                 for ctl in self._held_controls:
@@ -668,15 +841,46 @@ class LocalLLMBackend:
         self._drain_queue(pending, block=False)
         for _, items in waves:
             pending.extend(items)
+        for pk in packs:
+            pending.extend(pk["items"].values())
         pending.extend(self._held_controls)
         self._held_controls = []
         for item in pending:
             item.fail(BackendError("backend closed"))
 
+    def _drive_packs(self, packs: "list[dict]") -> None:
+        """Advance in-flight packed admissions by one decode step and
+        resolve any finished decisions (this also harvests decode chunks
+        piggybacked during admission — the engine's one sync point)."""
+        try:
+            fins = self.engine.step()
+        except Exception as exc:
+            logger.exception("packed decode step failed")
+            for pk in packs:
+                for item in pk["items"].values():
+                    item.fail(BackendError(str(exc)))
+            packs.clear()
+            try:
+                self.engine.abort_all()
+            except Exception:  # pragma: no cover - best-effort cleanup
+                logger.exception("engine abort after failed pack step")
+            return
+        now = time.perf_counter()
+        for fin in fins:
+            for pk in packs:
+                item = pk["items"].pop(fin.req_id, None)
+                if item is not None:
+                    handle = SimpleNamespace(submitted_at=pk["submitted_at"])
+                    self._attach_item_spans(item, handle, fin, now)
+                    item.resolve(fin.text)
+                    break
+        packs[:] = [pk for pk in packs if pk["items"]]
+
     def _worker_tick(
         self,
         pending: list[_WorkItem],
         waves: "deque[tuple[Any, list[_WorkItem]]]",
+        packs: "list[dict]",
     ) -> list[_WorkItem]:
         """One submit+harvest cycle; returns items still waiting on a group
         switch."""
@@ -691,7 +895,12 @@ class LocalLLMBackend:
                 self._drain_queue(pending, block=False)
                 if len(pending) == before or len(pending) >= self.engine.max_slots:
                     break
-        pending = self._submit_waves(pending, waves)
+        pending = self._submit_waves(pending, waves, packs)
+        if packs:
+            # Packed admissions decode via the paged path: advance them
+            # (and harvest piggybacked emissions) every tick so their
+            # decisions resolve while waves pipeline alongside.
+            self._drive_packs(packs)
         if waves:
             handle, items = waves[0]
             # While the oldest wave executes, keep feeding the pipeline:
@@ -720,6 +929,11 @@ class LocalLLMBackend:
             deadline = (
                 max(handle.submitted_at, self._last_harvest_t) + 0.5 * ema
             )
+            if packs:
+                # in-flight packed decodes must not starve behind the
+                # straggler poll — harvest this wave blockingly and get
+                # back to stepping the packs
+                deadline = 0.0
             while (
                 not handle.is_ready()
                 and not self._stopped.is_set()
@@ -731,14 +945,14 @@ class LocalLLMBackend:
                     if pending:
                         # held ragged tails re-check their hold deadline
                         # even with no new arrivals (run_group)
-                        pending = self._submit_waves(pending, waves)
+                        pending = self._submit_waves(pending, waves, packs)
                     continue
                 if got is None:
                     self._stopped.set()
                     break
                 pending.append(got)
                 self._drain_queue(pending, block=False)
-                pending = self._submit_waves(pending, waves)
+                pending = self._submit_waves(pending, waves, packs)
             prof = getattr(self.engine, "profiler", None)
             if prof is not None and handle.is_ready():
                 # ready edge observed by the poll (or already ready when
@@ -777,11 +991,12 @@ class LocalLLMBackend:
                 for fin, item in zip(fins, items):
                     self._attach_item_spans(item, handle, fin, now)
                     item.resolve(fin.text)
-        if self._held_controls and not waves:
-            # Wave barrier reached (everything in flight harvested above,
-            # admissions held since the control arrived): run the quiesced
-            # actions on this — the engine-owner — thread. Held work in
-            # `pending` resumes on the next tick.
+        if self._held_controls and not waves and not packs:
+            # Wave barrier reached (everything in flight harvested above —
+            # waves AND packed admissions — admissions held since the
+            # control arrived): run the quiesced actions on this — the
+            # engine-owner — thread. Held work in `pending` resumes on
+            # the next tick.
             controls, self._held_controls = self._held_controls, []
             for ctl in controls:
                 try:
@@ -801,8 +1016,12 @@ class LocalLLMBackend:
             # group so the next wave REINSTALLS prefix + grammar instead
             # of matching the old key and decoding against an empty
             # prefix. Costs one prefix prefill per quiesce — correctness
-            # over a cache hit.
+            # over a cache hit. Pinned snapshot prefixes went stale with
+            # the same swap (engine.prefix_epoch bump): tidy the manager
+            # so the next group install re-pins under the new weights.
             self._current_group = None
+            if self._pin_manager is not None:
+                self._pin_manager.invalidate_stale()
         return pending
 
     @staticmethod
@@ -898,6 +1117,21 @@ class LocalLLMBackend:
         if self.pool_role != "mixed":
             out["pool_role"] = self.pool_role
             out["role_refusals"] = self.role_refusals
+        if self._delta is not None:
+            out["delta"] = self._delta.stats()
+        if self._pin_manager is not None:
+            pin_stats = self._pin_manager.stats()
+            if pin_stats["pins"]:
+                out["pins"] = pin_stats
+        # THE admission-efficiency headline (sublinearity in node count is
+        # measured on this): prefill tokens actually computed per finished
+        # decision — prefix prefills count only NON-REUSED tokens, so
+        # delta encoding + pinning drive this toward O(changed).
+        completed = out.get("completed", 0)
+        if completed:
+            out["prefill_tokens_per_decision"] = round(
+                out.get("prefill_tokens", 0) / completed, 2
+            )
         return out
 
 
@@ -978,6 +1212,11 @@ def build_local_backend(
     spec_draft_checkpoint: str | None = None,
     spec_k: int = 4,
     spec_disable_threshold: float = 0.3,
+    packed_admission: bool = True,
+    admission_chunk_tokens: int = 256,
+    delta_prompts: bool = False,
+    repin_fraction: float = 0.25,
+    max_pins: int = 4,
 ) -> LocalLLMBackend:
     """Construct the full local stack: params (from an HF safetensors or
     orbax checkpoint when checkpoint_path is set, random-init otherwise —
@@ -1096,6 +1335,7 @@ def build_local_backend(
         prefix_attn_impl=prefix_attn_impl,
         decode_matmul=decode_matmul,
         mesh=mesh if multi else None,
+        admission_chunk_tokens=admission_chunk_tokens,
     )
     if spec_enabled:
         if multi:
@@ -1122,4 +1362,8 @@ def build_local_backend(
         prewarm_idle_delay_s=prewarm_idle_delay_s,
         answer_style=answer_style,
         max_reason_tokens=max_reason_tokens,
+        packed_admission=packed_admission,
+        delta_prompts=delta_prompts,
+        repin_fraction=repin_fraction,
+        max_pins=max_pins,
     )

@@ -12,10 +12,14 @@ the two phases to distinct worker pools so they never contend:
 - **prefill pool**: absorbs admission. The first decisions against a
   NEW cluster snapshot (cold prefix) go here, PREPACKED: concurrent
   short scheduler prompts against one snapshot are batched into a
-  single `decide_batch` wire frame (sched/replica.py), so the worker's
-  engine admits them together and coalesces them into one prefill wave
-  — many short prompts, one prefill, block-diagonal attention on
-  device.
+  single `decide_batch` wire frame (sched/replica.py), and the worker's
+  batch surface (LocalLLMBackend.get_scheduling_decisions_batch) hands
+  the whole frame to the engine's PACKED CHUNKED admission
+  (engine.admit_packed — block-diagonal attention over one packed token
+  stream, engine/admission/). The wire-level prepack window and the
+  engine-level pack are ONE mechanism: the frame that ships together
+  prefills together, with no second whole-prompt prefill wave behind
+  the wire batch.
 - **decode pool**: serves continuation. Once a snapshot's prefix is
   WARM on the decode pool (the router fires an advisory
   `prewarm_prefix` at the decode pool the moment it first sees a

@@ -397,6 +397,123 @@ def forward_prefill_suffix_dense(
     return _last_valid_logits(params, cfg, x, suffix_lens), k_sfx, v_sfx
 
 
+def forward_prefill_packed(
+    params: Params,
+    cfg: LlamaConfig,
+    tokens: jax.Array,     # [C] int32 — one packed chunk (pad holes)
+    seg: jax.Array,        # [C] int32 — segment id per token, -1 on padding
+    positions: jax.Array,  # [C] int32 — ABSOLUTE position of each token
+    prefix_k_all: jax.Array,  # [L, Sp, n_kv, hd] — shared dense prefix KV
+    prefix_v_all: jax.Array,
+    prefix_len: jax.Array,    # scalar int32
+    carry_k: jax.Array,    # [L, CAP, n_kv, hd] pack carry (donate)
+    carry_v: jax.Array,
+    carry_seg: jax.Array,  # [CAP] int32 segment per carry entry (-1 empty)
+    carry_len: jax.Array,  # scalar int32 — tokens already in the carry
+    k_cache: jax.Array,    # [L, num_pages, page_size, n_kv, hd] (donate)
+    v_cache: jax.Array,
+    page_ids: jax.Array,   # [C] per-token dest page (0 = scratch)
+    offs: jax.Array,       # [C] per-token dest offset within the page
+    end_idx: jax.Array,    # [E] chunk-local indices of prompt-final tokens
+    prefix_impl: str | None = None,  # static
+) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
+    """One PACKED prefill chunk: many prompts in one token stream with
+    BLOCK-DIAGONAL attention (the Prepacking scheme, arXiv:2404.09529).
+
+    Token i (segment s_i) attends to:
+    - the burst-shared dense prefix (every real token — the prompts all
+      continue the same cluster-state prefix);
+    - carry entries of the SAME segment (this prompt's tokens from earlier
+      chunks of the pack — how a prompt spans a chunk boundary);
+    - chunk tokens j <= i of the SAME segment (causal within the prompt,
+      blocked across prompts).
+
+    Padding tokens (seg -1) only ever match other padding (their K/V
+    lands in the scratch page / is never attended by real queries), so a
+    partially-filled final chunk needs no special casing. The chunk's K/V
+    is scattered per token into the paged KV cache (each prompt's slot
+    pages) AND appended to the pack carry at `carry_len`.
+
+    Returns (end_logits [E, V] f32 — logits at each listed prompt-final
+    token, carry_k, carry_v, carry_seg, k_cache, v_cache). Semantically
+    this computes EXACTLY what per-prompt serial prefill computes — the
+    token-identity test pins packed+chunked greedy decode against the
+    serial whole-prompt path (tests/test_admission.py).
+    """
+    C = tokens.shape[0]
+    CAP = carry_k.shape[1]
+    hd = cfg.head_dim
+    inv_freq = rope_inv_freq(cfg)
+
+    x = params["embed"][tokens][None]  # [1, C, D]
+    pos_b = positions[None, :]  # [1, C]
+
+    # Masks are layer-independent: build once outside the scan.
+    carry_mask = (
+        (jnp.arange(CAP)[None, :] < carry_len)
+        & (carry_seg[None, :] == seg[:, None])
+    )[None, None, None, :, :]  # [1, 1, 1, C, CAP]
+    j = jnp.arange(C)
+    blk_mask = (
+        (j[:, None] >= j[None, :]) & (seg[:, None] == seg[None, :])
+    )[None, None, None, :, :]  # [1, 1, 1, C, C]
+
+    def body(carry, xs):
+        x, ck, cv, kc, vc = carry
+        lp, pk, pv, idx = xs
+        h = rms_norm(x, lp["attn_norm"], cfg.rms_eps)
+        q = _dense(h, lp["wq"], "bsd,dh->bsh").reshape(1, C, cfg.n_heads, hd)
+        k = _dense(h, lp["wk"], "bsd,dh->bsh").reshape(1, C, cfg.n_kv_heads, hd)
+        v = _dense(h, lp["wv"], "bsd,dh->bsh").reshape(1, C, cfg.n_kv_heads, hd)
+        q = apply_rope(q, pos_b, inv_freq)
+        k = apply_rope(k, pos_b, inv_freq)
+        qg = (q.astype(jnp.float32) * hd**-0.5).reshape(
+            1, C, cfg.n_kv_heads, cfg.q_per_kv, hd
+        )
+        parts = [
+            prefix_attend_parts(q, qg, pk, pv, prefix_len, impl=prefix_impl),
+            attend_part(
+                qg, ck[idx][None], cv[idx][None], carry_mask,
+                "bqkgh,bskh->bkgqs",
+            ),
+            attend_part(qg, k, v, blk_mask, "bqkgh,bskh->bkgqs"),
+        ]
+        attn = merge_attention_parts(parts)  # [1, n_kv, g, C, hd]
+        attn = jnp.moveaxis(attn, 3, 1).reshape(1, C, cfg.n_heads * hd)
+        attn = _dense(attn.astype(x.dtype), lp["wo"], "bsh,hd->bsd")
+        x = x + attn
+        x = x + _mlp(lp, cfg, x)
+        # Scatter this chunk's K/V into the paged cache (per-token dests;
+        # padding routed to the reserved scratch page 0 by the caller)...
+        kc = kc.at[idx, page_ids, offs].set(k[0].astype(kc.dtype))
+        vc = vc.at[idx, page_ids, offs].set(v[0].astype(vc.dtype))
+        # ...and append it to the pack carry so later chunks of a
+        # boundary-spanning prompt can attend their earlier tokens.
+        layer_k = jax.lax.dynamic_update_slice_in_dim(
+            ck[idx], k[0].astype(ck.dtype), carry_len, axis=0
+        )
+        layer_v = jax.lax.dynamic_update_slice_in_dim(
+            cv[idx], v[0].astype(cv.dtype), carry_len, axis=0
+        )
+        ck = jax.lax.dynamic_update_index_in_dim(ck, layer_k, idx, axis=0)
+        cv = jax.lax.dynamic_update_index_in_dim(cv, layer_v, idx, axis=0)
+        return (x, ck, cv, kc, vc), None
+
+    (x, carry_k, carry_v, k_cache, v_cache), _ = jax.lax.scan(
+        body,
+        (x, carry_k, carry_v, k_cache, v_cache),
+        (
+            params["layers"], prefix_k_all, prefix_v_all,
+            jnp.arange(cfg.n_layers),
+        ),
+    )
+    carry_seg = jax.lax.dynamic_update_slice(carry_seg, seg, (carry_len,))
+    # LM head only at the prompt-final tokens: the full [C, V] logits
+    # tensor is pure waste on the admission path.
+    x_end = x[0][end_idx]  # [E, D]
+    return _logits(params, cfg, x_end), carry_k, carry_v, carry_seg, k_cache, v_cache
+
+
 def forward_block_decode(
     params: Params,
     cfg: LlamaConfig,

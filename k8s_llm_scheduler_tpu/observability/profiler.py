@@ -44,6 +44,14 @@ bounded ring exports at /debug/profile (observability/metrics.py) and the
 windowed means surface as Prometheus gauges — this is the layer every
 subsequent perf PR proves itself against.
 
+The admission plane (engine/admission/) gets the same treatment: each
+packed admission contributes a record whose PACK_SEGMENTS telescope to
+its host wall (admission_pack / chunk_prefill / decode_piggyback /
+unattributed, sum == wall), and a `prefill_tokens_per_decision` gauge —
+windowed (wave suffix + packed + prefix tokens ACTUALLY prefilled) per
+decision — measures the delta-encoding claim directly: prefill cost
+scaling with what changed, not cluster size.
+
 Cost discipline: all fencing is perf_counter reads on the PER-WAVE path
 (waves run at ~10-60/s, never per token); with no profiler attached the
 engine pays one None check per wave. bench.py --preset obs-overhead
@@ -69,6 +77,18 @@ SEGMENTS = (
     "dispatch_gap",
     "host_sync",
     "harvest",
+    "unattributed",
+)
+
+# Packed-admission segments (engine.admit_packed — the admission plane),
+# telescoping over each pack's host wall time with the same sum==wall
+# identity the wave segments keep: admission_pack is host-side packing /
+# bookkeeping, chunk_prefill the packed block-diagonal prefill dispatches,
+# decode_piggyback the SARATHI decode chunks interleaved between them.
+PACK_SEGMENTS = (
+    "admission_pack",
+    "chunk_prefill",
+    "decode_piggyback",
     "unattributed",
 )
 
@@ -158,6 +178,17 @@ class EngineProfiler:
         self._flops_total = 0.0
         self._tokens_total = 0
         self.waves_profiled = 0
+        # Admission-plane books: per-pack records (engine.admit_packed)
+        # and the prefill-tokens-per-decision gauge inputs. Prefix
+        # prefills contribute only their NON-REUSED tokens — the delta
+        # path's O(changed) claim is measured on exactly this figure.
+        self._pack_ring: deque[dict] = deque(maxlen=self.window)
+        self._pack_totals = {name: 0.0 for name in PACK_SEGMENTS}
+        self._pack_totals["wall"] = 0.0
+        self._prefix_prefills: deque[tuple[int, int]] = deque(
+            maxlen=self.window
+        )  # (tokens prefilled, prefix length)
+        self.packs_profiled = 0
         self.closed = False
 
     # ------------------------------------------------------------- fences
@@ -308,6 +339,84 @@ class EngineProfiler:
                 self._flops_total += flops
                 self._tokens_total += tokens
 
+    def note_prefix_prefill(self, tokens_prefilled: int, prefix_len: int) -> None:
+        """A cluster-state prefix (re)prefill happened: `tokens_prefilled`
+        is what was actually COMPUTED (0 on a cache hit; only the
+        non-reused tail on an LCP-seeded / pinned-snapshot prefill), so
+        the prefill-tokens-per-decision gauge credits delta encoding with
+        exactly the work it skipped."""
+        with self._lock:
+            self._prefix_prefills.append(
+                (int(tokens_prefilled), int(prefix_len))
+            )
+
+    def on_pack(
+        self,
+        *,
+        wall_s: float,
+        chunk_prefill_s: float,
+        piggyback_s: float,
+        n_prompts: int,
+        tokens: int,
+        chunks: int,
+    ) -> None:
+        """One packed admission completed dispatching (engine.admit_packed;
+        the host never synced — segments are host-side enqueue walls).
+        admission_pack = wall minus the measured dispatch segments (the
+        packing/bookkeeping share); the identity sum(segments) == wall
+        holds by construction and the acceptance test pins it."""
+        wall = max(float(wall_s), 0.0)
+        seg = {
+            "chunk_prefill": max(float(chunk_prefill_s), 0.0),
+            "decode_piggyback": max(float(piggyback_s), 0.0),
+        }
+        seg["admission_pack"] = max(
+            wall - seg["chunk_prefill"] - seg["decode_piggyback"], 0.0
+        )
+        seg["unattributed"] = max(wall - sum(seg.values()), 0.0)
+        record = {
+            "pack": 0,  # stamped under the lock below
+            "n_prompts": int(n_prompts),
+            "tokens": int(tokens),
+            "chunks": int(chunks),
+            "wall_ms": wall * 1000.0,
+            "segments_ms": {k: v * 1000.0 for k, v in seg.items()},
+        }
+        with self._lock:
+            self.packs_profiled += 1
+            record["pack"] = self.packs_profiled
+            if len(self._pack_ring) == self._pack_ring.maxlen:
+                old = self._pack_ring[0]
+                for name in PACK_SEGMENTS:
+                    self._pack_totals[name] = max(
+                        self._pack_totals[name]
+                        - old["segments_ms"].get(name, 0.0) / 1000.0,
+                        0.0,
+                    )
+                self._pack_totals["wall"] = max(
+                    self._pack_totals["wall"] - old["wall_ms"] / 1000.0, 0.0
+                )
+            self._pack_ring.append(record)
+            for name in PACK_SEGMENTS:
+                self._pack_totals[name] += seg[name]
+            self._pack_totals["wall"] += wall
+
+    def _prefill_tokens_per_decision_locked(self) -> float | None:
+        """Windowed prefill tokens per decision: (wave suffix tokens +
+        packed tokens + prefix tokens actually prefilled) / decisions.
+        Caller holds the lock."""
+        decisions = sum(r["n_requests"] for r in self._ring) + sum(
+            r["n_prompts"] for r in self._pack_ring
+        )
+        if decisions <= 0:
+            return None
+        tokens = (
+            sum(r["suffix_tokens"] for r in self._ring)
+            + sum(r["tokens"] for r in self._pack_ring)
+            + sum(t for t, _ in self._prefix_prefills)
+        )
+        return tokens / decisions
+
     # -------------------------------------------------------------- flops
     def _wave_flops(
         self,
@@ -380,6 +489,10 @@ class EngineProfiler:
             flops = self._flops_total
             tokens = self._tokens_total
             waves = self.waves_profiled
+            pack_ring = list(self._pack_ring)
+            pack_totals = dict(self._pack_totals)
+            packs = self.packs_profiled
+            tpd = self._prefill_tokens_per_decision_locked()
         wall = totals["wall"]
         n_warm = sum(1 for r in ring if not r["cold_compile"])
         out: dict[str, Any] = {
@@ -419,6 +532,27 @@ class EngineProfiler:
         mfu = self._mfu(flops, wall, totals["device_compute"], totals)
         if mfu is not None:
             out["mfu"] = mfu
+        if packs:
+            pack_wall = pack_totals["wall"]
+            out["packs"] = {
+                "packs_profiled": packs,
+                "wall_ms_total": round(pack_wall * 1000.0, 3),
+                "segments_ms_total": {
+                    name: round(pack_totals[name] * 1000.0, 3)
+                    for name in PACK_SEGMENTS
+                },
+                "segment_frac": {
+                    name: (
+                        round(pack_totals[name] / pack_wall, 4)
+                        if pack_wall > 0
+                        else 0.0
+                    )
+                    for name in PACK_SEGMENTS
+                },
+                "ring": pack_ring,
+            }
+        if tpd is not None:
+            out["prefill_tokens_per_decision"] = round(tpd, 2)
         return out
 
     def gauges(self) -> dict[str, float]:
@@ -428,12 +562,26 @@ class EngineProfiler:
             totals = dict(self._totals)
             flops = self._flops_total
             waves = self.waves_profiled
+            pack_totals = dict(self._pack_totals)
+            packs = self.packs_profiled
+            tpd = self._prefill_tokens_per_decision_locked()
         wall = totals["wall"]
         out: dict[str, float] = {"waves_profiled": float(waves)}
         for name in SEGMENTS:
             out[f"{name}_frac"] = (
                 round(totals[name] / wall, 4) if wall > 0 else 0.0
             )
+        if packs:
+            out["packs_profiled"] = float(packs)
+            pack_wall = pack_totals["wall"]
+            for name in PACK_SEGMENTS:
+                out[f"pack_{name}_frac"] = (
+                    round(pack_totals[name] / pack_wall, 4)
+                    if pack_wall > 0
+                    else 0.0
+                )
+        if tpd is not None:
+            out["prefill_tokens_per_decision"] = round(tpd, 2)
         out["device_compute_frac"] = (
             round(totals["device_compute"] / wall, 4) if wall > 0 else 0.0
         )

@@ -1,0 +1,553 @@
+"""Delta-prefill admission plane (engine/admission/ + sched/delta.py).
+
+Packer and delta-encoder tests are pure host logic. Engine tests run on a
+micro real engine (f32, 2 layers — the test_rollout pattern, compiles in
+seconds): token identity of the packed/chunked/delta paths against serial
+whole-prompt prefill is the load-bearing acceptance pin, plus the
+chunk-boundary edge cases (prompt shorter than a chunk, a prompt spanning
+chunks, pin refresh mid-burst, eviction under KV-page pressure) and the
+swap-invalidation regression (a stale pin must never serve a post-swap
+decision)."""
+
+import dataclasses
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from k8s_llm_scheduler_tpu.core.prompt import PromptEngine
+from k8s_llm_scheduler_tpu.engine.admission import (
+    PinnedPrefixManager,
+    pack_prompts,
+)
+from k8s_llm_scheduler_tpu.engine.engine import InferenceEngine
+from k8s_llm_scheduler_tpu.engine.local import LocalLLMBackend
+from k8s_llm_scheduler_tpu.engine.tokenizer import ByteTokenizer
+from k8s_llm_scheduler_tpu.models.configs import LlamaConfig
+from k8s_llm_scheduler_tpu.sched.delta import DELTA_HEADER, SnapshotDeltaEncoder
+
+from conftest import make_node, make_pod
+
+TOK = ByteTokenizer()
+
+MICRO = LlamaConfig(
+    name="admission-micro", vocab_size=512, d_model=64, n_layers=2,
+    n_heads=2, n_kv_heads=1, d_ff=128, max_seq_len=4096,
+    rope_theta=10000.0, dtype=jnp.float32, tie_embeddings=True,
+)
+
+
+def micro_params(seed: int = 0):
+    from k8s_llm_scheduler_tpu.models.llama import init_params
+
+    return init_params(jax.random.PRNGKey(seed), MICRO)
+
+
+def micro_engine(params=None, **kw):
+    kw.setdefault("num_pages", 128)
+    kw.setdefault("page_size", 16)
+    kw.setdefault("max_slots", 4)
+    kw.setdefault("max_pages_per_seq", 16)
+    kw.setdefault("prefill_buckets", (32, 64, 128, 256, 512, 1024, 2048))
+    kw.setdefault("chunk_steps", 4)
+    kw.setdefault("temperature", 0.0)
+    kw.setdefault("admission_chunk_tokens", 16)
+    kw.setdefault("prefix_chunk", 64)
+    return InferenceEngine(
+        params if params is not None else micro_params(), MICRO, TOK, **kw
+    )
+
+
+# ------------------------------------------------------------------ packer
+class TestPacker:
+    def test_single_short_prompt(self):
+        plan = pack_prompts([[5, 6, 7]], chunk_tokens=8, pad_id=0)
+        assert plan.n_chunks == 1 and plan.total_tokens == 3
+        c = plan.chunks[0]
+        assert list(c.tokens) == [5, 6, 7, 0, 0, 0, 0, 0]
+        assert list(c.seg) == [0, 0, 0, -1, -1, -1, -1, -1]
+        assert list(c.positions[:3]) == [0, 1, 2]
+        assert len(c.ends) == 1
+        assert c.ends[0].prompt == 0 and c.ends[0].index == 2
+
+    def test_multiple_prompts_share_a_chunk(self):
+        plan = pack_prompts([[1, 2], [3], [4, 5]], chunk_tokens=8, pad_id=0)
+        c = plan.chunks[0]
+        assert list(c.tokens[:5]) == [1, 2, 3, 4, 5]
+        assert list(c.seg[:5]) == [0, 0, 1, 2, 2]
+        assert list(c.positions[:5]) == [0, 1, 0, 0, 1]
+        assert [(e.prompt, e.index) for e in c.ends] == [(0, 1), (1, 2), (2, 4)]
+
+    def test_prompt_spans_chunk_boundary(self):
+        plan = pack_prompts([[1, 2], list(range(10, 20))], chunk_tokens=4, pad_id=0)
+        assert plan.n_chunks == 3
+        # segment id and positions carry across the boundary
+        assert list(plan.chunks[0].seg) == [0, 0, 1, 1]
+        assert list(plan.chunks[0].positions) == [0, 1, 0, 1]
+        assert list(plan.chunks[1].seg) == [1, 1, 1, 1]
+        assert list(plan.chunks[1].positions) == [2, 3, 4, 5]
+        assert [(e.prompt, e.index) for e in plan.chunks[0].ends] == [(0, 1)]
+        assert plan.chunks[1].ends == ()
+        assert [(e.prompt, e.index) for e in plan.chunks[2].ends] == [(1, 3)]
+
+    def test_rejects_empty(self):
+        with pytest.raises(ValueError):
+            pack_prompts([], chunk_tokens=8, pad_id=0)
+        with pytest.raises(ValueError):
+            pack_prompts([[1], []], chunk_tokens=8, pad_id=0)
+
+
+# ----------------------------------------------------------- delta encoder
+class TestDeltaEncoder:
+    def _nodes(self, n=4, cpu=10.0):
+        return [make_node(f"node-{i}", cpu_pct=cpu + i) for i in range(n)]
+
+    def test_first_encode_pins_and_matches_plain_render(self):
+        enc = SnapshotDeltaEncoder()
+        nodes = self._nodes()
+        dp = enc.encode(nodes)
+        assert dp.repinned and dp.delta_nodes == 0
+        # byte-identical to the non-delta rendering path: zero drift means
+        # zero encoding overhead and an unchanged group key
+        assert dp.cluster_part == PromptEngine().cluster_part(nodes)
+
+    def test_metric_drift_appends_delta_with_pin_prefix(self):
+        enc = SnapshotDeltaEncoder()
+        nodes = self._nodes()
+        pin = enc.encode(nodes)
+        drifted = list(nodes)
+        drifted[2] = dataclasses.replace(drifted[2], cpu_usage_percent=88.0)
+        dp = enc.encode(drifted)
+        assert not dp.repinned and dp.delta_nodes == 1
+        # the pinned text is a literal string prefix — what makes the
+        # pinned KV LCP-reusable
+        assert dp.cluster_part.startswith(pin.cluster_part)
+        assert DELTA_HEADER in dp.cluster_part
+        assert "node-2" in dp.cluster_part[len(pin.cluster_part):]
+        assert "88.0" in dp.cluster_part[len(pin.cluster_part):]
+
+    def test_unchanged_snapshot_is_clean(self):
+        enc = SnapshotDeltaEncoder()
+        nodes = self._nodes()
+        pin = enc.encode(nodes)
+        dp = enc.encode([dataclasses.replace(n) for n in nodes])
+        assert dp.cluster_part == pin.cluster_part and dp.delta_nodes == 0
+
+    def test_membership_change_repins(self):
+        enc = SnapshotDeltaEncoder()
+        enc.encode(self._nodes(4))
+        dp = enc.encode(self._nodes(5))
+        assert dp.repinned
+        assert enc.stats()["repin_membership"] == 1
+
+    def test_readiness_change_repins(self):
+        # readiness drives the decision grammar AND the VALID NODE NAMES
+        # reinforcement — a pin rendered under other readiness is wrong
+        enc = SnapshotDeltaEncoder()
+        nodes = self._nodes()
+        enc.encode(nodes)
+        flipped = list(nodes)
+        flipped[0] = make_node("node-0", cpu_pct=10.0, ready=False)
+        assert enc.encode(flipped).repinned
+
+    def test_drift_fraction_repins(self):
+        enc = SnapshotDeltaEncoder(repin_fraction=0.25)
+        nodes = self._nodes(4)
+        enc.encode(nodes)
+        drifted = [
+            dataclasses.replace(n, cpu_usage_percent=77.0 + i)
+            for i, n in enumerate(nodes[:2])
+        ] + list(nodes[2:])
+        dp = enc.encode(drifted)  # 2/4 changed > 0.25
+        assert dp.repinned
+        assert enc.stats()["repin_drift"] == 1
+
+    def test_encode_is_deterministic(self):
+        enc = SnapshotDeltaEncoder()
+        nodes = self._nodes()
+        enc.encode(nodes)
+        drifted = list(nodes)
+        drifted[1] = dataclasses.replace(drifted[1], memory_usage_percent=66.0)
+        a = enc.encode(drifted)
+        b = enc.encode([dataclasses.replace(n) for n in drifted])
+        assert a.cluster_part == b.cluster_part and a.pin_key == b.pin_key
+
+
+# -------------------------------------------------- packed engine identity
+class TestPackedAdmission:
+    def test_token_identity_vs_serial_whole_prompt(self):
+        """THE acceptance pin: packed block-diagonal chunked prefill
+        decodes token-identically to per-prompt serial prefill under
+        greedy decoding — including a prompt shorter than one chunk and
+        a prompt spanning several chunks."""
+        engine = micro_engine()
+        prefix = TOK.encode("CLUSTER STATE: " + " ".join(
+            f"node-{i} cpu={10 + i}" for i in range(8)
+        ))
+        engine.set_prefix(prefix)
+        prompts = [
+            TOK.encode("pod-a needs a node"),          # shorter than chunk
+            TOK.encode("p" * 45),                      # spans 3 chunks of 16
+            TOK.encode("pod-c: tiny"),
+        ]
+        serial = [
+            engine.generate(p, max_new_tokens=8).token_ids for p in prompts
+        ]
+        assert not engine.has_active
+        req_ids = engine.admit_packed(prompts, max_new_tokens=8)
+        out = {}
+        deadline = time.monotonic() + 60
+        while len(out) < len(prompts):
+            assert time.monotonic() < deadline, "packed decode wedged"
+            for fin in engine.step():
+                out[fin.req_id] = fin.token_ids
+        assert [out[r] for r in req_ids] == serial
+        assert engine.stats["packed_admissions"] == 1
+        assert engine.stats["pack_chunks"] >= 4
+        # in-flight decode advanced between prefill chunks (SARATHI)
+        assert engine.stats["piggyback_chunks"] >= 1
+
+    def test_identity_vs_row_batched_admission(self):
+        """Packed admission == add_requests (row-batched) token streams:
+        the block-diagonal mask computes exactly the row-mask attention."""
+        engine = micro_engine()
+        engine.set_prefix(TOK.encode("shared cluster prefix text here"))
+        prompts = [TOK.encode("alpha pod"), TOK.encode("beta pod longer")]
+        ids_row = engine.add_requests(prompts, max_new_tokens=6)
+        row_out = {}
+        while len(row_out) < 2:
+            for fin in engine.step():
+                row_out[fin.req_id] = fin.token_ids
+        ids_pack = engine.admit_packed(prompts, max_new_tokens=6)
+        pack_out = {}
+        while len(pack_out) < 2:
+            for fin in engine.step():
+                pack_out[fin.req_id] = fin.token_ids
+        assert [pack_out[r] for r in ids_pack] == [row_out[r] for r in ids_row]
+
+    def test_backpressure_and_validation(self):
+        engine = micro_engine()
+        with pytest.raises(ValueError):
+            engine.admit_packed([[]], max_new_tokens=4)
+        with pytest.raises(RuntimeError):
+            engine.admit_packed([[1]] * 5, max_new_tokens=4)  # > max_slots
+        assert engine.admit_packed([], max_new_tokens=4) == []
+        too_long = [1] * (engine.max_suffix_tokens(4) + 1)
+        with pytest.raises(ValueError):
+            engine.admit_packed([too_long], max_new_tokens=4)
+
+    def test_allocation_failure_rolls_back_pages(self):
+        """Eviction under KV-page pressure: when the pool cannot hold the
+        pack, admission fails CLEANLY — no leaked pages, no leaked slots,
+        and the engine still serves afterwards."""
+        engine = micro_engine(num_pages=8, max_pages_per_seq=8)
+        free0 = engine.kv.pages_free
+        big = [TOK.encode("x" * 40)] * 3  # needs more pages than the pool has
+        with pytest.raises(Exception):
+            engine.admit_packed(big, max_new_tokens=40)
+        assert engine.kv.pages_free == free0
+        assert engine.free_slots == engine.max_slots
+        fin = engine.generate(TOK.encode("still works"), max_new_tokens=4)
+        assert len(fin.token_ids) >= 1
+
+
+# ------------------------------------------------------------ pin lifecycle
+class TestPinLifecycle:
+    def test_pin_survives_byte_pressure_unpinned_evicts(self):
+        engine = micro_engine()
+        pinned_ids = TOK.encode("p" * 120)
+        other_ids = TOK.encode("q" * 120)
+        key, epoch = engine.pin_prefix(pinned_ids)
+        assert engine.pin_alive(key, epoch)
+        # shrink the budget so the next insert forces eviction
+        engine.PREFIX_CACHE_BYTES = 1  # instance attr shadows the class
+        engine.set_prefix(other_ids)
+        assert engine.pin_alive(key, epoch)  # pinned entry kept
+        assert tuple(other_ids) in engine._prefix_cache  # newest kept too
+        # a THIRD prefix evicts the unpinned one, never the pin
+        engine.set_prefix(TOK.encode("r" * 120))
+        assert engine.pin_alive(key, epoch)
+        assert tuple(other_ids) not in engine._prefix_cache
+
+    def test_unpin_makes_entry_evictable(self):
+        engine = micro_engine()
+        key, epoch = engine.pin_prefix(TOK.encode("s" * 120))
+        engine.unpin_prefix(key)
+        assert not engine.pin_alive(key, epoch)
+        engine.PREFIX_CACHE_BYTES = 1
+        engine.set_prefix(TOK.encode("t" * 120))
+        engine.set_prefix(TOK.encode("u" * 120))
+        assert key not in engine._prefix_cache
+
+    def test_manager_ensure_hit_and_lru_eviction(self):
+        engine = micro_engine()
+        mgr = PinnedPrefixManager(engine, max_pins=2)
+        assert mgr.ensure("snap-1", TOK.encode("a" * 80)) is True
+        assert mgr.ensure("snap-1", TOK.encode("a" * 80)) is False  # hit
+        mgr.ensure("snap-2", TOK.encode("b" * 80))
+        mgr.ensure("snap-3", TOK.encode("c" * 80))  # evicts snap-1 (LRU)
+        assert set(mgr.pins) == {"snap-2", "snap-3"}
+        s = mgr.stats()
+        assert s["pins"] == 3 and s["pin_hits"] == 1 and s["evictions"] == 1
+
+    def test_pin_refresh_on_changed_snapshot_content(self):
+        engine = micro_engine()
+        mgr = PinnedPrefixManager(engine)
+        mgr.ensure("snap", TOK.encode("v1 " * 30))
+        assert mgr.ensure("snap", TOK.encode("v2 " * 30)) is True  # re-pin
+        assert mgr.pins["snap"].cache_key == tuple(TOK.encode("v2 " * 30))
+
+    def test_swap_params_invalidates_pins(self):
+        """Satellite regression: swap_params must ALSO invalidate pinned
+        snapshot-prefix KV — a stale pin can never serve post-swap."""
+        engine = micro_engine()
+        mgr = PinnedPrefixManager(engine)
+        ids = TOK.encode("pinned cluster snapshot " * 4)
+        mgr.ensure("snap", ids)
+        h = mgr.pins["snap"]
+        assert engine.pin_alive(h.cache_key, h.epoch)
+        engine.swap_params(engine.params)  # identical params, new epoch
+        assert not engine.pin_alive(h.cache_key, h.epoch)
+        assert engine.prefix_epoch == 1
+        assert mgr.invalidate_stale() == 1
+        assert mgr.ensure("snap", ids) is True  # re-pins under new epoch
+        h2 = mgr.pins["snap"]
+        assert engine.pin_alive(h2.cache_key, h2.epoch)
+
+
+# ----------------------------------------- delta path on the real backend
+def _mk_backend(**kw):
+    kw.setdefault("max_new_tokens", 80)
+    kw.setdefault("delta_prompts", True)
+    # 32 pages/slot: a real pod suffix (~200 byte-tokens) + the decode
+    # budget must fit the paged pack path (engine.max_suffix_tokens)
+    return LocalLLMBackend(
+        micro_engine(max_slots=4, max_pages_per_seq=32), **kw
+    )
+
+
+class TestDeltaBackend:
+    def _nodes(self, n=4, cpu=10.0):
+        return [make_node(f"node-{i}", cpu_pct=cpu + i) for i in range(n)]
+
+    def test_delta_decision_identical_to_cold_prefill_of_same_prompt(self):
+        """The delta path's KV shortcuts (pinned prefix + LCP seeding) are
+        EXACT: the same delta-encoded prompt prefilled cold on a fresh
+        engine yields bit-identical greedy decisions."""
+        params = micro_params()
+        nodes = self._nodes()
+        drifted = list(nodes)
+        drifted[1] = dataclasses.replace(drifted[1], cpu_usage_percent=91.0)
+        pod = make_pod("pod-x")
+
+        a = LocalLLMBackend(
+            micro_engine(params), max_new_tokens=80, delta_prompts=True
+        )
+        try:
+            a.get_scheduling_decision(make_pod("warm"), nodes)  # pins
+            da = a.get_scheduling_decision(pod, drifted)
+            reused = a.engine.stats["prefix_reused_tokens"]
+            delta_stats = a._delta.stats()
+        finally:
+            a.close()
+        assert delta_stats["delta_encodes"] == 1
+        assert reused > 0  # the pinned snapshot KV actually seeded
+
+        b = LocalLLMBackend(
+            micro_engine(params), max_new_tokens=80, delta_prompts=True
+        )
+        try:
+            # replay the SAME encode sequence on a cold engine with pin
+            # seeding disabled (no pin manager): full cold prefill
+            b._pin_manager = None
+            b.get_scheduling_decision(make_pod("warm"), nodes)
+            db = b.get_scheduling_decision(pod, drifted)
+        finally:
+            b.close()
+        assert da.selected_node == db.selected_node
+        assert da.reasoning == db.reasoning
+
+    def test_pin_refresh_mid_burst(self):
+        """Chunk-boundary edge case: a re-pin (drift past the threshold)
+        mid-sequence switches groups cleanly — decisions stay valid and
+        the manager carries the new pin."""
+        backend = _mk_backend(repin_fraction=0.2)
+        try:
+            nodes = self._nodes()
+            d1 = backend.get_scheduling_decision(make_pod("p1"), nodes)
+            # drift 3/4 nodes: far past repin_fraction
+            drifted = [
+                dataclasses.replace(n, cpu_usage_percent=70.0 + i)
+                for i, n in enumerate(nodes[:3])
+            ] + [nodes[3]]
+            d2 = backend.get_scheduling_decision(make_pod("p2"), drifted)
+            assert d1.selected_node in {n.name for n in nodes}
+            assert d2.selected_node in {n.name for n in nodes}
+            assert backend._delta.stats()["repin_drift"] == 1
+            assert backend._pin_manager.stats()["pins"] >= 2
+        finally:
+            backend.close()
+
+    def test_swap_under_live_wave_traffic_repins(self):
+        """Satellite regression under live traffic: decisions flow, a
+        quiesced identical-params swap lands, and the NEXT decision
+        re-pins under the new epoch instead of serving the stale pin."""
+        backend = _mk_backend()
+        try:
+            nodes = self._nodes()
+            assert backend.get_scheduling_decision(
+                make_pod("before"), nodes
+            ).selected_node
+            pins_before = backend._pin_manager.stats()["pins"]
+            _, pause = backend.run_quiesced(
+                lambda: backend.engine.swap_params(backend.engine.params),
+                timeout_s=60,
+            )
+            assert pause >= 0.0
+            assert backend.engine.prefix_epoch == 1
+            d = backend.get_scheduling_decision(make_pod("after"), nodes)
+            assert d.selected_node in {n.name for n in nodes}
+            assert backend._pin_manager.stats()["pins"] == pins_before + 1
+            # and the new pin is alive under the new epoch
+            for h in backend._pin_manager.pins.values():
+                assert backend.engine.pin_alive(h.cache_key, h.epoch)
+        finally:
+            backend.close()
+
+    def test_batch_routes_through_packed_admission(self):
+        backend = _mk_backend()
+        try:
+            nodes = self._nodes()
+            pods = [make_pod(f"pod-{i}", cpu=0.1 + 0.01 * i) for i in range(3)]
+            res = backend.get_scheduling_decisions_batch(pods, nodes)
+            names = {n.name for n in nodes}
+            assert all(r.selected_node in names for r in res)
+            assert backend.engine.stats["packed_admissions"] == 1
+            assert backend.engine.stats["packed_prompts"] == 3
+            assert backend.engine.stats["waves"] == 0
+        finally:
+            backend.close()
+
+    def test_packed_admission_disabled_falls_back_to_waves(self):
+        backend = _mk_backend(packed_admission=False)
+        try:
+            nodes = self._nodes()
+            pods = [make_pod(f"pod-{i}", cpu=0.1 + 0.01 * i) for i in range(2)]
+            res = backend.get_scheduling_decisions_batch(pods, nodes)
+            assert all(hasattr(r, "selected_node") for r in res)
+            assert backend.engine.stats["packed_admissions"] == 0
+            assert backend.engine.stats["waves"] >= 1
+        finally:
+            backend.close()
+
+    def test_smoke_deterministic_admission(self):
+        """Fast deterministic admission smoke (<10s): singles + a batch,
+        drift between bursts, two identical runs, identical decisions."""
+        t0 = time.monotonic()
+
+        def run():
+            params = micro_params()
+            backend = LocalLLMBackend(
+                micro_engine(params), max_new_tokens=80, delta_prompts=True
+            )
+            picks = []
+            try:
+                nodes = self._nodes()
+                picks.append(
+                    backend.get_scheduling_decision(
+                        make_pod("s1"), nodes
+                    ).selected_node
+                )
+                drifted = list(nodes)
+                drifted[0] = dataclasses.replace(
+                    drifted[0], cpu_usage_percent=55.0
+                )
+                for r in backend.get_scheduling_decisions_batch(
+                    [make_pod(f"b{i}", cpu=0.1 + 0.02 * i) for i in range(3)],
+                    drifted,
+                ):
+                    picks.append(r.selected_node)
+            finally:
+                backend.close()
+            return picks
+
+        assert run() == run()
+        assert time.monotonic() - t0 < 10.0, "admission smoke exceeded 10s"
+
+
+# -------------------------------------------------------- profiler + config
+class TestAdmissionProfiler:
+    def test_pack_segments_telescope_and_tokens_gauge(self):
+        from k8s_llm_scheduler_tpu.observability.profiler import (
+            PACK_SEGMENTS,
+            EngineProfiler,
+        )
+
+        engine = micro_engine()
+        prof = EngineProfiler(MICRO)
+        engine.attach_profiler(prof)
+        engine.set_prefix(TOK.encode("cluster prefix " * 4))
+        req_ids = engine.admit_packed(
+            [TOK.encode("pod one"), TOK.encode("pod two two")],
+            max_new_tokens=6,
+        )
+        done = set()
+        while len(done) < len(req_ids):
+            done.update(f.req_id for f in engine.step())
+        snap = prof.snapshot()
+        packs = snap["packs"]
+        assert packs["packs_profiled"] == 1
+        rec = packs["ring"][0]
+        # the telescoping identity: sum(segments) == wall (to float noise)
+        assert sum(rec["segments_ms"].values()) == pytest.approx(
+            rec["wall_ms"], abs=1e-6
+        )
+        assert set(rec["segments_ms"]) == set(PACK_SEGMENTS)
+        assert rec["n_prompts"] == 2 and rec["tokens"] > 0
+        # prefix prefill noted + packed tokens -> per-decision gauge
+        assert snap["prefill_tokens_per_decision"] > 0
+        gauges = prof.gauges()
+        assert gauges["packs_profiled"] == 1.0
+        assert gauges["prefill_tokens_per_decision"] > 0
+        assert sum(
+            gauges[f"pack_{name}_frac"] for name in PACK_SEGMENTS
+        ) == pytest.approx(1.0, abs=0.01)
+
+    def test_prefix_prefill_notes_only_computed_tokens(self):
+        from k8s_llm_scheduler_tpu.observability.profiler import EngineProfiler
+
+        engine = micro_engine()
+        prof = EngineProfiler(MICRO)
+        engine.attach_profiler(prof)
+        pin_ids = TOK.encode("pinned " * 30)
+        engine.pin_prefix(pin_ids)
+        engine.set_prefix(pin_ids + TOK.encode(" tail"))
+        computed = [t for t, _ in prof._prefix_prefills]
+        assert computed[0] == len(pin_ids)        # the pin's full prefill
+        assert 0 < computed[1] <= 64 + 5          # only the seeded tail
+
+
+class TestAdmissionConfig:
+    def test_defaults_and_env_overrides(self):
+        from k8s_llm_scheduler_tpu.config import load_config
+
+        cfg = load_config(yaml_path=None, env={})
+        assert cfg.get("admission.packed") is True
+        assert cfg.get("admission.chunk_tokens") == 256
+        assert cfg.get("admission.delta_prompts") is True
+        assert cfg.get("admission.repin_fraction") == 0.25
+        assert cfg.get("admission.max_pins") == 4
+        cfg = load_config(yaml_path=None, env={
+            "ADMISSION_PACKED": "false",
+            "ADMISSION_CHUNK_TOKENS": "512",
+            "ADMISSION_DELTA_PROMPTS": "0",
+            "ADMISSION_REPIN_FRACTION": "0.5",
+            "ADMISSION_MAX_PINS": "8",
+        })
+        assert cfg.get("admission.packed") is False
+        assert cfg.get("admission.chunk_tokens") == 512
+        assert cfg.get("admission.delta_prompts") is False
+        assert cfg.get("admission.repin_fraction") == 0.5
+        assert cfg.get("admission.max_pins") == 8

@@ -130,7 +130,7 @@ class TestPrewarmUnderLoad:
         try:
             item = backend._prepare_prewarm(make_nodes(3))
             waves = deque([(object(), [])])  # one wave "in flight"
-            rest = backend._submit_waves([item], waves)
+            rest = backend._submit_waves([item], waves, [])
             assert rest == []
             assert item.future.result(timeout=1) is False
             assert backend._current_group is None

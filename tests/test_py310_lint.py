@@ -72,6 +72,15 @@ class TestRepoIsClean:
         assert "k8s_llm_scheduler_tpu/learn/curriculum.py" in files
         assert "k8s_llm_scheduler_tpu/learn/loop.py" in files
         assert "tests/test_learn.py" in files
+        # admission round: the delta-prefill admission plane (packed
+        # chunked prefill + pinned prefix KV + snapshot-delta prompts) —
+        # worker-thread + futures-heavy code, the same 3.11+-API risk
+        # class as the engine worker it extends
+        assert "k8s_llm_scheduler_tpu/engine/admission/packer.py" in files
+        assert "k8s_llm_scheduler_tpu/engine/admission/chunked.py" in files
+        assert "k8s_llm_scheduler_tpu/engine/admission/pinned.py" in files
+        assert "k8s_llm_scheduler_tpu/sched/delta.py" in files
+        assert "tests/test_admission.py" in files
         # the lint never lints its own pattern table
         assert "tools/py310_lint.py" not in files
 
