@@ -65,6 +65,9 @@ def _backend_kwargs(cfg: Config, **overrides) -> dict:
         spec_disable_threshold=float(
             cfg.get("llm.spec_disable_threshold", 0.3)
         ),
+        # fused on-device decode runtime (engine/fused/)
+        fused_decode=bool(cfg.get("llm.fused_decode", True)),
+        top_k=int(cfg.get("llm.top_k", 0)),
         # delta-prefill admission plane (engine/admission/, sched/delta.py)
         packed_admission=bool(cfg.get("admission.packed", True)),
         admission_chunk_tokens=int(cfg.get("admission.chunk_tokens", 256)),

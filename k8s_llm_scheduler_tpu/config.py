@@ -107,6 +107,15 @@ DEFAULTS: dict[str, Any] = {
         # persistent XLA compile cache dir ("auto" = ~/.cache/...; null
         # disables) — utils/compile_cache.py
         "compile_cache_dir": "auto",
+        # --- fused on-device decode runtime (engine/fused/): the paged
+        # decode loop as ONE lax.while_loop program with early exit —
+        # host syncs once per harvest chunk, never per token. Falls back
+        # to the sparse chunked path by itself when a grammar can't
+        # export a dense table (size cap) or a spec round is open. ---
+        "fused_decode": True,
+        # top-k sampling cut applied INSIDE the fused loop (0 = full
+        # distribution; greedy decode is unaffected by construction)
+        "top_k": 0,
     },
     # Delta-prefill admission plane (engine/admission/ + sched/delta.py):
     # packed chunked admission for batch surfaces, and snapshot-delta
@@ -335,6 +344,8 @@ ENV_OVERRIDES: dict[str, str] = {
     "LLM_MAX_TOKENS": "llm.max_tokens",
     "LLM_TEMPERATURE": "llm.temperature",
     "SPEC_ENABLED": "llm.spec_enabled",
+    "FUSED_DECODE": "llm.fused_decode",
+    "LLM_TOP_K": "llm.top_k",
     "SPEC_K": "llm.spec_k",
     "SPEC_DRAFT_MODEL": "llm.spec_draft_model",
     "SPEC_DRAFT_CHECKPOINT": "llm.spec_draft_checkpoint",

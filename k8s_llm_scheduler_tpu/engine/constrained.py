@@ -343,6 +343,36 @@ def sparse_tables(dfa: DecisionDFA) -> SparseDFATables:
     return tables
 
 
+def dense_transition_table(
+    dfa: DecisionDFA, vocab_size: int | None = None
+) -> np.ndarray:
+    """Dense [n_states, vocab] next-state table: entry [s, v] is the state
+    reached by emitting token v from state s, -1 when disallowed.
+
+    The FUSED decode loop's grammar representation (engine/fused/): inside
+    a lax.while_loop body one row gather yields both the allowed-token
+    mask (`row >= 0`) and the transition — no K-space mapping, no
+    per-grammar K-bucket compile variants. Host memory is O(states x
+    vocab), which is exactly why the sparse tables above remain the
+    serving representation for the wave/chunked paths: the engine's fused
+    runtime size-caps this export (engine/fused/tables.py) and falls back
+    to sparse chunked decode when a grammar cannot afford it.
+
+    `vocab_size` widens the table past dfa.vocab_size (a checkpoint-shaped
+    model's padded vocab served with a small domain tokenizer): the extra
+    columns are all -1, so the mask forbids undecodable ids for free."""
+    V = int(vocab_size if vocab_size is not None else dfa.vocab_size)
+    if V < dfa.vocab_size:
+        raise ValueError(
+            f"vocab_size {V} narrower than the DFA's {dfa.vocab_size}"
+        )
+    table = np.full((dfa.n_states, V), -1, dtype=np.int32)
+    for s, out in enumerate(dfa.edges):
+        if out:
+            table[s, list(out.keys())] = list(out.values())
+    return table
+
+
 def wave_iterations(dfa: DecisionDFA, block_size: int) -> int:
     """Worst-case number of block-decode iterations to complete ANY path
     through the grammar.

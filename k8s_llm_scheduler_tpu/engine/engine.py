@@ -502,6 +502,9 @@ class InferenceEngine:
         decode_matmul: str = "dense",  # "dense" | "ragged" (single device)
         mesh=None,  # jax.sharding.Mesh | None — set for multi-device serving
         admission_chunk_tokens: int = 256,
+        fused_decode: bool = True,
+        top_k: int = 0,
+        fused_table_bytes: int | None = None,
     ) -> None:
         self.cfg = cfg
         self.params = params
@@ -617,6 +620,46 @@ class InferenceEngine:
             static_argnums=(1, 20, 21, 22),
             donate_argnums=(2, 3, 8, 9, 10, 11, 12),
         )
+        # Fused on-device decode runtime (engine/fused/): the autoregressive
+        # loop as ONE lax.while_loop program with early exit, on-device
+        # sampling (greedy/temperature/top-k), dense-table grammar and
+        # per-slot stop detection — the host syncs once per harvest chunk.
+        # step_fused/decode_fused route here and FALL BACK to the sparse
+        # chunked path whenever the grammar can't export a dense table
+        # (size cap) or a spec round holds the slot state (fused_hold).
+        self.fused_decode = bool(fused_decode)
+        self.top_k = int(top_k)
+        from k8s_llm_scheduler_tpu.engine.fused import (
+            DENSE_TABLE_MAX_BYTES,
+            fused_decode_chunk_impl,
+        )
+
+        self.fused_table_bytes = (
+            int(fused_table_bytes)
+            if fused_table_bytes is not None
+            else DENSE_TABLE_MAX_BYTES
+        )
+        self._fused_chunk = jax.jit(
+            functools.partial(
+                fused_decode_chunk_impl,
+                shmap=chunk_shmap,
+                vocab_limit=self._vocab_limit,
+            ),
+            static_argnums=(1, 19, 20, 21, 22),
+            donate_argnums=(2, 3, 8, 9, 10, 11, 12),
+        )
+        # Unconstrained fused chunks never read the table; a [1,1] dummy
+        # keeps the traced shape stable. The real table is built lazily on
+        # first constrained fused use (set_grammar resets it).
+        self._fused_dummy = jnp.full((1, 1), -1, dtype=jnp.int32)
+        self._fused_next_d: jax.Array | None = None
+        self._fused_unsupported = False
+        self._dfa: DecisionDFA | None = None
+        # Explicit non-fused interop: a speculative round (spec/decoder.py)
+        # diverges slot device state from the host mirrors mid-round, so
+        # fused chunks must not run while one is open. The spec decoder
+        # increments/decrements this around each request.
+        self.fused_hold = 0
         self._wave = jax.jit(
             functools.partial(
                 _wave_impl,
@@ -748,6 +791,9 @@ class InferenceEngine:
             "piggyback_chunks": 0,
             "pinned_prefixes": 0,
             "pin_evictions": 0,
+            "fused_chunks": 0,
+            "fused_steps": 0,
+            "fused_fallbacks": 0,
         }
 
     # ------------------------------------------------------------- grammar
@@ -762,6 +808,13 @@ class InferenceEngine:
         emitted pads would be dropped from output and max_new_tokens
         accounting (generate() could spin forever on a pad-argmaxing
         model)."""
+        # Fused-runtime table state resets with the grammar: the dense
+        # table is built lazily on the first fused chunk (engine/fused/
+        # tables.py caches per DFA, so reinstalls of a cached grammar
+        # re-upload without re-deriving).
+        self._dfa = dfa
+        self._fused_next_d = None
+        self._fused_unsupported = False
         if dfa is None:
             self._constrained = False
             self._sp_tokens = jnp.full((1, 1), -1, dtype=jnp.int32)
@@ -972,7 +1025,7 @@ class InferenceEngine:
             m = min(len(old_key), len(key))
             if m < threshold:
                 continue
-            old_arr = np.asarray(old_key[:m], dtype=np.int64)
+            old_arr = np.asarray(old_key[:m], dtype=np.int64)  # graftlint: ok[device-sync-in-loop] — old_key is a host-side tuple of token ids (cache key), not a device value; no transfer happens
             mismatch = np.nonzero(old_arr != key_arr[:m])[0]
             lcp = int(mismatch[0]) if mismatch.size else m
             if lcp > best_reuse:
@@ -1763,14 +1816,25 @@ class InferenceEngine:
             (emissions, self._act_d, self._budget_d, self._first_d)
         )
         emitted_np, act_np, budget_np, first_np = fetched
+        self.stats["syncs"] += 1
+        return self._finish_harvest(emitted_np, act_np, budget_np, first_np)
+
+    def _finish_harvest(
+        self, emitted_np, act_np, budget_np, first_np
+    ) -> list[Finished]:
+        """Resolve harvested emissions into per-request token streams and
+        Finished records — the shared back half of step() and the fused
+        harvest (step_fused/decode_fused). Token accounting is EXACT:
+        emitted counts pad-filtered tokens actually sampled, never
+        chunk-capacity estimates (pad is unsampleable for active slots —
+        set_grammar), so early-exiting fused chunks book only what ran."""
         # np.array copies: device_get may hand back read-only views and the
         # mirrors are mutated host-side (optimistic admission flags).
         self._act_np = np.array(act_np)
         self._budget_np = np.array(budget_np)
-        self.stats["syncs"] += 1
         toks = (
             np.concatenate(emitted_np, axis=1)
-            if emitted_np
+            if len(emitted_np)
             else np.zeros((self.max_slots + 1, 0), dtype=np.int32)
         )
 
@@ -1799,6 +1863,209 @@ class InferenceEngine:
                     )
                 )
                 self.stats["completed"] += 1
+        return finished
+
+    # ---------------------------------------------------------- fused decode
+    def _fused_ready(self) -> bool:
+        """Whether the fused runtime can serve the CURRENT grammar/slot
+        state. False routes callers to the sparse chunked path: grammar
+        too large for a dense table (size cap — a 128k-vocab production
+        grammar), fused decode disabled, or a speculative round holding
+        the slot state (spec/decoder.py explicit non-fused interop)."""
+        if not self.fused_decode or self.fused_hold:
+            return False
+        if not self._constrained:
+            return True
+        if self._fused_unsupported:
+            return False
+        if self._fused_next_d is None:
+            from k8s_llm_scheduler_tpu.engine.fused import dense_tables
+
+            tables = (
+                dense_tables(
+                    self._dfa, self.cfg.vocab_size, self.fused_table_bytes
+                )
+                if self._dfa is not None
+                else None
+            )
+            if tables is None:
+                self._fused_unsupported = True
+                logger.info(
+                    "grammar cannot export a dense fused table (cap %d "
+                    "bytes); decode stays on the sparse chunked path",
+                    self.fused_table_bytes,
+                )
+                return False
+            self._fused_next_d = jnp.asarray(tables.next_state)
+        return True
+
+    def _fused_chunk_dispatch(self, prefix: _PrefixKV):
+        """Dispatch ONE fused decode chunk (no host sync); returns the
+        device pair (emitted tokens [M+1, chunk_steps], steps_run scalar).
+        The fused twin of _chunk_dispatch."""
+        self._rng, sub = jax.random.split(self._rng)
+        table = (
+            self._fused_next_d if self._constrained else self._fused_dummy
+        )
+        (
+            self.kv.k, self.kv.v,
+            self._tok_d, self._pos_d, self._act_d, self._st_d,
+            self._budget_d, toks_d, steps_d,
+        ) = self._fused_chunk(
+            self.params, self.cfg, self.kv.k, self.kv.v,
+            self._padded_tables(),
+            prefix.k, prefix.v, jnp.int32(prefix.length),
+            self._tok_d, self._pos_d, self._act_d, self._st_d,
+            self._budget_d,
+            table, self._done_state,
+            jnp.int32(self.tokenizer.eos_id),
+            jnp.int32(self.tokenizer.pad_id),
+            sub, jnp.float32(self.temperature),
+            self.chunk_steps, self._constrained, self.top_k,
+            self.paged_attn,
+        )
+        self.stats["chunks"] += 1
+        self.stats["fused_chunks"] += 1
+        return toks_d, steps_d
+
+    def _mean_decode_ctx(self) -> float:
+        """Host-side mean attention context of in-flight decode slots
+        (prefix + prompt + generated so far) — feeds the profiler's fused
+        FLOP books without a device fetch."""
+        if not self._by_slot:
+            return float(self.prefix_len)
+        own = [
+            req.prompt_len + len(req.generated)
+            for req in self._by_slot.values()
+        ]
+        return self.prefix_len + sum(own) / len(own)
+
+    def step_fused(self, chunks: int = 1) -> list[Finished]:
+        """step()'s fused twin: `chunks` while_loop decode chunks dispatched
+        back-to-back, then ONE host sync. Early exit makes over-dispatch
+        free (a finished batch's remaining chunks run zero iterations), so
+        token accounting stays exact — the span and stats book tokens
+        actually emitted, never chunk capacity. Falls back to step() when
+        the fused runtime can't serve (_fused_ready)."""
+        if not self._by_slot:
+            return []
+        if not self._fused_ready():
+            self.stats["fused_fallbacks"] += 1
+            return self.step(chunks)
+        prof = self.profiler
+        t0 = time.perf_counter() if prof is not None else 0.0
+        with spans.span("decode_chunk", chunks=chunks, fused=True) as sp:
+            tok_before = self.stats["decode_tokens"]
+            step_before = self.stats["fused_steps"]
+            finished = self._step_fused_inner(chunks, prof, t0)
+            if sp is not None:
+                sp.attrs["finished"] = len(finished)
+                sp.attrs["tokens"] = self.stats["decode_tokens"] - tok_before
+                sp.attrs["steps"] = self.stats["fused_steps"] - step_before
+        return finished
+
+    def _step_fused_inner(self, chunks: int, prof, t0: float) -> list[Finished]:
+        prefix = self._prefix or self._get_empty_prefix()
+        emissions: list[jax.Array] = list(self._pending_emissions)
+        self._pending_emissions = []
+        steps_ds: list[jax.Array] = []
+        any_active = bool(
+            (self._act_np & (self._budget_np > 0))[list(self._by_slot)].any()
+        )
+        ctx = self._mean_decode_ctx() if prof is not None else 0.0
+        if any_active:
+            for _ in range(max(1, chunks)):
+                toks_d, steps_d = self._fused_chunk_dispatch(prefix)
+                emissions.append(toks_d)
+                steps_ds.append(steps_d)
+        t_disp = time.perf_counter() if prof is not None else 0.0
+        fetched = jax.device_get(
+            (emissions, steps_ds, self._act_d, self._budget_d, self._first_d)
+        )
+        emitted_np, steps_np, act_np, budget_np, first_np = fetched
+        t_sync = time.perf_counter() if prof is not None else 0.0
+        self.stats["syncs"] += 1
+        self.stats["fused_steps"] += int(sum(int(s) for s in steps_np))
+        tok_before = self.stats["decode_tokens"]
+        finished = self._finish_harvest(emitted_np, act_np, budget_np, first_np)
+        if prof is not None:
+            now = time.perf_counter()
+            prof.on_fused(
+                wall_s=now - t0,
+                dispatch_s=t_disp - t0,
+                sync_s=t_sync - t_disp,
+                harvest_s=now - t_sync,
+                steps=int(sum(int(s) for s in steps_np)),
+                tokens=self.stats["decode_tokens"] - tok_before,
+                chunks=len(steps_ds),
+                ctx=ctx,
+            )
+        return finished
+
+    def decode_fused(self) -> list[Finished]:
+        """Drive every in-flight slot to COMPLETION through the fused
+        runtime: dispatch ceil(max remaining budget / chunk_steps) fused
+        chunks back-to-back with no intervening host sync (they pipeline
+        on device; early exit makes post-completion chunks free), then
+        harvest with ONE host sync per chunk in dispatch order — the
+        per-token round trip is gone and the per-chunk sync overlaps the
+        later chunks' device execution. The device-side budget guarantees
+        completion within the dispatched chunks. Falls back to a step()
+        drain when the fused runtime can't serve."""
+        if not self._by_slot:
+            return []
+        if not self._fused_ready():
+            self.stats["fused_fallbacks"] += 1
+            out: list[Finished] = []
+            while self._by_slot:
+                out.extend(self.step())
+            return out
+        prof = self.profiler
+        t0 = time.perf_counter() if prof is not None else 0.0
+        ctx = self._mean_decode_ctx() if prof is not None else 0.0
+        prefix = self._prefix or self._get_empty_prefix()
+        emissions: list[jax.Array] = list(self._pending_emissions)
+        self._pending_emissions = []
+        live = list(self._by_slot)
+        budget_max = int(self._budget_np[live].max()) if live else 0
+        n_chunks = max(1, -(-budget_max // self.chunk_steps))
+        handles = []
+        for _ in range(n_chunks):
+            handles.append(self._fused_chunk_dispatch(prefix))
+        t_disp = time.perf_counter() if prof is not None else 0.0
+        # Pending (piggybacked) emissions are chronologically FIRST per
+        # slot; fetching them is its own host sync and is counted as one
+        # (by the time it runs, every chunk is already enqueued, so it
+        # gates nothing extra — but the books must not undercount).
+        emitted_np: list[np.ndarray] = []
+        if emissions:
+            emitted_np = list(jax.device_get(emissions))
+            self.stats["syncs"] += 1
+        steps_total = 0
+        for toks_d, steps_d in handles:
+            toks_np, steps_np = jax.device_get((toks_d, steps_d))  # graftlint: ok[device-sync-in-loop] — THE fused harvest cadence: one sync per CHUNK (chunk_steps tokens), never per token, while later chunks keep executing on device
+            emitted_np.append(toks_np)
+            steps_total += int(steps_np)
+            self.stats["syncs"] += 1
+        t_sync = time.perf_counter() if prof is not None else 0.0
+        self.stats["fused_steps"] += steps_total
+        act_np, budget_np, first_np = jax.device_get(
+            (self._act_d, self._budget_d, self._first_d)
+        )
+        tok_before = self.stats["decode_tokens"]
+        finished = self._finish_harvest(emitted_np, act_np, budget_np, first_np)
+        if prof is not None:
+            now = time.perf_counter()
+            prof.on_fused(
+                wall_s=now - t0,
+                dispatch_s=t_disp - t0,
+                sync_s=t_sync - t_disp,
+                harvest_s=now - t_sync,
+                steps=steps_total,
+                tokens=self.stats["decode_tokens"] - tok_before,
+                chunks=n_chunks,
+                ctx=ctx,
+            )
         return finished
 
     def release_slot(self, slot: int) -> None:

@@ -1,0 +1,38 @@
+"""Fused on-device decode runtime.
+
+The autoregressive loop as ONE XLA program (*Kernel Looping*, PAPERS.md):
+a `lax.while_loop` whose body runs the model forward, samples on device
+(greedy + temperature/top-k under threaded PRNG keys), applies the grammar
+as a dense transition-table gather, appends KV toward the paged cache, and
+detects per-slot stops — so the host syncs once per harvest CHUNK, never
+per token, and a finished batch's remaining iterations cost nothing (the
+loop exits the moment no slot is live).
+
+Modules:
+- tables.py  — dense [states, vocab] next-state table export from a
+  DecisionDFA (the allowed-token mask is `next >= 0`); size-capped, the
+  engine falls back to the sparse chunked path when a grammar cannot fuse.
+- sampler.py — the on-device sampling step shared by every fused chunk.
+- loop.py    — the while_loop decode program (fused_decode_chunk_impl).
+
+The engine-facing surface is InferenceEngine.step_fused / decode_fused
+(engine/engine.py), which composes with the admission plane (packs admit
+into fused slots) and falls back to _decode_chunk_impl whenever grammar or
+spec features can't fuse.
+"""
+
+from k8s_llm_scheduler_tpu.engine.fused.loop import fused_decode_chunk_impl
+from k8s_llm_scheduler_tpu.engine.fused.sampler import sample_fused
+from k8s_llm_scheduler_tpu.engine.fused.tables import (
+    DENSE_TABLE_MAX_BYTES,
+    DenseGrammarTables,
+    dense_tables,
+)
+
+__all__ = [
+    "DENSE_TABLE_MAX_BYTES",
+    "DenseGrammarTables",
+    "dense_tables",
+    "fused_decode_chunk_impl",
+    "sample_fused",
+]

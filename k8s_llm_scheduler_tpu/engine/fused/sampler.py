@@ -1,0 +1,71 @@
+"""On-device sampling for the fused decode loop.
+
+One function, called once per while_loop iteration, entirely traced:
+grammar mask (dense-table gather) or pad/vocab-limit mask, then greedy /
+temperature / top-k selection under a threaded PRNG key (the loop body
+splits its carried key each step — the stream never leaves the device).
+
+Greedy (temperature == 0) is TOKEN-IDENTICAL to the chunked path's
+K-space sparse sampling: both argmax the same allowed logit set and both
+break ties toward the lowest token id (tests/test_fused.py pins it).
+Top-k restricts only the SAMPLED distribution — the greedy branch reads
+the unrestricted masked logits, so turning top-k on can never change a
+greedy decode.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from k8s_llm_scheduler_tpu.ops.attention import NEG_INF
+
+
+def sample_fused(
+    logits,        # [R, V] f32
+    st,            # [R] int32 current DFA states (ignored unconstrained)
+    dense_next,    # [S, V] int32 transition table (-1 disallowed)
+    key,           # threaded PRNG key for this step
+    temperature,   # scalar f32 (0 = greedy)
+    top_k: int,    # static: 0 = full distribution
+    constrained: bool,       # static
+    pad_id,        # scalar int32
+    vocab_limit: int | None = None,  # static (engine._sample_unconstrained)
+):
+    """Returns (token [R] int32, next_state [R] int32).
+
+    Constrained: the allowed mask is `dense_next[st] >= 0` and the
+    transition is one gather — no K-space mapping, no per-grammar compile
+    variants beyond the state-capacity bucket. Unconstrained: pad (the
+    idle-slot sentinel) and ids past the tokenizer's table are masked,
+    exactly as the chunked path does; next_state passes through."""
+    V = logits.shape[-1]
+    if constrained:
+        rows = dense_next[st]  # [R, V]
+        masked = jnp.where(rows >= 0, logits, NEG_INF)
+    else:
+        ids = jnp.arange(V)[None, :]
+        bad = ids == pad_id
+        if vocab_limit is not None and vocab_limit < V:
+            bad = bad | (ids >= vocab_limit)
+        masked = jnp.where(bad, NEG_INF, logits)
+
+    greedy = jnp.argmax(masked, axis=-1)
+    if top_k and 0 < top_k < V:
+        kth = jax.lax.top_k(masked, top_k)[0][..., -1:]
+        sample_logits = jnp.where(masked < kth, NEG_INF, masked)
+    else:
+        sample_logits = masked
+    scaled = sample_logits / jnp.maximum(temperature, 1e-6)
+    sampled = jax.random.categorical(key, scaled, axis=-1)
+    tok = jnp.where(temperature > 0, sampled, greedy).astype(jnp.int32)
+
+    if constrained:
+        nxt = jnp.take_along_axis(rows, tok[:, None], axis=1)[:, 0]
+        # A sampled token is always allowed for a live state; a state with
+        # no out-edges (never reachable — done self-loops on pad) would
+        # yield -1, clamped to "stay" so idle rows can't corrupt st.
+        new_st = jnp.where(nxt >= 0, nxt, st).astype(jnp.int32)
+    else:
+        new_st = st
+    return tok, new_st

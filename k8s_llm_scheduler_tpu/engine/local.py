@@ -851,9 +851,14 @@ class LocalLLMBackend:
     def _drive_packs(self, packs: "list[dict]") -> None:
         """Advance in-flight packed admissions by one decode step and
         resolve any finished decisions (this also harvests decode chunks
-        piggybacked during admission — the engine's one sync point)."""
+        piggybacked during admission — the engine's one sync point).
+        Packs admit into FUSED slots: the step routes through the fused
+        while_loop runtime when the engine carries one (engine/fused/),
+        which early-exits past finished slots and falls back to the
+        sparse chunked path on its own when the grammar can't fuse."""
         try:
-            fins = self.engine.step()
+            step_fused = getattr(self.engine, "step_fused", None)
+            fins = step_fused() if step_fused is not None else self.engine.step()
         except Exception as exc:
             logger.exception("packed decode step failed")
             for pk in packs:
@@ -1217,6 +1222,8 @@ def build_local_backend(
     delta_prompts: bool = False,
     repin_fraction: float = 0.25,
     max_pins: int = 4,
+    fused_decode: bool = True,
+    top_k: int = 0,
 ) -> LocalLLMBackend:
     """Construct the full local stack: params (from an HF safetensors or
     orbax checkpoint when checkpoint_path is set, random-init otherwise —
@@ -1336,6 +1343,8 @@ def build_local_backend(
         decode_matmul=decode_matmul,
         mesh=mesh if multi else None,
         admission_chunk_tokens=admission_chunk_tokens,
+        fused_decode=fused_decode,
+        top_k=top_k,
     )
     if spec_enabled:
         if multi:

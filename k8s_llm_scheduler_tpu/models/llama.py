@@ -792,6 +792,50 @@ def forward_decode_buffered(
     return _logits(params, cfg, x), chunk_k, chunk_v
 
 
+def forward_decode_fused_body(
+    params: Params,
+    cfg: LlamaConfig,
+    tokens: jax.Array,
+    positions: jax.Array,
+    k_own: jax.Array,
+    v_own: jax.Array,
+    own_lens: jax.Array,
+    chunk_k: jax.Array,
+    chunk_v: jax.Array,
+    tail_len: jax.Array,
+    prefix_k_all: jax.Array,
+    prefix_v_all: jax.Array,
+    prefix_len: jax.Array,
+    page_tables: jax.Array | None = None,
+    own_impl: str = "dense",
+    shmap: Any = None,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """The fused decode loop's BODY forward (engine/fused/loop.py).
+
+    Identical math to `forward_decode_buffered` — the one-step cascade
+    the chunked scan runs — re-exported under the fused loop's contract so
+    the two decode paths provably share one forward (greedy fused ==
+    chunked token identity rests on this being the SAME function, not a
+    lookalike):
+
+    - every array keeps a STATIC shape across iterations (`tail_len` is
+      the only induction input; the chunk buffer is preallocated at the
+      chunk length), which is what lets `lax.while_loop` carry the state
+      without re-tracing;
+    - the frozen own-page KV (`k_own`/`v_own`) is closed over by the loop
+      body as a while_loop constant — the gather happens once per chunk
+      outside the loop, never per iteration;
+    - per-step K/V lands in the chunk buffer at `tail_len`, so the fused
+      loop's post-exit page flush sees exactly the layout the chunked
+      path's flush was written for.
+    """
+    return forward_decode_buffered(
+        params, cfg, tokens, positions, k_own, v_own, own_lens,
+        chunk_k, chunk_v, tail_len, prefix_k_all, prefix_v_all, prefix_len,
+        page_tables=page_tables, own_impl=own_impl, shmap=shmap,
+    )
+
+
 # ------------------------------------------------------------------- decode
 def forward_decode(
     params: Params,

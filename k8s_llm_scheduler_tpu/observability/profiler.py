@@ -92,6 +92,18 @@ PACK_SEGMENTS = (
     "unattributed",
 )
 
+# Fused-decode segments (engine.step_fused / decode_fused — the fused
+# on-device runtime, engine/fused/): telescoping over each fused harvest's
+# host wall with the same sum==wall identity. dispatch is the back-to-back
+# chunk enqueues (no syncs), host_sync the per-chunk device_get window,
+# harvest the host-side token decode after the last sync.
+FUSED_SEGMENTS = (
+    "dispatch",
+    "host_sync",
+    "harvest",
+    "unattributed",
+)
+
 # Peak dense bf16 TFLOP/s by jax device_kind (public spec sheets). Shared
 # with bench.py's MFU figures so the profiler's decomposition and the
 # bench headline always normalize against the same peak.
@@ -185,6 +197,15 @@ class EngineProfiler:
         self._pack_ring: deque[dict] = deque(maxlen=self.window)
         self._pack_totals = {name: 0.0 for name in PACK_SEGMENTS}
         self._pack_totals["wall"] = 0.0
+        # Fused-decode books (engine/fused/): per-harvest records with
+        # telescoping FUSED_SEGMENTS and their own MFU figure — the
+        # before/after proof the fused runtime is measured against.
+        self._fused_ring: deque[dict] = deque(maxlen=self.window)
+        self._fused_totals = {name: 0.0 for name in FUSED_SEGMENTS}
+        self._fused_totals["wall"] = 0.0
+        self._fused_flops = 0.0
+        self._fused_tokens = 0
+        self.fused_profiled = 0
         self._prefix_prefills: deque[tuple[int, int]] = deque(
             maxlen=self.window
         )  # (tokens prefilled, prefix length)
@@ -401,6 +422,72 @@ class EngineProfiler:
                 self._pack_totals[name] += seg[name]
             self._pack_totals["wall"] += wall
 
+    def on_fused(
+        self,
+        *,
+        wall_s: float,
+        dispatch_s: float,
+        sync_s: float,
+        harvest_s: float,
+        steps: int,
+        tokens: int,
+        chunks: int,
+        ctx: float = 0.0,
+    ) -> None:
+        """One fused harvest completed (engine.step_fused / decode_fused).
+        The three measured segments partition the wall by construction
+        (consecutive perf_counter fences), so sum(segments) == wall holds
+        exactly and the acceptance test pins it. `tokens` counts EMITTED
+        tokens (pad-filtered, early-exit aware) — never chunk capacity —
+        and `ctx` is the mean decode attention context for the FLOP books.
+        """
+        wall = max(float(wall_s), 0.0)
+        seg = {
+            "dispatch": max(float(dispatch_s), 0.0),
+            "host_sync": max(float(sync_s), 0.0),
+            "harvest": max(float(harvest_s), 0.0),
+        }
+        seg["unattributed"] = max(wall - sum(seg.values()), 0.0)
+        flops = 0.0
+        if self.cfg is not None and tokens > 0:
+            flops = tokens * (
+                matmul_flops_per_token(self.cfg)
+                + attn_flops_per_token(self.cfg, max(float(ctx), 0.0))
+            )
+        record = {
+            "harvest": 0,  # stamped under the lock below
+            "chunks": int(chunks),
+            "steps": int(steps),
+            "tokens": int(tokens),
+            "wall_ms": wall * 1000.0,
+            "segments_ms": {k: v * 1000.0 for k, v in seg.items()},
+            "flops": flops,
+        }
+        with self._lock:
+            self.fused_profiled += 1
+            record["harvest"] = self.fused_profiled
+            if len(self._fused_ring) == self._fused_ring.maxlen:
+                old = self._fused_ring[0]
+                for name in FUSED_SEGMENTS:
+                    self._fused_totals[name] = max(
+                        self._fused_totals[name]
+                        - old["segments_ms"].get(name, 0.0) / 1000.0,
+                        0.0,
+                    )
+                self._fused_totals["wall"] = max(
+                    self._fused_totals["wall"] - old["wall_ms"] / 1000.0, 0.0
+                )
+                self._fused_flops = max(self._fused_flops - old["flops"], 0.0)
+                self._fused_tokens = max(
+                    self._fused_tokens - old["tokens"], 0
+                )
+            self._fused_ring.append(record)
+            for name in FUSED_SEGMENTS:
+                self._fused_totals[name] += seg.get(name, 0.0)
+            self._fused_totals["wall"] += wall
+            self._fused_flops += flops
+            self._fused_tokens += int(tokens)
+
     def _prefill_tokens_per_decision_locked(self) -> float | None:
         """Windowed prefill tokens per decision: (wave suffix tokens +
         packed tokens + prefix tokens actually prefilled) / decisions.
@@ -492,6 +579,11 @@ class EngineProfiler:
             pack_ring = list(self._pack_ring)
             pack_totals = dict(self._pack_totals)
             packs = self.packs_profiled
+            fused_ring = list(self._fused_ring)
+            fused_totals = dict(self._fused_totals)
+            fused_flops = self._fused_flops
+            fused_tokens = self._fused_tokens
+            fused = self.fused_profiled
             tpd = self._prefill_tokens_per_decision_locked()
         wall = totals["wall"]
         n_warm = sum(1 for r in ring if not r["cold_compile"])
@@ -551,6 +643,38 @@ class EngineProfiler:
                 },
                 "ring": pack_ring,
             }
+        if fused:
+            fused_wall = fused_totals["wall"]
+            fused_out: dict[str, Any] = {
+                "harvests_profiled": fused,
+                "tokens": fused_tokens,
+                "wall_ms_total": round(fused_wall * 1000.0, 3),
+                "segments_ms_total": {
+                    name: round(fused_totals[name] * 1000.0, 3)
+                    for name in FUSED_SEGMENTS
+                },
+                "segment_frac": {
+                    name: (
+                        round(fused_totals[name] / fused_wall, 4)
+                        if fused_wall > 0
+                        else 0.0
+                    )
+                    for name in FUSED_SEGMENTS
+                },
+                "ring": fused_ring,
+            }
+            if fused_wall > 0:
+                fused_out["tokens_per_s"] = round(
+                    fused_tokens / fused_wall, 1
+                )
+                fused_out["achieved_tflops"] = round(
+                    fused_flops / fused_wall / 1e12, 4
+                )
+                if self.peak_flops and fused_flops > 0:
+                    fused_out["mfu_decode"] = round(
+                        fused_flops / fused_wall / self.peak_flops, 5
+                    )
+            out["fused"] = fused_out
         if tpd is not None:
             out["prefill_tokens_per_decision"] = round(tpd, 2)
         return out
@@ -564,6 +688,9 @@ class EngineProfiler:
             waves = self.waves_profiled
             pack_totals = dict(self._pack_totals)
             packs = self.packs_profiled
+            fused_totals = dict(self._fused_totals)
+            fused_flops = self._fused_flops
+            fused = self.fused_profiled
             tpd = self._prefill_tokens_per_decision_locked()
         wall = totals["wall"]
         out: dict[str, float] = {"waves_profiled": float(waves)}
@@ -579,6 +706,19 @@ class EngineProfiler:
                     round(pack_totals[name] / pack_wall, 4)
                     if pack_wall > 0
                     else 0.0
+                )
+        if fused:
+            out["fused_profiled"] = float(fused)
+            fused_wall = fused_totals["wall"]
+            for name in FUSED_SEGMENTS:
+                out[f"fused_{name}_frac"] = (
+                    round(fused_totals[name] / fused_wall, 4)
+                    if fused_wall > 0
+                    else 0.0
+                )
+            if self.peak_flops and fused_wall > 0 and fused_flops > 0:
+                out["fused_mfu_decode"] = round(
+                    fused_flops / fused_wall / self.peak_flops, 5
                 )
         if tpd is not None:
             out["prefill_tokens_per_decision"] = round(tpd, 2)

@@ -9,7 +9,15 @@ engine every `interval_s` and derives
 - `batch_occupancy`     in-flight paged slots / max_slots
 - `kv_page_util`        allocated KV pages / pool size
 - `prefix_cache_hit_rate`  prefix hits / (hits + prefills), lifetime ratio
-- `tokens_per_s`        decode-token delta / wall delta (window rate)
+- `tokens_per_s`        decode-token delta / wall delta (window rate);
+                        counts EMITTED tokens (the engine books only
+                        pad-filtered harvested tokens, exact under the
+                        fused runtime's early-exiting chunks). A window
+                        in which NO harvest sync landed reports None —
+                        under fused chunked harvest the device may be
+                        mid-chunk with tokens not yet visible, and a
+                        fabricated 0.0 would saw-tooth the gauge at the
+                        harvest cadence instead of measuring a rate.
 - `hbm_used_frac`       device bytes_in_use / bytes_limit (None off-TPU)
 
 `latest()` feeds /metrics as gauges; `series()` backs /debug/engine with
@@ -58,7 +66,7 @@ class EngineSampler:
         self._series: dict[str, deque[tuple[float, float | None]]] = {
             name: deque(maxlen=self.window) for name in SERIES
         }
-        self._last_tokens: tuple[float, int] | None = None
+        self._last_tokens: tuple[float, int, int] | None = None
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self.samples_taken = 0
@@ -108,6 +116,7 @@ class EngineSampler:
         out["hbm_used_frac"] = self._hbm_used_frac()
 
         tokens = int(stats.get("decode_tokens", 0))
+        syncs = int(stats.get("syncs", 0))
         # The rate baseline, clock read, and ring appends share ONE lock
         # acquisition: the background thread and /debug/engine's
         # cold-sample path (handler threads) may sample concurrently, and
@@ -118,14 +127,27 @@ class EngineSampler:
         with self._lock:
             now = self._clock()
             if self._last_tokens is not None:
-                t_prev, n_prev = self._last_tokens
+                t_prev, n_prev, s_prev = self._last_tokens
                 dt = now - t_prev
-                out["tokens_per_s"] = (
-                    max(tokens - n_prev, 0) / dt if dt > 0 else None
-                )
+                if dt <= 0:
+                    out["tokens_per_s"] = None
+                elif syncs == s_prev and tokens == n_prev:
+                    # No harvest landed in this window: under fused
+                    # chunked harvest the device may be mid-chunk with
+                    # emitted tokens not yet host-visible — the rate is
+                    # UNKNOWN, not zero, and the baseline is NOT advanced:
+                    # the next synced sample reports the exact emitted
+                    # rate over the whole elapsed span, so tokens decoded
+                    # during unsynced windows are never misattributed.
+                    # (A window WITH a sync and zero new tokens is
+                    # genuine idle and reports 0.0.)
+                    out["tokens_per_s"] = None
+                else:
+                    out["tokens_per_s"] = max(tokens - n_prev, 0) / dt
+                    self._last_tokens = (now, tokens, syncs)
             else:
                 out["tokens_per_s"] = None
-            self._last_tokens = (now, tokens)
+                self._last_tokens = (now, tokens, syncs)
             self.samples_taken += 1
             for name in SERIES:
                 self._series[name].append((now, out[name]))

@@ -162,6 +162,12 @@ class SpeculativeDecoder:
         # accept/reject deltas — per-round spans would be dozens per request
         s0 = self.stats
         before = (s0.proposed, s0.accepted, s0.rounds, s0.disables)
+        # Explicit NON-FUSED interop (engine/fused/): a speculative round
+        # diverges the slot's device decode state from the host mirrors
+        # mid-round (truncate/restore), so fused chunks must not run while
+        # one is open — engine.step_fused checks fused_hold and falls back
+        # to the plain chunked path, which is also what _fallback drives.
+        eng.fused_hold += 1
         try:
             with spans.span("spec_decode") as sp:
                 out = self._generate_admitted(
@@ -184,6 +190,8 @@ class SpeculativeDecoder:
             if slot in eng._by_slot:
                 eng.release_slot(slot)
             raise
+        finally:
+            eng.fused_hold -= 1
 
     def _generate_admitted(
         self,
@@ -260,7 +268,7 @@ class SpeculativeDecoder:
                     r_verify, jnp.float32(eng.temperature),
                     eng._constrained, eng.temperature == 0.0,
                 )
-                a, t_next, st_next, d_toks_np, d_states_np = jax.device_get(
+                a, t_next, st_next, d_toks_np, d_states_np = jax.device_get(  # graftlint: ok[device-sync-in-loop] — the speculative round's ONE host fetch per K proposed tokens: accept/rollback is a host decision (kv.truncate frees pages); bounded at 1 sync per round by design
                     (a_d, t_next_d, st_next_d, d_toks, d_states)
                 )
             eng.stats["syncs"] += 1

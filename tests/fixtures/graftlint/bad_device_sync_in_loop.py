@@ -1,0 +1,54 @@
+"""TRUE-POSITIVE fixture: device-sync-in-loop.
+
+Reproduces the pre-fused-runtime decode shape: an autoregressive HOST
+loop that synchronizes with the device every iteration (`.item()` /
+`jax.device_get` / `np.asarray` on device values inside the `for`/`while`
+body). Each iteration pays a full dispatch round trip — the exact
+synchronization boundary engine/fused/ moves on-device (*Kernel
+Looping*): the shipped engine dispatches whole fused chunks and syncs
+once per harvest, never per token.
+"""
+
+import jax
+import numpy as np
+
+
+def decode_per_token(step_fn, state, n_steps):
+    out = []
+    for _ in range(n_steps):
+        logits, state = step_fn(state)
+        # BAD: one host round trip per decoded token
+        tok = int(jax.device_get(logits.argmax()))
+        out.append(tok)
+    return out
+
+
+def drain_until_done(step_fn, state):
+    while True:
+        done, state = step_fn(state)
+        # BAD: .item() blocks the dispatch pipeline every iteration
+        if done.item():
+            return state
+
+
+def gather_rows(step_fn, state, rows):
+    acc = []
+    for r in rows:
+        vals, state = step_fn(state, r)
+        # BAD: np.asarray on a device value forces a transfer per row
+        acc.append(np.asarray(vals))
+    return acc
+
+
+def harvest_per_chunk(handles):
+    """Suppressed: one sync per harvest CHUNK (not per token) is the
+    fused runtime's own discipline — the pragma records the judgment."""
+    out = []
+    for h in handles:
+        out.append(jax.device_get(h))  # graftlint: ok[device-sync-in-loop] — fixture: one sync per harvest chunk by design, later chunks keep executing on device
+    return out
+
+
+def good_batched_harvest(handles):
+    """The shipped discipline: ONE device_get for everything."""
+    return jax.device_get(tuple(handles))

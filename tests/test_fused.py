@@ -1,0 +1,433 @@
+"""Fused on-device decode runtime (engine/fused/).
+
+Host-side table/sampler tests are pure logic; engine tests run on a micro
+real engine (f32, 2 layers — the test_admission pattern, compiles in
+seconds). The load-bearing acceptance pin: greedy fused decode is
+TOKEN-IDENTICAL to the chunked path AND to serial whole-prompt generate()
+— constrained and unconstrained — plus exact token accounting under early
+exit, the documented fallbacks (dense-table size cap, spec hold, disabled
+runtime), admission-plane composition (packs admit into fused slots), and
+the profiler's fused-segment telescoping (sum == wall).
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from k8s_llm_scheduler_tpu.engine.constrained import (
+    build_decision_dfa,
+    dense_transition_table,
+    sparse_tables,
+)
+from k8s_llm_scheduler_tpu.engine.engine import InferenceEngine
+from k8s_llm_scheduler_tpu.engine.fused import dense_tables, sample_fused
+from k8s_llm_scheduler_tpu.engine.tokenizer import ByteTokenizer
+from k8s_llm_scheduler_tpu.models.configs import LlamaConfig
+from k8s_llm_scheduler_tpu.observability.profiler import (
+    FUSED_SEGMENTS,
+    EngineProfiler,
+)
+from k8s_llm_scheduler_tpu.observability.sampler import EngineSampler
+
+TOK = ByteTokenizer()
+
+MICRO = LlamaConfig(
+    name="fused-micro", vocab_size=512, d_model=64, n_layers=2,
+    n_heads=2, n_kv_heads=1, d_ff=128, max_seq_len=4096,
+    rope_theta=10000.0, dtype=jnp.float32, tie_embeddings=True,
+)
+
+_PARAMS = None
+
+
+def micro_params():
+    global _PARAMS
+    if _PARAMS is None:
+        from k8s_llm_scheduler_tpu.models.llama import init_params
+
+        _PARAMS = init_params(jax.random.PRNGKey(0), MICRO)
+    return _PARAMS
+
+
+def micro_engine(**kw):
+    kw.setdefault("num_pages", 128)
+    kw.setdefault("page_size", 16)
+    kw.setdefault("max_slots", 4)
+    kw.setdefault("max_pages_per_seq", 16)
+    kw.setdefault("prefill_buckets", (32, 64, 128, 256, 512))
+    kw.setdefault("chunk_steps", 4)
+    kw.setdefault("temperature", 0.0)
+    kw.setdefault("prefix_chunk", 64)
+    return InferenceEngine(micro_params(), MICRO, TOK, **kw)
+
+
+def drain_chunked(engine, n):
+    out = {}
+    deadline = time.monotonic() + 120
+    while len(out) < n:
+        assert time.monotonic() < deadline, "chunked decode wedged"
+        for fin in engine.step():
+            out[fin.req_id] = fin.token_ids
+    return out
+
+
+def drain_fused(engine, n):
+    out = {}
+    deadline = time.monotonic() + 120
+    while len(out) < n:
+        assert time.monotonic() < deadline, "fused decode wedged"
+        for fin in engine.step_fused():
+            out[fin.req_id] = fin.token_ids
+    return out
+
+
+# ------------------------------------------------------------ dense tables
+class TestDenseTables:
+    def _dfa(self):
+        return build_decision_dfa(
+            TOK, ["node-a", "node-b2"], max_reason_tokens=4
+        )
+
+    def test_table_matches_dfa_edges(self):
+        dfa = self._dfa()
+        table = dense_transition_table(dfa)
+        assert table.shape == (dfa.n_states, dfa.vocab_size)
+        for s, out in enumerate(dfa.edges):
+            row = table[s]
+            allowed = np.nonzero(row >= 0)[0]
+            assert sorted(allowed.tolist()) == sorted(out.keys())
+            for tok, dst in out.items():
+                assert row[tok] == dst
+
+    def test_vocab_widening_pads_disallowed(self):
+        dfa = self._dfa()
+        table = dense_transition_table(dfa, vocab_size=dfa.vocab_size + 64)
+        assert table.shape[1] == dfa.vocab_size + 64
+        assert (table[:, dfa.vocab_size:] == -1).all()
+        with pytest.raises(ValueError):
+            dense_transition_table(dfa, vocab_size=dfa.vocab_size - 1)
+
+    def test_allowed_sets_equal_sparse_tables(self):
+        """The fused mask and the sparse K-space rows describe the SAME
+        allowed set per state — the foundation of greedy identity."""
+        dfa = self._dfa()
+        dense = dense_transition_table(dfa)
+        sp = sparse_tables(dfa)
+        for s in range(dfa.n_states):
+            dense_allowed = set(np.nonzero(dense[s] >= 0)[0].tolist())
+            sparse_allowed = {t for t in sp.sp_tokens[s].tolist() if t >= 0}
+            assert dense_allowed == sparse_allowed
+
+    def test_size_cap_returns_none(self):
+        dfa = self._dfa()
+        assert dense_tables(dfa, max_bytes=64) is None
+        tables = dense_tables(dfa)
+        assert tables is not None
+        assert tables.done_state == dfa.done_state
+        # cached on the DFA: same object back
+        assert dense_tables(dfa) is tables
+
+
+# ----------------------------------------------------------------- sampler
+class TestSampleFused:
+    def _inputs(self):
+        key = jax.random.PRNGKey(1)
+        logits = jax.random.normal(key, (3, 16)).astype(jnp.float32)
+        dense = np.full((4, 16), -1, dtype=np.int32)
+        dense[0, [2, 5, 9]] = [1, 2, 3]
+        dense[1, [4]] = 2
+        dense[2, [7, 8]] = [3, 3]
+        return logits, jnp.asarray(dense), key
+
+    def test_constrained_greedy_picks_allowed_argmax(self):
+        logits, dense, key = self._inputs()
+        st = jnp.asarray([0, 1, 2], dtype=jnp.int32)
+        tok, nxt = sample_fused(
+            logits, st, dense, key, jnp.float32(0.0), 0, True,
+            jnp.int32(0),
+        )
+        tok, nxt = np.asarray(tok), np.asarray(nxt)
+        rows = np.asarray(dense)
+        for i, s in enumerate([0, 1, 2]):
+            allowed = np.nonzero(rows[s] >= 0)[0]
+            best = allowed[np.argmax(np.asarray(logits)[i, allowed])]
+            assert tok[i] == best
+            assert nxt[i] == rows[s, tok[i]]
+
+    def test_top_k_never_changes_greedy(self):
+        logits, dense, key = self._inputs()
+        st = jnp.asarray([0, 1, 2], dtype=jnp.int32)
+        base, _ = sample_fused(
+            logits, st, dense, key, jnp.float32(0.0), 0, True, jnp.int32(0)
+        )
+        cut, _ = sample_fused(
+            logits, st, dense, key, jnp.float32(0.0), 2, True, jnp.int32(0)
+        )
+        assert np.array_equal(np.asarray(base), np.asarray(cut))
+
+    def test_sampling_stays_inside_allowed_set(self):
+        logits, dense, _ = self._inputs()
+        st = jnp.asarray([0, 0, 0], dtype=jnp.int32)
+        rows = np.asarray(dense)
+        for seed in range(8):
+            tok, _ = sample_fused(
+                logits, st, dense, jax.random.PRNGKey(seed),
+                jnp.float32(1.3), 2, True, jnp.int32(0),
+            )
+            for t in np.asarray(tok):
+                assert rows[0, t] >= 0
+
+    def test_unconstrained_masks_pad_and_vocab_limit(self):
+        logits = jnp.zeros((1, 16), dtype=jnp.float32)
+        # pad (id 0) and the undecodable tail carry the HIGHEST logits —
+        # the mask must still exclude them
+        logits = logits.at[0, 0].set(10.0).at[0, 12:].set(9.0)
+        tok, st = sample_fused(
+            logits, jnp.asarray([5]), jnp.full((1, 1), -1, jnp.int32),
+            jax.random.PRNGKey(0), jnp.float32(0.0), 0, False,
+            jnp.int32(0), vocab_limit=12,
+        )
+        assert 0 < int(tok[0]) < 12
+        assert int(st[0]) == 5  # unconstrained passes state through
+
+
+# ------------------------------------------------------------ identity pins
+class TestFusedIdentity:
+    def test_greedy_fused_equals_chunked_equals_whole_prompt(self):
+        """THE acceptance pin: greedy fused == chunked == whole-prompt
+        serial generate(), token for token (unconstrained arm)."""
+        engine = micro_engine()
+        engine.set_prefix(TOK.encode("CLUSTER STATE: " + " ".join(
+            f"node-{i} cpu={10 + i}" for i in range(6)
+        )))
+        prompts = [
+            TOK.encode("pod-a needs a node"),
+            TOK.encode("pod-b: a somewhat longer request line"),
+            TOK.encode("p-c"),
+        ]
+        serial = [
+            engine.generate(p, max_new_tokens=10).token_ids for p in prompts
+        ]
+        ids = engine.add_requests(prompts, max_new_tokens=10)
+        chunked = drain_chunked(engine, len(prompts))
+        ids2 = engine.add_requests(prompts, max_new_tokens=10)
+        fused = drain_fused(engine, len(prompts))
+        assert [chunked[i] for i in ids] == serial
+        assert [fused[i] for i in ids2] == serial
+        assert engine.stats["fused_chunks"] >= 1
+        assert engine.stats["fused_fallbacks"] == 0
+
+    def test_constrained_identity_and_decode_fused(self):
+        """Grammar arm: the dense-table fused loop emits the same
+        decision JSON as sparse chunked decode, and decode_fused drives
+        to completion with one sync per chunk."""
+        engine = micro_engine()
+        engine.set_prefix(TOK.encode("shared cluster prefix"))
+        engine.set_grammar(build_decision_dfa(
+            TOK, ["node-a", "node-b2"], max_reason_tokens=6
+        ))
+        prompts = [TOK.encode("pod-a"), TOK.encode("pod-b longer")]
+        ids = engine.add_requests(prompts, max_new_tokens=60)
+        chunked = drain_chunked(engine, 2)
+        syncs0 = engine.stats["syncs"]
+        ids2 = engine.add_requests(prompts, max_new_tokens=60)
+        fused = {f.req_id: f for f in engine.decode_fused()}
+        assert [fused[i].token_ids for i in ids2] == [
+            chunked[i] for i in ids
+        ]
+        # one sync per dispatched chunk (+1 state fetch), never per token
+        n_chunks = -(-59 // engine.chunk_steps)
+        assert engine.stats["syncs"] - syncs0 <= n_chunks + 1
+        assert fused[ids2[0]].text.startswith('{"selected_node": ')
+
+    def test_packs_admit_into_fused_slots(self):
+        """Admission-plane composition: admit_packed + step_fused decodes
+        token-identically to serial whole-prompt generate() — the packed
+        block-diagonal prefill's piggybacked emissions harvest through
+        the fused runtime's sync."""
+        engine = micro_engine(admission_chunk_tokens=16)
+        engine.set_prefix(TOK.encode("cluster prefix for packs"))
+        prompts = [
+            TOK.encode("pod-a needs"),
+            TOK.encode("p" * 45),  # spans 3 chunks of 16
+        ]
+        serial = [
+            engine.generate(p, max_new_tokens=8).token_ids for p in prompts
+        ]
+        req_ids = engine.admit_packed(prompts, max_new_tokens=8)
+        out = drain_fused(engine, 2)
+        assert [out[r] for r in req_ids] == serial
+        assert engine.stats["packed_admissions"] == 1
+        assert engine.stats["fused_chunks"] >= 1
+
+
+# ------------------------------------------------------- exact accounting
+class TestExactAccounting:
+    def test_early_exit_books_only_steps_run(self):
+        """A budget far below the chunk capacity must book EXACTLY the
+        steps/tokens that ran — the while_loop's early exit, not
+        chunk-capacity estimates."""
+        engine = micro_engine(chunk_steps=8)
+        engine.set_prefix(TOK.encode("prefix"))
+        ids = engine.add_requests(
+            [TOK.encode("pod-x")], max_new_tokens=3
+        )
+        tok0 = engine.stats["decode_tokens"]
+        out = drain_fused(engine, 1)
+        emitted = len(out[ids[0]])
+        assert emitted == 3
+        # first token came from admission; the fused loop ran budget-1
+        # steps of an 8-step chunk and exited
+        assert engine.stats["fused_steps"] == 2
+        assert engine.stats["decode_tokens"] - tok0 == emitted - 1
+
+    def test_over_dispatch_is_free_and_exact(self):
+        """step_fused(chunks=4) on a request finishing in chunk 1: the
+        extra dispatched chunks run zero iterations and book nothing."""
+        engine = micro_engine(chunk_steps=8)
+        engine.set_prefix(TOK.encode("prefix"))
+        ids = engine.add_requests([TOK.encode("pod-y")], max_new_tokens=4)
+        fins = engine.step_fused(chunks=4)
+        assert [f.req_id for f in fins] == ids
+        assert engine.stats["fused_chunks"] == 4
+        assert engine.stats["fused_steps"] == 3  # budget-1, not 4*8
+        assert len(fins[0].token_ids) == 4
+
+    def test_sampler_rate_counts_emitted_tokens_not_harvest_polls(self):
+        """EngineSampler regression: a window with NO harvest sync
+        reports None (unknown — the device may be mid-fused-chunk), a
+        window with a sync reports the exact emitted-token rate, and a
+        synced idle window reports a genuine 0.0."""
+
+        class FakeEngine:
+            max_slots, free_slots = 4, 4
+
+            class kv:
+                num_pages, pages_free = 64, 64
+
+            stats = {"decode_tokens": 0, "syncs": 0}
+
+        eng = FakeEngine()
+        clock = {"t": 100.0}
+        sampler = EngineSampler(eng, clock=lambda: clock["t"])
+        sampler.sample_once()
+        # fused chunks in flight: no sync landed yet -> unknown, not 0
+        clock["t"] = 101.0
+        assert sampler.sample_once()["tokens_per_s"] is None
+        # harvest lands 24 emitted tokens; the unsynced window did NOT
+        # advance the baseline, so the rate is exact over the FULL 2s
+        # elapsed span — emitted tokens, never harvest-poll cadence
+        eng.stats = {"decode_tokens": 24, "syncs": 1}
+        clock["t"] = 102.0
+        assert sampler.sample_once()["tokens_per_s"] == pytest.approx(12.0)
+        # a synced window with zero new tokens is genuine idle
+        eng.stats = {"decode_tokens": 24, "syncs": 2}
+        clock["t"] = 103.0
+        assert sampler.sample_once()["tokens_per_s"] == 0.0
+
+
+# ------------------------------------------------------------- fallbacks
+class TestFallbacks:
+    def test_dense_table_cap_falls_back_to_chunked(self):
+        """A grammar too large for the dense-table budget must decode
+        CORRECTLY through the sparse chunked path (fused_fallbacks
+        counts it; output identical to a fused-capable engine)."""
+        engine = micro_engine(fused_table_bytes=64)
+        engine.set_prefix(TOK.encode("shared prefix"))
+        engine.set_grammar(build_decision_dfa(
+            TOK, ["node-a"], max_reason_tokens=4
+        ))
+        ids = engine.add_requests([TOK.encode("pod-a")], max_new_tokens=50)
+        out = drain_fused(engine, 1)
+        assert engine.stats["fused_fallbacks"] >= 1
+        assert engine.stats["fused_chunks"] == 0
+        assert out[ids[0]]  # decoded through the chunked path
+        text = engine.tokenizer.decode(out[ids[0]])
+        assert text.startswith('{"selected_node": "node-a"')
+
+    def test_disabled_runtime_and_fused_hold(self):
+        engine = micro_engine(fused_decode=False)
+        engine.set_prefix(TOK.encode("p"))
+        engine.add_requests([TOK.encode("pod")], max_new_tokens=3)
+        drain_fused(engine, 1)
+        assert engine.stats["fused_chunks"] == 0
+        assert engine.stats["fused_fallbacks"] >= 1
+
+        engine2 = micro_engine()
+        engine2.set_prefix(TOK.encode("p"))
+        engine2.fused_hold += 1  # an open speculative round
+        engine2.add_requests([TOK.encode("pod")], max_new_tokens=3)
+        drain_fused(engine2, 1)
+        assert engine2.stats["fused_chunks"] == 0
+        engine2.fused_hold -= 1
+        engine2.add_requests([TOK.encode("pod")], max_new_tokens=3)
+        drain_fused(engine2, 1)
+        assert engine2.stats["fused_chunks"] >= 1
+
+    def test_spec_round_releases_hold(self):
+        """Explicit non-fused interop: a speculative request holds the
+        fused runtime for its own duration and releases it after —
+        greedy output still matches plain decode (self-draft)."""
+        from k8s_llm_scheduler_tpu.spec.decoder import SpeculativeDecoder
+
+        engine = micro_engine(num_pages=256)
+        engine.set_prefix(TOK.encode("spec prefix"))
+        spec = SpeculativeDecoder(engine, micro_params(), MICRO, k=2)
+        engine.attach_spec(spec)
+        prompt = TOK.encode("pod-spec request")
+        plain = engine.generate(prompt, 8, use_spec=False)
+        out = spec.generate(prompt, 8)
+        assert out.token_ids == plain.token_ids
+        assert engine.fused_hold == 0
+        # the fused runtime serves again once the round closed
+        engine.add_requests([prompt], max_new_tokens=3)
+        drain_fused(engine, 1)
+        assert engine.stats["fused_chunks"] >= 1
+
+
+# ---------------------------------------------------------- profiler books
+class TestFusedProfiling:
+    def test_fused_segments_telescope(self):
+        """sum(FUSED_SEGMENTS) == wall, exactly (unit, injected times)."""
+        prof = EngineProfiler(MICRO, peak_tflops=0.01)
+        prof.on_fused(
+            wall_s=0.010, dispatch_s=0.002, sync_s=0.006, harvest_s=0.002,
+            steps=12, tokens=12, chunks=3, ctx=128.0,
+        )
+        snap = prof.snapshot()["fused"]
+        seg_sum = sum(
+            snap["segments_ms_total"][name] for name in FUSED_SEGMENTS
+        )
+        assert seg_sum == pytest.approx(snap["wall_ms_total"], abs=1e-6)
+        assert snap["tokens"] == 12
+        assert snap["mfu_decode"] > 0
+        gauges = prof.gauges()
+        assert gauges["fused_profiled"] == 1.0
+        frac_sum = sum(
+            gauges[f"fused_{name}_frac"] for name in FUSED_SEGMENTS
+        )
+        assert frac_sum == pytest.approx(1.0, abs=0.01)
+
+    def test_engine_integration_telescopes_and_books_exact(self):
+        engine = micro_engine()
+        prof = EngineProfiler(MICRO, peak_tflops=100.0)
+        engine.attach_profiler(prof)
+        engine.set_prefix(TOK.encode("profiled prefix"))
+        ids = engine.add_requests(
+            [TOK.encode("pod-a"), TOK.encode("pod-b")], max_new_tokens=9
+        )
+        out = {f.req_id: f for f in engine.decode_fused()}
+        assert set(out) == set(ids)
+        snap = prof.snapshot()["fused"]
+        assert snap["harvests_profiled"] == 1
+        seg_sum = sum(
+            snap["segments_ms_total"][name] for name in FUSED_SEGMENTS
+        )
+        # to per-segment rounding noise (each figure rounds to 1us)
+        assert seg_sum == pytest.approx(snap["wall_ms_total"], abs=0.01)
+        # tokens booked == emitted decode tokens (first tokens excluded)
+        emitted = sum(len(f.token_ids) - 1 for f in out.values())
+        assert snap["tokens"] == emitted

@@ -82,6 +82,37 @@ class TestSegmentMath:
         snap = prof.snapshot()
         assert snap["coverage_frac"] >= 0.95
 
+    def test_fused_segments_telescope_and_window(self):
+        """Fused-harvest books (engine/fused/): FUSED_SEGMENTS telescope
+        (sum == wall, exactly) and the windowed totals evict correctly."""
+        from k8s_llm_scheduler_tpu.observability.profiler import (
+            FUSED_SEGMENTS,
+        )
+
+        prof = EngineProfiler(
+            cfg=get_config("tiny"), peak_tflops=1.0, window=2
+        )
+        for i in range(3):  # one eviction at window=2
+            prof.on_fused(
+                wall_s=0.020, dispatch_s=0.004, sync_s=0.012,
+                harvest_s=0.004, steps=16, tokens=16, chunks=2,
+                ctx=256.0,
+            )
+        snap = prof.snapshot()["fused"]
+        assert snap["harvests_profiled"] == 3
+        assert len(snap["ring"]) == 2
+        seg_sum = sum(
+            snap["segments_ms_total"][n] for n in FUSED_SEGMENTS
+        )
+        assert seg_sum == pytest.approx(snap["wall_ms_total"])
+        assert snap["wall_ms_total"] == pytest.approx(40.0)  # windowed
+        assert snap["tokens"] == 32
+        assert snap["mfu_decode"] > 0
+        gauges = prof.gauges()
+        assert sum(
+            gauges[f"fused_{n}_frac"] for n in FUSED_SEGMENTS
+        ) == pytest.approx(1.0, abs=0.01)
+
     def test_mfu_decomposition_identity(self):
         """mfu_decode + sum(loss terms) == mfu_device (the decomposition
         contract the module exists for)."""

@@ -81,6 +81,15 @@ class TestRepoIsClean:
         assert "k8s_llm_scheduler_tpu/engine/admission/pinned.py" in files
         assert "k8s_llm_scheduler_tpu/sched/delta.py" in files
         assert "tests/test_admission.py" in files
+        # fused-decode round: the fused runtime (while_loop decode loop,
+        # dense tables, on-device sampler) plus the zero-copy replica
+        # transport — the transport is thread+futures-heavy (outbox
+        # flush protocol), the same 3.11+-API risk class as the worker
+        assert "k8s_llm_scheduler_tpu/engine/fused/loop.py" in files
+        assert "k8s_llm_scheduler_tpu/engine/fused/sampler.py" in files
+        assert "k8s_llm_scheduler_tpu/engine/fused/tables.py" in files
+        assert "k8s_llm_scheduler_tpu/sched/replica.py" in files
+        assert "tests/test_fused.py" in files
         # the lint never lints its own pattern table
         assert "tools/py310_lint.py" not in files
 

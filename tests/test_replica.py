@@ -473,6 +473,189 @@ class TestReconnectBackoff:
                 srv2.close()
 
 
+class TestZeroCopyFraming:
+    def test_vectored_send_handles_partial_writes(self):
+        """_send_frames must reassemble correctly when the kernel accepts
+        arbitrary partial iovec spans (short sendmsg returns that split a
+        header, a payload, and a frame boundary)."""
+        from k8s_llm_scheduler_tpu.sched.replica import (
+            _encode_frame,
+            _send_frames,
+        )
+
+        class ChunkySock:
+            """sendmsg accepts at most `cap` bytes per call."""
+
+            def __init__(self, cap):
+                self.cap = cap
+                self.data = bytearray()
+
+            def sendmsg(self, bufs):
+                take = self.cap
+                n = 0
+                for b in bufs:
+                    piece = bytes(b[:take])
+                    self.data.extend(piece)
+                    n += len(piece)
+                    take -= len(piece)
+                    if take <= 0:
+                        break
+                return n
+
+        objs = [{"id": i, "payload": "x" * (7 * i + 3)} for i in range(5)]
+        for cap in (1, 2, 3, 5, 64, 4096):
+            sock = ChunkySock(cap)
+            _send_frames(sock, [_encode_frame(o) for o in objs])
+            # decode the byte stream back into frames
+            import json as _json
+            import struct as _struct
+
+            buf = bytes(sock.data)
+            decoded = []
+            while buf:
+                (length,) = _struct.unpack(">I", buf[:4])
+                decoded.append(_json.loads(buf[4:4 + length].decode()))
+                buf = buf[4 + length:]
+            assert decoded == objs, f"cap={cap}"
+
+
+class TestBatchedFlush:
+    def test_concurrent_frames_share_one_socket_and_flush(self, server):
+        """Batched decision-frame flushing: a burst of concurrent
+        decisions rides ONE persistent socket (dials == 1 across the
+        whole burst) and every frame reaches the wire (frames_sent
+        exact); flushes never exceed frames (coalescing can only merge
+        syscalls, not add them)."""
+        client = ReplicaClient("127.0.0.1", server.port)
+        try:
+            nodes = make_nodes()
+            with ThreadPoolExecutor(12) as pool:
+                futs = [
+                    pool.submit(
+                        client.get_scheduling_decision, make_pod(i), nodes
+                    )
+                    for i in range(24)
+                ]
+                decisions = [f.result(timeout=30) for f in futs]
+            assert len(decisions) == 24
+            w = client.wire_stats()
+            assert w["dials"] == 1
+            assert w["frames_sent"] == 24
+            assert 1 <= w["flushes"] <= w["frames_sent"]
+            assert w["bytes_sent"] > 0
+            assert w["max_batch"] >= 1
+        finally:
+            client.close()
+
+    def test_send_failure_fails_batchmates_not_hangs(self, server):
+        """A frame whose flush hits a dead socket must resolve every
+        batchmate with BackendError (no caller may hang out its full
+        request timeout)."""
+        client = ReplicaClient("127.0.0.1", server.port, request_timeout_s=5.0)
+        try:
+            client.get_scheduling_decision(make_pod(), make_nodes())  # dial
+            server.close()  # peer gone; next sends hit a dead socket
+            t0 = time.monotonic()
+            with ThreadPoolExecutor(4) as pool:
+                futs = [
+                    pool.submit(
+                        client.get_scheduling_decision,
+                        make_pod(i), make_nodes(),
+                    )
+                    for i in range(4)
+                ]
+                outcomes = []
+                for fut in futs:
+                    try:
+                        outcomes.append(fut.result(timeout=10))
+                    except BackendError as exc:
+                        outcomes.append(exc)
+            assert all(isinstance(o, BackendError) for o in outcomes)
+            assert time.monotonic() - t0 < 5.0  # nobody waited out 5s
+        finally:
+            client.close()
+
+
+class TestPersistentReuseUnderRecovery:
+    def test_kill_restart_reuses_persistent_socket(self):
+        """Connection-reuse keepalive under recovery (the fused decision
+        plane's dispatch transport): kill and restart the worker under
+        in-flight decisions — after recovery, EVERY subsequent decision
+        frame reuses one persistent socket (exactly one re-dial, no
+        per-frame reconnect/handshake), and the first-failure
+        immediate-retry contract holds (a single failed dial opens no
+        backoff window)."""
+        backend = StubBackend(latency_s=0.1)
+        srv1 = ReplicaServer(backend, host="127.0.0.1", port=0)
+        port = srv1.port
+        client = ReplicaClient(
+            "127.0.0.1", port,
+            reconnect_base_s=0.05, reconnect_cap_s=0.2,
+        )
+        srv2 = None
+        try:
+            client.get_scheduling_decision(make_pod(), make_nodes())
+            assert client.wire_stats()["dials"] == 1
+
+            with ThreadPoolExecutor(max_workers=4) as pool:
+                futs = [
+                    pool.submit(
+                        client.get_scheduling_decision,
+                        make_pod(i), make_nodes(),
+                    )
+                    for i in range(4)
+                ]
+                time.sleep(0.03)
+                srv1.close()  # kill under in-flight decisions
+                for fut in futs:
+                    try:
+                        fut.result(timeout=10)
+                    except BackendError:
+                        pass  # in-flight failures are the expected shape
+
+            # First-failure immediate retry: with the server still down,
+            # ONE failed dial must not open a fail-fast window...
+            with pytest.raises(BackendError):
+                client.get_scheduling_decision(make_pod(), make_nodes())
+            assert client._dial_failures >= 1
+            # ...so the very next attempt AFTER the worker rebinds its
+            # socket succeeds without waiting out any backoff (the
+            # "backing off" error shape must not appear once the peer
+            # is up, if only one dial had failed).
+            srv2 = ReplicaServer(StubBackend(), host="127.0.0.1", port=port)
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                try:
+                    client.get_scheduling_decision(make_pod(), make_nodes())
+                    break
+                except BackendError:
+                    time.sleep(0.02)
+            else:
+                pytest.fail("never healed after restart")
+
+            dials_after_heal = client.wire_stats()["dials"]
+            # Post-recovery decisions all reuse the healed socket: the
+            # dial counter must not move again.
+            with ThreadPoolExecutor(4) as pool:
+                futs = [
+                    pool.submit(
+                        client.get_scheduling_decision,
+                        make_pod(i), make_nodes(),
+                    )
+                    for i in range(8)
+                ]
+                for fut in futs:
+                    fut.result(timeout=30)
+            w = client.wire_stats()
+            assert w["dials"] == dials_after_heal
+            assert w["frames_sent"] >= 8
+        finally:
+            client.close()
+            srv1.close()
+            if srv2 is not None:
+                srv2.close()
+
+
 class TestAsyncPath:
     async def test_async_decision_and_fanout(self, server):
         """The natively-async client path resolves without a worker

@@ -44,9 +44,12 @@ def main() -> None:
     ap.add_argument("--reps", type=int, default=2)
     ap.add_argument("--peak-tflops", type=float, default=None)
     ap.add_argument(
-        "--arm", choices=("matmul", "spec"), default="matmul",
+        "--arm", choices=("matmul", "spec", "fused"), default="matmul",
         help="matmul: dense-vs-ragged wave decode; spec: speculative vs "
-             "plain paged decode",
+             "plain paged decode; fused: fused while_loop runtime vs "
+             "sparse chunked decode (engine/fused/) — greedy token "
+             "identity is test-pinned (tests/test_fused.py), this arm "
+             "measures the speed and the syncs-per-request reduction",
     )
     ap.add_argument(
         "--draft", default="tiny",
@@ -62,6 +65,21 @@ def main() -> None:
 
     cfg = bench.build_cfg(args.model)
 
+    if args.arm == "fused":
+        if args.quantize == "int8":
+            from k8s_llm_scheduler_tpu.models.quant import init_params_int8_host
+
+            params = init_params_int8_host(0, cfg)
+        else:
+            params = init_params(jax.random.PRNGKey(0), cfg)
+        # fused_ab interleaves its arms internally; reps widens the best-of
+        summary = bench.fused_ab(
+            args.model, quantize=args.quantize, reps=args.reps,
+            n_prompts=min(args.slots, 8), params=params,
+            peak_override=args.peak_tflops,
+        )
+        print(json.dumps(summary), flush=True)
+        return
     if args.arm == "spec":
         if args.quantize is not None:
             ap.error("--arm spec does not take --quantize (plain bf16 A/B)")

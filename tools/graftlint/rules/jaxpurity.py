@@ -458,6 +458,71 @@ class NonHashableStatic(LintRule):
                 )
 
 
+_LOOP_SYNC_METHODS = ("block_until_ready", "item")
+_LOOP_SYNC_CALLS = (
+    "np.asarray", "numpy.asarray", "jax.device_get", "device_get",
+)
+
+
+def _loop_scope(name: str) -> bool:
+    """Runtime modules only (same discipline as the resilience family's
+    raw-clock rule): the engine/sched hot paths are where a per-iteration
+    sync costs a dispatch-pipeline stall; tests, tools, and bench.py sync
+    deliberately. The fixture corpus stays in scope so the detector stays
+    testable."""
+    if name.startswith("k8s_llm_scheduler_tpu/"):
+        return True
+    return "fixtures/graftlint" in name
+
+
+class DeviceSyncInLoop(LintRule):
+    id = "device-sync-in-loop"
+    family = "jax"
+    description = (
+        "host-device synchronization (.block_until_ready()/.item()/"
+        "np.asarray/jax.device_get) inside a for/while body in a runtime "
+        "module — per-iteration syncs serialize the dispatch pipeline"
+    )
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        if not _loop_scope(ctx.name):
+            return
+        seen: set[int] = set()  # nested loops must not double-report
+        for node in ctx.all_nodes():
+            if not isinstance(node, (ast.For, ast.While, ast.AsyncFor)):
+                continue
+            # Only the BODY repeats: the loop's iterable/test expressions
+            # run once (or once per re-check, host-side), and an `else:`
+            # clause executes exactly once after the loop — neither is a
+            # per-iteration sync.
+            for stmt in node.body:
+                for sub in ast.walk(stmt):
+                    if not isinstance(sub, ast.Call) or id(sub) in seen:
+                        continue
+                    msg = self._classify(sub)
+                    if msg:
+                        seen.add(id(sub))
+                        yield ctx.finding(
+                            self, sub,
+                            f"{msg} inside a loop body — one host round "
+                            f"trip PER ITERATION is the synchronization "
+                            f"boundary the fused decode runtime exists to "
+                            f"remove (Kernel Looping); hoist the sync out "
+                            f"of the loop, batch it into one device_get, "
+                            f"or justify via pragma",
+                        )
+
+    @staticmethod
+    def _classify(call: ast.Call) -> str | None:
+        if isinstance(call.func, ast.Attribute) \
+                and call.func.attr in _LOOP_SYNC_METHODS:
+            return f"device sync `.{call.func.attr}()`"
+        name = dotted_name(call.func)
+        if name in _LOOP_SYNC_CALLS:
+            return f"device sync `{name}(...)`"
+        return None
+
+
 class DonatedBufferReuse(LintRule):
     id = "jit-donated-reuse"
     family = "jax"
@@ -535,5 +600,6 @@ JAX_RULES: list[LintRule] = [
     HostSyncInJit(),
     ClosureMutationInJit(),
     NonHashableStatic(),
+    DeviceSyncInLoop(),
     DonatedBufferReuse(),
 ]
