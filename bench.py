@@ -156,6 +156,17 @@ PRESETS = {
     # bind p50/p99 at replica counts 1/4/16; acceptance bar: 4 replicas
     # >= 2.5x the decisions/s of 1.
     "fleet": {"pods": 600, "nodes": 500, "shapes": 0, "rounds": 1},
+    # elastic fleet autoscaler (fleet/autoscale.py): a seeded DIURNAL
+    # arrival curve (trough -> ~19x peak -> trough, wave-quantized)
+    # replayed against static-N baselines through REAL elastic fleets
+    # (health-gated joins, drain-before-release removals, real binds);
+    # per-wave latency is modeled deterministically from queue position
+    # over the serving replica count (20ms simulated device time), so
+    # the published SLO-burn-vs-replica-seconds frontier is exact and
+    # replayable. Bars: the elastic arm must DOMINATE at least one
+    # static arm on both axes, and every arm binds every pod exactly
+    # once across all scale events (zero dropped, zero double-bound).
+    "autoscale": {"pods": 600, "nodes": 64, "shapes": 32, "rounds": 1},
     # burst AFTER a cluster-state change: every round perturbs node usage
     # (so the cluster prefix differs from the engine's resident group),
     # idles perturb_idle seconds, then bursts — the production shape
@@ -999,6 +1010,14 @@ def chaos_bench(args) -> dict:
             "quality": report.get("quality"),
             "wall_ms": report["wall_ms"],
         }
+        if "autoscale" in report:
+            regimes[regime]["autoscale"] = {
+                k: report["autoscale"][k]
+                for k in ("scale_ups", "scale_downs", "join_failures")
+            }
+            regimes[regime]["scale_events"] = [
+                (e["tick"], e["action"]) for e in report["scale_events"]
+            ]
     assert violations == 0, (
         f"{violations} invariant violation(s) across chaos regimes: "
         + json.dumps({r: v for r, v in regimes.items() if not v["clean"]})
@@ -1008,6 +1027,22 @@ def chaos_bench(args) -> dict:
     assert regimes["brownout"]["degraded_fraction"] > 0, (
         "brownout regime shed no decisions — the degradation ladder "
         "never engaged"
+    )
+    # scale-thrash: flapping arrival at the threshold every wave must
+    # produce BOUNDED oscillation — membership changes strictly fewer
+    # than waves (never one per wave; hysteresis + cooldowns working)
+    thrash = regimes["scale-thrash"]["autoscale"]
+    thrash_changes = thrash["scale_ups"] + thrash["scale_downs"]
+    assert 0 < thrash_changes < 6, (
+        f"scale-thrash oscillation out of bounds: {thrash_changes} "
+        f"membership changes over 6 flapping waves "
+        f"(0 = controller never engaged; >=6 = one per wave, thrashing)"
+    )
+    # join-fail: every mid-join death must roll back AND the post-window
+    # retry must land (the fleet ends the run scaled up)
+    jf = regimes["join-fail"]["autoscale"]
+    assert jf["join_failures"] >= 2 and jf["scale_ups"] >= 1, (
+        f"join-fail regime did not exercise the gate: {jf}"
     )
     return {
         "metric": "chaos",
@@ -1405,6 +1440,281 @@ async def fleet_bench(args) -> dict:
             "prefill_tokens_per_decision": _snapshot_token_table(
                 (args.nodes,)
             )[0],
+        },
+    }
+
+
+# ------------------------------------------------------------- autoscale
+async def _autoscale_arm(
+    scenario, *, elastic: bool, n_static: int = 1, max_replicas: int = 8,
+    service_ms: float = 20.0, threshold_ms: float = 200.0,
+    tick_s: float = 1.0, timeout_s: float = 120.0,
+) -> dict:
+    """One frontier arm: replay the diurnal scenario's waves through a
+    REAL fleet (elastic: AutoscaleController over Fleet.start_join/
+    remove_replica; static: fixed N). Binds are real (exactly-once
+    accounting); per-pod latency is MODELED from queue position over the
+    serving replica count — ceil-position x service time — so the SLO
+    axis is deterministic and identical in structure across arms."""
+    from k8s_llm_scheduler_tpu.chaos.harness import (
+        HashPlacementBackend,
+        _VirtualClock,
+    )
+    from k8s_llm_scheduler_tpu.cluster.fake import FakeCluster, FakeNode
+    from k8s_llm_scheduler_tpu.fleet import Fleet
+    from k8s_llm_scheduler_tpu.fleet.autoscale import (
+        AutoscaleConfig,
+        AutoscaleController,
+    )
+    from k8s_llm_scheduler_tpu.fleet.lease import shard_of
+
+    scheduler_name = "ai-llama-scheduler"
+    cluster = FakeCluster()
+    for n in scenario.nodes:
+        cluster.add_node(FakeNode(
+            name=n.name,
+            cpu_capacity_cores=n.cpu_cores,
+            memory_capacity_gb=n.memory_gb,
+            max_pods=n.max_pods,
+            labels=dict(n.labels),
+            taints=n.taints,
+            ready=n.ready,
+        ))
+    clock = _VirtualClock()
+    fleet = Fleet(
+        cluster, cluster, lambda i: HashPlacementBackend(),
+        n_replicas=1 if elastic else n_static,
+        n_shards=2 * max(max_replicas, n_static),
+        scheduler_name=scheduler_name,
+        lease_ttl_s=6 * tick_s, clock=clock,
+        snapshot_ttl_s=1e9,
+        list_pending=lambda: cluster.pending_pods(scheduler_name),
+    )
+    bound: set[str] = set()
+
+    def tap_replica(replica) -> None:
+        orig = replica.scheduler._note_bind
+
+        def tagging_note(ok, pod, decision, _orig=orig):
+            if ok:
+                bound.add(pod.name)
+            _orig(ok, pod, decision)
+
+        replica.scheduler._note_bind = tagging_note
+
+    fleet.on_replica_start = tap_replica
+    for replica in fleet.replicas:
+        tap_replica(replica)
+
+    wave_state = {"i": 0, "incoming": 0}
+    controller = None
+    if elastic:
+        controller = AutoscaleController(
+            fleet,
+            AutoscaleConfig(
+                min_replicas=1, max_replicas=max_replicas,
+                target_per_replica=8.0, target_utilization=0.75,
+                up_threshold=1.0, down_threshold=0.5,
+                max_step=2,
+                up_cooldown_s=tick_s,       # one join per wave max
+                down_cooldown_s=3 * tick_s,
+                join_budget_ticks=3, join_backoff_ticks=1,
+                max_join_retries=3, split_enabled=False,
+            ),
+            queue_depth_fn=lambda: wave_state["incoming"],
+            clock=lambda: wave_state["i"] * tick_s,
+        )
+
+    def serving_replicas() -> int:
+        return max(
+            1, sum(1 for r in fleet.replicas if r.manager.owned())
+        )
+
+    def reoffer() -> list:
+        pending = cluster.pending_pods(scheduler_name)
+        coros = []
+        for replica in fleet.replicas:
+            todo = [
+                p for p in pending
+                if replica.manager.owns(
+                    shard_of(p.namespace, p.name, fleet.n_shards)
+                )
+            ]
+            coros.extend(replica.scheduler.schedule_pod(p) for p in todo)
+        return coros
+
+    async def drain(released: set[str]) -> None:
+        deadline = time.perf_counter() + timeout_s
+        stalls = 0
+        while released - bound:
+            if time.perf_counter() > deadline:
+                raise RuntimeError(
+                    f"autoscale arm: {len(released - bound)} pods never "
+                    f"bound (wave {wave_state['i']})"
+                )
+            await asyncio.sleep(0.01)
+            stalls += 1
+            if stalls % 25 == 0:
+                fleet.tick_leases()
+                coros = reoffer()
+                if coros:
+                    await asyncio.gather(*coros, return_exceptions=True)
+
+    capacity_per_replica = int(threshold_ms // service_ms)
+    violations = 0
+    replica_seconds = 0.0
+    per_wave: list[dict] = []
+    await fleet.start(lease_threads=False)
+    try:
+        for wave_idx, wave in enumerate(scenario.waves):
+            clock.advance(tick_s)
+            fleet.tick_leases()
+            wave_state["i"] = wave_idx + 1
+            wave_state["incoming"] = len(wave)
+            if controller is not None:
+                await controller.tick()
+            serving = serving_replicas()
+            replica_seconds += serving * tick_s
+            w = len(wave)
+            wave_viol = max(0, w - serving * capacity_per_replica)
+            violations += wave_viol
+            per_wave.append({
+                "wave": wave_idx, "pods": w, "replicas": serving,
+                "violations": wave_viol,
+            })
+            if not wave:
+                continue
+            for pod in wave:
+                cluster.add_pod(pod.to_raw_pod())
+            await drain({p.name for p in wave})
+        n_pods = scenario.n_pods
+        # zero dropped / zero double-bound across every scale event:
+        # every pod observed bound exactly once, and the cluster's own
+        # bind book agrees (a double bind would either fail loudly there
+        # or inflate bind_count past the pod count)
+        assert len(bound) == n_pods, (
+            f"dropped pods: {n_pods - len(bound)}"
+        )
+        assert cluster.bind_count == n_pods, (
+            f"bind_count {cluster.bind_count} != {n_pods} pods "
+            "(double or lost bind)"
+        )
+        stats = fleet.get_stats()
+        out = {
+            "arm": "elastic" if elastic else f"static-{n_static}",
+            "slo_violations": violations,
+            "slo_violation_frac": round(violations / n_pods, 6),
+            "replica_seconds": round(replica_seconds, 1),
+            "final_replicas": fleet.n_live,
+            "fenced_binds": stats["fenced_binds"],
+            "failed_bindings": stats["failed_bindings"],
+        }
+        if controller is not None:
+            out["scale"] = {
+                k: controller.counters[k]
+                for k in ("scale_ups", "scale_downs", "join_failures")
+            }
+            out["scale_events"] = len(controller.scale_events())
+            out["peak_replicas"] = max(p["replicas"] for p in per_wave)
+        out["per_wave"] = per_wave
+        return out
+    finally:
+        await fleet.stop()
+
+
+async def autoscale_bench(args) -> dict:
+    """`--preset autoscale`: the SLO-burn-vs-replica-seconds frontier.
+
+    One seeded diurnal arrival curve (sim/scenarios arrival="diurnal")
+    replayed through an ELASTIC fleet and static-N baselines. The
+    elastic arm must DOMINATE at least one static arm on BOTH axes
+    (<= on both, strictly better on one): over-provisioning (static at
+    peak size) burns replica-seconds all day, under-provisioning burns
+    the SLO budget at peak — the control loop must beat at least one of
+    those corners outright, or it is not earning its complexity."""
+    from k8s_llm_scheduler_tpu.sim.scenarios import (
+        ScenarioSpec,
+        generate_scenario,
+    )
+
+    seed = args.seed if args.seed is not None else 0
+    spec = ScenarioSpec(
+        name="autoscale-diurnal",
+        seed=seed,
+        n_nodes=args.nodes,
+        n_pods=args.pods,
+        shapes=args.shapes,
+        arrival="diurnal",
+        n_waves=24,
+        diurnal_amplitude=0.9,
+        hetero=True,
+        constraint_mix=("uniform",),
+    )
+    scenario = generate_scenario(spec)
+    max_replicas = 8
+    arms = {}
+    arms["elastic"] = await _autoscale_arm(
+        scenario, elastic=True, max_replicas=max_replicas
+    )
+    for n in (2, 4, max_replicas):
+        arm = await _autoscale_arm(scenario, elastic=False, n_static=n)
+        arms[arm["arm"]] = arm
+
+    elastic = arms["elastic"]
+    dominated = [
+        name for name, arm in arms.items()
+        if name != "elastic"
+        and elastic["slo_violation_frac"] <= arm["slo_violation_frac"]
+        and elastic["replica_seconds"] <= arm["replica_seconds"]
+        and (
+            elastic["slo_violation_frac"] < arm["slo_violation_frac"]
+            or elastic["replica_seconds"] < arm["replica_seconds"]
+        )
+    ]
+    assert dominated, (
+        "elastic arm dominates no static arm — frontier: "
+        + json.dumps({
+            name: {
+                "burn": arm["slo_violation_frac"],
+                "replica_seconds": arm["replica_seconds"],
+            }
+            for name, arm in arms.items()
+        })
+    )
+    static_peak = arms[f"static-{max_replicas}"]
+    frontier = {
+        name: {
+            "slo_violation_frac": arm["slo_violation_frac"],
+            "replica_seconds": arm["replica_seconds"],
+        }
+        for name, arm in arms.items()
+    }
+    return {
+        "metric": "autoscale_frontier",
+        # headline: elastic cost as a fraction of peak static provisioning
+        # (same curve, zero-drop, SLO no worse than the dominated arm)
+        "value": round(
+            elastic["replica_seconds"] / static_peak["replica_seconds"], 3
+        ),
+        "unit": f"replica_seconds_vs_static{max_replicas}",
+        "extra": {
+            "seed": seed,
+            "pods": args.pods,
+            "nodes": args.nodes,
+            "waves": 24,
+            "diurnal_amplitude": 0.9,
+            "service_ms": 20.0,
+            "threshold_ms": 200.0,
+            "frontier": frontier,
+            "dominated_arms": dominated,
+            "arms": {
+                name: {k: v for k, v in arm.items() if k != "per_wave"}
+                for name, arm in arms.items()
+            },
+            "elastic_wave_trajectory": [
+                (p["wave"], p["pods"], p["replicas"])
+                for p in elastic["per_wave"]
+            ],
         },
     }
 
@@ -2214,6 +2524,9 @@ def main() -> None:
         return
     if args.preset == "fleet":
         _emit(asyncio.run(fleet_bench(args)))
+        return
+    if args.preset == "autoscale":
+        _emit(asyncio.run(autoscale_bench(args)))
         return
     if args.preset == "chaos":
         _emit(chaos_bench(args))

@@ -59,7 +59,7 @@ import time
 from collections import Counter
 from typing import Any, Callable, Sequence
 
-SEAMS = ("wire", "lease", "watch", "backend", "cache", "slo", "swap")
+SEAMS = ("wire", "lease", "watch", "backend", "cache", "slo", "swap", "scale")
 
 FAULT_KINDS: dict[str, tuple[str, ...]] = {
     "wire": ("reset", "drop", "delay", "dup"),
@@ -73,6 +73,17 @@ FAULT_KINDS: dict[str, tuple[str, ...]] = {
     # canary burn-in over the live scheduler stats — the promotion shape
     # the learn loop performs; chaos/harness.py)
     "swap": ("hot_swap",),
+    # elastic-fleet scale events (fleet/autoscale.py + fleet/frontend.py
+    # Fleet.fault_seam): `join_fail` kills a joining replica at the
+    # dial/prewarm probe, `gate_stall` kills it mid-health-gate (after
+    # the probe, before any heartbeat — the controller rolls the
+    # observed death back on its next tick),
+    # `drain_race` is harness-interpreted (a replica CRASHES — leases
+    # lingering to TTL — while the controller's scale-down drain is
+    # converging), and `thrash` marks the flapping-arrival window
+    # (workload-shaped; the marker makes the window visible in the
+    # injection report).
+    "scale": ("thrash", "join_fail", "gate_stall", "drain_race"),
 }
 
 
@@ -307,6 +318,46 @@ def _regime_learn_swap(rng, n_waves: int, n_nodes: int):
     ], []
 
 
+def _regime_scale_thrash(rng, n_waves: int, n_nodes: int):
+    # no seam fault at all: the WORKLOAD is the fault — arrival flaps
+    # between heavy and light every wave (chaos_scenario gives scale
+    # regimes their arrival shape), parking the pressure signal exactly
+    # on the scale threshold. The controller's hysteresis band +
+    # per-direction cooldowns must bound the oscillation: scale events
+    # at most at the cooldown rate, never one per wave. The marker
+    # window makes the thrash span visible in the injection report and
+    # ends one wave early so the run keeps a post-fault recovery wave.
+    return [_ev("scale", "thrash", 1, max(2, n_waves - 1))], []
+
+
+def _regime_join_fail(rng, n_waves: int, n_nodes: int):
+    # demand ramps into the windows (diurnal arrival peaks mid-run), so
+    # the controller WANTS a new replica exactly while joins are dying:
+    # first at the dial/prewarm probe (join_fail), then mid-health-gate
+    # (gate_stall — the observed death rolls back on the next
+    # controller tick). Every failure must roll back completely
+    # (bounded retries, no half-joined member), and the retry once the
+    # windows close — demand still above threshold on the ramp — must
+    # land. Windows sit EARLY (the up-slope): that is when the
+    # controller's first scale-up attempts fire.
+    a = max(1, n_waves // 4)
+    return [
+        _ev("scale", "join_fail", a, a + 1),
+        _ev("scale", "gate_stall", a + 1, a + 2),
+    ], []
+
+
+def _regime_drain_race(rng, n_waves: int, n_nodes: int):
+    # late one-wave window on the diurnal DOWN-slope: while the
+    # controller's scale-down drain is releasing the newest replica's
+    # shards, the OLDEST replica crashes (no lease release — failover
+    # rides TTL expiry). Two membership changes race through the lease
+    # plane at once; epoch fencing + the drain-before-release ordering
+    # must keep every bind exactly-once and every pod recoverable.
+    start = max(1, (2 * n_waves) // 3)
+    return [_ev("scale", "drain_race", start, start + 1)], []
+
+
 REGIMES: dict[str, dict[str, Any]] = {
     # mode: which harness stack the regime drives (chaos/harness.py) —
     # "single" = Scheduler over the wire-fake API server; "wire" =
@@ -362,6 +413,31 @@ REGIMES: dict[str, dict[str, Any]] = {
         "describe": "hot swap opens a canary burn-in mid-run while an "
                     "SLO brownout burns through it: the burn-in must "
                     "close clean, never roll back the healthy candidate",
+    },
+    # --- elastic-fleet regimes (mode "autoscale": an elastic Fleet +
+    # AutoscaleController over the in-memory cluster with a virtual
+    # store clock; chaos/harness._run_autoscale_stack). The `arrival`
+    # key shapes the workload side (sim/scenarios.chaos_scenario).
+    "scale-thrash": {
+        "build": _regime_scale_thrash, "mode": "autoscale",
+        "arrival": "flap",
+        "describe": "arrival flaps at the scale threshold every wave: "
+                    "hysteresis + cooldowns must bound oscillation "
+                    "(never one scale event per wave)",
+    },
+    "join-fail": {
+        "build": _regime_join_fail, "mode": "autoscale",
+        "arrival": "diurnal",
+        "describe": "joining replicas die at the dial probe, then "
+                    "mid-health-gate: every failed join must roll back "
+                    "completely, the post-window retry must land",
+    },
+    "drain-race": {
+        "build": _regime_drain_race, "mode": "autoscale",
+        "arrival": "diurnal",
+        "describe": "a scale-down drain races a crashed replica's lease "
+                    "failover: binds stay exactly-once across both "
+                    "membership changes",
     },
 }
 

@@ -564,7 +564,6 @@ async def _run_fleet_stack(
 
     await fleet.start(lease_threads=False)
     waves_out: list[dict] = []
-    lost: set[str] = set()
     try:
         for wave_idx, wave in enumerate(scenario.waves):
             injector.begin_wave(wave_idx)
@@ -580,12 +579,12 @@ async def _run_fleet_stack(
             for pod in wave:
                 cluster.add_pod(pod.to_raw_pod())
             released = {p.name for p in wave}
-            drained = await _settle(
+            # a timed-out barrier is not a verdict: the recovery ticks
+            # below get another chance and finalize() judges lost pods
+            await _settle(
                 lambda: released <= resolved_names(),
                 wave_timeout_s, f"wave{wave_idx}",
             )
-            if not drained:
-                lost |= released - resolved_names()
             waves_out.append({
                 "wave": wave_idx,
                 "n_pods": len(wave),
@@ -666,6 +665,268 @@ async def _run_fleet_stack(
         cluster.close()
 
 
+# ---------------------------------------------------------- autoscale mode
+async def _run_autoscale_stack(
+    scenario, plan: FaultPlan, injector: FaultInjector,
+    monitor: InvariantMonitor, *, deadline_ms: float | None,
+    wave_timeout_s: float, tick_s: float = 2.0, lease_ttl_s: float = 5.0,
+) -> dict:
+    """An ELASTIC fleet (fleet/autoscale.AutoscaleController over
+    Fleet.start_join/remove_replica) driven in virtual wave time.
+
+    Determinism: the controller's ONLY inputs are the incoming wave's
+    pod count (queue-depth signal, known before the wave releases) and
+    a WAVE-QUANTIZED control clock (wave index x tick_s) — the store
+    clock may be advanced extra inside a stalled wave barrier to let a
+    TTL failover converge (the periodic re-list a live watch performs),
+    but the controller never sees those advances, so the scale-event
+    sequence is a pure function of (scenario, plan). Placements stay
+    deterministic-by-shape exactly as in fleet mode."""
+    from k8s_llm_scheduler_tpu.cluster.fake import FakeCluster, FakeNode
+    from k8s_llm_scheduler_tpu.fleet import Fleet
+    from k8s_llm_scheduler_tpu.fleet.autoscale import (
+        AutoscaleConfig,
+        AutoscaleController,
+    )
+    from k8s_llm_scheduler_tpu.fleet.lease import shard_of
+
+    cluster = FakeCluster()
+    for n in scenario.nodes:
+        cluster.add_node(FakeNode(
+            name=n.name,
+            cpu_capacity_cores=n.cpu_cores,
+            memory_capacity_gb=n.memory_gb,
+            max_pods=n.max_pods,
+            labels=dict(n.labels),
+            taints=n.taints,
+            ready=n.ready,
+        ))
+    clock = _VirtualClock()
+    fleet = Fleet(
+        cluster, cluster, lambda i: HashPlacementBackend(),
+        n_replicas=1, n_shards=8,
+        lease_ttl_s=lease_ttl_s, clock=clock,
+        list_pending=lambda: cluster.pending_pods(SCHEDULER_NAME),
+    )
+    store = fleet.store
+    store.fault_seam = injector.seam("lease")
+    fleet.fault_seam = injector.seam("scale")
+    scale_seam = injector.seam("scale")
+
+    clients: list = []
+    deferred: set[str] = set()
+    crashed: list = []
+
+    def wire_replica(replica) -> None:
+        """Monitor-wrap a replica before it can bind anything — initial
+        members here, joiners via Fleet.on_replica_start (which fires
+        before the joiner's scheduler starts)."""
+        replica.cache.fault_seam = injector.seam("cache")
+        replica.client.cache = monitor.wrap_cache(replica.cache)
+        replica.client.deadline_ms = deadline_ms
+        monitor.watch_breaker(replica.client.breaker, name=replica.holder)
+        replica.scheduler.binder = monitor.wrap_binder(
+            replica.scheduler.binder,
+            holder=replica.holder, store=store, n_shards=store.n_shards,
+        )
+        clients.append(replica.client)
+
+        orig_schedule = replica.scheduler.schedule_pod
+
+        async def tracking_schedule(raw, pod=None, _orig=orig_schedule):
+            ok = await _orig(raw, pod)
+            if not ok:
+                deferred.add(raw.name)
+            return ok
+
+        replica.scheduler.schedule_pod = tracking_schedule
+
+    fleet.on_replica_start = wire_replica
+    for replica in fleet.replicas:
+        wire_replica(replica)
+
+    wave_state = {"i": 0, "incoming": 0}
+    acfg = AutoscaleConfig(
+        min_replicas=1, max_replicas=4,
+        # 6 decisions/replica/wave: the diurnal ramp's second wave
+        # already crosses the up threshold, so scale-up attempts land
+        # INSIDE the join-fail windows (and the thrash flap's heavy
+        # waves sit well above the band while light waves sit below it)
+        target_per_replica=6.0, target_utilization=0.75,
+        up_threshold=1.0, down_threshold=0.5,
+        max_step=1,
+        up_cooldown_s=tick_s,            # at most one up per wave
+        down_cooldown_s=3 * tick_s,      # downs at most every 3 waves
+        join_budget_ticks=3, join_backoff_ticks=1, max_join_retries=3,
+        split_enabled=False,
+    )
+    controller = AutoscaleController(
+        fleet, acfg,
+        queue_depth_fn=lambda: wave_state["incoming"],
+        # wave-quantized control clock (see docstring): never advanced
+        # by the intra-wave failover catch-up the store clock needs
+        clock=lambda: wave_state["i"] * tick_s,
+        on_scale=monitor.note_scale,
+    )
+
+    def bound_names() -> set[str]:
+        return {name for (_ns, name), _node in monitor.bound_pods().items()}
+
+    def resolved_names() -> set[str]:
+        return (
+            {name for _ns, name in monitor.attempted_pods()} | deferred
+        )
+
+    def reoffer_pending() -> list:
+        """The periodic watch re-list: offer still-pending pods to the
+        shard owner's scheduler (the in-flight dedup suppresses
+        doubles; a stale local owner's bind is fenced at the store)."""
+        pending = cluster.pending_pods(SCHEDULER_NAME)
+        coros = []
+        for replica in fleet.replicas:
+            todo = [
+                p for p in pending
+                if replica.manager.owns(
+                    shard_of(p.namespace, p.name, fleet.n_shards)
+                )
+            ]
+            coros.extend(replica.scheduler.schedule_pod(p) for p in todo)
+        return coros
+
+    async def drain_wave(released: set[str], label: str) -> bool:
+        """Wave barrier. A stalled barrier (shards mid-failover after a
+        drain-race crash) advances the STORE clock and re-offers — the
+        lease protocol converging in accelerated virtual time — without
+        touching the control clock."""
+        deadline = time.monotonic() + wave_timeout_s
+        stalls = 0
+        while time.monotonic() < deadline:
+            if released <= resolved_names():
+                return True
+            await asyncio.sleep(0.02)
+            stalls += 1
+            if stalls % 25 == 0:
+                clock.advance(tick_s)
+                fleet.tick_leases()
+                coros = reoffer_pending()
+                if coros:
+                    await asyncio.gather(*coros, return_exceptions=True)
+        return released <= resolved_names()
+
+    await fleet.start(lease_threads=False)
+    waves_out: list[dict] = []
+    try:
+        for wave_idx, wave in enumerate(scenario.waves):
+            injector.begin_wave(wave_idx)
+            _wave_brownout(injector, clients)
+            clock.advance(tick_s)
+            fleet.tick_leases()
+            wave_state["i"] = wave_idx + 1
+            wave_state["incoming"] = len(wave)
+            if scale_seam.active("thrash"):
+                # marker only (the workload IS the fault) — note it so
+                # the injection report shows the thrash span
+                injector.note("scale", "thrash", None)
+            before = _client_counts(clients)
+            inj_before = dict(injector.injection_counts())
+            tick_record = await controller.tick()
+            if scale_seam.should("drain_race") is not None:
+                # the race: a controller-path drain (real
+                # remove_replica: drain -> release -> teardown) while
+                # the OLDEST replica crashes with its leases lingering
+                # to TTL — two membership changes through the lease
+                # plane at once
+                if fleet.n_live > 1:
+                    victim = fleet.pick_removal()
+                    await fleet.remove_replica(victim)
+                survivors = [
+                    r for r in fleet.replicas if r not in crashed
+                ]
+                if len(survivors) > 1:
+                    corpse = min(survivors, key=lambda r: r.replica_id)
+                    await corpse.stop(release_leases=False)
+                    crashed.append(corpse)
+            t0 = time.perf_counter()
+            if not wave:
+                waves_out.append({
+                    "wave": wave_idx, "n_pods": 0,
+                    "replicas": fleet.n_live,
+                    "scale_action": tick_record["action"],
+                })
+                continue
+            for pod in wave:
+                cluster.add_pod(pod.to_raw_pod())
+            released = {p.name for p in wave}
+            # a timed-out barrier is not a verdict: finalize() judges
+            # lost pods after the recovery ticks below
+            await drain_wave(released, f"wave{wave_idx}")
+            waves_out.append({
+                "wave": wave_idx,
+                "n_pods": len(wave),
+                "n_bound": len(released & bound_names()),
+                "replicas": fleet.n_live,
+                "scale_action": tick_record["action"],
+                "wall_ms": round((time.perf_counter() - t0) * 1000.0, 3),
+                "client": _delta(_client_counts(clients), before),
+                "injections": _delta(
+                    dict(injector.injection_counts()), inj_before
+                ),
+            })
+        injector.end_run()
+
+        # recovery: lease failover of crashed replicas converges and
+        # every still-pending pod re-offers to its live owner (the
+        # controller does NOT tick here — scale events stay a pure
+        # function of the scenario's waves)
+        all_names = {p.name for wave in scenario.waves for p in wave}
+        for _ in range(24):
+            if not (all_names - bound_names() - deferred):
+                break
+            clock.advance(tick_s)
+            fleet.tick_leases()
+            coros = reoffer_pending()
+            if coros:
+                await asyncio.gather(*coros, return_exceptions=True)
+            await _settle(
+                lambda: not (all_names - bound_names() - deferred),
+                0.5, "recovery",
+            )
+
+        all_pods = [p for wave in scenario.waves for p in wave]
+        still_pending = {
+            (p.namespace, p.name)
+            for p in cluster.pending_pods(SCHEDULER_NAME)
+        }
+        monitor.finalize(
+            expected=[("default", p.name) for p in all_pods],
+            pending=still_pending,
+        )
+        placements = {
+            name: node
+            for (_ns, name), node in monitor.bound_pods().items()
+        }
+        return {
+            "placements": dict(sorted(placements.items())),
+            "unschedulable": sorted(
+                n for n in all_names if n not in placements
+            ),
+            "waves": waves_out,
+            "client": {
+                "totals": _client_counts(clients),
+                "fleet": {
+                    k: v for k, v in fleet.get_stats().items()
+                    if k != "replicas"
+                },
+            },
+            "scale_events": controller.scale_events(),
+            "autoscale": controller.stats(),
+        }
+    finally:
+        injector.end_run()
+        await fleet.stop()
+        cluster.close()
+
+
 # ------------------------------------------------------------------- runner
 def run_chaos(
     regime: str,
@@ -697,10 +958,10 @@ def run_chaos(
         )
     mode = REGIMES[regime]["mode"]
     if n_pods is None:
-        # fleet mode shares the cluster across 2 replicas whose snapshots
-        # are not wave-settled: keep per-node worst-case fill clear of
-        # max_pods so the feasible set never shifts mid-run
-        n_pods = 64 if mode == "fleet" else 96
+        # fleet/autoscale modes share the cluster across replicas whose
+        # snapshots are not wave-settled: keep per-node worst-case fill
+        # clear of max_pods so the feasible set never shifts mid-run
+        n_pods = 96 if mode in ("single", "wire") else 64
     spec, plan = chaos_scenario(
         regime, seed, n_nodes=n_nodes, n_pods=n_pods, n_waves=n_waves
     )
@@ -709,7 +970,12 @@ def run_chaos(
     monitor = InvariantMonitor(injector)
 
     t_run = time.perf_counter()
-    if mode == "fleet":
+    if mode == "autoscale":
+        stack = asyncio.run(_run_autoscale_stack(
+            scenario, plan, injector, monitor,
+            deadline_ms=deadline_ms, wave_timeout_s=wave_timeout_s,
+        ))
+    elif mode == "fleet":
         stack = asyncio.run(_run_fleet_stack(
             scenario, plan, injector, monitor,
             deadline_ms=deadline_ms, wave_timeout_s=wave_timeout_s,
@@ -748,6 +1014,13 @@ def run_chaos(
         # learn-swap regime: the burn-in verdict (timing-free booleans,
         # but run-local — stays in the report, not the trace)
         report["canary"] = stack["canary"]
+    if "scale_events" in stack:
+        # autoscale mode: the controller's membership-change sequence
+        # is deterministic in virtual wave time, so it rides the TRACE
+        # (byte-replay pins the control loop, not just the placements);
+        # the controller stats stay report-only
+        report["scale_events"] = stack["scale_events"]
+        report["autoscale"] = stack["autoscale"]
     if quality:
         report["quality"] = _quality_vs_teacher(scenario, scores)
     return report
@@ -814,9 +1087,11 @@ def _quality_vs_teacher(scenario, scores: dict) -> dict:
 # -------------------------------------------------------------------- trace
 def build_chaos_trace(report: dict) -> dict:
     """The DETERMINISTIC payload of a chaos run (sim/trace.py
-    discipline): plan + placements + violations identities + scores.
-    Timing (waves, recovery ms) deliberately stays in the report."""
-    return {
+    discipline): plan + placements + violations identities + scores —
+    plus, for autoscale mode, the controller's scale-event sequence
+    (wave-quantized control clock makes it replay-stable). Timing
+    (waves, recovery ms) deliberately stays in the report."""
+    trace = {
         "version": TRACE_VERSION,
         "scenario_spec": report["scenario_spec"],
         "plan": report["plan"],
@@ -832,6 +1107,9 @@ def build_chaos_trace(report: dict) -> dict:
         ),
         "scores": report["scores"],
     }
+    if "scale_events" in report:
+        trace["scale_events"] = report["scale_events"]
+    return trace
 
 
 def canonical_chaos_bytes(trace: dict) -> bytes:
@@ -895,7 +1173,7 @@ def replay_chaos_trace(trace: dict) -> dict:
     scores = score_placement(
         scenario, placements, trace.get("unschedulable", ())
     )
-    return {
+    out = {
         "version": TRACE_VERSION,
         "scenario_spec": spec.to_dict(),
         "plan": plan.to_dict(),
@@ -905,6 +1183,11 @@ def replay_chaos_trace(trace: dict) -> dict:
         "violations": list(trace.get("violations", ())),
         "scores": scores,
     }
+    if "scale_events" in trace:
+        # run-recorded, not re-derivable without re-running the stack —
+        # carried verbatim; byte-identity across RUNS is what pins it
+        out["scale_events"] = list(trace["scale_events"])
+    return out
 
 
 def verify_chaos_trace(path) -> tuple[bool, str]:

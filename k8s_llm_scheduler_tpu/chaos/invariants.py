@@ -48,6 +48,18 @@ INVARIANTS = (
     "stale_generation",
     "lost_pod",
     "breaker_transition",
+    # elastic-fleet round: binds stay exactly-once ACROSS membership
+    # changes — a pod successfully bound by two DIFFERENT holders means
+    # a scale event (join, drain, crash failover) let ownership overlap.
+    # Refines exactly_once_bind with holder attribution: the membership
+    # hazard is specifically two replicas both believing they own the
+    # pod's shard, which only holder identity can distinguish from a
+    # same-replica retry bug.
+    "single_holder_bind",
+    # the autoscale controller must never steer the fleet outside its
+    # configured [min, max] replica clamp (checked on every controller
+    # tick via note_scale)
+    "replica_bounds",
 )
 
 # legal breaker edges (core/breaker.py state machine); reset() is
@@ -87,6 +99,7 @@ class InvariantMonitor:
         self._lock = threading.Lock()
         self.violations: list[Violation] = []
         self._bound: dict[tuple[str, str], str] = {}
+        self._bound_holder: dict[tuple[str, str], str] = {}
         # every bind ATTEMPT (ok or fenced/failed) — the harness's wave
         # barrier resolves pods here because the scheduler's cache-hit
         # fast path binds without passing through schedule_pod
@@ -154,6 +167,16 @@ class InvariantMonitor:
                 "exactly_once_bind", f"{namespace}/{name}",
                 f"bound twice: first -> {previous}, again -> {node}",
             )
+        if holder is not None:
+            self._check("single_holder_bind")
+            with self._lock:
+                first_holder = self._bound_holder.setdefault(key, holder)
+            if first_holder != holder:
+                self.record(
+                    "single_holder_bind", f"{namespace}/{name}",
+                    f"bound by two holders across a membership change: "
+                    f"first {first_holder}, again {holder}",
+                )
         if holder is not None and store is not None and n_shards:
             from k8s_llm_scheduler_tpu.fleet.lease import shard_of
 
@@ -166,6 +189,22 @@ class InvariantMonitor:
                     f"bind by {holder} succeeded but shard {shard} is "
                     f"held by {live!r} in the store",
                 )
+
+    # ---------------------------------------------------------------- scale
+    def note_scale(self, n_replicas: int, min_replicas: int,
+                   max_replicas: int) -> None:
+        """Autoscale-controller hook (fleet/autoscale.AutoscaleController
+        on_scale): fires after every control tick with the fleet size
+        and the configured clamp. Outside [min, max] is the
+        replica_bounds violation — the controller's own clamp and this
+        independent re-derivation must agree."""
+        self._check("replica_bounds")
+        if not min_replicas <= n_replicas <= max_replicas:
+            self.record(
+                "replica_bounds", f"replicas={n_replicas}",
+                f"fleet size {n_replicas} outside configured clamp "
+                f"[{min_replicas}, {max_replicas}]",
+            )
 
     # ---------------------------------------------------------------- cache
     def wrap_cache(self, cache: Any) -> "MonitoredCache":

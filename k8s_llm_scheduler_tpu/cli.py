@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import argparse
 import asyncio
+import dataclasses
 import importlib
 import json
 import logging
@@ -188,10 +189,22 @@ async def _run_scheduler(cfg: Config, cluster, demo_pods: bool = False) -> int:
     # SLO burn-rate engine (observability/slo.py): declarative objectives
     # from the `slo` config block evaluated over multi-window burn rates;
     # trips surface at /debug/slo, as gauges, and as an ADVISORY into the
-    # circuit breaker (never a forced state change).
+    # circuit breaker (never a forced state change). The stats tree it
+    # reads embeds the profiler's gauges under `engine_profile` — the
+    # cumulative segment counters (queue_stall_ms_total et al.) make a
+    # throughput/pressure objective expressible straight from config
+    # (numerator engine_profile.queue_stall_ms_total over
+    # engine_profile.wall_ms_cum_total), with no custom provider;
+    # before this the segment books were reachable only via
+    # /debug/profile.
     from k8s_llm_scheduler_tpu.observability import slo as slo_mod
 
-    slo_engine = slo_mod.from_config(cfg.section("slo"), scheduler.get_stats)
+    slo_stats_provider = scheduler.get_stats
+    if profiler is not None:
+        def slo_stats_provider(_base=scheduler.get_stats, _prof=profiler):
+            return {**_base(), "engine_profile": _prof.gauges()}
+
+    slo_engine = slo_mod.from_config(cfg.section("slo"), slo_stats_provider)
     if slo_engine is not None:
         breaker = scheduler.client.breaker
         if breaker is not None:
@@ -1901,6 +1914,153 @@ def cmd_fleet(args: argparse.Namespace, cfg: Config) -> int:
         )
         return 0 if stats["total_scheduled"] >= args.pods else 1
 
+    if args.fleet_cmd == "autoscale":
+        from k8s_llm_scheduler_tpu.chaos.harness import (
+            HashPlacementBackend,
+            _VirtualClock,
+        )
+        from k8s_llm_scheduler_tpu.cluster.fake import FakeCluster, FakeNode
+        from k8s_llm_scheduler_tpu.fleet import Fleet
+        from k8s_llm_scheduler_tpu.fleet.autoscale import (
+            AutoscaleConfig,
+            AutoscaleController,
+        )
+        from k8s_llm_scheduler_tpu.fleet.lease import shard_of
+        from k8s_llm_scheduler_tpu.sim.scenarios import (
+            ScenarioSpec,
+            generate_scenario,
+        )
+
+        # from_dict keeps its curated unknown-key error for config.yaml
+        # typos; demo pacing then overrides the wall-clock cooldowns
+        # (the virtual tick is one wave, so the config's second-scale
+        # cooldowns would freeze the demo) while keeping their RATIO
+        # (up fast, down deliberate) — the part the demo demonstrates
+        acfg = dataclasses.replace(
+            AutoscaleConfig.from_dict(cfg.section("autoscale")),
+            up_cooldown_s=1.0, down_cooldown_s=3.0,
+            join_budget_ticks=4, join_backoff_ticks=1,
+            split_enabled=False,
+        )
+        scheduler_name = cfg.get("scheduler.name")
+        spec = ScenarioSpec(
+            name="autoscale-demo", seed=args.seed,
+            n_nodes=args.nodes, n_pods=args.pods, shapes=16,
+            arrival="diurnal", n_waves=args.waves,
+            hetero=True, constraint_mix=("uniform",),
+        )
+        scenario = generate_scenario(spec)
+
+        async def demo() -> dict:
+            cluster = FakeCluster()
+            for n in scenario.nodes:
+                cluster.add_node(FakeNode(
+                    name=n.name, cpu_capacity_cores=n.cpu_cores,
+                    memory_capacity_gb=n.memory_gb, max_pods=n.max_pods,
+                    labels=dict(n.labels), taints=n.taints, ready=n.ready,
+                ))
+            clock = _VirtualClock()
+            fleet = Fleet(
+                cluster, cluster, lambda i: HashPlacementBackend(),
+                n_replicas=acfg.min_replicas,
+                n_shards=2 * acfg.max_replicas,
+                scheduler_name=scheduler_name,
+                lease_ttl_s=6.0, clock=clock, snapshot_ttl_s=1e9,
+                list_pending=lambda: cluster.pending_pods(scheduler_name),
+            )
+            wave_state = {"i": 0, "incoming": 0}
+            controller = AutoscaleController(
+                fleet, acfg,
+                queue_depth_fn=lambda: wave_state["incoming"],
+                clock=lambda: wave_state["i"] * 1.0,
+            )
+
+            def reoffer() -> list:
+                pending = cluster.pending_pods(scheduler_name)
+                coros = []
+                for replica in fleet.replicas:
+                    todo = [
+                        p for p in pending
+                        if replica.manager.owns(
+                            shard_of(p.namespace, p.name, fleet.n_shards)
+                        )
+                    ]
+                    coros.extend(
+                        replica.scheduler.schedule_pod(p) for p in todo
+                    )
+                return coros
+
+            trajectory = []
+            await fleet.start(lease_threads=False)
+            try:
+                for wave_idx, wave in enumerate(scenario.waves):
+                    clock.advance(1.0)
+                    fleet.tick_leases()
+                    wave_state["i"] = wave_idx + 1
+                    wave_state["incoming"] = len(wave)
+                    record = await controller.tick()
+                    for pod in wave:
+                        cluster.add_pod(pod.to_raw_pod())
+                    # every demo pod is placeable (uniform constraints),
+                    # so the wave drains exactly when nothing is pending
+                    deadline = time.monotonic() + 30.0
+                    stalls = 0
+                    while cluster.pending_pods(scheduler_name):
+                        if time.monotonic() > deadline:
+                            break
+                        await asyncio.sleep(0.01)
+                        stalls += 1
+                        if stalls % 25 == 0:
+                            fleet.tick_leases()
+                            coros = reoffer()
+                            if coros:
+                                await asyncio.gather(
+                                    *coros, return_exceptions=True
+                                )
+                    trajectory.append({
+                        "wave": wave_idx,
+                        "pods": len(wave),
+                        "replicas": fleet.n_live,
+                        "pressure": record["pressure"],
+                        "action": record["action"],
+                    })
+                stats = fleet.get_stats()
+                return {
+                    "trajectory": trajectory,
+                    "scale_events": controller.scale_events(),
+                    "autoscale": controller.stats(),
+                    # the cluster's bind book is the authority: roster
+                    # stats lose a drained replica's counts with it
+                    "bind_count": cluster.bind_count,
+                    "lease": stats["lease"],
+                }
+            finally:
+                await fleet.stop()
+
+        out = asyncio.run(demo())
+        if args.json:
+            print(json.dumps(out))
+            return 0
+        print(
+            f"autoscale demo: {args.pods} pods over a {args.waves}-wave "
+            f"diurnal curve, clamp [{acfg.min_replicas}, "
+            f"{acfg.max_replicas}]"
+        )
+        for t in out["trajectory"]:
+            bar = "#" * t["replicas"]
+            print(
+                f"  wave {t['wave']:>2}  pods {t['pods']:>4}  "
+                f"pressure {t['pressure']:>6.2f}  replicas "
+                f"{t['replicas']} {bar:<8} {t['action']}"
+            )
+        a = out["autoscale"]
+        print(
+            f"  {out['bind_count']}/{args.pods} bound exactly once; "
+            f"{a['scale_ups']} up(s), {a['scale_downs']} down(s), "
+            f"{a['join_failures']} failed join(s)"
+        )
+        return 0 if out["bind_count"] >= args.pods else 1
+
     if args.fleet_cmd == "top":
         from k8s_llm_scheduler_tpu.observability.fleetview import (
             FleetAggregator,
@@ -2434,6 +2594,21 @@ def main(argv: list[str] | None = None) -> int:
         "--n-shards", type=int, default=None,
         help="shard count (default: fleet.n_shards config)",
     )
+    p_fauto = fsub.add_parser(
+        "autoscale",
+        help="elastic-fleet demo: replay a seeded diurnal arrival curve "
+             "through the SLO-burn-driven autoscale controller "
+             "(fleet/autoscale.py) over a fake cluster and print the "
+             "replica trajectory + scale events",
+    )
+    p_fauto.add_argument("--pods", type=int, default=240)
+    p_fauto.add_argument("--nodes", type=int, default=24)
+    p_fauto.add_argument(
+        "--waves", type=int, default=12,
+        help="diurnal curve length in waves (one controller tick each)",
+    )
+    p_fauto.add_argument("--seed", type=int, default=0)
+    p_fauto.add_argument("--json", action="store_true")
     p_ftop = fsub.add_parser(
         "top",
         help="live merged fleet telemetry: pull every replica's stats/"

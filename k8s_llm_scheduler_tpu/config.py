@@ -303,6 +303,44 @@ DEFAULTS: dict[str, Any] = {
         "prepack_max_batch": 16,
         "prepack_window_ms": 2.0,
     },
+    # Elastic fleet autoscaler (fleet/autoscale.py): SLO-burn-driven
+    # deadband control loop over replica count + prefill/decode pool
+    # split. Thrash-proofing knobs: hysteresis band
+    # [down_threshold, up_threshold] with target_utilization strictly
+    # inside it, per-direction cooldowns, max_step clamp, and the
+    # [min, max] replica clamp the chaos invariant monitor re-checks.
+    "autoscale": {
+        "enabled": False,
+        "min_replicas": 1,
+        "max_replicas": 8,
+        # work units (queued decisions per tick) one replica serves at
+        # target utilization — the demand normalizer
+        "target_per_replica": 8.0,
+        "target_utilization": 0.75,
+        "up_threshold": 1.0,
+        "down_threshold": 0.5,
+        "max_step": 2,
+        "up_cooldown_s": 30.0,
+        "down_cooldown_s": 120.0,
+        # scale-up health gate: ticks a join may wait for its first
+        # lease claim before rollback, backoff between attempts, and
+        # the bounded retry budget
+        "join_budget_ticks": 8,
+        "join_backoff_ticks": 4,
+        "max_join_retries": 3,
+        # optional decide-p99 pressure term (merged fleet buckets); null
+        # disables it
+        "latency_target_ms": None,
+        # profiler queue_stall fraction above which admission counts as
+        # starved (the SARATHI-style pressure signal)
+        "stall_budget": 0.25,
+        # prefill<->decode pool split rebalancing
+        "split_enabled": True,
+        "split_cooldown_s": 60.0,
+        # controller tick cadence (live deployments; harness/bench tick
+        # in virtual wave time)
+        "tick_interval_s": 5.0,
+    },
     # Multi-host JAX (parallel/distributed.py). On TPU pods the launcher
     # auto-detects coordinator/count/id (leave them null); set them
     # explicitly for manual/CPU launches. The control plane (watch/bind)
@@ -389,6 +427,14 @@ ENV_OVERRIDES: dict[str, str] = {
     "FLEET_PREPACK_WINDOW_MS": "fleet.prepack_window_ms",
     "FLEET_PREFILL_ADDRS": "fleet.prefill_addrs",
     "FLEET_DECODE_ADDRS": "fleet.decode_addrs",
+    "AUTOSCALE_ENABLED": "autoscale.enabled",
+    "AUTOSCALE_MIN_REPLICAS": "autoscale.min_replicas",
+    "AUTOSCALE_MAX_REPLICAS": "autoscale.max_replicas",
+    "AUTOSCALE_TARGET_PER_REPLICA": "autoscale.target_per_replica",
+    "AUTOSCALE_MAX_STEP": "autoscale.max_step",
+    "AUTOSCALE_UP_COOLDOWN_S": "autoscale.up_cooldown_s",
+    "AUTOSCALE_DOWN_COOLDOWN_S": "autoscale.down_cooldown_s",
+    "AUTOSCALE_TICK_INTERVAL_S": "autoscale.tick_interval_s",
     "LEARN_CORPUS_DIR": "learn.corpus_dir",
     "LEARN_REPLAY_FRACTION": "learn.replay_fraction",
     "LEARN_STEPS": "learn.steps",

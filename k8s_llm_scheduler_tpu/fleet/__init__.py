@@ -9,11 +9,24 @@ cache, disaggregated prefill/decode pools (ROADMAP open item 4).
   many short scheduler prompts per prefill wave), warm continuation to
   a decode pool;
 - `fleet/frontend.py` — N sharded scheduler replicas composed over one
-  cluster.
+  cluster (elastic: health-gated joins, drain-before-release removal);
+- `fleet/autoscale.py` — the SLO-burn-driven deadband control loop
+  that grows/shrinks the replica set and rebalances the pool split.
 """
 
+from k8s_llm_scheduler_tpu.fleet.autoscale import (
+    AutoscaleConfig,
+    AutoscaleController,
+    AutoscalePolicy,
+    AutoscaleSignals,
+)
 from k8s_llm_scheduler_tpu.fleet.cache import TieredDecisionCache
-from k8s_llm_scheduler_tpu.fleet.frontend import Fleet, FleetReplica
+from k8s_llm_scheduler_tpu.fleet.frontend import (
+    Fleet,
+    FleetReplica,
+    JoinError,
+    PendingJoin,
+)
 from k8s_llm_scheduler_tpu.fleet.lease import (
     Lease,
     LeaseExpired,
@@ -33,10 +46,15 @@ from k8s_llm_scheduler_tpu.fleet.pools import (
 )
 
 __all__ = [
+    "AutoscaleConfig",
+    "AutoscaleController",
+    "AutoscalePolicy",
+    "AutoscaleSignals",
     "DECODE",
     "DisaggregatedBackend",
     "Fleet",
     "FleetReplica",
+    "JoinError",
     "Lease",
     "LeaseExpired",
     "LeaseManager",
@@ -45,6 +63,7 @@ __all__ = [
     "MIXED",
     "POOL_ROLES",
     "PREFILL",
+    "PendingJoin",
     "TieredDecisionCache",
     "assign_initial",
     "check_pool_role",

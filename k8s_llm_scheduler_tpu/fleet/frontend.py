@@ -147,6 +147,7 @@ class FleetReplica:
     ) -> None:
         self.replica_id = replica_id
         self.holder = f"replica-{replica_id}"
+        self.backend = backend  # kept for teardown on elastic removal
         self._list_pending = list_pending
         self._loop: asyncio.AbstractEventLoop | None = None
         self.fenced_binds = 0
@@ -276,6 +277,7 @@ class FleetReplica:
             "replica_id": self.replica_id,
             "owned_shards": sorted(self.manager.owned()),
             "fenced_binds": self.fenced_binds,
+            "lease": self.manager.stats(),
             **self.scheduler.get_stats(),
         }
 
@@ -297,8 +299,38 @@ class FleetReplica:
         )
 
 
+class JoinError(RuntimeError):
+    """A scale-up health gate failed (backend construction, the dial/
+    prewarm probe, or a chaos-injected mid-join death). The join is
+    rolled back by the caller; no partially-joined replica serves."""
+
+
+class PendingJoin:
+    """One in-flight scale-up: the replica is constructed, probed, and
+    running, but not ADMITTED until its health gate completes — dial +
+    prewarm probe already passed (start_join), first lease claim still
+    pending (complete_join, driven by lease ticks). The controller holds
+    this across ticks so the gate never blocks a control loop."""
+
+    __slots__ = ("replica", "ticks_waited", "dead")
+
+    def __init__(self, replica: FleetReplica) -> None:
+        self.replica = replica
+        self.ticks_waited = 0
+        self.dead = False  # chaos: died mid-gate (never heartbeats)
+
+
 class Fleet:
-    """N replicas + the shared pieces, run on the current event loop."""
+    """N replicas + the shared pieces, run on the current event loop.
+
+    ELASTIC since the autoscale round: `start_join`/`complete_join`/
+    `abort_join` grow the member set one health-gated replica at a time,
+    and `remove_replica` shrinks it through the drain-before-release
+    ordering FleetReplica.stop() already guarantees (in-flight decisions
+    complete their binds BEFORE leases release — the PR 6 stop-ordering
+    fix, now on the scale-down path). Scale events are staggered by
+    construction: one join or one drain at a time, and removal below
+    min 1 replica is refused, so no wave ever observes zero capacity."""
 
     def __init__(
         self,
@@ -329,28 +361,54 @@ class Fleet:
         kwargs = {} if clock is None else {"clock": clock}
         self.store = LeaseStore(n_shards, ttl_s=lease_ttl_s, **kwargs)
         self.l2 = DecisionCache(ttl_seconds=l2_ttl_s, max_size=l2_size)
-        self.replicas = [
-            FleetReplica(
-                i,
-                cluster=cluster,
-                binder=binder,
-                backend=backend_factory(i),
-                store=self.store,
-                l2=self.l2,
-                scheduler_name=scheduler_name,
-                l1_size=l1_size,
-                renew_interval_s=renew_interval_s,
-                max_concurrency=max_concurrency,
-                snapshot_ttl_s=snapshot_ttl_s,
-                list_pending=list_pending,
-            )
-            for i in range(n_replicas)
-        ]
+        self._backend_factory = backend_factory
+        self._mk = dict(
+            cluster=cluster,
+            binder=binder,
+            scheduler_name=scheduler_name,
+            l1_size=l1_size,
+            renew_interval_s=renew_interval_s,
+            max_concurrency=max_concurrency,
+            snapshot_ttl_s=snapshot_ttl_s,
+            list_pending=list_pending,
+        )
+        self._lease_threads = True  # recorded by start(); joins follow it
+        # Chaos seam (chaos/faults.py, seam "scale"): None in production.
+        # Interpreted at the join health gate: `join_fail` kills a
+        # joining replica either at the dial/prewarm probe
+        # (phase="dial") or silently mid-gate (phase="claim" — the
+        # replica never heartbeats, so it never claims and the gate
+        # times out into the rollback path).
+        self.fault_seam = None
+        # observation hook: called with a JOINING replica after its
+        # probe passes and BEFORE its scheduler starts (it owns no
+        # shards yet, so nothing can slip past the wrap) — the chaos
+        # harness wraps binder/cache with the invariant monitor here,
+        # the bench attaches its bind taps. None in production.
+        self.on_replica_start: Callable[[FleetReplica], None] | None = None
+        self.scale_counters = {
+            "joins_started": 0,
+            "joins_completed": 0,
+            "joins_failed": 0,
+            "removals": 0,
+        }
+        self.replicas = [self._make_replica(i) for i in range(n_replicas)]
+        self._next_id = n_replicas
+
+    def _make_replica(self, replica_id: int) -> FleetReplica:
+        return FleetReplica(
+            replica_id,
+            backend=self._backend_factory(replica_id),
+            store=self.store,
+            l2=self.l2,
+            **self._mk,
+        )
 
     async def start(self, lease_threads: bool = True) -> None:
         """Bootstrap ownership deterministically (every shard held
         before the first pod event), then start the replica loops. With
         `lease_threads=False` tests drive `tick_leases()` manually."""
+        self._lease_threads = lease_threads
         assigned = assign_initial(
             self.store, [r.holder for r in self.replicas]
         )
@@ -373,6 +431,147 @@ class Fleet:
         """Simulated crash: the scheduler stops, leases are NOT
         released — failover happens via TTL expiry."""
         await self.replicas[index].stop(release_leases=False)
+
+    # ----------------------------------------------------------- elasticity
+    @property
+    def n_live(self) -> int:
+        return len(self.replicas)
+
+    def _scale_seam_event(self, kind: str, key: str):
+        seam = self.fault_seam
+        return None if seam is None else seam.should(kind, key=key)
+
+    async def start_join(self) -> PendingJoin:
+        """Scale-up, phase 1 — construct + health-gate a new replica:
+
+        1. the backend factory runs (a remote worker would be dialed
+           here; a factory failure is a failed join, not a crash);
+        2. the dial/prewarm probe: the backend must answer a cheap
+           read (`health_probe()` when it has one, else `get_stats()`)
+           — a replica that cannot answer must never enter the roster;
+        3. the replica's scheduler starts and its lease manager begins
+           heartbeating — it now counts toward everyone's fair share,
+           so incumbents start shedding toward it.
+
+        The replica is IN the roster from here (its watch filter owns
+        nothing yet, so it schedules nothing), but the join is complete
+        only when `complete_join` observes its first lease claim. Any
+        failure raises JoinError after rolling the replica back out."""
+        self.scale_counters["joins_started"] += 1
+        replica_id = self._next_id
+        holder = f"replica-{replica_id}"
+        try:
+            if self._scale_seam_event("join_fail", holder) is not None:
+                raise JoinError(
+                    f"{holder}: died mid-join (chaos join_fail)"
+                )
+            replica = self._make_replica(replica_id)
+        except JoinError:
+            self.scale_counters["joins_failed"] += 1
+            raise
+        except Exception as exc:
+            self.scale_counters["joins_failed"] += 1
+            raise JoinError(f"{holder}: backend factory failed: {exc}") from exc
+        self._next_id = replica_id + 1
+        join = PendingJoin(replica)
+        try:
+            probe = getattr(
+                replica.backend, "health_probe", None
+            ) or getattr(replica.backend, "get_stats", None)
+            if probe is not None:
+                await asyncio.to_thread(probe)
+        except Exception as exc:
+            self.scale_counters["joins_failed"] += 1
+            self._close_backend(replica)
+            raise JoinError(f"{holder}: dial/prewarm probe failed: {exc}") from exc
+        # chaos gate_stall: the replica dies right AFTER the probe — it
+        # never enters the roster, never heartbeats, never claims; the
+        # dead flag tells the controller the death was OBSERVED, so it
+        # rolls the join back on its next tick (a silent death nobody
+        # observes is the separate budget-expiry path: a live joiner
+        # that simply never claims)
+        event = self._scale_seam_event("gate_stall", holder)
+        join.dead = event is not None
+        if join.dead:
+            return join
+        if self.on_replica_start is not None:
+            self.on_replica_start(replica)
+        self.replicas.append(replica)
+        await replica.start(lease_thread=self._lease_threads)
+        if not self._lease_threads:
+            # manual-tick fleets: heartbeat immediately so the next
+            # tick's fair-share census already counts the newcomer
+            replica.manager.tick()
+        return join
+
+    def join_ready(self, join: PendingJoin) -> bool:
+        """Has the joining replica claimed its first lease? (The last
+        health-gate condition — callable from sync control loops.)"""
+        return bool(join.replica.manager.owned())
+
+    async def complete_join(self, join: PendingJoin) -> bool:
+        """Scale-up, phase 2: admit the replica once it holds >= 1
+        lease. Returns True when the gate is complete; the caller keeps
+        driving ticks (and re-calling) until then or aborts on its
+        budget."""
+        join.ticks_waited += 1
+        if not self.join_ready(join):
+            return False
+        self.scale_counters["joins_completed"] += 1
+        return True
+
+    async def abort_join(self, join: PendingJoin) -> None:
+        """Failed-join rollback: stop the scheduler (drains anything in
+        flight — with no shards there is nothing), release any leases it
+        did claim, close the backend, and drop it from the roster. The
+        fleet is exactly as it was before start_join."""
+        self.scale_counters["joins_failed"] += 1
+        replica = join.replica
+        if replica in self.replicas:
+            self.replicas.remove(replica)
+        await replica.stop(release_leases=True)
+        self._close_backend(replica)
+
+    def pick_removal(self) -> FleetReplica:
+        """Deterministic scale-down victim: the NEWEST replica (highest
+        id). Bootstrap members persist, so repeated scale cycles churn
+        the same tail instead of rotating ownership through the whole
+        fleet."""
+        return max(self.replicas, key=lambda r: r.replica_id)
+
+    async def remove_replica(self, replica: FleetReplica) -> None:
+        """Scale-down, drain-before-release (the PR 6 stop ordering, now
+        on the controller path): the scheduler drains its in-flight
+        decisions and completes their binds FIRST (leases still held, so
+        the fenced binder passes), THEN leases release (survivors'
+        fair-share claims converge on the freed shards), THEN the
+        backend closes (socket teardown last — a decision in flight on
+        the wire must never lose its transport before its bind lands).
+        Refuses to shrink below one replica: a wave must never observe
+        zero capacity."""
+        if len(self.replicas) <= 1:
+            raise ValueError("cannot remove the last replica")
+        if replica not in self.replicas:
+            raise ValueError(f"{replica.holder} is not in this fleet")
+        self.replicas.remove(replica)
+        try:
+            # drains, then releases leases (FleetReplica.stop ordering)
+            await replica.stop(release_leases=True)
+        finally:
+            self._close_backend(replica)
+        self.scale_counters["removals"] += 1
+
+    @staticmethod
+    def _close_backend(replica: FleetReplica) -> None:
+        close = getattr(replica.backend, "close", None)
+        if close is not None:
+            try:
+                close()
+            except Exception:
+                logger.exception(
+                    "%s: backend close failed during scale event",
+                    replica.holder,
+                )
 
     def aggregator(self, include_traces: bool = True):
         """A FleetAggregator over this fleet's replicas (observability/
@@ -412,6 +611,9 @@ class Fleet:
         return {
             **totals,
             "n_shards": self.n_shards,
+            "n_replicas": len(self.replicas),
+            "scale": dict(self.scale_counters),
+            "lease": self.store.gauges(),
             "l2": self.l2.stats(),
             "replicas": per_replica,
         }

@@ -102,6 +102,15 @@ class LeaseStore:
         # k8s-backed store maps this to the replica's own identity Lease.
         self._heartbeats: dict[str, float] = {}
         self._lock = threading.Lock()
+        # lease-plane observability (llm_scheduler_lease_* gauges): the
+        # autoscale controller and scrapers read these through the fleet
+        # stats tree — monotone counters, mutated only under the lock
+        self.counters: dict[str, int] = {
+            "acquisitions": 0,      # fresh epochs granted (not renewals)
+            "releases": 0,          # voluntary releases that landed
+            "fence_checks": 0,
+            "fence_rejections": 0,  # check_fence answered False
+        }
 
     # ----------------------------------------------------------- chaos seam
     def _chaos_check(self, holder: str) -> None:
@@ -151,6 +160,18 @@ class LeaseStore:
             for h in dead:
                 del self._heartbeats[h]
 
+    def retract_heartbeat(self, holder: str) -> None:
+        """Remove a holder's presence record immediately (clean
+        shutdown/scale-down). Without this the departed replica reads
+        as a zero-shard STARVED peer for a full TTL, and the
+        yield-to-most-starved claim rule would hold every freed shard
+        unclaimed for it — pods on those shards would strand exactly as
+        long. A crash does NOT retract: its heartbeat ages out with its
+        leases, which is the failover path."""
+        self._chaos_check(holder)
+        with self._lock:
+            self._heartbeats.pop(holder, None)
+
     def live_holders(self) -> set[str]:
         """Replicas that are PRESENT: unexpired lease holders plus
         unexpired heartbeats (a newcomer with no shards yet)."""
@@ -190,12 +211,16 @@ class LeaseStore:
         now = self._clock()
         with self._lock:
             lease = self._leases.get(shard_id)
-            return (
+            ok = (
                 lease is not None
                 and lease.expires_at > now
                 and lease.holder == holder
                 and lease.epoch == epoch
             )
+            self.counters["fence_checks"] += 1
+            if not ok:
+                self.counters["fence_rejections"] += 1
+            return ok
 
     def snapshot(self) -> dict[int, Lease]:
         """Copy of all UNEXPIRED leases (for /metrics and cli fleet)."""
@@ -227,6 +252,7 @@ class LeaseStore:
             self._epochs[shard_id] = epoch
             lease = Lease(shard_id, holder, epoch, now + self.ttl_s)
             self._leases[shard_id] = lease
+            self.counters["acquisitions"] += 1
             logger.debug(
                 "lease: shard %d -> %s (epoch %d)", shard_id, holder, epoch
             )
@@ -269,7 +295,28 @@ class LeaseStore:
             if lease is None or lease.holder != holder:
                 return False
             del self._leases[shard_id]
+            self.counters["releases"] += 1
             return True
+
+    def gauges(self) -> dict:
+        """Flat lease-store view for the fleet stats tree (rendered as
+        llm_scheduler_lease_* gauges by observability/metrics._flatten).
+        Holder names are sanitized to metric-name-legal identifiers."""
+        holdings = self.holdings()
+        with self._lock:
+            counters = dict(self.counters)
+        leased = sum(holdings.values())
+        return {
+            **counters,
+            "shards": self.n_shards,
+            "leased_shards": leased,
+            "free_shards": self.n_shards - leased,
+            "live_holders": len(holdings),
+            "holdings": {
+                h.replace("-", "_").replace(".", "_"): n
+                for h, n in sorted(holdings.items())
+            },
+        }
 
 
 class LeaseManager:
@@ -317,6 +364,17 @@ class LeaseManager:
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+        # per-holder lease agent counters (llm_scheduler_lease_* via the
+        # replica stats tree): the shed/claim churn rate is the autoscale
+        # controller's view of how settled membership is
+        self.counters: dict[str, int] = {
+            "ticks": 0,
+            "claims": 0,
+            "sheds": 0,
+            "losses": 0,
+            "renewals": 0,
+            "store_unavailable": 0,
+        }
 
     # -------------------------------------------------------------- queries
     def owned(self) -> frozenset[int]:
@@ -334,6 +392,13 @@ class LeaseManager:
         with self._lock:
             lease = self._held.get(shard_id)
             return None if lease is None else lease.epoch
+
+    def stats(self) -> dict:
+        """This agent's lease-plane counters + current holdings (the
+        `lease` subtree of FleetReplica.get_stats, flattened into
+        llm_scheduler_lease_* gauges)."""
+        with self._lock:
+            return {**self.counters, "held": len(self._held)}
 
     def adopt(self, lease: Lease) -> None:
         """Take ownership of a lease acquired on this holder's behalf
@@ -361,9 +426,13 @@ class LeaseManager:
         pods forever: no later tick re-reports a shard already held)."""
         gained: set[int] = set()
         lost: set[int] = set()
+        with self._lock:
+            self.counters["ticks"] += 1
         try:
             self._tick_inner(gained, lost)
         except LeaseStoreUnavailable as exc:
+            with self._lock:
+                self.counters["store_unavailable"] += 1
             logger.warning(
                 "lease tick aborted for %s (%s): %d gain(s)/%d loss(es) "
                 "already applied, callbacks firing for those",
@@ -390,10 +459,12 @@ class LeaseManager:
                 lost.add(sid)
             else:
                 with self._lock:
+                    self.counters["renewals"] += 1
                     if sid in self._held:
                         self._held[sid] = renewed
         if lost:
             with self._lock:
+                self.counters["losses"] += len(lost)
                 for sid in lost:
                     self._held.pop(sid, None)
             logger.warning(
@@ -429,6 +500,7 @@ class LeaseManager:
             # until TTL.
             self.store.release(shed, self.holder)
             with self._lock:
+                self.counters["sheds"] += 1
                 self._held.pop(shed, None)
             logger.info(
                 "lease manager %s: shed shard %d toward fair share %d",
@@ -437,6 +509,19 @@ class LeaseManager:
         # while a peer is starved, claim only up to the floor — claiming
         # to ceil would race the starved peer for the shard we just freed
         claim_target = floor_share if starved else target
+        # Yield-to-most-starved: never claim while a live peer holds
+        # STRICTLY fewer shards than we do. Without this, tick order
+        # decides who wins each freed shard — an under-target incumbent
+        # that ticks earlier hoovers every shard the over-target members
+        # shed, and a zero-shard newcomer (an autoscale join waiting on
+        # its health gate's first-claim condition) starves for as many
+        # ticks as the incumbent is below target. The minimum holder is
+        # always allowed to claim, so every free shard keeps a claimant
+        # and balanced states are untouched.
+        min_other = min(
+            (count for h, count in holdings.items() if h != self.holder),
+            default=None,
+        )
         for sid in range(self.store.n_shards):
             with self._lock:
                 n_held = len(self._held)
@@ -445,11 +530,14 @@ class LeaseManager:
                 continue
             if n_held >= claim_target:
                 break
+            if min_other is not None and n_held > min_other:
+                break
             if self.store.holder_of(sid) is not None:
                 continue
             lease = self.store.try_acquire(sid, self.holder)
             if lease is not None:
                 with self._lock:
+                    self.counters["claims"] += 1
                     self._held[sid] = lease
                 gained.add(sid)
         if gained:
@@ -492,6 +580,10 @@ class LeaseManager:
                 self._held.clear()
             for sid in held:
                 self.store.release(sid, self.holder)
+            try:
+                self.store.retract_heartbeat(self.holder)
+            except LeaseStoreUnavailable:
+                pass  # unreachable store: presence ages out via TTL
 
 
 def assign_initial(

@@ -412,6 +412,51 @@ class DisaggregatedBackend:
                     BackendError(f"batch member returned {type(result).__name__}")
                 )
 
+    # ------------------------------------------------------------ elasticity
+    def occupancy(self) -> dict[str, float]:
+        """Mean in-flight work per member, per pool — the autoscale
+        controller's pool-pressure signal (fleet/autoscale.py). A pool
+        with no members reads 0.0 (nothing to rebalance toward)."""
+        with self._lock:
+            def mean(pool: list[Any]) -> float:
+                if not pool:
+                    return 0.0
+                total = sum(self._inflight.get(id(m), 0) for m in pool)
+                return total / len(pool)
+
+            return {
+                "prefill": round(mean(self.prefill_pool), 4),
+                "decode": round(mean(self.decode_pool), 4),
+            }
+
+    def set_split(self, n_prefill: int) -> dict[str, int]:
+        """Rebalance the prefill<->decode split over the SAME member
+        roster (autoscale output #2). The roster order is stable
+        (prefill members first, then decode, as currently assigned), so
+        the same `n_prefill` always produces the same assignment —
+        membership moves are deterministic, not load-timing-chosen.
+        `n_prefill` clamps to [1, members] (admission must always have
+        somewhere to land; 0 decode members degrades to a pure prefill
+        fleet, the pre-disaggregation behavior). Members exposing a
+        `pool_role` attribute are retagged so the worker-side admission
+        gate (check_pool_role) stays consistent with the router's view.
+        In-flight work is untouched: classification is per-decision, so
+        the new split applies from the next admission on."""
+        with self._lock:
+            roster = [*self.prefill_pool, *self.decode_pool]
+            n_prefill = max(1, min(int(n_prefill), len(roster)))
+            new_prefill = roster[:n_prefill]
+            new_decode = roster[n_prefill:]
+            self.prefill_pool[:] = new_prefill
+            self.decode_pool[:] = new_decode
+        for member, role in (
+            *((m, PREFILL) for m in new_prefill),
+            *((m, DECODE) for m in new_decode),
+        ):
+            if hasattr(member, "pool_role"):
+                member.pool_role = role
+        return {"prefill": len(new_prefill), "decode": len(new_decode)}
+
     # ----------------------------------------------------------- advisories
     def prewarm_prefix(self, nodes: Sequence[NodeMetrics]):
         """Scheduler idle-prewarm advisory: forward to the PREFILL pool

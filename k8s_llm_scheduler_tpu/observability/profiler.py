@@ -190,6 +190,15 @@ class EngineProfiler:
         self._flops_total = 0.0
         self._tokens_total = 0
         self.waves_profiled = 0
+        # CUMULATIVE (never-windowed) segment books beside the windowed
+        # ones: monotone counters the SLO burn-rate engine can window
+        # itself (delta against its own baselines) — an error_rate
+        # objective over queue_stall_ms_total/wall_ms_total is the
+        # admission-pressure objective the autoscaler consumes without a
+        # custom stats provider. The windowed `*_frac` gauges cannot
+        # serve that role: eviction makes them non-monotone.
+        self._cum = {name: 0.0 for name in SEGMENTS}
+        self._cum["wall"] = 0.0
         # Admission-plane books: per-pack records (engine.admit_packed)
         # and the prefill-tokens-per-decision gauge inputs. Prefix
         # prefills contribute only their NON-REUSED tokens — the delta
@@ -355,8 +364,10 @@ class EngineProfiler:
             if not st["cold_compile"]:
                 for name in SEGMENTS:
                     self._totals[name] += seg.get(name, 0.0)
+                    self._cum[name] += seg.get(name, 0.0)
                 self._totals["device_compute"] += device
                 self._totals["wall"] += wall
+                self._cum["wall"] += wall
                 self._flops_total += flops
                 self._tokens_total += tokens
 
@@ -684,6 +695,7 @@ class EngineProfiler:
         renders each as a llm_scheduler_engine_profile_* gauge)."""
         with self._lock:
             totals = dict(self._totals)
+            cum = dict(self._cum)
             flops = self._flops_total
             waves = self.waves_profiled
             pack_totals = dict(self._pack_totals)
@@ -698,6 +710,10 @@ class EngineProfiler:
             out[f"{name}_frac"] = (
                 round(totals[name] / wall, 4) if wall > 0 else 0.0
             )
+            # monotone ms counters (module __init__ comment): what the
+            # SLO engine's windowed deltas consume
+            out[f"{name}_ms_total"] = round(cum[name] * 1000.0, 3)
+        out["wall_ms_cum_total"] = round(cum["wall"] * 1000.0, 3)
         if packs:
             out["packs_profiled"] = float(packs)
             pack_wall = pack_totals["wall"]

@@ -180,6 +180,12 @@ class SloEngine:
         self.on_clear: list[Callable[[str, dict], None]] = []
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+        # lifecycle lock: start/stop are called from more than one owner
+        # (MetricsServer.stop, cli shutdown paths, autoscale-driven
+        # controller restarts) — without serialization a start racing a
+        # stop could observe the dying thread's slot as free, clear the
+        # stop event under it, and leak BOTH threads
+        self._lifecycle = threading.Lock()
 
     # Stored-baseline resolution: _baseline only ever picks the snapshot
     # nearest a window cutoff, so the ring needs ~this many points per
@@ -378,29 +384,40 @@ class SloEngine:
     # ---------------------------------------------------------- lifecycle
     def start(self, interval_s: float = 10.0) -> None:
         """Background evaluation ticker (same restartable discipline as
-        EngineSampler: stop() sets the event, start() clears it)."""
-        if self._thread is not None:
-            return
-        self._stop.clear()
-        interval = max(0.05, float(interval_s))
+        EngineSampler: stop() sets the event, start() clears it).
+        IDEMPOTENT under repeated controller restarts: a double start is
+        a no-op while the ticker lives (never a second thread), and a
+        start racing a stop waits for the old thread to be joined
+        before clearing the stop event (clearing it early would revive
+        the dying thread alongside the new one)."""
+        with self._lifecycle:
+            if self._thread is not None:
+                return
+            self._stop.clear()
+            interval = max(0.05, float(interval_s))
 
-        def run() -> None:
-            while not self._stop.wait(interval):
-                try:
-                    self.evaluate()
-                except Exception:
-                    logger.exception("slo evaluation failed")
+            def run() -> None:
+                while not self._stop.wait(interval):
+                    try:
+                        self.evaluate()
+                    except Exception:
+                        logger.exception("slo evaluation failed")
 
-        self._thread = threading.Thread(
-            target=run, daemon=True, name="slo-engine"
-        )
-        self._thread.start()
+            self._thread = threading.Thread(
+                target=run, daemon=True, name="slo-engine"
+            )
+            self._thread.start()
 
     def stop(self) -> None:
-        self._stop.set()
-        if self._thread is not None:
-            self._thread.join(timeout=5)
+        """Idempotent: the first caller joins the ticker exactly once
+        (MetricsServer.stop and the owner's own shutdown path may both
+        call this); later callers find no thread and return."""
+        with self._lifecycle:
+            self._stop.set()
+            thread = self._thread
             self._thread = None
+            if thread is not None:
+                thread.join(timeout=5)
 
 
 def from_config(

@@ -127,10 +127,21 @@ class ScenarioSpec:
     n_nodes: int = 16
     n_pods: int = 64
     shapes: int = 8
-    arrival: str = "burst"        # burst | poisson | waves | multitenant
+    # burst | poisson | waves | multitenant | flap | diurnal
+    arrival: str = "burst"
     arrival_rate: float = 500.0   # pods/sec (poisson / multitenant aggregate)
     wave_window_s: float = 0.1    # arrival quantization window (poisson)
-    n_waves: int = 4              # explicit wave count (arrival="waves")
+    n_waves: int = 4              # explicit wave count (waves/flap/diurnal)
+    # flap arrival (scale-thrash workloads): heavy and light waves
+    # alternate; heavy waves carry this fraction of the pods — arrival
+    # pressure flaps across the autoscaler's deadband every wave
+    flap_heavy_frac: float = 0.85
+    # diurnal arrival (the millions-of-users day curve, wave-quantized):
+    # per-wave load follows 1 + amplitude*sin starting at the trough, so
+    # one period ramps trough -> peak -> trough. amplitude 0.9 ~ a 19x
+    # trough-to-peak swing (the ROADMAP "diurnal 10x" class).
+    diurnal_amplitude: float = 0.9
+    diurnal_period_waves: int = 0  # 0 = one full period over n_waves
     # multitenant arrival shaping: number of independent tenant sources.
     # Each tenant is an on/off Poisson stream whose share of the
     # aggregate rate is drawn lognormal (heavy-tailed) — a few heavy
@@ -298,6 +309,41 @@ def generate_scenario(spec: ScenarioSpec) -> Scenario:
         arrivals = np.sort(np.concatenate(streams)) if streams else np.zeros(0)
         wave_of = (arrivals // max(spec.wave_window_s, 1e-9)).astype(int)
         _, wave_of = np.unique(wave_of, return_inverse=True)
+    elif spec.arrival == "flap":
+        # alternating heavy/light waves: the scale-thrash workload.
+        # Allocation is pure arithmetic (no rng draw) so the flap shape
+        # is identical across seeds that share a geometry.
+        n_waves = max(2, spec.n_waves)
+        heavy = [w for w in range(n_waves) if w % 2 == 0]
+        light = [w for w in range(n_waves) if w % 2 == 1]
+        n_heavy = int(round(spec.n_pods * min(max(spec.flap_heavy_frac, 0.0), 1.0)))
+        counts = np.zeros(n_waves, dtype=int)
+        for group, total in ((heavy, n_heavy), (light, spec.n_pods - n_heavy)):
+            base, rem = divmod(total, len(group))
+            for j, w in enumerate(group):
+                counts[w] = base + (1 if j < rem else 0)
+        wave_of = np.repeat(np.arange(n_waves), counts)
+        arrivals = np.zeros(spec.n_pods)
+    elif spec.arrival == "diurnal":
+        # wave-quantized day curve: per-wave weight 1 + A*sin starting
+        # at the trough (wave 0 lightest, peak mid-period). Pod counts
+        # come from largest-remainder apportionment of the weights —
+        # deterministic, and the total is exactly n_pods.
+        n_waves = max(1, spec.n_waves)
+        period = spec.diurnal_period_waves or n_waves
+        phase = 2.0 * np.pi * (np.arange(n_waves) + 0.5) / max(period, 1)
+        weights = 1.0 + spec.diurnal_amplitude * np.sin(phase - np.pi / 2.0)
+        weights = np.clip(weights, 0.0, None)
+        if weights.sum() <= 0:
+            weights = np.ones(n_waves)
+        weights = weights / weights.sum()
+        raw = weights * spec.n_pods
+        counts = np.floor(raw).astype(int)
+        remainder = spec.n_pods - int(counts.sum())
+        order = np.argsort(-(raw - counts), kind="stable")
+        counts[order[:remainder]] += 1
+        wave_of = np.repeat(np.arange(n_waves), counts)
+        arrivals = np.zeros(spec.n_pods)
     elif spec.arrival == "burst":
         arrivals = np.zeros(spec.n_pods)
         wave_of = np.zeros(spec.n_pods, dtype=int)
@@ -308,6 +354,12 @@ def generate_scenario(spec: ScenarioSpec) -> Scenario:
     n_churn_waves = max((e.wave for e in spec.churn), default=-1) + 1
     n_waves_total = max(int(wave_of.max()) + 1 if spec.n_pods else 1,
                         n_churn_waves)
+    if spec.arrival in ("flap", "diurnal"):
+        # a trough wave may carry ZERO pods — it still exists (the
+        # harness's fault windows and the autoscaler's down-scale ticks
+        # are indexed by wave, and an idle wave is exactly when a
+        # scale-down should fire)
+        n_waves_total = max(n_waves_total, max(1, spec.n_waves))
     waves: list[list[SimPod]] = [[] for _ in range(n_waves_total)]
     for i in range(spec.n_pods):
         shape = i % spec.shapes
@@ -410,20 +462,24 @@ def chaos_scenario(
     unschedulable-by-construction pod would be indistinguishable from a
     dropped one without carrying the constraint solver into the chaos
     verdict)."""
-    from k8s_llm_scheduler_tpu.chaos.faults import FaultPlan
+    from k8s_llm_scheduler_tpu.chaos.faults import REGIMES, FaultPlan
 
     plan = FaultPlan.generate(regime, seed, n_waves, n_nodes=n_nodes)
     churn = tuple(
         ChurnEvent(wave=int(c["wave"]), kind=c["kind"], node=c["node"])
         for c in plan.churn
     )
+    # scale regimes shape the workload side too: flap parks arrival
+    # pressure on the autoscaler's threshold, diurnal ramps it through
+    # the fault window (chaos/faults.REGIMES declares which)
+    arrival = REGIMES[regime].get("arrival", "waves")
     spec = ScenarioSpec(
         name=f"chaos-{regime}",
         seed=seed,
         n_nodes=n_nodes,
         n_pods=n_pods,
         shapes=shapes,
-        arrival="waves",
+        arrival=arrival,
         n_waves=n_waves,
         hetero=True,
         zones=4,

@@ -1,0 +1,846 @@
+"""Elastic fleet autoscaler (fleet/autoscale.py + elastic Fleet ops):
+deadband policy arithmetic, thrash-proofing (hysteresis + cooldowns +
+clamps), the health-gated join/rollback path, scale-down drain ordering
+(the PR 6 drain-before-release fix exercised via the controller path),
+lease-plane gauges, pool-split rebalancing, and the three scale chaos
+regimes end to end."""
+
+import asyncio
+import json
+
+import pytest
+
+from k8s_llm_scheduler_tpu.chaos.faults import (
+    FaultEvent,
+    FaultInjector,
+    FaultPlan,
+)
+from k8s_llm_scheduler_tpu.chaos.harness import HashPlacementBackend
+from k8s_llm_scheduler_tpu.chaos.invariants import InvariantMonitor
+from k8s_llm_scheduler_tpu.cluster.fake import FakeCluster
+from k8s_llm_scheduler_tpu.cluster.interface import RawPod
+from k8s_llm_scheduler_tpu.fleet import (
+    AutoscaleConfig,
+    AutoscaleController,
+    AutoscalePolicy,
+    AutoscaleSignals,
+    DisaggregatedBackend,
+    Fleet,
+    JoinError,
+    LeaseStore,
+    shard_of,
+)
+from k8s_llm_scheduler_tpu.types import (
+    DecisionSource,
+    NodeMetrics,
+    SchedulingDecision,
+)
+
+SCHEDULER_NAME = "ai-llama-scheduler"
+
+
+class VClock:
+    def __init__(self, t: float = 1000.0) -> None:
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def make_nodes(n=3):
+    return [
+        NodeMetrics(
+            name=f"node-{i}", cpu_usage_percent=10.0,
+            memory_usage_percent=10.0, available_cpu_cores=8.0,
+            available_memory_gb=32.0, pod_count=0, max_pods=110,
+            labels={}, taints=(), conditions={"Ready": "True"},
+        )
+        for i in range(n)
+    ]
+
+
+def _cfg(**over):
+    base = dict(
+        min_replicas=1, max_replicas=8,
+        target_per_replica=8.0, target_utilization=0.75,
+        up_threshold=1.0, down_threshold=0.5,
+        max_step=2, up_cooldown_s=1.0, down_cooldown_s=3.0,
+        join_budget_ticks=3, join_backoff_ticks=1, max_join_retries=3,
+        split_enabled=False,
+    )
+    base.update(over)
+    return AutoscaleConfig(**base)
+
+
+# ------------------------------------------------------------------ policy
+class TestPolicy:
+    def test_deadband_holds(self):
+        policy = AutoscalePolicy(_cfg())
+        for pressure in (0.5, 0.75, 1.0):
+            assert policy.desired(4, pressure) == 4
+
+    def test_scale_up_retargets_inside_band_with_step_clamp(self):
+        policy = AutoscalePolicy(_cfg(max_step=2))
+        # pressure 2.0 at n=2 wants ceil(2*2/0.75)=6, clamped to +2
+        assert policy.desired(2, 2.0) == 4
+        assert policy.desired(2, 1.1) == 3
+
+    def test_scale_down_retargets_with_step_and_min_clamp(self):
+        policy = AutoscalePolicy(_cfg(max_step=2))
+        # pressure 0.1 at n=6 wants ceil(6*0.1/0.75)=1, clamped to -2
+        assert policy.desired(6, 0.1) == 4
+        assert policy.desired(2, 0.1) == 1  # min clamp
+
+    def test_max_clamp(self):
+        policy = AutoscalePolicy(_cfg(max_replicas=4))
+        assert policy.desired(4, 5.0) == 4
+
+    def test_pressure_queue_normalization(self):
+        policy = AutoscalePolicy(_cfg())
+        sig = AutoscaleSignals(queue_depth=24.0)
+        assert policy.pressure(2, sig) == pytest.approx(24 / 16)
+
+    def test_pressure_slo_burn_needs_both_windows(self):
+        policy = AutoscalePolicy(_cfg())
+        # fast burning alone is a blip, not pressure
+        sig = AutoscaleSignals(slo_fast_burn=14.0, slo_slow_burn=0.5)
+        assert policy.pressure(2, sig) == 0.0
+        sig = AutoscaleSignals(slo_fast_burn=14.0, slo_slow_burn=6.0)
+        assert policy.pressure(2, sig) == pytest.approx(6.0)
+
+    def test_pressure_stall_and_latency_terms(self):
+        policy = AutoscalePolicy(_cfg(latency_target_ms=200.0))
+        sig = AutoscaleSignals(queue_stall_frac=0.5)
+        assert policy.pressure(1, sig) == pytest.approx(0.5 / 0.25)
+        sig = AutoscaleSignals(decide_p99_ms=400.0)
+        assert policy.pressure(1, sig) == pytest.approx(2.0)
+
+    def test_config_validation(self):
+        with pytest.raises(ValueError, match="min_replicas"):
+            AutoscaleConfig(min_replicas=0)
+        with pytest.raises(ValueError, match="max_replicas"):
+            AutoscaleConfig(min_replicas=4, max_replicas=2)
+        with pytest.raises(ValueError, match="inside the deadband"):
+            AutoscaleConfig(target_utilization=0.4, down_threshold=0.5)
+        with pytest.raises(ValueError, match="unknown keys"):
+            AutoscaleConfig.from_dict({"nope": 1})
+
+    def test_from_dict_tolerates_wiring_keys(self):
+        cfg = AutoscaleConfig.from_dict(
+            {"enabled": True, "tick_interval_s": 5.0, "max_replicas": 3}
+        )
+        assert cfg.max_replicas == 3
+
+
+# ------------------------------------------------------------- controller
+def _elastic_fleet(n_replicas=1, n_shards=16, lease_ttl_s=6.0):
+    cluster = FakeCluster()
+    cluster.add_nodes(6, prefix="n")
+    clock = VClock()
+    fleet = Fleet(
+        cluster, cluster, lambda i: HashPlacementBackend(),
+        n_replicas=n_replicas, n_shards=n_shards,
+        lease_ttl_s=lease_ttl_s, clock=clock,
+        list_pending=lambda: cluster.pending_pods(SCHEDULER_NAME),
+    )
+    return cluster, clock, fleet
+
+
+def _controller(fleet, wave_state, **cfg_over):
+    return AutoscaleController(
+        fleet, _cfg(**cfg_over),
+        queue_depth_fn=lambda: wave_state["q"],
+        clock=lambda: wave_state["i"] * 1.0,
+    )
+
+
+async def _drive(fleet, clock, controller, wave_state, loads):
+    records = []
+    for w, q in enumerate(loads):
+        clock.advance(1.0)
+        fleet.tick_leases()
+        wave_state["i"] = w + 1
+        wave_state["q"] = q
+        records.append(await controller.tick())
+    return records
+
+
+class TestControllerLoop:
+    def test_health_gated_join_lands(self):
+        async def run():
+            cluster, clock, fleet = _elastic_fleet()
+            ws = {"i": 0, "q": 0}
+            controller = _controller(fleet, ws)
+            await fleet.start(lease_threads=False)
+            try:
+                recs = await _drive(
+                    fleet, clock, controller, ws, [20, 20, 20]
+                )
+            finally:
+                await fleet.stop()
+            return recs, controller, fleet
+
+        recs, controller, fleet = asyncio.run(run())
+        actions = [r["action"] for r in recs]
+        assert actions[0] == "join_started"
+        assert "join_admitted" in actions
+        assert controller.counters["scale_ups"] == 1
+        # the joiner claimed its first lease before admission
+        assert fleet.scale_counters["joins_completed"] == 1
+
+    def test_flapping_load_is_thrash_proof(self):
+        async def run():
+            cluster, clock, fleet = _elastic_fleet()
+            ws = {"i": 0, "q": 0}
+            controller = _controller(fleet, ws)
+            await fleet.start(lease_threads=False)
+            try:
+                loads = [20, 2] * 6  # flap across the band every wave
+                await _drive(fleet, clock, controller, ws, loads)
+            finally:
+                await fleet.stop()
+            return controller
+
+        controller = asyncio.run(run())
+        changes = (
+            controller.counters["scale_ups"]
+            + controller.counters["scale_downs"]
+        )
+        # bounded oscillation: membership changes strictly fewer than
+        # waves, and downs bounded by the down cooldown (12 waves /
+        # 3-wave cooldown = at most 4)
+        assert 0 < changes < 12
+        assert controller.counters["scale_downs"] <= 4
+
+    def test_join_fail_rolls_back_with_bounded_retries(self):
+        plan = FaultPlan(
+            regime="join-fail", seed=0, n_waves=99,
+            events=(FaultEvent("scale", "join_fail", 0, 99),),
+        )
+        injector = FaultInjector(plan)
+        injector.begin_wave(1)
+
+        async def run():
+            cluster, clock, fleet = _elastic_fleet()
+            fleet.fault_seam = injector.seam("scale")
+            ws = {"i": 0, "q": 0}
+            controller = _controller(fleet, ws, max_join_retries=2)
+            await fleet.start(lease_threads=False)
+            try:
+                recs = await _drive(
+                    fleet, clock, controller, ws, [40] * 8
+                )
+            finally:
+                await fleet.stop()
+            return recs, controller, fleet
+
+        recs, controller, fleet = asyncio.run(run())
+        assert controller.counters["join_failures"] == 2  # bounded
+        assert fleet.n_live == 1  # every failed join fully rolled back
+        assert any(
+            r["action"] == "hold"
+            and r.get("detail") == "join_retries_exhausted"
+            for r in recs
+        )
+
+    def test_silent_gate_stall_aborts_on_budget_expiry(self):
+        """The budget-expiry path proper: a LIVE joiner that simply
+        never claims (no lease ticks run while the gate is open — the
+        silent-death shape nobody observes) must roll back with
+        detail='budget' once join_budget_ticks expire."""
+
+        async def run():
+            cluster, clock, fleet = _elastic_fleet()
+            ws = {"i": 0, "q": 0}
+            controller = _controller(
+                fleet, ws, join_budget_ticks=2, max_join_retries=1,
+            )
+            await fleet.start(lease_threads=False)
+            recs = []
+            try:
+                for w, q in enumerate([40, 40, 40, 40]):
+                    clock.advance(1.0)
+                    # deliberately NO fleet.tick_leases(): incumbents
+                    # never shed, the joiner never claims
+                    ws["i"] = w + 1
+                    ws["q"] = q
+                    recs.append(await controller.tick())
+            finally:
+                await fleet.stop()
+            return recs, fleet
+
+        recs, fleet = asyncio.run(run())
+        rolled = [r for r in recs if r["action"] == "join_rolled_back"]
+        assert rolled and rolled[0]["detail"] == "budget"
+        assert fleet.n_live == 1  # fully rolled back
+
+    def test_observed_gate_death_rolls_back_next_tick(self):
+        plan = FaultPlan(
+            regime="join-fail", seed=0, n_waves=99,
+            events=(FaultEvent("scale", "gate_stall", 1, 3),),
+        )
+        injector = FaultInjector(plan)
+
+        async def run():
+            cluster, clock, fleet = _elastic_fleet()
+            fleet.fault_seam = injector.seam("scale")
+            ws = {"i": 0, "q": 0}
+            controller = _controller(fleet, ws)
+            await fleet.start(lease_threads=False)
+            recs = []
+            try:
+                for w, q in enumerate([2, 40, 40, 40, 40, 40]):
+                    injector.begin_wave(w)
+                    clock.advance(1.0)
+                    fleet.tick_leases()
+                    ws["i"] = w + 1
+                    ws["q"] = q
+                    recs.append(await controller.tick())
+            finally:
+                await fleet.stop()
+            return recs, controller, fleet
+
+        recs, controller, fleet = asyncio.run(run())
+        actions = [r["action"] for r in recs]
+        assert "join_rolled_back" in actions
+        # the retry after the window lands, proving full rollback
+        assert "join_admitted" in actions
+        assert fleet.n_live >= 2
+
+    def test_retry_budget_rearms_below_band_not_only_inside_it(self):
+        """Regression: a load flapping heavy/light (pressure never
+        settles INSIDE the band) must still re-arm the join-retry
+        budget on the light waves — gating re-arm on the band interior
+        permanently locked scale-ups out after one fault episode."""
+        plan = FaultPlan(
+            regime="join-fail", seed=0, n_waves=99,
+            events=(FaultEvent("scale", "join_fail", 0, 3),),
+        )
+        injector = FaultInjector(plan)
+
+        async def run():
+            cluster, clock, fleet = _elastic_fleet()
+            fleet.fault_seam = injector.seam("scale")
+            ws = {"i": 0, "q": 0}
+            controller = _controller(
+                fleet, ws, max_join_retries=1, join_backoff_ticks=0,
+            )
+            await fleet.start(lease_threads=False)
+            try:
+                # fault window: the one permitted retry burns out
+                loads = [(0, 40), (1, 40), (2, 40)]
+                # post-window flap: heavy/light, never inside the band
+                loads += [(4, 2), (4, 40), (4, 2), (4, 40)]
+                for w, (wave, q) in enumerate(loads):
+                    injector.begin_wave(wave)
+                    clock.advance(1.0)
+                    fleet.tick_leases()
+                    ws["i"] = w + 1
+                    ws["q"] = q
+                    await controller.tick()
+            finally:
+                await fleet.stop()
+            return controller
+
+        controller = asyncio.run(run())
+        assert controller.counters["join_failures"] >= 1
+        # the light wave re-armed the budget; the next heavy wave scaled
+        assert controller.counters["scale_ups"] >= 1
+
+    def test_replica_bounds_hook_feeds_invariant_monitor(self):
+        monitor = InvariantMonitor()
+
+        async def run():
+            cluster, clock, fleet = _elastic_fleet()
+            ws = {"i": 0, "q": 0}
+            controller = AutoscaleController(
+                fleet, _cfg(max_replicas=2),
+                queue_depth_fn=lambda: ws["q"],
+                clock=lambda: ws["i"] * 1.0,
+                on_scale=monitor.note_scale,
+            )
+            await fleet.start(lease_threads=False)
+            try:
+                await _drive(
+                    fleet, clock, controller, ws, [40, 40, 40, 40]
+                )
+            finally:
+                await fleet.stop()
+            return controller
+
+        controller = asyncio.run(run())
+        assert monitor.checks["replica_bounds"] == 4
+        assert monitor.clean
+        # the clamp held even though demand wanted more
+        assert controller.fleet.n_live <= 2
+
+    def test_scale_events_exclude_cadence_noise(self):
+        async def run():
+            cluster, clock, fleet = _elastic_fleet()
+            ws = {"i": 0, "q": 0}
+            controller = _controller(fleet, ws)
+            await fleet.start(lease_threads=False)
+            try:
+                await _drive(
+                    fleet, clock, controller, ws, [2, 2, 20, 20, 2]
+                )
+            finally:
+                await fleet.stop()
+            return controller
+
+        controller = asyncio.run(run())
+        actions = {e["action"] for e in controller.scale_events()}
+        assert "hold" not in actions
+        assert "join_pending" not in actions
+
+
+# ----------------------------------------------------------- elastic fleet
+class TestElasticFleet:
+    def test_remove_refuses_last_replica(self):
+        async def run():
+            cluster, clock, fleet = _elastic_fleet(n_replicas=1)
+            await fleet.start(lease_threads=False)
+            try:
+                with pytest.raises(ValueError, match="last replica"):
+                    await fleet.remove_replica(fleet.replicas[0])
+            finally:
+                await fleet.stop()
+
+        asyncio.run(run())
+
+    def test_clean_removal_retracts_heartbeat_immediately(self):
+        async def run():
+            cluster, clock, fleet = _elastic_fleet(n_replicas=2)
+            await fleet.start(lease_threads=False)
+            try:
+                victim = fleet.pick_removal()
+                holder = victim.holder
+                assert holder in fleet.store.live_holders()
+                await fleet.remove_replica(victim)
+                # gone NOW, not after TTL: a lingering heartbeat would
+                # read as a starved zero-shard peer and freeze the
+                # yield-to-most-starved claim rule for a full TTL
+                assert holder not in fleet.store.live_holders()
+                # survivor converges on the freed shards
+                for _ in range(20):
+                    clock.advance(1.0)
+                    fleet.tick_leases()
+                survivor = fleet.replicas[0]
+                assert len(survivor.manager.owned()) == fleet.n_shards
+            finally:
+                await fleet.stop()
+
+        asyncio.run(run())
+
+    def test_join_factory_failure_is_join_error(self):
+        async def run():
+            cluster = FakeCluster()
+            cluster.add_nodes(3, prefix="n")
+            clock = VClock()
+            calls = {"n": 0}
+
+            def factory(i):
+                calls["n"] += 1
+                if calls["n"] > 1:
+                    raise RuntimeError("worker image pull failed")
+                return HashPlacementBackend()
+
+            fleet = Fleet(
+                cluster, cluster, factory, n_replicas=1, n_shards=8,
+                lease_ttl_s=6.0, clock=clock,
+            )
+            await fleet.start(lease_threads=False)
+            try:
+                with pytest.raises(JoinError, match="factory failed"):
+                    await fleet.start_join()
+                assert fleet.n_live == 1
+                assert fleet.scale_counters["joins_failed"] == 1
+            finally:
+                await fleet.stop()
+
+        asyncio.run(run())
+
+    def test_scale_down_drains_binds_before_lease_release(self):
+        """Regression guard on the PR 6 stop-ordering fix, via the
+        CONTROLLER path: a replica removed while holding an in-flight
+        decision must complete its bind (lease still held, fence
+        passes) BEFORE its leases release."""
+
+        class GatedBackend:
+            def __init__(self) -> None:
+                self.gate = asyncio.Event()
+                self.entered = asyncio.Event()
+
+            async def get_scheduling_decision_async(self, pod, nodes):
+                self.entered.set()
+                await self.gate.wait()
+                ready = sorted(n.name for n in nodes if n.is_ready)
+                return SchedulingDecision(
+                    selected_node=ready[0], confidence=0.9,
+                    reasoning="gated", source=DecisionSource.LLM,
+                )
+
+        events: list = []
+
+        async def run():
+            cluster = FakeCluster()
+            cluster.add_nodes(3, prefix="n")
+            clock = VClock()
+            backends = {}
+
+            def factory(i):
+                backends[i] = GatedBackend()
+                return backends[i]
+
+            fleet = Fleet(
+                cluster, cluster, factory, n_replicas=2, n_shards=8,
+                lease_ttl_s=3600.0, clock=clock,
+                list_pending=lambda: cluster.pending_pods(SCHEDULER_NAME),
+            )
+            victim = fleet.replicas[1]  # pick_removal picks newest
+            orig_release = fleet.store.release
+
+            def recording_release(sid, holder):
+                if holder == victim.holder:
+                    events.append(("release", sid))
+                return orig_release(sid, holder)
+
+            fleet.store.release = recording_release
+            orig_note = victim.scheduler._note_bind
+
+            def tagging_note(ok, pod, decision):
+                events.append(("bind", pod.name, ok))
+                orig_note(ok, pod, decision)
+
+            victim.scheduler._note_bind = tagging_note
+            await fleet.start(lease_threads=False)
+            try:
+                # a pod whose shard the victim owns (odd shards via
+                # round-robin bootstrap)
+                name = next(
+                    f"pod-{i}" for i in range(200)
+                    if victim.manager.owns(
+                        shard_of("default", f"pod-{i}", fleet.n_shards)
+                    )
+                )
+                cluster.add_pod(RawPod(
+                    name=name, namespace="default",
+                    scheduler_name=SCHEDULER_NAME,
+                    container_requests=({"cpu": "100m"},),
+                ))
+                await asyncio.wait_for(
+                    backends[1].entered.wait(), timeout=10
+                )
+                removal = asyncio.create_task(
+                    fleet.remove_replica(victim)
+                )
+                await asyncio.sleep(0.1)
+                # drain in progress: the bind has NOT happened and the
+                # leases have NOT been released
+                assert not removal.done()
+                assert events == []
+                backends[1].gate.set()
+                await asyncio.wait_for(removal, timeout=10)
+            finally:
+                await fleet.stop()
+            return cluster
+
+        cluster = asyncio.run(run())
+        kinds = [e[0] for e in events]
+        assert "bind" in kinds and "release" in kinds
+        # every release comes after the bind landed
+        assert kinds.index("bind") < kinds.index("release")
+        bind_event = next(e for e in events if e[0] == "bind")
+        assert bind_event[2] is True  # bound, not fenced
+        assert cluster.bind_count == 1
+
+
+# ------------------------------------------------------ lease-plane gauges
+class TestLeaseGauges:
+    def test_store_gauges_and_manager_stats(self):
+        async def run():
+            cluster, clock, fleet = _elastic_fleet(n_replicas=2)
+            await fleet.start(lease_threads=False)
+            try:
+                for _ in range(3):
+                    clock.advance(1.0)
+                    fleet.tick_leases()
+                victim = fleet.pick_removal()
+                # one store-side fence verification on an owned shard
+                victim._store_fence(sorted(victim.manager.owned())[0])
+                stats = fleet.get_stats()
+            finally:
+                await fleet.stop()
+            return stats
+
+        stats = asyncio.run(run())
+        store_g = stats["lease"]
+        assert store_g["acquisitions"] >= stats["n_shards"]
+        assert store_g["leased_shards"] == stats["n_shards"]
+        assert store_g["live_holders"] == 2
+        assert store_g["fence_checks"] >= 1
+        assert sum(store_g["holdings"].values()) == stats["n_shards"]
+        for replica_stats in stats["replicas"]:
+            mgr = replica_stats["lease"]
+            assert mgr["ticks"] >= 3
+            assert mgr["renewals"] >= 1
+            assert mgr["held"] >= 1
+
+    def test_lease_gauges_render_as_prometheus_families(self):
+        from k8s_llm_scheduler_tpu.observability.metrics import (
+            render_prometheus,
+        )
+
+        async def run():
+            cluster, clock, fleet = _elastic_fleet(n_replicas=2)
+            await fleet.start(lease_threads=False)
+            try:
+                clock.advance(1.0)
+                fleet.tick_leases()
+                return render_prometheus(fleet.get_stats())
+            finally:
+                await fleet.stop()
+
+        text = asyncio.run(run())
+        assert "llm_scheduler_lease_acquisitions" in text
+        assert "llm_scheduler_lease_leased_shards" in text
+        assert "llm_scheduler_lease_holdings_replica_0" in text
+        # per-replica manager counters ride the replicas list
+        assert "llm_scheduler_replicas_0_lease_claims" in text
+        # no raw holder name (dashes are metric-name-illegal) leaked
+        assert "replica-0" not in text.replace('"', "")
+
+    def test_shed_and_claim_counters_move_on_rebalance(self):
+        store = LeaseStore(8, ttl_s=100.0, clock=VClock())
+        from k8s_llm_scheduler_tpu.fleet import LeaseManager, assign_initial
+
+        m0 = LeaseManager(store, "a")
+        assigned = assign_initial(store, ["a"])
+        for lease in assigned["a"]:
+            m0.adopt(lease)
+        m1 = LeaseManager(store, "b")
+        for _ in range(10):
+            m0.tick()
+            m1.tick()
+        assert m0.counters["sheds"] >= 1
+        assert m1.counters["claims"] >= 1
+        assert store.counters["releases"] >= 1
+        assert m0.stats()["held"] + m1.stats()["held"] == 8
+
+
+# ------------------------------------------------------------- pool split
+class _Member:
+    def __init__(self, role="prefill") -> None:
+        self.pool_role = role
+
+    def get_scheduling_decision(self, pod, nodes, work="prefill"):
+        raise NotImplementedError
+
+
+class TestPoolSplit:
+    def test_set_split_moves_members_deterministically(self):
+        members = [_Member() for _ in range(4)]
+        backend = DisaggregatedBackend(members[:2], members[2:])
+        split = backend.set_split(3)
+        assert split == {"prefill": 3, "decode": 1}
+        assert backend.prefill_pool == members[:3]
+        assert backend.decode_pool == members[3:]
+        assert [m.pool_role for m in members] == [
+            "prefill", "prefill", "prefill", "decode",
+        ]
+
+    def test_set_split_clamps_to_keep_admission_alive(self):
+        members = [_Member() for _ in range(3)]
+        backend = DisaggregatedBackend(members[:2], members[2:])
+        assert backend.set_split(0) == {"prefill": 1, "decode": 2}
+        assert backend.set_split(99) == {"prefill": 3, "decode": 0}
+
+    def test_occupancy_reads_inflight_means(self):
+        members = [_Member() for _ in range(2)]
+        backend = DisaggregatedBackend([members[0]], [members[1]])
+        backend._acquire(members[0])
+        backend._acquire(members[0])
+        backend._acquire(members[1])
+        occ = backend.occupancy()
+        assert occ == {"prefill": 2.0, "decode": 1.0}
+
+    def test_controller_rebalances_split_on_occupancy(self):
+        members = [_Member() for _ in range(4)]
+        pools = DisaggregatedBackend(members[:2], members[2:])
+        # admission-heavy: prefill members carry all in-flight work
+        pools._acquire(members[0])
+        pools._acquire(members[0])
+        pools._acquire(members[1])
+        pools._acquire(members[1])
+
+        async def run():
+            cluster, clock, fleet = _elastic_fleet()
+            ws = {"i": 0, "q": 0}
+            controller = AutoscaleController(
+                fleet, _cfg(split_enabled=True, split_cooldown_s=0.0),
+                queue_depth_fn=lambda: ws["q"],
+                pools=pools,
+                clock=lambda: ws["i"] * 1.0,
+            )
+            await fleet.start(lease_threads=False)
+            try:
+                await _drive(fleet, clock, controller, ws, [4])
+            finally:
+                await fleet.stop()
+            return controller
+
+        controller = asyncio.run(run())
+        assert controller.counters["split_changes"] == 1
+        assert len(pools.prefill_pool) == 3
+
+
+# ---------------------------------------------------------------- signals
+class TestSignals:
+    def test_gather_reads_slo_and_profiler_and_aggregator(self):
+        class SloDouble:
+            def snapshot(self):
+                return {"objectives": {
+                    "lat": {"fast": {"burn": 3.0}, "slow": {"burn": 2.0}},
+                    "err": {"fast": {"burn": 9.0}, "slow": {"burn": 1.0}},
+                }}
+
+        class AggDouble:
+            def fleet_percentiles(self, phase):
+                return {"p99_ms": 120.0 if phase == "decide" else 40.0,
+                        "p50_ms": 1, "p95_ms": 1, "count": 10,
+                        "max_ms": 1}
+
+        class ProfDouble:
+            def gauges(self):
+                return {"queue_stall_frac": 0.4}
+
+        async def run():
+            cluster, clock, fleet = _elastic_fleet()
+            controller = AutoscaleController(
+                fleet, _cfg(),
+                queue_depth_fn=lambda: 5.0,
+                slo_engine=SloDouble(), aggregator=AggDouble(),
+                profiler=ProfDouble(), clock=lambda: 0.0,
+            )
+            return controller.gather()
+
+        sig = asyncio.run(run())
+        assert sig.queue_depth == 5.0
+        assert sig.slo_fast_burn == 9.0
+        assert sig.slo_slow_burn == 2.0
+        assert sig.decide_p99_ms == 120.0
+        assert sig.bind_p99_ms == 40.0
+        assert sig.queue_stall_frac == 0.4
+
+    def test_slo_objective_over_profiler_cumulative_counters(self):
+        """Satellite: queue_stall is consumable by a config-declared SLO
+        objective through the composed stats tree — no custom provider."""
+        from k8s_llm_scheduler_tpu.observability.slo import (
+            SloEngine,
+            SloObjective,
+        )
+
+        gauges = {"queue_stall_ms_total": 0.0, "wall_ms_cum_total": 0.0}
+        clock = VClock()
+        engine = SloEngine(
+            [SloObjective(
+                name="admission_pressure", kind="error_rate",
+                numerator="engine_profile.queue_stall_ms_total",
+                denominator="engine_profile.wall_ms_cum_total",
+                budget=0.1, fast_burn_threshold=2.0,
+                slow_burn_threshold=2.0,
+            )],
+            lambda: {"engine_profile": dict(gauges)},
+            fast_window_s=10.0, slow_window_s=100.0, clock=clock,
+        )
+        engine.evaluate()
+        # admission-starved window: stall is 60% of wall
+        for _ in range(12):
+            clock.advance(10.0)
+            gauges["queue_stall_ms_total"] += 600.0
+            gauges["wall_ms_cum_total"] += 1000.0
+            engine.evaluate()
+        assert "admission_pressure" in engine.tripped()
+
+
+# -------------------------------------------------------- chaos regimes
+class TestScaleChaosFast:
+    def test_scale_thrash_clean_and_bounded(self):
+        from k8s_llm_scheduler_tpu.chaos.harness import run_chaos
+
+        report = run_chaos(
+            "scale-thrash", seed=3, n_waves=6, n_nodes=8, n_pods=36,
+            quality=False,
+        )
+        assert report["invariants"]["clean"], (
+            report["invariants"]["violations"]
+        )
+        assert report["scores"]["bound_frac"] == 1.0
+        scale = report["autoscale"]
+        changes = scale["scale_ups"] + scale["scale_downs"]
+        assert 0 < changes < 6  # never one membership change per wave
+        assert report["invariants"]["checks"]["replica_bounds"] >= 6
+        assert report["invariants"]["checks"]["single_holder_bind"] >= 1
+
+    def test_join_fail_regime_rolls_back_and_recovers(self):
+        from k8s_llm_scheduler_tpu.chaos.harness import run_chaos
+
+        report = run_chaos(
+            "join-fail", seed=5, n_waves=6, n_nodes=8, n_pods=48,
+            quality=False,
+        )
+        assert report["invariants"]["clean"]
+        assert report["scores"]["bound_frac"] == 1.0
+        assert report["injections"].get("scale.join_fail", 0) >= 1
+        assert report["injections"].get("scale.gate_stall", 0) >= 1
+        assert report["autoscale"]["join_failures"] >= 2
+        # the post-window retry landed
+        assert report["autoscale"]["scale_ups"] >= 1
+
+    def test_drain_race_regime_stays_exactly_once(self):
+        from k8s_llm_scheduler_tpu.chaos.harness import run_chaos
+
+        report = run_chaos(
+            "drain-race", seed=5, n_waves=6, n_nodes=8, n_pods=48,
+            quality=False,
+        )
+        assert report["invariants"]["clean"]
+        assert report["scores"]["bound_frac"] == 1.0
+        assert report["injections"].get("scale.drain_race", 0) >= 1
+
+    def test_cli_fleet_autoscale_smoke(self, capsys):
+        from k8s_llm_scheduler_tpu.cli import main
+
+        rc = main([
+            "fleet", "autoscale", "--pods", "48", "--waves", "6",
+            "--nodes", "8", "--json",
+        ])
+        assert rc == 0
+        out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert out["bind_count"] == 48
+        assert out["autoscale"]["scale_ups"] >= 1
+        assert len(out["trajectory"]) == 6
+        assert "holdings" in out["lease"]
+
+    def test_scale_trace_replays_byte_identical(self):
+        from k8s_llm_scheduler_tpu.chaos.harness import (
+            build_chaos_trace,
+            canonical_chaos_bytes,
+            replay_chaos_trace,
+            run_chaos,
+        )
+
+        report = run_chaos(
+            "scale-thrash", seed=3, n_waves=6, n_nodes=8, n_pods=36,
+            quality=False,
+        )
+        trace = build_chaos_trace(report)
+        assert trace["scale_events"], "scale events must ride the trace"
+        replayed = replay_chaos_trace(
+            json.loads(canonical_chaos_bytes(trace).decode())
+        )
+        assert canonical_chaos_bytes(replayed) == \
+            canonical_chaos_bytes(trace)

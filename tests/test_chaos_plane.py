@@ -118,7 +118,9 @@ class TestFaultPlan:
 
     def test_every_regime_declares_a_known_mode(self):
         for name, info in REGIMES.items():
-            assert info["mode"] in ("single", "wire", "fleet"), name
+            assert info["mode"] in (
+                "single", "wire", "fleet", "autoscale"
+            ), name
 
     def test_every_regime_generates_at_minimum_waves(self):
         # regression: staged windows (410 then 5xx; renewals then

@@ -90,6 +90,11 @@ class TestRepoIsClean:
         assert "k8s_llm_scheduler_tpu/engine/fused/tables.py" in files
         assert "k8s_llm_scheduler_tpu/sched/replica.py" in files
         assert "tests/test_fused.py" in files
+        # autoscale round: the elastic control loop (async fleet ops,
+        # tick-driven controller) — the same asyncio-heavy risk class
+        # as the scheduler loop it scales
+        assert "k8s_llm_scheduler_tpu/fleet/autoscale.py" in files
+        assert "tests/test_autoscale.py" in files
         # the lint never lints its own pattern table
         assert "tools/py310_lint.py" not in files
 

@@ -311,6 +311,97 @@ class TestSurfaces:
         assert stats["state"] == "closed"  # advisory, never a transition
 
 
+class TestTickerLifecycle:
+    """The background ticker under repeated controller restarts: a
+    double start must never leak a second thread, and stop must join
+    exactly once no matter how many owners call it (MetricsServer.stop
+    and the CLI shutdown path both do)."""
+
+    def _engine(self):
+        return SloEngine(
+            [SloObjective(name="o", kind="throughput", min_per_s=1.0)],
+            lambda: {"total_scheduled": 0},
+        )
+
+    def _slo_threads(self):
+        import threading
+
+        return [
+            t for t in threading.enumerate() if t.name == "slo-engine"
+        ]
+
+    def test_double_start_keeps_one_thread(self):
+        eng = self._engine()
+        eng.start(interval_s=60.0)
+        first = eng._thread
+        for _ in range(5):
+            eng.start(interval_s=60.0)
+        try:
+            assert eng._thread is first
+            assert len(self._slo_threads()) == 1
+        finally:
+            eng.stop()
+
+    def test_stop_is_idempotent_and_joins_once(self):
+        eng = self._engine()
+        eng.start(interval_s=60.0)
+        thread = eng._thread
+        eng.stop()
+        assert not thread.is_alive()
+        assert eng._thread is None
+        eng.stop()  # second owner: no-op, no error
+        assert self._slo_threads() == []
+
+    def test_restart_cycle_leaks_no_threads(self):
+        eng = self._engine()
+        for _ in range(4):
+            eng.start(interval_s=60.0)
+            eng.stop()
+        assert self._slo_threads() == []
+        # restartable: a fresh start after the cycles still ticks
+        eng.start(interval_s=60.0)
+        try:
+            assert len(self._slo_threads()) == 1
+        finally:
+            eng.stop()
+
+    def test_concurrent_starts_spawn_exactly_one_thread(self):
+        import threading
+
+        eng = self._engine()
+        barrier = threading.Barrier(8)
+
+        def racer():
+            barrier.wait()
+            eng.start(interval_s=60.0)
+
+        racers = [threading.Thread(target=racer) for _ in range(8)]
+        for t in racers:
+            t.start()
+        for t in racers:
+            t.join()
+        try:
+            assert len(self._slo_threads()) == 1
+        finally:
+            eng.stop()
+        assert self._slo_threads() == []
+
+    def test_metrics_server_stop_joins_ticker(self):
+        from k8s_llm_scheduler_tpu.observability.metrics import (
+            MetricsServer,
+        )
+
+        eng = self._engine()
+        eng.start(interval_s=60.0)
+        server = MetricsServer(
+            lambda: {}, port=0, host="127.0.0.1", slo_engine=eng,
+        )
+        server.start()
+        server.stop()
+        assert self._slo_threads() == []
+        eng.stop()  # the owner's own teardown is still safe
+
+
 class TestCanaryIntegration:
     """Acceptance path: latency regression -> SLO trip -> an OPEN canary
     burn-in rolls back immediately (rollout/canary.py slo_engine input)."""
