@@ -1871,7 +1871,7 @@ def model_throughput(
     return out
 
 
-# ------------------------------------------------------------ spec-vs-plain A/B
+# ------------------------------------------------------------ spec-vs-fused A/B
 def spec_ab(
     model: str,
     draft: str = "tiny",
@@ -1880,27 +1880,41 @@ def spec_ab(
     n_prompts: int = 4,
     reps: int = 2,
     params=None,
+    arm: str = "draft",
+    constrained: bool = True,
 ) -> dict:
-    """Speculative-vs-plain decode A/B on the general paged path.
+    """Speculative-vs-FUSED-decode A/B on the general paged path.
 
-    One engine, one set of weights; the arms alternate A/B/A/B in-process
-    (same cross-run-weather rationale as tools/ab_decode.py). Greedy
-    (temperature 0) so BOTH arms emit identical tokens — the A/B measures
-    pure decode machinery, and the token-identity assert doubles as a
-    correctness check on the real bench model.
+    The baseline arm is the fused while_loop runtime (engine.decode_fused
+    — plain generate() rides it since the async-spec round), NOT the
+    chunked path: the spec arm must beat the fastest thing the engine
+    already has, which is the ROADMAP item 3 bar. One engine, one set of
+    weights; the arms alternate A/B/A/B in-process (same cross-run-weather
+    rationale as tools/ab_decode.py). Greedy (temperature 0) and — by
+    default — grammar-CONSTRAINED with a decision DFA, so both arms emit
+    identical tokens through the serving configuration's masking
+    machinery (dense transition table on both sides); the token-identity
+    probe doubles as a correctness check on the real bench model.
 
-    `draft`: a config name (random-init, widened to the tokenizer vocab) or
-    "self" — draft == target, acceptance 1.0 by construction, which bounds
-    the best case the machinery allows at this K. Random-init non-self
-    drafts measure the OVERHEAD floor (acceptance ~0 without distillation);
-    the production operating point is a train/distill.py checkpoint served
-    via llm.spec_draft_checkpoint.
+    `arm`: "draft" (two-model async pipeline; `draft` names the config,
+    or "self" for the acceptance-1.0 / overlap-1.0 upper bound) or
+    "hidden" (draft-free hidden-transfer heads — random-init here; serve
+    a train/hidden.py checkpoint for real acceptance).
+
+    Beside tok/s the line reports the async pipeline's own books: the
+    ROUND-OVERLAP fraction (rounds whose proposal block was
+    device-resident before the round began), acceptance-weighted tok/s,
+    per-request p50 latency, and the decode preset's RTT extras —
+    dispatch-gating sync boundaries per arm and the per-request RTT cost
+    they imply at the measured tunnel round trip.
     """
     import jax
 
+    from k8s_llm_scheduler_tpu.engine.constrained import build_decision_dfa
     from k8s_llm_scheduler_tpu.engine.engine import InferenceEngine
     from k8s_llm_scheduler_tpu.engine.tokenizer import ByteTokenizer
     from k8s_llm_scheduler_tpu.models.llama import init_params
+    from k8s_llm_scheduler_tpu.observability.profiler import EngineProfiler
     from k8s_llm_scheduler_tpu.spec.decoder import SpeculativeDecoder
     from k8s_llm_scheduler_tpu.spec.draft import build_random_draft
 
@@ -1915,73 +1929,157 @@ def spec_ab(
         prefill_buckets=(128, 256, 512, 1024),
         chunk_steps=16, temperature=0.0,
     )
-    if draft == "self":
-        draft_cfg, draft_params = cfg, params
+    profiler = EngineProfiler(cfg)
+    eng.attach_profiler(profiler)
+    if constrained:
+        eng.set_grammar(build_decision_dfa(
+            tok, [f"node-{chr(97 + i)}{i}" for i in range(8)],
+            max_reason_tokens=max(max_new - 48, 16),
+        ))
+    if arm == "hidden":
+        spec = SpeculativeDecoder(eng, arm="hidden", k=spec_k)
+    elif draft == "self":
+        spec = SpeculativeDecoder(eng, params, cfg, k=spec_k)
     else:
         # the SAME widening/init rule serving uses (spec/draft.py) — the
         # A/B must measure the configuration production would run
         draft_params, draft_cfg = build_random_draft(
             build_cfg(draft), tok.vocab_size, seed=1
         )
-    spec = SpeculativeDecoder(eng, draft_params, draft_cfg, k=spec_k)
+        spec = SpeculativeDecoder(eng, draft_params, draft_cfg, k=spec_k)
     eng.attach_spec(spec)
 
-    prompts = [tok.encode(_synthetic_text(40 + i, 200)) for i in range(n_prompts)]
+    if constrained:
+        prompts = [
+            tok.encode(f"Pick a node for pod-{40 + i}: ")
+            for i in range(n_prompts)
+        ]
+    else:
+        prompts = [
+            tok.encode(_synthetic_text(40 + i, 200)) for i in range(n_prompts)
+        ]
     # compile+warm both arms. Token identity is EXACT at f32 (pinned by
-    # tests/test_spec.py); at bf16 the two decode implementations can flip
-    # a near-tie argmax (random-init top-2 logit gaps are ~1e-2, bf16 KV
-    # rounding differs between the paged-block and chunk-buffer paths), so
-    # the bench REPORTS the match instead of asserting it.
+    # tests/test_spec.py + test_spec_async.py); at bf16 the two decode
+    # implementations can flip a near-tie argmax (random-init top-2 logit
+    # gaps are ~1e-2, bf16 KV rounding differs between the paged-block
+    # and chunk-buffer paths), so the bench REPORTS the match instead of
+    # asserting it.
     warm_spec = eng.generate(prompts[0], max_new, use_spec=True)
-    warm_plain = eng.generate(prompts[0], max_new, use_spec=False)
+    warm_fused = eng.generate(prompts[0], max_new, use_spec=False)
     first_div = next(
         (
             i
             for i, (x, y) in enumerate(
-                zip(warm_spec.token_ids, warm_plain.token_ids)
+                zip(warm_spec.token_ids, warm_fused.token_ids)
             )
             if x != y
         ),
         None,
     )
 
-    # (time, ACTUAL tokens) per rep: random-init greedy can hit EOS early,
-    # and the two arms can stop at different lengths at bf16 — assuming
+    # (time, ACTUAL tokens, gating sync boundaries, per-request
+    # latencies) per rep: random-init greedy can hit EOS early, and the
+    # two arms can stop at different lengths at bf16 — assuming
     # n_prompts*max_new would inflate both rates and skew the ratio.
-    runs = {"plain": [], "spec": []}
+    # Gating boundaries: on the spec arm EVERY sync gates the next
+    # dispatch — the admission-state fetch, each round's verify fetch
+    # (the ahead proposal is already in flight, the NEXT round's verify
+    # is not), and any post-auto-disable step_fused drains (one chunk
+    # per sync) — so the arm's total sync count IS its gated-boundary
+    # count. A fused generate pays ONE gating boundary per request (all
+    # chunks enqueue up front; the per-chunk harvests overlap later
+    # chunks' device execution — the fused_ab argument).
+    runs = {"fused": [], "spec": []}
     for _ in range(reps):
-        for arm, use in (("plain", False), ("spec", True)):
+        for arm_name, use in (("fused", False), ("spec", True)):
+            s0 = eng.stats["syncs"]
+            lat = []
             t0 = time.perf_counter()
             n_toks = 0
             for p in prompts:
-                n_toks += len(eng.generate(p, max_new, use_spec=use).token_ids)
-            runs[arm].append((time.perf_counter() - t0, n_toks))
+                t_req = time.perf_counter()
+                n_toks += len(
+                    eng.generate(p, max_new, use_spec=use).token_ids
+                )
+                lat.append((time.perf_counter() - t_req) * 1000.0)
+            dt = time.perf_counter() - t0
+            syncs = eng.stats["syncs"] - s0
+            boundaries = syncs if arm_name == "spec" else len(prompts)
+            runs[arm_name].append((dt, n_toks, syncs, boundaries, lat))
     tps = {
-        arm: round(max(n / dt for dt, n in reps_), 1)
-        for arm, reps_ in runs.items()
+        a: round(max(n / dt for dt, n, _, _, _ in rs), 1)
+        for a, rs in runs.items()
     }
+    p50 = {
+        a: round(
+            statistics.median([ms for r in rs for ms in r[4]]), 2
+        )
+        for a, rs in runs.items()
+    }
+    syncs_per_req = {
+        a: round(min(s for _, _, s, _, _ in rs) / n_prompts, 2)
+        for a, rs in runs.items()
+    }
+    gating = {
+        a: round(min(b for _, _, _, b, _ in rs) / n_prompts, 2)
+        for a, rs in runs.items()
+    }
+    rtt = measure_dispatch_rtt_ms()
     snap = spec.stats.snapshot()
+    psnap = profiler.snapshot().get("spec") or {}
     return {
         "metric": "spec_decode_ab",
-        "value": round(tps["spec"] / tps["plain"], 3),
+        "value": round(tps["spec"] / tps["fused"], 3),
         "unit": "speedup_x",
         "extra": {
             "model": model,
             "weights": "random-init",
-            "draft": draft,
+            "arm": arm,
+            "draft": draft if arm == "draft" else None,
             "spec_k": spec_k,
             "max_new": max_new,
+            "constrained": constrained,
+            "baseline": "fused_decode",
             "decode_tok_per_s": tps,
+            "raw_p50_ms": p50,
             "acceptance_rate": round(snap["acceptance_rate"], 4),
+            "acceptance_weighted_tok_per_s": round(
+                tps["spec"] * snap["acceptance_rate"], 1
+            ),
             "tokens_per_round": round(snap["tokens_per_round"], 3),
+            # the async pipeline's headline: fraction of rounds whose
+            # proposal block was device-resident before the round began
+            # (draft ran in the shadow of the previous verify sync)
+            "round_overlap_fraction": round(snap["overlap_fraction"], 4),
+            "spec_segment_frac": psnap.get("segment_frac"),
             "disables": snap["disables"],
             "fallback_requests": snap["fallback_requests"],
+            # the decode preset's RTT extras, per REQUEST: only
+            # dispatch-gating sync boundaries pay a serialized tunnel
+            # round trip (the ahead proposal and the fused chunk queue
+            # are both already enqueued when their round's sync lands)
+            "syncs_per_request": syncs_per_req,
+            "gating_syncs_per_request": gating,
+            # < 1 means the spec arm pays MORE gated round trips per
+            # request than the fused baseline (one per round vs one per
+            # request) — the tunnel-RTT tax the acceptance win must beat;
+            # the overlap fraction above is what keeps the DRAFT's
+            # latency off those gated paths entirely
+            "rtt_boundary_reduction_x": round(
+                gating["fused"] / max(gating["spec"], 1e-9), 2
+            ),
+            "dispatch_rtt_ms": rtt,
+            "rtt_per_request_ms": {
+                a: round(g * rtt, 1) for a, g in gating.items()
+            },
             # None = greedy arms agreed token-for-token; an int is the
             # first bf16 near-tie flip (see comment at the warmup)
             "greedy_first_divergence": first_div,
             "note": (
-                "random-init drafts bound overhead (acceptance ~0 unless "
-                "draft='self'); serve a distilled checkpoint for real wins"
+                "random-init drafts/heads bound overhead (acceptance ~0 "
+                "unless draft='self'); serve a distilled draft "
+                "(train/distill.py) or trained hidden-transfer head "
+                "(train/hidden.py) for real wins"
             ),
         },
     }
@@ -2434,7 +2532,17 @@ def main() -> None:
     parser.add_argument(
         "--draft-model", default="tiny",
         help="draft config for --preset spec-ab ('self' = draft == target, "
-             "the acceptance-1.0 upper bound)",
+             "the acceptance-1.0 / overlap-1.0 upper bound)",
+    )
+    parser.add_argument(
+        "--spec-arm", choices=("draft", "hidden"), default="draft",
+        help="--preset spec-ab arm: two-model async draft pipeline, or "
+             "the draft-free hidden-transfer head (spec/hidden.py)",
+    )
+    parser.add_argument(
+        "--spec-unconstrained", action="store_true",
+        help="--preset spec-ab: drop the decision grammar (default is "
+             "grammar-constrained greedy — the serving configuration)",
     )
     parser.add_argument(
         "--peak-tflops", type=float, default=None,
@@ -2503,6 +2611,8 @@ def main() -> None:
             args.model or DEFAULTS["model"],
             draft=args.draft_model,
             spec_k=args.spec_k,
+            arm=args.spec_arm,
+            constrained=not args.spec_unconstrained,
         )
         _emit(result)
         return

@@ -60,6 +60,7 @@ def _backend_kwargs(cfg: Config, **overrides) -> dict:
         group_switch_after_s=float(cfg.get("llm.group_switch_after_s")),
         compile_cache_dir=cfg.get("llm.compile_cache_dir"),
         spec_enabled=bool(cfg.get("llm.spec_enabled", False)),
+        spec_arm=cfg.get("llm.spec_arm", "draft"),
         spec_draft_model=cfg.get("llm.spec_draft_model", "tiny"),
         spec_draft_checkpoint=cfg.get("llm.spec_draft_checkpoint", None),
         spec_k=int(cfg.get("llm.spec_k", 4)),

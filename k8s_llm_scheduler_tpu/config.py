@@ -95,14 +95,19 @@ DEFAULTS: dict[str, Any] = {
         # paged path only — decision waves are already grammar-accelerated
         # and never speculate) ---
         "spec_enabled": False,
+        # "draft" (two-model async pipeline) or "hidden" (draft-free
+        # hidden-transfer heads over the target's own hidden states —
+        # spec/hidden.py; no second model resident)
+        "spec_arm": "draft",
         # draft model: a config name (models/configs.py) random-initialized,
         # or serve the distilled checkpoint via spec_draft_checkpoint
-        # (train/distill.py output — the intended production draft)
+        # (train/distill.py output — the intended production draft; for
+        # spec_arm=hidden it names a train/hidden.py head checkpoint)
         "spec_draft_model": "tiny",
         "spec_draft_checkpoint": None,
         "spec_k": 4,  # draft tokens proposed per round
         # acceptance-rate EWMA floor: below it speculation auto-disables
-        # for the request and decode falls back to the plain chunked path
+        # for the request and the slot hands back to the FUSED decode path
         "spec_disable_threshold": 0.3,
         # persistent XLA compile cache dir ("auto" = ~/.cache/...; null
         # disables) — utils/compile_cache.py
@@ -111,7 +116,8 @@ DEFAULTS: dict[str, Any] = {
         # decode loop as ONE lax.while_loop program with early exit —
         # host syncs once per harvest chunk, never per token. Falls back
         # to the sparse chunked path by itself when a grammar can't
-        # export a dense table (size cap) or a spec round is open. ---
+        # export a dense table (size cap); open speculative rounds
+        # COEXIST with it (each spec stream owns only its slot). ---
         "fused_decode": True,
         # top-k sampling cut applied INSIDE the fused loop (0 = full
         # distribution; greedy decode is unaffected by construction)
@@ -382,6 +388,7 @@ ENV_OVERRIDES: dict[str, str] = {
     "LLM_MAX_TOKENS": "llm.max_tokens",
     "LLM_TEMPERATURE": "llm.temperature",
     "SPEC_ENABLED": "llm.spec_enabled",
+    "SPEC_ARM": "llm.spec_arm",
     "FUSED_DECODE": "llm.fused_decode",
     "LLM_TOP_K": "llm.top_k",
     "SPEC_K": "llm.spec_k",

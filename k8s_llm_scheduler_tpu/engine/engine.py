@@ -430,6 +430,13 @@ class _Request:
     generated: list[int] = dataclasses.field(default_factory=list)
     first_pending: bool = True  # first token not yet harvested from device
     done: bool = False
+    # Driven by an EXTERNAL decoder (spec/decoder.py): the slot is
+    # deactivated in the engine's decode batch and every harvest path
+    # skips it — fused chunks and open speculative rounds share one
+    # dispatch pipeline without an engine-wide hold. The external owner
+    # finishes the request through release_slot (or hands it back by
+    # clearing this flag and re-arming the slot — the auto-disable path).
+    external: bool = False
     submitted_at: float = dataclasses.field(default_factory=time.perf_counter)
 
 
@@ -626,7 +633,8 @@ class InferenceEngine:
         # per-slot stop detection — the host syncs once per harvest chunk.
         # step_fused/decode_fused route here and FALL BACK to the sparse
         # chunked path whenever the grammar can't export a dense table
-        # (size cap) or a spec round holds the slot state (fused_hold).
+        # (size cap). Open speculative rounds do NOT gate it: a spec
+        # stream deactivates only its own slot (_Request.external).
         self.fused_decode = bool(fused_decode)
         self.top_k = int(top_k)
         from k8s_llm_scheduler_tpu.engine.fused import (
@@ -655,11 +663,6 @@ class InferenceEngine:
         self._fused_next_d: jax.Array | None = None
         self._fused_unsupported = False
         self._dfa: DecisionDFA | None = None
-        # Explicit non-fused interop: a speculative round (spec/decoder.py)
-        # diverges slot device state from the host mirrors mid-round, so
-        # fused chunks must not run while one is open. The spec decoder
-        # increments/decrements this around each request.
-        self.fused_hold = 0
         self._wave = jax.jit(
             functools.partial(
                 _wave_impl,
@@ -1841,6 +1844,11 @@ class InferenceEngine:
         finished: list[Finished] = []
         pad = self.tokenizer.pad_id
         for slot, req in list(self._by_slot.items()):
+            if req.external:
+                # Driven by an external decoder (an open speculative
+                # stream): its slot is inactive in the decode batch and
+                # its completion/teardown belongs to that owner.
+                continue
             if req.first_pending:
                 req.generated.append(int(first_np[slot]))
                 req.first_pending = False
@@ -1866,18 +1874,14 @@ class InferenceEngine:
         return finished
 
     # ---------------------------------------------------------- fused decode
-    def _fused_ready(self) -> bool:
-        """Whether the fused runtime can serve the CURRENT grammar/slot
-        state. False routes callers to the sparse chunked path: grammar
-        too large for a dense table (size cap — a 128k-vocab production
-        grammar), fused decode disabled, or a speculative round holding
-        the slot state (spec/decoder.py explicit non-fused interop)."""
-        if not self.fused_decode or self.fused_hold:
-            return False
-        if not self._constrained:
-            return True
-        if self._fused_unsupported:
-            return False
+    def dense_grammar(self) -> jax.Array | None:
+        """The active grammar's dense [states, vocab] transition table on
+        device, or None (no grammar / past the byte cap). Built lazily on
+        first use and shared by every dense-table consumer — the fused
+        while_loop AND the speculative verifier's greedy grammar path
+        (spec/verify.py) gather from this one array."""
+        if not self._constrained or self._fused_unsupported:
+            return None
         if self._fused_next_d is None:
             from k8s_llm_scheduler_tpu.engine.fused import dense_tables
 
@@ -1895,9 +1899,23 @@ class InferenceEngine:
                     "bytes); decode stays on the sparse chunked path",
                     self.fused_table_bytes,
                 )
-                return False
+                return None
             self._fused_next_d = jnp.asarray(tables.next_state)
-        return True
+        return self._fused_next_d
+
+    def _fused_ready(self) -> bool:
+        """Whether the fused runtime can serve the CURRENT grammar state.
+        False routes callers to the sparse chunked path: grammar too
+        large for a dense table (size cap — a 128k-vocab production
+        grammar) or fused decode disabled. Open speculative rounds no
+        longer gate this: a spec stream deactivates only its own slot
+        (_Request.external), so fused chunks and spec rounds pipeline
+        together."""
+        if not self.fused_decode:
+            return False
+        if not self._constrained:
+            return True
+        return self.dense_grammar() is not None
 
     def _fused_chunk_dispatch(self, prefix: _PrefixKV):
         """Dispatch ONE fused decode chunk (no host sync); returns the
@@ -2017,9 +2035,20 @@ class InferenceEngine:
         if not self._fused_ready():
             self.stats["fused_fallbacks"] += 1
             out: list[Finished] = []
-            while self._by_slot:
+            # external (spec-driven) requests never finish through step()
+            # — draining on them would spin forever
+            while any(not r.external for r in self._by_slot.values()):
                 out.extend(self.step())
             return out
+        with spans.span("decode_chunk", fused=True, drain=True) as sp:
+            before = self.stats["decode_tokens"]
+            finished = self._decode_fused_inner()
+            if sp is not None:
+                sp.attrs["finished"] = len(finished)
+                sp.attrs["tokens"] = self.stats["decode_tokens"] - before
+        return finished
+
+    def _decode_fused_inner(self) -> list[Finished]:
         prof = self.profiler
         t0 = time.perf_counter() if prof is not None else 0.0
         ctx = self._mean_decode_ctx() if prof is not None else 0.0
@@ -2116,9 +2145,16 @@ class InferenceEngine:
           callers must drain first, which run_quiesced guarantees for the
           wave path);
         - grammar tables, decode state, and the paged KV survive: none of
-          them depend on weight values.
+          them depend on weight values;
+        - any OPEN SPECULATIVE stream rolls back first (spec/decoder.py
+          on_swap): its un-verified block's pages truncate via
+          PagedKVCache.truncate and device-resident proposal blocks drop,
+          so nothing computed under the old weights can seed a post-swap
+          round.
         The decision cache above the engine needs its own epoch bump —
         rollout/hotswap.py owns that (core/cache.bump_generation)."""
+        if self.spec is not None:
+            self.spec.on_swap()
         old = self.params
         self.params = params
         self._prefix_cache.clear()
@@ -2146,10 +2182,14 @@ class InferenceEngine:
     def attach_spec(self, decoder) -> None:
         """Attach a speculative decoder (spec/decoder.py SpeculativeDecoder).
 
-        generate() then routes single-request completions through
-        draft-propose/target-verify; the plain paged path remains the
+        generate() then routes single-request completions through the
+        async propose/verify pipeline; the fused decode path remains the
         fallback (unsupported prompts, auto-disable) and the multi-slot
-        add_requests/step surface is unchanged."""
+        add_requests/step surface is unchanged. An open speculative
+        stream occupies only its own slot (_Request.external) — fused
+        chunks for other slots keep dispatching — and swap_params calls
+        decoder.on_swap() so open blocks roll back before new weights
+        install."""
         self.spec = decoder
 
     def attach_profiler(self, profiler) -> None:
@@ -2180,8 +2220,12 @@ class InferenceEngine:
         ):
             return self.spec.generate(prompt_ids, max_new_tokens)
         req_id = self.add_request(prompt_ids, max_new_tokens)
+        # Plain decode rides the FUSED runtime (decode_fused: all chunks
+        # enqueued back-to-back, one gating sync) — this is the baseline
+        # the spec A/B is judged against; falls back internally when the
+        # grammar can't fuse.
         while True:
-            for fin in self.step():
+            for fin in self.decode_fused():
                 if fin.req_id == req_id:
                     return fin
 

@@ -1143,20 +1143,53 @@ class LocalLLMBackend:
 def _attach_spec(
     engine: InferenceEngine,
     *,
+    arm: str,
     draft_model: str,
     draft_checkpoint: str | None,
     k: int,
     disable_threshold: float,
     rng_seed: int,
 ) -> None:
-    """Build the draft model and attach a SpeculativeDecoder to the engine.
+    """Build the speculative arm and attach a SpeculativeDecoder.
 
-    The draft serves the SAME tokenizer as the target (a distilled draft —
-    train/distill.py — trains on exactly that vocab). A random-init draft
-    config narrower than the tokenizer is widened so every legal token is
-    proposable; a checkpoint must already match (SpeculativeDecoder
-    validates)."""
+    `arm="draft"`: a second (small) model — the draft serves the SAME
+    tokenizer as the target (a distilled draft — train/distill.py —
+    trains on exactly that vocab); a random-init draft config narrower
+    than the tokenizer is widened so every legal token is proposable,
+    a checkpoint must already match (SpeculativeDecoder validates).
+    `arm="hidden"`: the draft-free hidden-transfer head (spec/hidden.py)
+    — `draft_checkpoint` then names a train/hidden.py head checkpoint
+    (random-init without one; correctness never depends on training,
+    only acceptance does)."""
     from k8s_llm_scheduler_tpu.spec import SpeculativeDecoder
+
+    if arm not in ("draft", "hidden"):
+        # A typo must not silently serve the wrong pipeline (the draft
+        # branch would otherwise swallow any unknown value).
+        raise ValueError(f"unknown llm.spec_arm {arm!r}")
+    if arm == "hidden":
+        hidden_head = None
+        if draft_checkpoint:
+            from k8s_llm_scheduler_tpu.train.hidden import (
+                restore_hidden_transfer,
+            )
+
+            hidden_head = restore_hidden_transfer(
+                Path(draft_checkpoint), engine.cfg, k
+            )
+        engine.attach_spec(
+            SpeculativeDecoder(
+                engine, arm="hidden", hidden_head=hidden_head,
+                hidden_seed=rng_seed + 1,
+                k=k, disable_threshold=disable_threshold,
+            )
+        )
+        logger.info(
+            "speculative decoding attached: arm=hidden k=%d disable<%.2f%s",
+            k, disable_threshold,
+            " (checkpoint)" if draft_checkpoint else " (random-init)",
+        )
+        return
     from k8s_llm_scheduler_tpu.spec.draft import build_random_draft
 
     draft_cfg = get_config(draft_model)
@@ -1213,6 +1246,7 @@ def build_local_backend(
     answer_style: str = "direct",
     max_reason_tokens: int = 320,
     spec_enabled: bool = False,
+    spec_arm: str = "draft",
     spec_draft_model: str = "tiny",
     spec_draft_checkpoint: str | None = None,
     spec_k: int = 4,
@@ -1357,6 +1391,7 @@ def build_local_backend(
         else:
             _attach_spec(
                 engine,
+                arm=spec_arm,
                 draft_model=spec_draft_model,
                 draft_checkpoint=spec_draft_checkpoint,
                 k=spec_k,

@@ -218,7 +218,11 @@ def forward_prefill(
     attn_impl: Any = None,  # (q,k,v,seq_lens)->out; default causal full attn
     return_logits: bool = True,  # static; False skips the LM head (KV-only)
     remat: bool = False,  # static; checkpoint each layer (training path)
-) -> tuple[jax.Array | None, jax.Array, jax.Array]:
+    return_hidden: bool = False,  # static; also return the final-layer
+    # pre-norm residual stream [B, S, D] (hidden-transfer head training)
+) -> tuple[jax.Array | None, jax.Array, jax.Array] | tuple[
+    jax.Array | None, jax.Array, jax.Array, jax.Array
+]:
     """Full-prompt forward pass.
 
     Returns (logits [B,S,V] f32, k_all [L,B,S,n_kv,hd], v_all [...]) — the
@@ -253,6 +257,8 @@ def forward_prefill(
         body = jax.checkpoint(body)
     x, (k_all, v_all) = jax.lax.scan(body, x, params["layers"])
     logits = _logits(params, cfg, x) if return_logits else None
+    if return_hidden:
+        return logits, k_all, v_all, x
     return logits, k_all, v_all
 
 
@@ -913,6 +919,52 @@ def forward_decode(
         body, (x, k_cache, v_cache), (params["layers"], layer_ids)
     )
     return _logits(params, cfg, x), k_cache, v_cache
+
+
+# ------------------------------------------------ hidden-transfer head
+def init_hidden_transfer(rng: jax.Array, cfg: LlamaConfig, k: int) -> Params:
+    """Random-init a hidden-transfer multi-token prediction head
+    (*Hidden Transfer*, PAPERS.md): `k` per-offset transfer matrices
+    [k, D, D] applied RESIDUALLY to the target's final-layer hidden state
+    — x_h = x + x @ T_h — then pushed through the model's OWN final norm
+    and LM head (no second vocab projection to train or store).
+
+    Init is small (0.02/sqrt(D)) so x_h ~= x at step 0: the untrained
+    head predicts roughly the current position's distribution for every
+    future offset — a sane warm start for train/hidden.py, and never a
+    correctness hazard (the spec verifier accepts only target-consistent
+    tokens regardless of what the head proposes).
+    """
+    if k < 1:
+        raise ValueError(f"hidden-transfer k must be >= 1, got {k}")
+    D = cfg.d_model
+    scale = 0.02 * D**-0.5
+    t = (
+        jax.random.normal(rng, (k, D, D), dtype=jnp.float32) * scale
+    ).astype(cfg.dtype)
+    return {"transfer": t}
+
+
+def hidden_transfer_hidden(ht: Params, x: jax.Array, h: int) -> jax.Array:
+    """Pseudo hidden state for future offset `h` (0-based head index):
+    x [..., D] -> x + x @ T_h. The caller runs _logits on the result."""
+    return x + _dense(x, ht["transfer"][h], "...d,de->...e")
+
+
+def hidden_transfer_logits(
+    params: Params, cfg: LlamaConfig, ht: Params, x: jax.Array
+) -> jax.Array:
+    """All heads' logits from one hidden state: x [..., D] ->
+    [..., k, V]. Training (train/hidden.py) and the fused verify+propose
+    program (spec/hidden.py) share this exact math."""
+    xs = jnp.stack(
+        [
+            hidden_transfer_hidden(ht, x, h)
+            for h in range(ht["transfer"].shape[0])
+        ],
+        axis=-2,
+    )  # [..., k, D]
+    return _logits(params, cfg, xs)
 
 
 def param_count(params: Params) -> int:

@@ -50,7 +50,10 @@ its host wall (admission_pack / chunk_prefill / decode_piggyback /
 unattributed, sum == wall), and a `prefill_tokens_per_decision` gauge —
 windowed (wave suffix + packed + prefix tokens ACTUALLY prefilled) per
 decision — measures the delta-encoding claim directly: prefill cost
-scaling with what changed, not cluster size.
+scaling with what changed, not cluster size. The speculative pipeline
+(spec/decoder.py) books per-request SPEC_SEGMENTS (draft / verify /
+rollback / unattributed, sum == wall) plus the measured round-overlap
+fraction — the draft-runs-in-the-shadow-of-the-verify claim, measured.
 
 Cost discipline: all fencing is perf_counter reads on the PER-WAVE path
 (waves run at ~10-60/s, never per token); with no profiler attached the
@@ -101,6 +104,23 @@ FUSED_SEGMENTS = (
     "dispatch",
     "host_sync",
     "harvest",
+    "unattributed",
+)
+
+# Speculative-decoding segments (spec/decoder.py — the async
+# propose/verify pipeline): telescoping over each spec REQUEST's host
+# wall with the same sum==wall identity. draft covers propose dispatches
+# (draft prefill + fresh + ahead — ~0 for the hidden arm, whose
+# proposals ride inside the verify program), verify the verify dispatch
+# plus the round's single device_get, rollback the paged-KV truncate +
+# host emit bookkeeping. Beside the segments, the books carry the round
+# OVERLAP fraction — rounds whose proposal block was device-resident
+# before the round began, i.e. the draft work hidden behind the previous
+# verify sync — the async pipeline's headline.
+SPEC_SEGMENTS = (
+    "draft",
+    "verify",
+    "rollback",
     "unattributed",
 )
 
@@ -219,6 +239,17 @@ class EngineProfiler:
             maxlen=self.window
         )  # (tokens prefilled, prefix length)
         self.packs_profiled = 0
+        # Speculative-pipeline books (spec/decoder.py): per-request
+        # records with telescoping SPEC_SEGMENTS plus windowed round /
+        # overlap counts — the draft/verify overlap fraction is derived
+        # from exactly these.
+        self._spec_ring: deque[dict] = deque(maxlen=self.window)
+        self._spec_totals = {name: 0.0 for name in SPEC_SEGMENTS}
+        self._spec_totals["wall"] = 0.0
+        self._spec_rounds = 0
+        self._spec_overlapped = 0
+        self._spec_tokens = 0
+        self.spec_profiled = 0
         self.closed = False
 
     # ------------------------------------------------------------- fences
@@ -499,6 +530,72 @@ class EngineProfiler:
             self._fused_flops += flops
             self._fused_tokens += int(tokens)
 
+    def on_spec(
+        self,
+        *,
+        wall_s: float,
+        draft_s: float,
+        verify_s: float,
+        rollback_s: float,
+        rounds: int,
+        overlapped_rounds: int,
+        tokens: int,
+        arm: str = "draft",
+        disabled: bool = False,
+    ) -> None:
+        """One speculative request closed (spec/decoder.py — at
+        completion, or at the auto-disable hand-off, in which case the
+        record covers only the speculative phase). The three measured
+        segments partition the wall by construction (consecutive
+        perf_counter fences accumulated over the request's rounds), so
+        sum(SPEC_SEGMENTS) == wall holds exactly and the acceptance test
+        pins it. `overlapped_rounds` counts rounds whose proposal block
+        was device-resident when the round began — the draft stream
+        running in the shadow of the verify."""
+        wall = max(float(wall_s), 0.0)
+        seg = {
+            "draft": max(float(draft_s), 0.0),
+            "verify": max(float(verify_s), 0.0),
+            "rollback": max(float(rollback_s), 0.0),
+        }
+        seg["unattributed"] = max(wall - sum(seg.values()), 0.0)
+        record = {
+            "request": 0,  # stamped under the lock below
+            "arm": str(arm),
+            "rounds": int(rounds),
+            "overlapped_rounds": int(overlapped_rounds),
+            "tokens": int(tokens),
+            "disabled": bool(disabled),
+            "wall_ms": wall * 1000.0,
+            "segments_ms": {k: v * 1000.0 for k, v in seg.items()},
+        }
+        with self._lock:
+            self.spec_profiled += 1
+            record["request"] = self.spec_profiled
+            if len(self._spec_ring) == self._spec_ring.maxlen:
+                old = self._spec_ring[0]
+                for name in SPEC_SEGMENTS:
+                    self._spec_totals[name] = max(
+                        self._spec_totals[name]
+                        - old["segments_ms"].get(name, 0.0) / 1000.0,
+                        0.0,
+                    )
+                self._spec_totals["wall"] = max(
+                    self._spec_totals["wall"] - old["wall_ms"] / 1000.0, 0.0
+                )
+                self._spec_rounds = max(self._spec_rounds - old["rounds"], 0)
+                self._spec_overlapped = max(
+                    self._spec_overlapped - old["overlapped_rounds"], 0
+                )
+                self._spec_tokens = max(self._spec_tokens - old["tokens"], 0)
+            self._spec_ring.append(record)
+            for name in SPEC_SEGMENTS:
+                self._spec_totals[name] += seg.get(name, 0.0)
+            self._spec_totals["wall"] += wall
+            self._spec_rounds += int(rounds)
+            self._spec_overlapped += int(overlapped_rounds)
+            self._spec_tokens += int(tokens)
+
     def _prefill_tokens_per_decision_locked(self) -> float | None:
         """Windowed prefill tokens per decision: (wave suffix tokens +
         packed tokens + prefix tokens actually prefilled) / decisions.
@@ -595,6 +692,12 @@ class EngineProfiler:
             fused_flops = self._fused_flops
             fused_tokens = self._fused_tokens
             fused = self.fused_profiled
+            spec_ring = list(self._spec_ring)
+            spec_totals = dict(self._spec_totals)
+            spec_rounds = self._spec_rounds
+            spec_overlapped = self._spec_overlapped
+            spec_tokens = self._spec_tokens
+            spec = self.spec_profiled
             tpd = self._prefill_tokens_per_decision_locked()
         wall = totals["wall"]
         n_warm = sum(1 for r in ring if not r["cold_compile"])
@@ -686,6 +789,36 @@ class EngineProfiler:
                         fused_flops / fused_wall / self.peak_flops, 5
                     )
             out["fused"] = fused_out
+        if spec:
+            spec_wall = spec_totals["wall"]
+            spec_out: dict[str, Any] = {
+                "requests_profiled": spec,
+                "tokens": spec_tokens,
+                "rounds": spec_rounds,
+                "overlapped_rounds": spec_overlapped,
+                "overlap_fraction": (
+                    round(spec_overlapped / spec_rounds, 4)
+                    if spec_rounds > 0
+                    else 0.0
+                ),
+                "wall_ms_total": round(spec_wall * 1000.0, 3),
+                "segments_ms_total": {
+                    name: round(spec_totals[name] * 1000.0, 3)
+                    for name in SPEC_SEGMENTS
+                },
+                "segment_frac": {
+                    name: (
+                        round(spec_totals[name] / spec_wall, 4)
+                        if spec_wall > 0
+                        else 0.0
+                    )
+                    for name in SPEC_SEGMENTS
+                },
+                "ring": spec_ring,
+            }
+            if spec_wall > 0:
+                spec_out["tokens_per_s"] = round(spec_tokens / spec_wall, 1)
+            out["spec"] = spec_out
         if tpd is not None:
             out["prefill_tokens_per_decision"] = round(tpd, 2)
         return out
@@ -703,6 +836,10 @@ class EngineProfiler:
             fused_totals = dict(self._fused_totals)
             fused_flops = self._fused_flops
             fused = self.fused_profiled
+            spec_totals = dict(self._spec_totals)
+            spec_rounds = self._spec_rounds
+            spec_overlapped = self._spec_overlapped
+            spec = self.spec_profiled
             tpd = self._prefill_tokens_per_decision_locked()
         wall = totals["wall"]
         out: dict[str, float] = {"waves_profiled": float(waves)}
@@ -736,6 +873,20 @@ class EngineProfiler:
                 out["fused_mfu_decode"] = round(
                     fused_flops / fused_wall / self.peak_flops, 5
                 )
+        if spec:
+            out["spec_profiled"] = float(spec)
+            spec_wall = spec_totals["wall"]
+            for name in SPEC_SEGMENTS:
+                out[f"spec_{name}_frac"] = (
+                    round(spec_totals[name] / spec_wall, 4)
+                    if spec_wall > 0
+                    else 0.0
+                )
+            out["spec_overlap_frac"] = (
+                round(spec_overlapped / spec_rounds, 4)
+                if spec_rounds > 0
+                else 0.0
+            )
         if tpd is not None:
             out["prefill_tokens_per_decision"] = round(tpd, 2)
         out["device_compute_frac"] = (

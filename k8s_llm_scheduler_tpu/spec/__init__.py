@@ -1,24 +1,36 @@
-"""Speculative decoding subsystem (SwiftSpec-shaped; PAPERS.md 2506.11309).
+"""Speculative decoding: asynchronous draft-ahead / verify-behind pipeline.
 
-A small distilled DRAFT model (train/distill.py produces exactly this) runs
-K tokens ahead of the big TARGET on the engine's general paged-decode path;
-the target scores all K proposals in ONE forward and accepts the longest
-target-consistent prefix (greedy) or rejection-samples so the emitted
-distribution is exactly the target's (sampling). Rejected draft tokens
-unwind through the paged-KV rollback op (engine/kv_cache.py truncate).
-Grammar composition is built in: proposals and verification both sample
-through the engine's SparseDFATables, so speculation can never emit a token
-the constrained decoder would forbid.
+(*SwiftSpec* + *Hidden Transfer*, PAPERS.md.) A DRAFT arm (a small
+distilled model — train/distill.py produces exactly this) or a draft-free
+HIDDEN-TRANSFER arm (transfer heads over the target's own hidden states —
+train/hidden.py) proposes K tokens ahead of the big TARGET on the
+engine's general paged-decode path; the target scores all K proposals in
+ONE forward with on-device acceptance (greedy longest-consistent-prefix,
+or rejection sampling that preserves the target distribution exactly).
+
+The pipeline is ASYNCHRONOUS: each round enqueues target-verify and the
+draft's ahead-proposal for the NEXT block back-to-back and syncs once —
+on a matched guess the next round's block is already device-resident, so
+the draft runs in the shadow of the verify (the hidden arm goes further:
+its proposals are computed INSIDE the verify program). Speculative
+streams COEXIST with the fused decode runtime — an open round deactivates
+only its own slot, never the engine (`fused_hold` is gone). Rejected
+tokens unwind through the paged-KV rollback op (engine/kv_cache.truncate);
+grammar composition is built in (sparse K-space tables, or the fused
+runtime's dense transition table for greedy verification), so speculation
+can never emit a token the constrained decoder would forbid.
 
 Modules:
-- draft.py   — DraftRunner: dense-KV draft state + the fused K-step
-               propose program (one dispatch proposes all K tokens).
+- draft.py   — DraftRunner: dense-KV draft state + the fused K+1-step
+               propose program (one dispatch proposes the block AND the
+               bonus-token guess the ahead pipeline anchors on).
 - verify.py  — the one-forward target scoring program over the paged cache
-               plus on-device accept logic (greedy longest-prefix /
-               distribution-preserving rejection sampling).
-- decoder.py — SpeculativeDecoder: orchestration, per-request acceptance
-               EWMA with auto-disable, fallback to plain chunked decode,
-               metrics/trace export.
+               plus on-device accept logic, shared by both arms.
+- hidden.py  — the draft-free arm's fused verify+propose program
+               (transfer-head proposal chain grown on device).
+- decoder.py — SpeculativeDecoder: the round state machine, per-request
+               acceptance EWMA with auto-disable onto the FUSED decode
+               path, swap rollback hook, SPEC_SEGMENTS profiler fencing.
 """
 
 from k8s_llm_scheduler_tpu.spec.decoder import SpeculativeDecoder

@@ -131,19 +131,25 @@ def _propose_impl(
     Step i processes the (i-1)-th proposal (step 0 processes `tok`), writes
     its K/V into the dense buffer, samples proposal i from the grammar- (or
     pad-)masked logits, and advances the DFA state. Returns
-    (tokens [K], states [K] — state AFTER each proposal, choice_idx [K] —
-    the sampled index into the step's masked distribution, step_logits
+    (tokens [K+1], states [K+1] — state AFTER each proposal, choice_idx [K]
+    — the sampled index into the step's masked distribution, step_logits
     [K, X] — that masked distribution's logits (X = grammar K-width when
     constrained, vocab when not; the verifier's rejection sampler needs the
     draft's actual proposal distribution), k_buf, v_buf).
 
     The scan runs K+1 steps — the extra step processes the K-th proposal
-    itself (its sampled successor is discarded) so the buffer holds valid
-    KV through position pos+K. Without it, a fully-accepted round (a == K
-    plus the bonus token) leaves the K-th proposal's buffer slot stale, and
-    the next round's draft attends garbage from then on (measured: self-
-    draft acceptance collapsed from 1.0 to ~0.53). One small-model step per
-    round is the price of a corruption-proof invariant.
+    itself so the buffer holds valid KV through position pos+K. Without
+    it, a fully-accepted round (a == K plus the bonus token) leaves the
+    K-th proposal's buffer slot stale, and the next round's draft attends
+    garbage from then on (measured: self-draft acceptance collapsed from
+    1.0 to ~0.53). The extra step's sample is no longer discarded: it is
+    the draft's GUESS at the round's bonus token — tokens[K] / states[K] —
+    and the async pipeline (spec/decoder.py) anchors the AHEAD proposal
+    for round n+1 on it while round n's verify is still in flight. When
+    the verify's bonus token matches the guess, the pre-proposed block is
+    exactly what a fresh propose would produce (greedy: bit-identical;
+    sampling: a valid draw from the same proposal distribution), so the
+    next round starts with zero draft latency on the critical path.
     """
 
     def step(carry, _):
@@ -177,7 +183,7 @@ def _propose_impl(
             step, (k_buf, v_buf, tok, pos, state, rng), None, length=K + 1
         )
     )
-    return toks[:K], states[:K], idxs[:K], step_logits[:K], k_buf, v_buf
+    return toks, states, idxs[:K], step_logits[:K], k_buf, v_buf
 
 
 def _prefill_impl(params, cfg, tokens, n, k_buf, v_buf):
@@ -233,6 +239,13 @@ class DraftRunner:
             donate_argnums=(2, 3),
         )
 
+    @property
+    def capacity(self) -> int:
+        """Current dense-buffer capacity in tokens (0 before begin()) —
+        the async pipeline checks AHEAD proposals against it instead of
+        letting propose() raise mid-round."""
+        return self._cap
+
     def begin(self, token_ids: list[int], pad_id: int, extra: int) -> None:
         """Start a request: allocate the dense buffer sized for
         `len(token_ids) + extra` tokens (bucketed) and prefill the prompt.
@@ -255,13 +268,17 @@ class DraftRunner:
         )
 
     def propose(
-        self, tok: int, pos: int, state: int,
+        self, tok, pos: int, state,
         sp_tokens, sp_next, pad_id: int,
         rng, temperature: float, k: int, constrained: bool,
     ):
         """Fused K-token proposal from (tok @ pos, DFA state). Returns the
         device arrays from _propose_impl (no host sync — the verifier
-        consumes them directly)."""
+        consumes them directly). `tok`/`state` may be host ints OR device
+        scalars: the async pipeline's AHEAD propose anchors on the
+        previous proposal's device-resident guess (toks[K]/states[K])
+        without ever fetching it. `pos` stays a host int — the overflow
+        check below is host bookkeeping."""
         if self._k is None:
             raise RuntimeError("DraftRunner.begin() not called")
         if pos + k + 1 > self._cap:  # K+1 steps write pos..pos+K

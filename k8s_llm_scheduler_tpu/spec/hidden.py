@@ -1,0 +1,151 @@
+"""Hidden-transfer draft-free speculation: propose INSIDE the verify.
+
+*Hidden Transfer* (PAPERS.md) replaces the second model entirely: the
+target's own final-layer hidden state at the acceptance point is linearly
+"transferred" to pseudo hidden states for the next K future positions
+(models/llama.init_hidden_transfer — per-offset residual matrices trained
+by train/hidden.py), and the model's own LM head turns each into a
+proposal distribution. The consequence for the async pipeline
+(spec/decoder.py) is structural: proposing costs ZERO extra dispatches —
+`_hidden_verify_impl` is ONE device program per round that
+
+1. scores the current block exactly as the draft arm's verify does
+   (spec/verify._forward_verify_block — same cascade, same KV scatter,
+   same on-device acceptance, so greedy output is token-identical to
+   plain decode by the same argument);
+2. gathers the final-layer hidden state at the acceptance index `a` (a
+   device-side gather — whichever prefix survives, the proposal chain
+   grows from the right context);
+3. chains K grammar-masked proposals from the transfer heads: each step
+   masks through the SAME tables the engine decodes with (dense
+   transition-table row gather when the grammar exports one — the fused
+   runtime's table — else sparse K-space), advances the DFA state, and
+   records the masked proposal logits the NEXT round's rejection sampler
+   needs.
+
+The proposals ride back device-resident: round n+1's block is assembled
+from round n's outputs without a host round trip, so the only per-round
+sync is the accept fetch — the draft stream has collapsed INTO the verify
+stream. A `W=1` bootstrap geometry (block = [first_token], K=0 drafts)
+starts each request and produces the first proposal block.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from k8s_llm_scheduler_tpu.engine.engine import _pick
+from k8s_llm_scheduler_tpu.models.configs import LlamaConfig
+from k8s_llm_scheduler_tpu.models.llama import (
+    Params,
+    _logits,
+    hidden_transfer_hidden,
+)
+from k8s_llm_scheduler_tpu.ops.attention import NEG_INF
+from k8s_llm_scheduler_tpu.spec.verify import (
+    _accept_block,
+    _forward_verify_block,
+    _masked_target,
+)
+
+
+def _hidden_verify_impl(
+    params: Params,
+    cfg: LlamaConfig,  # static
+    ht: Params,        # {"transfer": [K, D, D]} hidden-transfer head
+    blk_tok,      # [W] — [t_cur, g_1..g_{W-1}] (W=1 on the bootstrap round)
+    positions,    # [W]
+    prefix_k, prefix_v, prefix_len,
+    k_cache, v_cache,  # donated
+    page_table, own_len, page_ids, offs,
+    mask_states,   # [W] — DFA state governing the token AFTER blk_tok[i]
+    choice_idx,    # [W-1] — proposal's masked-space index (rejection path)
+    guess_logits,  # [W-1, X] — previous round's masked proposal logits
+    sp_tokens, sp_next,
+    dense_next,    # [S, V] dense transition table (grammar == "dense")
+    pad_id,
+    rng, temperature,
+    grammar: str,               # static — verify.GRAMMAR_MODES
+    greedy: bool,               # static
+    n_guess: int,               # static — K proposals for the NEXT round
+    vocab_limit: int | None = None,  # static
+    prefix_impl=None,           # static
+):
+    """Verify the current block AND propose the next one, one program.
+
+    Returns (a, t_next, st_next,
+             g_toks [n_guess], g_states [n_guess], g_idx [n_guess],
+             g_logits [n_guess, X], k_cache, v_cache).
+    g_states[h] is the DFA state AFTER guess h; g_logits are the masked
+    proposal logits (the q distributions for the next round's rejection
+    sampler); X matches the accept path's space (K-width under "sparse",
+    vocab otherwise)."""
+    logits_all, x_all, k_cache, v_cache = _forward_verify_block(
+        params, cfg, blk_tok, positions, prefix_k, prefix_v, prefix_len,
+        k_cache, v_cache, page_table, own_len, page_ids, offs,
+        prefix_impl=prefix_impl,
+    )
+    masked, idx_to_tok = _masked_target(
+        logits_all, mask_states, sp_tokens, sp_next, dense_next,
+        pad_id, grammar, vocab_limit,
+    )
+    rng_acc, rng_g = jax.random.split(rng)
+    a, t_next, st_next = _accept_block(
+        masked, idx_to_tok, blk_tok[1:], choice_idx, guess_logits,
+        rng_acc, temperature, grammar, greedy,
+        sp_tokens=sp_tokens, mask_states=mask_states,
+    )
+
+    # ---- propose the next block from the hidden state at the acceptance
+    # point: x_all[a] predicted t_next; head h predicts the h+1-th token
+    # after it. The chain is sequential in the DFA state (h's legality
+    # depends on h-1's guess) but every step is pure gathers + one LM-head
+    # matmul — no model call.
+    x_a = x_all[a]  # [D]
+    st = st_next.astype(jnp.int32)
+    keys = jax.random.split(rng_g, max(n_guess, 1))
+    g_toks, g_states, g_idx, g_logits = [], [], [], []
+    for h in range(n_guess):
+        xh = hidden_transfer_hidden(ht, x_a, h)
+        lg = _logits(params, cfg, xh)  # [V] f32
+        if grammar == "dense":
+            row = dense_next[st]  # [V]
+            m = jnp.where(row >= 0, lg, NEG_INF)
+            k_idx = _pick(m[None, :], keys[h], temperature)[0]
+            tok = k_idx
+            nxt = row[k_idx]
+        elif grammar == "sparse":
+            rows = sp_tokens[st]  # [Kw]
+            gathered = lg[jnp.maximum(rows, 0)]
+            m = jnp.where(rows >= 0, gathered, NEG_INF)
+            k_idx = _pick(m[None, :], keys[h], temperature)[0]
+            tok = rows[k_idx]
+            nxt = sp_next[st, k_idx]
+        else:
+            V = lg.shape[-1]
+            ids = jnp.arange(V)
+            bad = ids == pad_id
+            if vocab_limit is not None and vocab_limit < V:
+                bad = bad | (ids >= vocab_limit)
+            m = jnp.where(bad, NEG_INF, lg)
+            k_idx = _pick(m[None, :], keys[h], temperature)[0]
+            tok = k_idx
+            nxt = st
+        g_toks.append(tok.astype(jnp.int32))
+        g_states.append(nxt.astype(jnp.int32))
+        g_idx.append(k_idx.astype(jnp.int32))
+        g_logits.append(m)
+        st = nxt.astype(jnp.int32)
+
+    return (
+        a.astype(jnp.int32),
+        t_next.astype(jnp.int32),
+        st_next.astype(jnp.int32),
+        jnp.stack(g_toks),
+        jnp.stack(g_states),
+        jnp.stack(g_idx),
+        jnp.stack(g_logits),
+        k_cache,
+        v_cache,
+    )

@@ -11,9 +11,16 @@
    accepted prefix is then already resident; the rejected tail unwinds via
    kv_cache.truncate — stale page contents are never attended because every
    reader masks by valid length);
-3. applies the SAME grammar masking as the constrained decoder
-   (SparseDFATables in K-space) to every position's target distribution —
-   verification can never accept or emit a grammar-illegal token;
+3. applies the SAME grammar as the constrained decoder to every position's
+   target distribution — verification can never accept or emit a
+   grammar-illegal token. Greedy constrained verification reads the DENSE
+   transition table when the engine's grammar exports one
+   (engine/fused/tables.py — the fused runtime's table, shared, not a
+   twin): the allowed mask is one row gather `dense_next[state] >= 0` and
+   the transition one element gather, exactly the discipline the fused
+   while_loop uses. Sampling-mode and cap-exceeded grammars keep the
+   sparse K-space tables (the rejection sampler's proposal distributions
+   live in K-space);
 4. accepts on device: greedy mode takes the longest draft prefix matching
    the target argmax and emits the target's token at the first divergence
    (so output == plain greedy decode by construction); sampling mode runs
@@ -22,7 +29,10 @@
    which preserves the target distribution exactly.
 
 Returns (accept_count, next_token, next_state, k_cache, v_cache) — one
-fetch per round, everything else stays on device.
+fetch per round, everything else stays on device. The forward also
+returns the block's final-layer hidden states so the draft-free
+hidden-transfer arm (spec/hidden.py) can grow its next proposal block
+inside the same program.
 """
 
 from __future__ import annotations
@@ -47,6 +57,12 @@ from k8s_llm_scheduler_tpu.ops.attention import (
     prefix_attend_parts,
 )
 
+# Grammar implementations a verify program can compile against. "dense"
+# is greedy-only (the rejection sampler needs K-space proposal
+# distributions); the decoder picks per engine state — see
+# SpeculativeDecoder._grammar_mode.
+GRAMMAR_MODES = ("none", "sparse", "dense")
+
 
 def _forward_verify_block(
     params: Params,
@@ -61,7 +77,10 @@ def _forward_verify_block(
     page_ids, offs,      # [W] scatter destinations for the block's KV
     prefix_impl=None,    # static
 ):
-    """Target forward over the block; returns (logits [W, V] f32, caches)."""
+    """Target forward over the block; returns
+    (logits [W, V] f32, hidden [W, D] — final-layer pre-norm residual
+    stream, caches). The hidden states feed the hidden-transfer arm's
+    on-device proposal chain (spec/hidden.py)."""
     W = blk_tok.shape[0]
     hd = cfg.head_dim
     ps = k_cache.shape[2]
@@ -105,47 +124,40 @@ def _forward_verify_block(
         body, (x, k_cache, v_cache),
         (params["layers"], prefix_k, prefix_v, jnp.arange(cfg.n_layers)),
     )
-    return _logits(params, cfg, x[0]), k_cache, v_cache
+    return _logits(params, cfg, x[0]), x[0], k_cache, v_cache
 
 
-def _verify_impl(
-    params: Params,
-    cfg: LlamaConfig,  # static
-    blk_tok,      # [W] — [t_cur, d_1..d_K]
-    positions,    # [W]
-    prefix_k, prefix_v, prefix_len,
-    k_cache, v_cache,  # donated
-    page_table, own_len, page_ids, offs,
-    mask_states,   # [W] — DFA state governing the token AFTER blk_tok[i]
-    choice_idx,    # [K] — draft's sampled index per step (rejection path)
-    draft_logits,  # [K, X] — draft's masked proposal logits (rejection path)
-    sp_tokens, sp_next,
+def _masked_target(
+    logits_all,   # [W, V] f32
+    mask_states,  # [W] — DFA state governing the token AFTER blk_tok[i]
+    sp_tokens, sp_next,  # sparse tables (grammar == "sparse")
+    dense_next,   # [S, V] dense table (grammar == "dense")
     pad_id,
-    rng, temperature,
-    constrained: bool,          # static
-    greedy: bool,               # static — temperature == 0 fast path
-    vocab_limit: int | None = None,  # static
-    prefix_impl=None,           # static
+    grammar: str,              # static — one of GRAMMAR_MODES
+    vocab_limit: int | None,   # static
 ):
-    """Score + accept in one program. See module doc for the contract."""
-    W = blk_tok.shape[0]
-    K = W - 1
-    logits_all, k_cache, v_cache = _forward_verify_block(
-        params, cfg, blk_tok, positions, prefix_k, prefix_v, prefix_len,
-        k_cache, v_cache, page_table, own_len, page_ids, offs,
-        prefix_impl=prefix_impl,
-    )
+    """Grammar-mask every position's target distribution.
 
-    if constrained:
-        rows_all = sp_tokens[mask_states]          # [W, Kw]
-        next_all = sp_next[mask_states]            # [W, Kw]
-        gathered = jnp.take_along_axis(
-            logits_all, jnp.maximum(rows_all, 0), axis=1
-        )
-        masked = jnp.where(rows_all >= 0, gathered, NEG_INF)  # [W, Kw]
+    Returns (masked [W, X], idx_to_tok) where idx_to_tok(i, k) maps a
+    selection index in the masked space back to (token id, next DFA
+    state). X = vocab for "dense"/"none" (token id == index), grammar
+    K-width for "sparse"."""
+    if grammar == "dense":
+        rows_all = dense_next[mask_states]  # [W, V]
+        masked = jnp.where(rows_all >= 0, logits_all, NEG_INF)
 
         def idx_to_tok(i, k_idx):
-            return rows_all[i, k_idx], next_all[i, k_idx]
+            return k_idx, rows_all[i, k_idx]
+    elif grammar == "sparse":
+        tok_rows = sp_tokens[mask_states]          # [W, Kw]
+        next_rows = sp_next[mask_states]           # [W, Kw]
+        gathered = jnp.take_along_axis(
+            logits_all, jnp.maximum(tok_rows, 0), axis=1
+        )
+        masked = jnp.where(tok_rows >= 0, gathered, NEG_INF)  # [W, Kw]
+
+        def idx_to_tok(i, k_idx):
+            return tok_rows[i, k_idx], next_rows[i, k_idx]
     else:
         V = logits_all.shape[-1]
         ids = jnp.arange(V)[None, :]
@@ -157,10 +169,34 @@ def _verify_impl(
         def idx_to_tok(i, k_idx):
             return k_idx, mask_states[i]
 
-    drafts = blk_tok[1:]  # [K]
+    return masked, idx_to_tok
+
+
+def _accept_block(
+    masked,        # [W, X] grammar-masked target logits
+    idx_to_tok,    # from _masked_target
+    drafts,        # [K] proposed token ids (blk_tok[1:])
+    choice_idx,    # [K] draft's sampled index per step (rejection path)
+    draft_logits,  # [K, X'] draft's masked proposal logits (rejection path)
+    rng, temperature,
+    grammar: str,   # static
+    greedy: bool,   # static — temperature == 0 fast path
+    sp_tokens=None, mask_states=None,  # sparse token rows (greedy map-back)
+):
+    """On-device acceptance over a masked block. Returns
+    (a — accepted prefix length, t_next, st_next).
+
+    Greedy: longest draft prefix matching the target argmax, target token
+    at the divergence — output == plain greedy decode by construction.
+    Sampling: standard speculative rejection sampling in the draft's
+    proposal space (K-space under a sparse grammar, token space
+    otherwise); preserves the target distribution exactly."""
+    W = masked.shape[0]
+    K = W - 1
     if greedy:
         tgt_k = jnp.argmax(masked, axis=-1)  # [W]
-        if constrained:
+        if grammar == "sparse":
+            rows_all = sp_tokens[mask_states]
             tgt_tok = jnp.take_along_axis(rows_all, tgt_k[:, None], 1)[:, 0]
         else:
             tgt_tok = tgt_k
@@ -168,12 +204,15 @@ def _verify_impl(
         a = jnp.sum(jnp.cumprod(match)) if K > 0 else jnp.int32(0)
         t_next, st_next = idx_to_tok(a, tgt_k[a])
     else:
-        if not constrained:
+        if grammar != "sparse" and K > 0:
             # Align vocab widths: the draft's padded vocab may differ from
             # the target's (widened to a 128 multiple, or simply a
             # different config). Both maskings confine all legal mass to
             # [0, tokenizer_vocab), which is <= both widths, so slicing to
             # the common width drops only NEG_INF/zero-probability tail.
+            # K == 0 (a bootstrap block with no proposals) must NOT align:
+            # its draft_logits is a [0, 1] placeholder and slicing the
+            # target to width 1 would leave only the pad column.
             v_common = min(masked.shape[-1], draft_logits.shape[-1])
             masked = masked[:, :v_common]
             draft_logits = draft_logits[:, :v_common]
@@ -201,7 +240,44 @@ def _verify_impl(
             dist = p[0]
         k_choice = jax.random.categorical(rng_s, jnp.log(dist + 1e-30))
         t_next, st_next = idx_to_tok(a, k_choice)
+    return a, t_next, st_next
 
+
+def _verify_impl(
+    params: Params,
+    cfg: LlamaConfig,  # static
+    blk_tok,      # [W] — [t_cur, d_1..d_K]
+    positions,    # [W]
+    prefix_k, prefix_v, prefix_len,
+    k_cache, v_cache,  # donated
+    page_table, own_len, page_ids, offs,
+    mask_states,   # [W] — DFA state governing the token AFTER blk_tok[i]
+    choice_idx,    # [K] — draft's sampled index per step (rejection path)
+    draft_logits,  # [K, X] — draft's masked proposal logits (rejection path)
+    sp_tokens, sp_next,
+    dense_next,    # [S, V] dense transition table (grammar == "dense")
+    pad_id,
+    rng, temperature,
+    grammar: str,               # static — one of GRAMMAR_MODES
+    greedy: bool,               # static — temperature == 0 fast path
+    vocab_limit: int | None = None,  # static
+    prefix_impl=None,           # static
+):
+    """Score + accept in one program. See module doc for the contract."""
+    logits_all, _x, k_cache, v_cache = _forward_verify_block(
+        params, cfg, blk_tok, positions, prefix_k, prefix_v, prefix_len,
+        k_cache, v_cache, page_table, own_len, page_ids, offs,
+        prefix_impl=prefix_impl,
+    )
+    masked, idx_to_tok = _masked_target(
+        logits_all, mask_states, sp_tokens, sp_next, dense_next,
+        pad_id, grammar, vocab_limit,
+    )
+    a, t_next, st_next = _accept_block(
+        masked, idx_to_tok, blk_tok[1:], choice_idx, draft_logits,
+        rng, temperature, grammar, greedy,
+        sp_tokens=sp_tokens, mask_states=mask_states,
+    )
     return (
         a.astype(jnp.int32),
         t_next.astype(jnp.int32),

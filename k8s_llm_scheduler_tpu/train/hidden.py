@@ -1,0 +1,194 @@
+"""Hidden-transfer head training: teach the target to predict its own
+future.
+
+(*Hidden Transfer*, PAPERS.md.) The draft-free speculative arm
+(spec/hidden.py) proposes K future tokens from per-offset transfer
+matrices over the target's final-layer hidden state
+(models/llama.init_hidden_transfer). This module trains exactly those
+matrices — the TARGET MODEL IS FROZEN (gradients flow only into the [K,
+D, D] head), so training is cheap enough to run beside a distillation
+job and the serving weights are untouched by construction.
+
+Data rides the existing distillation machinery unchanged: batches come
+from train/distill.make_batches — the same teacher-decision sequences
+the draft arm distills on, so both arms train on the serving
+distribution. The loss is plain cross-entropy per head at its serving
+offset: the hidden state at position p predicts token p+1 via the LM
+head, and head h (0-based) predicts token p+2+h — the (h+1)-th token
+AFTER the next one, exactly what spec/hidden.py proposes it as — masked
+to positions whose target is inside the sequence.
+
+`train_hidden_transfer` publishes the finished head through the rollout
+registry (rollout/registry.py) with the target config's fingerprint and
+the train-side scores, the same provenance discipline every promotable
+checkpoint carries — `registry_dir=None` keeps a bare orbax directory
+for tests and ad-hoc runs.
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+from pathlib import Path
+
+logger = logging.getLogger(__name__)
+
+
+def hidden_transfer_loss(params, cfg, ht, tokens, seq_lens):
+    """Mean masked CE of every head's offset prediction over a batch.
+
+    tokens [B, S] int32; head h's logits at position p score token
+    p+2+h (the LM head owns p+1 — head h proposes the (h+1)-th token
+    after it, the serving alignment spec/hidden.py relies on).
+    Positions whose target falls past seq_len (or past S) are masked
+    out. The model forward runs WITHOUT gradient tracking into `params`
+    — callers differentiate wrt `ht` only."""
+    import jax
+    import jax.numpy as jnp
+
+    from k8s_llm_scheduler_tpu.models.llama import (
+        forward_prefill,
+        hidden_transfer_logits,
+    )
+
+    B, S = tokens.shape
+    K = ht["transfer"].shape[0]
+    _, _, _, x = forward_prefill(
+        params, cfg, tokens, seq_lens, return_logits=False,
+        return_hidden=True,
+    )  # x: [B, S, D]
+    logits = hidden_transfer_logits(params, cfg, ht, x)  # [B, S, K, V]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    pos = jnp.arange(S)
+    total = jnp.float32(0.0)
+    count = jnp.float32(0.0)
+    for h in range(K):
+        off = h + 2  # hidden at p predicts p+1; head h predicts p+1+(h+1)
+        tgt_idx = jnp.clip(pos + off, 0, S - 1)
+        tgt = tokens[:, tgt_idx]  # [B, S]
+        lp = jnp.take_along_axis(
+            logp[:, :, h, :], tgt[..., None], axis=-1
+        )[..., 0]  # [B, S]
+        valid = (pos[None, :] + off < seq_lens[:, None]).astype(jnp.float32)
+        total = total - jnp.sum(lp * valid)
+        count = count + jnp.sum(valid)
+    return total / jnp.maximum(count, 1.0)
+
+
+def restore_hidden_transfer(path, cfg, k: int):
+    """Restore a hidden-transfer head checkpoint (train_hidden_transfer's
+    out_dir / a registry version's checkpoint dir) and validate its
+    geometry against the serving config — a head trained for another
+    d_model or K must fail loudly, not propose garbage."""
+    import orbax.checkpoint as ocp
+
+    with ocp.StandardCheckpointer() as ckptr:
+        ht = ckptr.restore(Path(path).resolve())
+    t = ht.get("transfer") if isinstance(ht, dict) else None
+    if t is None or tuple(t.shape) != (k, cfg.d_model, cfg.d_model):
+        raise ValueError(
+            f"hidden-transfer checkpoint at {path} has shape "
+            f"{None if t is None else tuple(t.shape)}; serving needs "
+            f"[{k}, {cfg.d_model}, {cfg.d_model}]"
+        )
+    import jax.numpy as jnp
+
+    return {"transfer": jnp.asarray(t, dtype=cfg.dtype)}
+
+
+def train_hidden_transfer(
+    params,
+    cfg,
+    *,
+    k: int = 4,
+    steps: int = 200,
+    batch_size: int = 4,
+    seq_len: int = 512,
+    lr: float = 1e-3,
+    seed: int = 0,
+    tokenizer=None,
+    batches=None,
+    out_dir: str | None = None,
+    registry_dir: str | None = None,
+    publish_note: str = "",
+    log_every: int = 50,
+):
+    """Train a fresh [k, D, D] hidden-transfer head against frozen
+    `params`. Returns (head params, final loss).
+
+    `batches`: an iterator of (tokens [B, S], seq_lens [B]) overrides
+    the default distill stream (tests train on exactly the text they
+    evaluate acceptance on). `out_dir` saves an orbax checkpoint;
+    `registry_dir` additionally publishes it with provenance."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from k8s_llm_scheduler_tpu.models.llama import init_hidden_transfer
+
+    ht = init_hidden_transfer(jax.random.PRNGKey(seed), cfg, k)
+    optimizer = optax.adamw(lr)
+    opt_state = optimizer.init(ht)
+
+    if batches is None:
+        if tokenizer is None:
+            from k8s_llm_scheduler_tpu.engine.tokenizer import ByteTokenizer
+
+            tokenizer = ByteTokenizer()
+        from k8s_llm_scheduler_tpu.train.distill import make_batches
+
+        def stream():
+            for tokens, seq_lens, _starts, _w in make_batches(
+                tokenizer, batch_size, seq_len, seed=seed
+            ):
+                yield tokens, seq_lens
+
+        batches = stream()
+
+    @functools.partial(jax.jit, static_argnums=(1,))
+    def step_fn(params, cfg, ht, opt_state, tokens, seq_lens):
+        loss, grads = jax.value_and_grad(
+            lambda h: hidden_transfer_loss(params, cfg, h, tokens, seq_lens)
+        )(ht)
+        updates, opt_state = optimizer.update(grads, opt_state, ht)
+        ht = optax.apply_updates(ht, updates)
+        return loss, ht, opt_state
+
+    loss = float("nan")
+    for i in range(steps):
+        tokens, seq_lens = next(batches)
+        loss_d, ht, opt_state = step_fn(
+            params, cfg, ht,
+            opt_state, jnp.asarray(tokens, dtype=jnp.int32),
+            jnp.asarray(seq_lens, dtype=jnp.int32),
+        )
+        if log_every and (i % log_every == 0 or i == steps - 1):
+            loss = float(loss_d)
+            logger.info("hidden-transfer step %d loss %.4f", i, loss)
+    loss = float(loss_d)
+
+    if out_dir is not None:
+        from k8s_llm_scheduler_tpu.models.loader import save_checkpoint
+
+        ht_host = jax.tree_util.tree_map(np.asarray, ht)
+        save_checkpoint(Path(out_dir), ht_host)
+        if registry_dir is not None:
+            from k8s_llm_scheduler_tpu.rollout.registry import (
+                CheckpointRegistry,
+            )
+
+            registry = CheckpointRegistry(registry_dir)
+            manifest = registry.publish(
+                out_dir,
+                cfg=cfg,
+                config_name=f"{cfg.name}-hidden-k{k}",
+                scores={"hidden_transfer_loss": loss, "hidden_k": k,
+                        "steps": steps},
+                note=publish_note or "hidden-transfer head (train/hidden.py)",
+            )
+            logger.info(
+                "hidden-transfer head published as registry v%d",
+                manifest.version,
+            )
+    return ht, loss

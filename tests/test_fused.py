@@ -348,7 +348,7 @@ class TestFallbacks:
         text = engine.tokenizer.decode(out[ids[0]])
         assert text.startswith('{"selected_node": "node-a"')
 
-    def test_disabled_runtime_and_fused_hold(self):
+    def test_disabled_runtime_falls_back(self):
         engine = micro_engine(fused_decode=False)
         engine.set_prefix(TOK.encode("p"))
         engine.add_requests([TOK.encode("pod")], max_new_tokens=3)
@@ -356,21 +356,13 @@ class TestFallbacks:
         assert engine.stats["fused_chunks"] == 0
         assert engine.stats["fused_fallbacks"] >= 1
 
-        engine2 = micro_engine()
-        engine2.set_prefix(TOK.encode("p"))
-        engine2.fused_hold += 1  # an open speculative round
-        engine2.add_requests([TOK.encode("pod")], max_new_tokens=3)
-        drain_fused(engine2, 1)
-        assert engine2.stats["fused_chunks"] == 0
-        engine2.fused_hold -= 1
-        engine2.add_requests([TOK.encode("pod")], max_new_tokens=3)
-        drain_fused(engine2, 1)
-        assert engine2.stats["fused_chunks"] >= 1
-
-    def test_spec_round_releases_hold(self):
-        """Explicit non-fused interop: a speculative request holds the
-        fused runtime for its own duration and releases it after —
-        greedy output still matches plain decode (self-draft)."""
+    def test_spec_stream_coexists_with_fused_chunks(self):
+        """`engine.fused_hold` is GONE: an OPEN speculative stream
+        occupies only its own slot (external), so fused chunks keep
+        serving other requests between spec rounds — and the spec output
+        still matches plain decode (self-draft, greedy).
+        tests/test_spec_async.py pins the full interleaving matrix; this
+        is the fused runtime's side of the contract."""
         from k8s_llm_scheduler_tpu.spec.decoder import SpeculativeDecoder
 
         engine = micro_engine(num_pages=256)
@@ -378,14 +370,25 @@ class TestFallbacks:
         spec = SpeculativeDecoder(engine, micro_params(), MICRO, k=2)
         engine.attach_spec(spec)
         prompt = TOK.encode("pod-spec request")
+        other = TOK.encode("pod-other request")
         plain = engine.generate(prompt, 8, use_spec=False)
-        out = spec.generate(prompt, 8)
-        assert out.token_ids == plain.token_ids
-        assert engine.fused_hold == 0
-        # the fused runtime serves again once the round closed
-        engine.add_requests([prompt], max_new_tokens=3)
-        drain_fused(engine, 1)
-        assert engine.stats["fused_chunks"] >= 1
+        plain_other = engine.generate(other, 8, use_spec=False)
+
+        assert not hasattr(engine, "fused_hold")
+        stream = spec.start(prompt, 8)
+        # fused chunks dispatch WHILE the speculative stream is open
+        other_ids = engine.add_requests([other], max_new_tokens=8)
+        chunks0 = engine.stats["fused_chunks"]
+        fin = None
+        out_other: dict[int, list[int]] = {}
+        while fin is None or len(out_other) < 1:
+            if fin is None:
+                fin = spec.advance(stream)
+            for f in engine.step_fused():
+                out_other[f.req_id] = f.token_ids
+        assert engine.stats["fused_chunks"] > chunks0
+        assert fin.token_ids == plain.token_ids
+        assert out_other[other_ids[0]] == plain_other.token_ids
 
 
 # ---------------------------------------------------------- profiler books

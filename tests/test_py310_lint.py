@@ -95,6 +95,16 @@ class TestRepoIsClean:
         # as the scheduler loop it scales
         assert "k8s_llm_scheduler_tpu/fleet/autoscale.py" in files
         assert "tests/test_autoscale.py" in files
+        # async-spec round: the rewritten speculative pipeline (round
+        # state machine over device futures + the hidden-transfer arm and
+        # its training loop) — dataclass/future-heavy code of the same
+        # 3.11+-API risk class as the engine worker it composes with
+        assert "k8s_llm_scheduler_tpu/spec/decoder.py" in files
+        assert "k8s_llm_scheduler_tpu/spec/draft.py" in files
+        assert "k8s_llm_scheduler_tpu/spec/verify.py" in files
+        assert "k8s_llm_scheduler_tpu/spec/hidden.py" in files
+        assert "k8s_llm_scheduler_tpu/train/hidden.py" in files
+        assert "tests/test_spec_async.py" in files
         # the lint never lints its own pattern table
         assert "tools/py310_lint.py" not in files
 

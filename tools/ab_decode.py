@@ -9,10 +9,14 @@ A/B/A/B to cancel slow drift.
 Arms:
 - ``--arm matmul`` (default): dense vs ragged block-decode matmuls through
   bench.model_throughput's wave phase (the VERDICT r4 item 2/5 numbers).
-- ``--arm spec``: speculative (spec/decoder.py) vs plain chunked decode
-  through bench.spec_ab on the general paged path. ``--draft self`` is the
-  acceptance-1.0 upper bound; named configs at random init measure the
-  overhead floor (the production draft is a train/distill.py checkpoint).
+- ``--arm spec``: the async speculative pipeline (spec/decoder.py) vs the
+  FUSED decode baseline through bench.spec_ab, grammar-constrained greedy
+  by default. ``--draft self`` is the acceptance-1.0 / overlap-1.0 upper
+  bound; named configs at random init measure the overhead floor (the
+  production draft is a train/distill.py checkpoint).
+- ``--arm hidden``: the draft-free hidden-transfer arm vs the same fused
+  baseline — no second model; random-init heads here, train/hidden.py
+  checkpoints in production.
 
 Usage:
     python tools/ab_decode.py --model llama-3.2-1b-instruct
@@ -44,19 +48,29 @@ def main() -> None:
     ap.add_argument("--reps", type=int, default=2)
     ap.add_argument("--peak-tflops", type=float, default=None)
     ap.add_argument(
-        "--arm", choices=("matmul", "spec", "fused"), default="matmul",
-        help="matmul: dense-vs-ragged wave decode; spec: speculative vs "
-             "plain paged decode; fused: fused while_loop runtime vs "
-             "sparse chunked decode (engine/fused/) — greedy token "
-             "identity is test-pinned (tests/test_fused.py), this arm "
-             "measures the speed and the syncs-per-request reduction",
+        "--arm", choices=("matmul", "spec", "hidden", "fused"),
+        default="matmul",
+        help="matmul: dense-vs-ragged wave decode; spec: async "
+             "speculative pipeline vs FUSED decode baseline; hidden: the "
+             "draft-free hidden-transfer arm vs the same baseline "
+             "(spec/hidden.py — no second model); fused: fused "
+             "while_loop runtime vs sparse chunked decode (engine/fused/)"
+             " — greedy token identity is test-pinned "
+             "(tests/test_fused.py, tests/test_spec_async.py); the spec "
+             "arms additionally report the round-overlap fraction and "
+             "acceptance-weighted tok/s",
     )
     ap.add_argument(
         "--draft", default="tiny",
         help="spec arm: draft config name, or 'self' for the "
-             "acceptance-1.0 upper bound",
+             "acceptance-1.0 / overlap-1.0 upper bound",
     )
     ap.add_argument("--spec-k", type=int, default=4)
+    ap.add_argument(
+        "--unconstrained", action="store_true",
+        help="spec/hidden arms: drop the decision grammar (default "
+             "measures grammar-constrained greedy — the serving shape)",
+    )
     args = ap.parse_args()
 
     import jax
@@ -80,14 +94,18 @@ def main() -> None:
         )
         print(json.dumps(summary), flush=True)
         return
-    if args.arm == "spec":
+    if args.arm in ("spec", "hidden"):
         if args.quantize is not None:
-            ap.error("--arm spec does not take --quantize (plain bf16 A/B)")
+            ap.error(
+                f"--arm {args.arm} does not take --quantize (plain bf16 A/B)"
+            )
         params = init_params(jax.random.PRNGKey(0), cfg)
         # spec_ab interleaves its arms internally; reps widens the best-of
         summary = bench.spec_ab(
             args.model, draft=args.draft, spec_k=args.spec_k,
             reps=args.reps, params=params,
+            arm="hidden" if args.arm == "hidden" else "draft",
+            constrained=not args.unconstrained,
         )
         print(json.dumps(summary), flush=True)
         return
