@@ -181,6 +181,12 @@ PRESETS = {
     # harness stack, zero invariant violations required; publishes
     # recovery time, degraded-decision fraction, quality-vs-teacher
     "chaos": {"pods": 48, "nodes": 10, "rounds": 1},
+    # durable decision plane (sched/journal.py + sched/recovery.py): the
+    # three crash regimes (cold kill -> rebuild from disk) must keep
+    # binds exactly-once ACROSS restarts; publishes per-restart MTTR and
+    # the journal's decision-p50 overhead A/B (<2% bar). pods/nodes size
+    # the crash scenarios; rounds pace the overhead A/B pairs.
+    "recovery": {"pods": 48, "nodes": 10, "rounds": 3, "shapes": 8},
     # closed policy-improvement loop (learn/): the full seeded
     # mine -> finetune -> publish -> gate -> hot-swap cycle on a micro
     # REAL engine; asserts the promoted checkpoint strictly improves the
@@ -1052,6 +1058,195 @@ def chaos_bench(args) -> dict:
             "seed": seed,
             "regimes": regimes,
             "invariant_violations": violations,
+        },
+    }
+
+
+# ---------------------------------------------------------- crash recovery
+async def _journal_overhead_ab(args) -> dict:
+    """Journal-on vs journal-off A/B through the same scheduler stack
+    (obs-overhead discipline: arrival-paced, OFF/ON paired per round,
+    min of round medians). The stub decision costs 80 ms — ~3x BELOW the
+    measured real-engine raw decision p50 (~233 ms at 1B, BENCH history),
+    so the reported percentage over-states production overhead. Binds
+    run through the scheduler's BLOCKING-binder path (to_thread — the
+    shape every real apiserver binder takes), so the ON arm's per-bind
+    fsync (~0.7 ms, default "intent" policy) rides the executor exactly
+    where production pays it, instead of serializing the event loop the
+    way no deployed binder does."""
+    import dataclasses as _dc
+    import shutil as _shutil
+    import tempfile as _tempfile
+
+    from k8s_llm_scheduler_tpu.engine.backend import StubBackend
+    from k8s_llm_scheduler_tpu.sched.client import DecisionClient
+    from k8s_llm_scheduler_tpu.sched.journal import DecisionJournal
+    from k8s_llm_scheduler_tpu.sched.loop import Scheduler
+    from k8s_llm_scheduler_tpu.sched.recovery import JournaledBinder
+    from k8s_llm_scheduler_tpu.testing import (
+        SCHEDULER_NAME,
+        pod_burst,
+        synthetic_cluster,
+    )
+
+    stub_latency_s = 0.080
+    n_pods = 160
+    arrival_rate = 50.0
+
+    class _ExecutorBinder:
+        # the production-binder shape: KubeCluster's binding POST is
+        # blocking, so the scheduler routes it through to_thread — both
+        # arms take that path, and the journaled arm's fsync lands on
+        # the executor where deployments actually pay it
+        bind_is_nonblocking = False
+
+        def __init__(self, inner) -> None:
+            self._inner = inner
+
+        def bind_pod_to_node(self, pod_name, namespace, node_name):
+            return self._inner.bind_pod_to_node(
+                pod_name, namespace, node_name
+            )
+
+    async def one_round(tag: str, journal_dir) -> float:
+        cluster = synthetic_cluster(args.nodes)
+        client = DecisionClient(
+            StubBackend(latency_s=stub_latency_s), cache=None,
+        )
+        binder = _ExecutorBinder(cluster)
+        journal = None
+        if journal_dir is not None:
+            journal = DecisionJournal(journal_dir, fsync_policy="intent")
+            binder = JournaledBinder(binder, journal)
+        scheduler = Scheduler(
+            cluster, binder, client,
+            scheduler_name=SCHEDULER_NAME, snapshot_ttl_s=300.0,
+            max_concurrency=256, prefix_prewarm_s=0.0,
+        )
+        task = asyncio.create_task(scheduler.run())
+        pods = [
+            _dc.replace(p, name=f"{tag}-{p.name}")
+            for p in pod_burst(n_pods, distinct_shapes=args.shapes)
+        ]
+        try:
+            latencies, _ = await run_burst(
+                scheduler, cluster, pods, timeout_s=300.0,
+                arrival_rate=arrival_rate,
+            )
+        finally:
+            scheduler.stop()
+            cluster.close()
+            await asyncio.wait_for(task, timeout=30)
+            if journal is not None:
+                journal.close()
+        return statistics.median(latencies.values())
+
+    workdir = _tempfile.mkdtemp(prefix="bench-recovery-")
+    try:
+        await one_round("warm", None)  # warm pools/paths, discarded
+        p50s: dict[bool, list[float]] = {False: [], True: []}
+        for r in range(args.rounds):
+            # OFF then ON inside each round: weather drift cancels in
+            # the pair (obs-overhead discipline)
+            p50s[False].append(await one_round(f"off{r}", None))
+            p50s[True].append(
+                await one_round(f"on{r}", f"{workdir}/j{r}")
+            )
+    finally:
+        _shutil.rmtree(workdir, ignore_errors=True)
+    p50_off = min(p50s[False])
+    p50_on = min(p50s[True])
+    overhead_pct = (p50_on - p50_off) / p50_off * 100.0
+    return {
+        "overhead_pct": round(overhead_pct, 3),
+        "p50_journaled_ms": round(p50_on, 3),
+        "p50_bare_ms": round(p50_off, 3),
+        "round_p50s_off_ms": [round(v, 3) for v in p50s[False]],
+        "round_p50s_on_ms": [round(v, 3) for v in p50s[True]],
+        "stub_latency_ms": stub_latency_s * 1000.0,
+        "fsync_policy": "intent",
+        "threshold_pct": 2.0,
+        "note": (
+            "stub at 80ms/decision (~3x below the measured 1B raw "
+            "decision p50) with binds on the blocking/to_thread path "
+            "both arms — the percentage over-states production overhead"
+        ),
+    }
+
+
+def recovery_bench(args) -> dict:
+    """`--preset recovery`: the durable decision plane end to end.
+
+    Runs the three crash regimes (chaos/harness crash mode: a journal-
+    backed replica over a file-backed lease store, dropped COLD at
+    seeded lifecycle points and rebuilt from disk) and FAILS unless
+    every run is invariant-clean with every pod bound exactly once
+    ACROSS the restarts — zero lost, zero double-bound, judged by the
+    monitor book that spans all process lifetimes. Publishes MTTR per
+    restart (waves + ms from the kill to the rebuilt replica's first
+    bind, rebuild + journal replay + reconciliation inclusive) and the
+    journal's overhead on decision p50 (bar: <2%, same discipline as
+    obs-overhead)."""
+    from k8s_llm_scheduler_tpu.chaos import run_chaos
+
+    seed = args.seed if args.seed is not None else 0
+    regimes = {}
+    worst_mttr_ms = 0.0
+    worst_mttr_waves = 0
+    for regime in (
+        "crash-restart", "torn-journal", "crash-during-recovery",
+    ):
+        report = run_chaos(
+            regime, seed=seed, n_waves=8,
+            n_nodes=args.nodes, n_pods=args.pods,
+        )
+        inv = report["invariants"]
+        assert inv["clean"], (
+            f"{regime}: invariant violations across restarts: "
+            + json.dumps(inv["violations"])
+        )
+        assert report["scores"]["bound_frac"] == 1.0, (
+            f"{regime}: lost binds — bound_frac "
+            f"{report['scores']['bound_frac']} (unschedulable: "
+            f"{report['unschedulable']})"
+        )
+        restarts = report["restarts"]
+        assert restarts, f"{regime}: no cold restart happened"
+        for r in restarts:
+            if "mttr_ms" in r:
+                worst_mttr_ms = max(worst_mttr_ms, r["mttr_ms"])
+                worst_mttr_waves = max(worst_mttr_waves, r["mttr_waves"])
+        regimes[regime] = {
+            "clean": inv["clean"],
+            "checks": inv["checks"],
+            "plan_digest": report["plan_digest"],
+            "restarts": restarts,
+            "journal": {
+                k: report["journal"][k]
+                for k in ("appends", "fsyncs", "open_intents",
+                          "torn_bytes_dropped", "counts")
+            },
+            "bound_frac": report["scores"]["bound_frac"],
+            "recovery_waves": report["recovery"]["recovery_waves"],
+            "wall_ms": report["wall_ms"],
+        }
+    overhead = asyncio.run(_journal_overhead_ab(args))
+    assert overhead["overhead_pct"] < 2.0, (
+        f"journal overhead {overhead['overhead_pct']:.2f}% >= 2% of "
+        f"decision p50 (journaled {overhead['p50_journaled_ms']:.3f}ms "
+        f"vs bare {overhead['p50_bare_ms']:.3f}ms)"
+    )
+    return {
+        "metric": "recovery",
+        "value": round(worst_mttr_ms, 3),
+        "unit": "worst_mttr_ms",
+        "extra": {
+            "seed": seed,
+            "worst_mttr_waves": worst_mttr_waves,
+            "regimes": regimes,
+            "journal_overhead": overhead,
+            "lost_binds": 0,
+            "double_binds": 0,
         },
     }
 
@@ -2640,6 +2835,9 @@ def main() -> None:
         return
     if args.preset == "chaos":
         _emit(chaos_bench(args))
+        return
+    if args.preset == "recovery":
+        _emit(recovery_bench(args))
         return
     if args.preset == "learn":
         _emit(learn_bench(args))

@@ -14,7 +14,8 @@ is a regression test, not an anecdote.
                         lost pods, breaker state legality), violations
                         carrying flight-recorder trace ids.
 - chaos/harness.py    — wave-barriered chaos runner over the real stack
-                        (wire-fake API server / replica wire / fleet),
+                        (wire-fake API server / replica wire / fleet /
+                        elastic autoscale / journal-backed crash-restart),
                         deterministic trace + replay verification.
 
 Entry points: `cli chaos run/replay/list`, `bench.py --preset chaos`,

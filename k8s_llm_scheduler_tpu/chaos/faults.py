@@ -59,7 +59,10 @@ import time
 from collections import Counter
 from typing import Any, Callable, Sequence
 
-SEAMS = ("wire", "lease", "watch", "backend", "cache", "slo", "swap", "scale")
+SEAMS = (
+    "wire", "lease", "watch", "backend", "cache", "slo", "swap", "scale",
+    "process",
+)
 
 FAULT_KINDS: dict[str, tuple[str, ...]] = {
     "wire": ("reset", "drop", "delay", "dup"),
@@ -84,6 +87,15 @@ FAULT_KINDS: dict[str, tuple[str, ...]] = {
     # (workload-shaped; the marker makes the window visible in the
     # injection report).
     "scale": ("thrash", "join_fail", "gate_stall", "drain_race"),
+    # cold process death (sched/recovery.JournaledBinder crash_seam +
+    # the crash harness mode): `crash` drops the replica at the lifecycle
+    # point named by params["point"] (post_decide / mid_bind / post_bind
+    # — sched/recovery.CRASH_POINTS), `crash_recovery` kills it AGAIN
+    # mid-recovery (recovery must be re-entrant), and `torn_tail` is
+    # harness-interpreted: the journal's last record is physically
+    # truncated by params["bytes"] before the rebuild opens it (replay
+    # must truncate the tear, never mis-parse it).
+    "process": ("crash", "crash_recovery", "torn_tail"),
 }
 
 
@@ -358,6 +370,55 @@ def _regime_drain_race(rng, n_waves: int, n_nodes: int):
     return [_ev("scale", "drain_race", start, start + 1)], []
 
 
+def _regime_crash_restart(rng, n_waves: int, n_nodes: int):
+    # three cold kills, one per lifecycle point, staggered across
+    # consecutive waves (each `times=1`: exactly one death per window,
+    # the victim is the first pod the sequential drive carries across
+    # the seam that wave). post_decide leaves a decision with no intent,
+    # mid_bind an intent whose bind never left, post_bind a LANDED bind
+    # with no ack — the three distinct rows of the recovery decision
+    # table, each proven by a full cold restart + journal replay.
+    w = max(1, n_waves // 4)
+    events = []
+    for i, point in enumerate(("post_decide", "mid_bind", "post_bind")):
+        # clamp inside the run (n_waves 3-4 stacks windows on the last
+        # pre-recovery wave; distinct `point` params keep them distinct
+        # events with their own times budgets)
+        start = min(w + i, n_waves - 1)
+        events.append(
+            _ev("process", "crash", start, start + 1, point=point, times=1)
+        )
+    return events, []
+
+
+def _regime_torn_journal(rng, n_waves: int, n_nodes: int):
+    start, _end = _mid_windows(n_waves)
+    # die right after the bind LANDED (ack never written), then tear the
+    # journal's tail by a seeded byte count before the rebuild opens it:
+    # replay must truncate the torn record, and reconciliation must
+    # re-derive the lost outcome from the cluster (the pod IS bound)
+    nbytes = int(rng.integers(1, 24))
+    return [
+        _ev("process", "crash", start, start + 1, point="post_bind",
+            times=1),
+        _ev("process", "torn_tail", start, start + 1, bytes=nbytes),
+    ], []
+
+
+def _regime_crash_during_recovery(rng, n_waves: int, n_nodes: int):
+    start, end = _mid_windows(n_waves)
+    return [
+        # first death leaves an intent whose bind never executed...
+        _ev("process", "crash", start, start + 1, point="mid_bind",
+            times=1),
+        # ...and the REBUILT replica dies again mid-recovery, right
+        # after its first reconcile action lands — the third process
+        # lifetime must finish the job from a journal that now contains
+        # recovery's own partial writes (recovery is re-entrant)
+        _ev("process", "crash_recovery", start, end, times=1),
+    ], []
+
+
 REGIMES: dict[str, dict[str, Any]] = {
     # mode: which harness stack the regime drives (chaos/harness.py) —
     # "single" = Scheduler over the wire-fake API server; "wire" =
@@ -438,6 +499,31 @@ REGIMES: dict[str, dict[str, Any]] = {
         "describe": "a scale-down drain races a crashed replica's lease "
                     "failover: binds stay exactly-once across both "
                     "membership changes",
+    },
+    # --- durable-state regimes (mode "crash": one journal-backed
+    # replica over a file-backed lease store, dropped COLD at seeded
+    # lifecycle points and rebuilt from disk by the recovery protocol —
+    # chaos/harness._run_crash_stack; the invariant monitor's bind book
+    # spans every process lifetime, so exactly-once is judged ACROSS
+    # restarts).
+    "crash-restart": {
+        "build": _regime_crash_restart, "mode": "crash",
+        "describe": "cold kills at post-decide, mid-bind, and post-bind "
+                    "(pre-ack); each restart replays the journal and "
+                    "reconciles against the cluster without re-deciding",
+    },
+    "torn-journal": {
+        "build": _regime_torn_journal, "mode": "crash",
+        "describe": "crash after a landed bind plus a seeded torn "
+                    "journal tail: replay truncates the tear, "
+                    "reconciliation re-derives the outcome from the "
+                    "cluster",
+    },
+    "crash-during-recovery": {
+        "build": _regime_crash_during_recovery, "mode": "crash",
+        "describe": "the rebuilt replica dies again mid-recovery: the "
+                    "third process lifetime finishes reconciliation "
+                    "from a journal holding recovery's partial writes",
     },
 }
 
@@ -538,7 +624,10 @@ class Seam:
             and (kind is None or e.kind == kind)
         ]
 
-    def should(self, kind: str, key: str | None = None) -> FaultEvent | None:
+    def should(
+        self, kind: str, key: str | None = None,
+        where: dict | None = None,
+    ) -> FaultEvent | None:
         """The active `kind` event covering `key` this wave, else None.
         Partial faults (params fraction < 1) pick victims by a stable
         hash of `key`, so the victim set is identical across runs and
@@ -548,8 +637,15 @@ class Seam:
         against the wave barrier that would advance past its window);
         which requests consume the budget is thread-order dependent, but
         `times` faults are only legal for kinds that DELAY work rather
-        than redirect it, so placements stay deterministic."""
+        than redirect it, so placements stay deterministic. `where`
+        filters by param equality BEFORE any budget draw — a caller
+        probing for crash point="mid_bind" must not consume the budget
+        of a point="post_bind" event sharing the window."""
         for event in self.active(kind):
+            if where and any(
+                event.param(k) != v for k, v in where.items()
+            ):
+                continue
             holder = event.param("holder")
             if holder is not None and key is not None and key != holder:
                 continue

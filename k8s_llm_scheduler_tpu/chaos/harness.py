@@ -927,6 +927,311 @@ async def _run_autoscale_stack(
         cluster.close()
 
 
+# -------------------------------------------------------------- crash mode
+class _FirstBindTap:
+    """Thin binder wrapper stamping the perf time of the first
+    SUCCESSFUL bind a rebuilt replica lands — the 'first post-restart
+    bind' edge of the MTTR the recovery bench publishes."""
+
+    def __init__(self, inner: Any) -> None:
+        self._inner = inner
+        self.first_ok: float | None = None
+        self.bind_is_nonblocking = getattr(inner, "bind_is_nonblocking", False)
+
+    def bind_pod_to_node(self, pod_name, namespace, node_name) -> bool:
+        ok = self._inner.bind_pod_to_node(pod_name, namespace, node_name)
+        if ok and self.first_ok is None:
+            self.first_ok = time.perf_counter()
+        return ok
+
+    def __getattr__(self, name: str) -> Any:
+        return getattr(self._inner, name)
+
+
+def _tear_journal_tail(journal_root, n_bytes: int) -> None:
+    """Harness interpretation of the `torn_tail` fault: physically cut
+    N bytes off the end of the newest journal segment — the bytes a
+    crash tore out of the record being written at the instant of
+    death. The rebuilt journal's replay must truncate (never mis-parse)
+    the tear."""
+    from pathlib import Path
+
+    segments = sorted(Path(journal_root).glob("seg-*.log"))
+    if not segments:
+        return
+    seg = segments[-1]
+    size = seg.stat().st_size
+    with open(seg, "ab") as fh:
+        fh.truncate(max(0, size - max(1, n_bytes)))
+
+
+async def _run_crash_stack(
+    scenario, plan: FaultPlan, injector: FaultInjector,
+    monitor: InvariantMonitor, *, deadline_ms: float | None,
+    wave_timeout_s: float, tick_s: float = 2.0, lease_ttl_s: float = 5.0,
+) -> dict:
+    """One JOURNAL-BACKED replica over the in-memory cluster, dropped
+    cold at seeded lifecycle points and rebuilt from disk.
+
+    The durable pieces are real: a FileLeaseStore (leases linger to TTL
+    across the death, exactly like a crashed pod's k8s Lease), an
+    fsync'd DecisionJournal, and the full recovery protocol
+    (FleetReplica.recover -> sched/recovery.recover). The invariant
+    monitor — and its exactly-once bind book — live OUTSIDE the replica
+    and span every process lifetime, so a double bind across a restart
+    is judged exactly like one inside a single lifetime, against the
+    store.
+
+    Determinism: pods are driven through the scheduler SEQUENTIALLY in
+    sorted order (the crash must always land on the same pod at the
+    same lifecycle point), placements are by-shape (HashPlacement), the
+    store clock is virtual, and `times=1` budgets mean exactly one
+    death per crash window. Restart timing (ms) stays in the report;
+    the (wave, point, reconciled-counts) sequence rides the trace."""
+    import shutil
+    import tempfile
+    from pathlib import Path
+
+    from k8s_llm_scheduler_tpu.cluster.fake import FakeCluster, FakeNode
+    from k8s_llm_scheduler_tpu.core.cache import DecisionCache
+    from k8s_llm_scheduler_tpu.fleet.frontend import FleetReplica
+    from k8s_llm_scheduler_tpu.fleet.lease import FileLeaseStore
+    from k8s_llm_scheduler_tpu.sched.journal import DecisionJournal
+    from k8s_llm_scheduler_tpu.sched.recovery import SimulatedCrash
+
+    workdir = Path(tempfile.mkdtemp(prefix="chaos-crash-"))
+    journal_root = workdir / "journal"
+    cluster = FakeCluster()
+    for n in scenario.nodes:
+        cluster.add_node(FakeNode(
+            name=n.name,
+            cpu_capacity_cores=n.cpu_cores,
+            memory_capacity_gb=n.memory_gb,
+            max_pods=n.max_pods,
+            labels=dict(n.labels),
+            taints=n.taints,
+            ready=n.ready,
+        ))
+    clock = _VirtualClock()
+    store = FileLeaseStore(
+        workdir / "leases.json", n_shards=4, ttl_s=lease_ttl_s, clock=clock,
+    )
+    store.fault_seam = injector.seam("lease")
+    process_seam = injector.seam("process")
+    clients: list = []
+    deferred: set[str] = set()
+
+    def pod_lookup(ns: str, name: str):
+        raw = cluster.get_pod(ns, name)
+        if raw is None:
+            return ("gone", None)
+        if raw.node_name:
+            return ("bound", raw.node_name)
+        return ("pending", None)
+
+    def build_replica() -> FleetReplica:
+        journal = DecisionJournal(journal_root, fsync_policy="always")
+        # monitor INSIDE the journal wrapper (fence(journal(monitor(
+        # cluster)))): a post_bind crash fires AFTER the inner bind
+        # returns — with the monitor outside, the exception would skip
+        # its bookkeeping and a genuinely-landed bind would read as a
+        # lost pod. Inside, the observation completes WITH the bind,
+        # which is also what the cluster (the real authority) sees.
+        tap = _FirstBindTap(cluster)
+        monitored = monitor.wrap_binder(
+            tap, holder="replica-0", store=store, n_shards=store.n_shards,
+        )
+        replica = FleetReplica(
+            0,
+            cluster=cluster, binder=monitored,
+            backend=HashPlacementBackend(),
+            store=store, l2=DecisionCache(max_size=4096),
+            scheduler_name=SCHEDULER_NAME,
+            snapshot_ttl_s=1e9,  # waves invalidate explicitly
+            journal=journal,
+            list_pending=lambda: cluster.pending_pods(SCHEDULER_NAME),
+        )
+        replica._journaled_binder.crash_seam = process_seam
+        replica.cache.fault_seam = injector.seam("cache")
+        replica.client.cache = monitor.wrap_cache(replica.cache)
+        replica.client.deadline_ms = deadline_ms
+        monitor.watch_breaker(replica.client.breaker, name=replica.holder)
+        replica.bind_tap = tap
+        clients.append(replica.client)
+        return replica
+
+    def bound_names() -> set[str]:
+        return {name for (_ns, name), _node in monitor.bound_pods().items()}
+
+    replica = build_replica()
+    replica.manager.tick()  # single holder claims every shard
+    restarts: list[dict] = []
+    open_restart: dict | None = None
+
+    def settle_restart(current_wave: int) -> None:
+        """Fill the open restart's MTTR once its rebuilt replica landed
+        a bind (kill -> rebuild -> recover -> first bind, inclusive)."""
+        nonlocal open_restart
+        if open_restart is None:
+            return
+        tap = open_restart["tap"]
+        if tap.first_ok is None:
+            return
+        rec = open_restart["record"]
+        rec["mttr_ms"] = round(
+            (tap.first_ok - open_restart["t_kill"]) * 1000.0, 3
+        )
+        rec["mttr_waves"] = current_wave - rec["wave"]
+        open_restart = None
+
+    waves_out: list[dict] = []
+    try:
+        for wave_idx, wave in enumerate(scenario.waves):
+            injector.begin_wave(wave_idx)
+            _wave_brownout(injector, clients)
+            clock.advance(tick_s)
+            replica.manager.tick()
+            if not wave:
+                waves_out.append({"wave": wave_idx, "n_pods": 0})
+                continue
+            replica.scheduler.invalidate_snapshot()
+            before = _client_counts(clients)
+            inj_before = dict(injector.injection_counts())
+            t0 = time.perf_counter()
+            for pod in wave:
+                cluster.add_pod(pod.to_raw_pod())
+            released = {p.name for p in wave}
+
+            # sequential deterministic drive, crash-aware: a pass over
+            # the pending set; a SimulatedCrash aborts the pass, the
+            # replica is rebuilt from disk, recovery reconciles, and a
+            # fresh pass covers whatever is still pending
+            while True:
+                pending = sorted(
+                    cluster.pending_pods(SCHEDULER_NAME),
+                    key=lambda p: (p.namespace, p.name),
+                )
+                crashed = False
+                for raw in pending:
+                    try:
+                        ok = await replica.scheduler.schedule_pod(raw)
+                    except SimulatedCrash as crash:
+                        # ---------------- cold process death ----------
+                        t_kill = time.perf_counter()
+                        replica.journal.abandon()
+                        # leases are NOT released; the store keeps them
+                        # until TTL — exactly a crashed pod's k8s Lease
+                        torn = process_seam.should("torn_tail")
+                        if torn is not None:
+                            _tear_journal_tail(
+                                journal_root,
+                                int(torn.param("bytes", 4)),
+                            )
+                        # ---------------- rebuild from disk -----------
+                        replica = build_replica()
+                        try:
+                            rec = await replica.recover(pod_lookup)
+                        except SimulatedCrash:
+                            # crash DURING recovery: die again, rebuild
+                            # again — the journal now holds recovery's
+                            # partial writes and must still reconcile
+                            replica.journal.abandon()
+                            replica = build_replica()
+                            rec = await replica.recover(pod_lookup)
+                        record = {
+                            "wave": wave_idx,
+                            "point": crash.point,
+                            "reconciled": {
+                                k: rec[k] for k in
+                                ("acked", "rebound", "dropped", "failed")
+                            },
+                        }
+                        restarts.append(record)
+                        open_restart = {
+                            "record": record, "t_kill": t_kill,
+                            "tap": replica.bind_tap,
+                        }
+                        replica.scheduler.invalidate_snapshot()
+                        crashed = True
+                        break
+                    else:
+                        if not ok:
+                            deferred.add(raw.name)
+                        settle_restart(wave_idx)
+                if not crashed:
+                    break
+            settle_restart(wave_idx)
+            waves_out.append({
+                "wave": wave_idx,
+                "n_pods": len(wave),
+                "n_bound": len(released & bound_names()),
+                "restarts": sum(
+                    1 for r in restarts if r["wave"] == wave_idx
+                ),
+                "wall_ms": round((time.perf_counter() - t0) * 1000.0, 3),
+                "client": _delta(_client_counts(clients), before),
+                "injections": _delta(
+                    dict(injector.injection_counts()), inj_before
+                ),
+            })
+        injector.end_run()
+
+        # recovery sweep: re-offer anything still pending (a deferred
+        # pod whose bind was refused mid-crash retries against the
+        # settled cluster)
+        all_names = {p.name for wave in scenario.waves for p in wave}
+        for _ in range(8):
+            if not (all_names - bound_names() - deferred):
+                break
+            clock.advance(tick_s)
+            replica.manager.tick()
+            for raw in sorted(
+                cluster.pending_pods(SCHEDULER_NAME),
+                key=lambda p: (p.namespace, p.name),
+            ):
+                try:
+                    await replica.scheduler.schedule_pod(raw)
+                except SimulatedCrash:
+                    break  # budgets are spent by now; defensive only
+            settle_restart(len(scenario.waves) - 1)
+
+        all_pods = [p for wave in scenario.waves for p in wave]
+        still_pending = {
+            (p.namespace, p.name)
+            for p in cluster.pending_pods(SCHEDULER_NAME)
+        }
+        monitor.finalize(
+            expected=[("default", p.name) for p in all_pods],
+            pending=still_pending,
+        )
+        monitor.finalize_journal(replica.journal.state, pod_lookup)
+        placements = {
+            name: node
+            for (_ns, name), node in monitor.bound_pods().items()
+        }
+        return {
+            "placements": dict(sorted(placements.items())),
+            "unschedulable": sorted(
+                n for n in all_names if n not in placements
+            ),
+            "waves": waves_out,
+            "client": {
+                "totals": _client_counts(clients),
+                "lease": store.gauges(),
+            },
+            "restarts": restarts,
+            "journal": replica.journal.stats(),
+        }
+    finally:
+        injector.end_run()
+        try:
+            replica.journal.close()
+        except Exception:
+            pass  # graftlint: ok[swallowed-exception] — teardown of a possibly-abandoned journal; state already on disk
+        cluster.close()
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
 # ------------------------------------------------------------------- runner
 def run_chaos(
     regime: str,
@@ -958,9 +1263,10 @@ def run_chaos(
         )
     mode = REGIMES[regime]["mode"]
     if n_pods is None:
-        # fleet/autoscale modes share the cluster across replicas whose
-        # snapshots are not wave-settled: keep per-node worst-case fill
-        # clear of max_pods so the feasible set never shifts mid-run
+        # fleet/autoscale/crash modes share the cluster across replicas
+        # (or process lifetimes) whose snapshots are not wave-settled:
+        # keep per-node worst-case fill clear of max_pods so the
+        # feasible set never shifts mid-run
         n_pods = 96 if mode in ("single", "wire") else 64
     spec, plan = chaos_scenario(
         regime, seed, n_nodes=n_nodes, n_pods=n_pods, n_waves=n_waves
@@ -970,7 +1276,12 @@ def run_chaos(
     monitor = InvariantMonitor(injector)
 
     t_run = time.perf_counter()
-    if mode == "autoscale":
+    if mode == "crash":
+        stack = asyncio.run(_run_crash_stack(
+            scenario, plan, injector, monitor,
+            deadline_ms=deadline_ms, wave_timeout_s=wave_timeout_s,
+        ))
+    elif mode == "autoscale":
         stack = asyncio.run(_run_autoscale_stack(
             scenario, plan, injector, monitor,
             deadline_ms=deadline_ms, wave_timeout_s=wave_timeout_s,
@@ -1021,6 +1332,12 @@ def run_chaos(
         # the controller stats stay report-only
         report["scale_events"] = stack["scale_events"]
         report["autoscale"] = stack["autoscale"]
+    if "restarts" in stack:
+        # crash mode: the (wave, point, reconciled) restart sequence is
+        # deterministic (sequential drive, times=1 budgets) and rides
+        # the trace; MTTR timing and the journal stats stay report-only
+        report["restarts"] = stack["restarts"]
+        report["journal"] = stack["journal"]
     if quality:
         report["quality"] = _quality_vs_teacher(scenario, scores)
     return report
@@ -1109,6 +1426,18 @@ def build_chaos_trace(report: dict) -> dict:
     }
     if "scale_events" in report:
         trace["scale_events"] = report["scale_events"]
+    if "restarts" in report:
+        # (wave, point, reconciled) is the deterministic restart
+        # identity; mttr_ms/mttr_waves are run-local timing and stay in
+        # the report
+        trace["restarts"] = [
+            {
+                "wave": r["wave"],
+                "point": r["point"],
+                "reconciled": dict(r["reconciled"]),
+            }
+            for r in report["restarts"]
+        ]
     return trace
 
 
@@ -1187,6 +1516,10 @@ def replay_chaos_trace(trace: dict) -> dict:
         # run-recorded, not re-derivable without re-running the stack —
         # carried verbatim; byte-identity across RUNS is what pins it
         out["scale_events"] = list(trace["scale_events"])
+    if "restarts" in trace:
+        # same contract as scale_events: the restart sequence is pinned
+        # by byte-identity across runs, not re-derived here
+        out["restarts"] = list(trace["restarts"])
     return out
 
 

@@ -60,6 +60,14 @@ INVARIANTS = (
     # configured [min, max] replica clamp (checked on every controller
     # tick via note_scale)
     "replica_bounds",
+    # durable-state round: at the end of a crash-restart run the journal
+    # (sched/journal.py), the monitor's own bind book — which spans
+    # every process lifetime — and the cluster must agree: no lifecycle
+    # left open (recovery reconciled everything), every ok-acked bind
+    # actually on the cluster at the acked node, no acked pod the
+    # monitor never saw bind. Judged at finalize_journal against the
+    # STORE (the cluster lookup), not the journal's own claims.
+    "journal_consistency",
 )
 
 # legal breaker edges (core/breaker.py state machine); reset() is
@@ -215,7 +223,10 @@ class InvariantMonitor:
         """Subscribe to the breaker's transition hook (core/breaker.py
         on_transition). The hook fires under the breaker's lock: this
         callback only appends under the monitor's own lock and never
-        calls back into the breaker."""
+        calls back into the breaker. CHAINS any observer already
+        installed (a durable replica journals its trips through the same
+        slot — monitoring must not silently disconnect it)."""
+        prior = getattr(breaker, "on_transition", None)
 
         def on_transition(old, new) -> None:
             self._check("breaker_transition")
@@ -225,6 +236,8 @@ class InvariantMonitor:
                     "breaker_transition", name,
                     f"illegal edge {old.value} -> {new.value}",
                 )
+            if prior is not None:
+                prior(old, new)
 
         breaker.on_transition = on_transition
 
@@ -246,6 +259,43 @@ class InvariantMonitor:
                 self.record(
                     "lost_pod", f"{key[0]}/{key[1]}",
                     "pod neither bound nor pending at end of run",
+                )
+
+    def finalize_journal(self, state: Any, pod_lookup: Any) -> None:
+        """Crash-plane accounting (see the journal_consistency entry in
+        INVARIANTS). `state` is the journal's folded JournalState;
+        `pod_lookup` the same cluster-truth probe recovery used."""
+        self._check("journal_consistency")
+        for (ns, name) in sorted(
+            set(state.open_decisions) | set(state.open_intents)
+        ):
+            self.record(
+                "journal_consistency", f"{ns}/{name}",
+                "journal lifecycle still open at end of run — recovery "
+                "never reconciled it",
+            )
+        with self._lock:
+            bound = dict(self._bound)
+        for (ns, name), node in sorted(state.acked.items()):
+            status, now = pod_lookup(ns, name)
+            if status == "pending":
+                self.record(
+                    "journal_consistency", f"{ns}/{name}",
+                    f"journal acked a bind to {node} but the cluster "
+                    f"still lists the pod pending",
+                )
+            elif status == "bound" and now != node:
+                self.record(
+                    "journal_consistency", f"{ns}/{name}",
+                    f"journal acked node {node} but the cluster has the "
+                    f"pod on {now}",
+                )
+            monitor_node = bound.get((ns, name))
+            if monitor_node is not None and monitor_node != node:
+                self.record(
+                    "journal_consistency", f"{ns}/{name}",
+                    f"journal acked {node} but the bind book (spanning "
+                    f"all process lifetimes) recorded {monitor_node}",
                 )
 
     # --------------------------------------------------------------- report

@@ -168,8 +168,78 @@ def _build_stack(cfg: Config, cluster) -> Any:
     return scheduler, backend
 
 
-async def _run_scheduler(cfg: Config, cluster, demo_pods: bool = False) -> int:
+def _maybe_journal(cfg: Config):
+    """Build the durable decision journal when the `durability` block
+    enables it (sched/journal.py); None otherwise."""
+    if not cfg.get("durability.enabled", False):
+        return None
+    journal_dir = cfg.get("durability.journal_dir", None)
+    if not journal_dir:
+        raise SystemExit(
+            "durability.enabled is set but durability.journal_dir is not "
+            "(DURABILITY_JOURNAL_DIR)"
+        )
+    from k8s_llm_scheduler_tpu.sched.journal import DecisionJournal
+
+    return DecisionJournal(
+        journal_dir,
+        fsync_policy=str(cfg.get("durability.fsync", "intent")),
+        segment_max_records=int(
+            cfg.get("durability.segment_max_records", 4096)
+        ),
+    )
+
+
+def _recovery_lookup(cluster):
+    """The cluster-truth probe recovery needs (sched/recovery.PodLookup),
+    from whatever cluster driver is in play."""
+    factory = getattr(cluster, "recovery_lookup", None)  # KubeCluster
+    if factory is not None:
+        return factory()  # one list snapshot answers the whole pass
+    get_pod = getattr(cluster, "get_pod", None)  # FakeCluster
+
+    def lookup(ns: str, name: str):
+        raw = get_pod(ns, name)
+        if raw is None:
+            return ("gone", None)
+        if raw.node_name:
+            return ("bound", raw.node_name)
+        return ("pending", None)
+
+    return lookup
+
+
+async def _run_scheduler(
+    cfg: Config, cluster, demo_pods: bool = False, journal=None,
+) -> int:
     scheduler, backend = _build_stack(cfg, cluster)
+
+    if journal is not None:
+        # Durable decision plane (sched/journal.py + sched/recovery.py):
+        # the binder journals the decide/intent/ack lifecycle, the
+        # breaker journals its trips, and recovery reconciles whatever a
+        # previous incarnation left open BEFORE the watch starts — a
+        # decided-but-unbound pod completes without a model call, a
+        # bound-but-unacked one just gets its ack.
+        from k8s_llm_scheduler_tpu.sched import recovery as recovery_mod
+        from k8s_llm_scheduler_tpu.sched.recovery import JournaledBinder
+
+        scheduler.binder = JournaledBinder(scheduler.binder, journal)
+        if scheduler.client.breaker is not None:
+            scheduler.client.breaker.journal_sink = journal.record_breaker
+        report = await asyncio.to_thread(
+            recovery_mod.recover,
+            journal,
+            pod_lookup=_recovery_lookup(cluster),
+            binder=scheduler.binder,
+            breaker=scheduler.client.breaker,
+        )
+        logger.info(
+            "journal recovery: %d acked, %d completed, %d dropped, "
+            "%d refused (resume rv=%s)",
+            report.acked, report.rebound, report.dropped, report.failed,
+            report.resume_rv,
+        )
 
     engine = getattr(backend, "engine", None)
     profiler = None
@@ -305,6 +375,8 @@ async def _run_scheduler(cfg: Config, cluster, demo_pods: bool = False) -> int:
         close_backend = getattr(backend, "close", None)
         if close_backend:
             close_backend()
+        if journal is not None:
+            journal.close()
         # Final stats dump (reference scheduler.py:803-819).
         print(json.dumps(scheduler.get_stats(), indent=2, default=str))
     return 0
@@ -336,6 +408,7 @@ def cmd_run(args: argparse.Namespace, cfg: Config) -> int:
         # decision-RPC transport until terminated (SCALING.md
         # "Multi-host"; sched/replica.py).
         return _run_worker_replica(cfg)
+    journal = _maybe_journal(cfg)
     if args.fake_cluster:
         from k8s_llm_scheduler_tpu.testing import synthetic_cluster
 
@@ -343,9 +416,19 @@ def cmd_run(args: argparse.Namespace, cfg: Config) -> int:
     else:
         from k8s_llm_scheduler_tpu.cluster.kube import KubeCluster
 
+        kube_kwargs = {}
+        if journal is not None:
+            # resume the watch after the journaled resourceVersion (one
+            # reconciling relist covers anything older) and keep the
+            # journal's resume point current as events stream
+            kube_kwargs = {
+                "resume_rv": journal.state.last_rv,
+                "rv_hook": journal.record_rv,
+            }
         try:
             cluster = KubeCluster(
-                watch_timeout_seconds=cfg.get("scheduler.watch_interval")
+                watch_timeout_seconds=cfg.get("scheduler.watch_interval"),
+                **kube_kwargs,
             )
         except Exception as exc:
             # a driver is always importable (in-tree httpapi fallback);
@@ -356,7 +439,9 @@ def cmd_run(args: argparse.Namespace, cfg: Config) -> int:
                 file=sys.stderr,
             )
             return 2
-    return asyncio.run(_run_scheduler(cfg, cluster, demo_pods=False))
+    return asyncio.run(
+        _run_scheduler(cfg, cluster, demo_pods=False, journal=journal)
+    )
 
 
 def _run_worker_replica(
@@ -889,7 +974,7 @@ def cmd_sim(args: argparse.Namespace, cfg: Config) -> int:
         save_trace(report, args.trace)
     report.pop("_traces")
     if args.out:
-        with open(args.out, "w", encoding="utf-8") as fh:
+        with open(args.out, "w", encoding="utf-8") as fh:  # graftlint: ok[nonatomic-state-write] — operator-requested report path, not runtime state; a torn copy is re-runnable
             json.dump(report, fh, indent=1, sort_keys=True)
     # headline: one line, deterministic fields only
     print(json.dumps({
@@ -985,6 +1070,50 @@ def cmd_chaos(args: argparse.Namespace, cfg: Config) -> int:
             "injections": report["injections"],
         }), flush=True)
     return exit_code
+
+
+def cmd_journal(args: argparse.Namespace, cfg: Config) -> int:
+    """Durable decision journal tooling (sched/journal.py):
+
+        cli journal fsck     # per-segment integrity + the folded state
+        cli journal show     # record stream (JSONL)
+        cli journal compact  # fold completed lifecycles into one segment
+    """
+    from k8s_llm_scheduler_tpu.sched import journal as journal_mod
+
+    root = args.dir or cfg.get("durability.journal_dir", None)
+    if not root:
+        raise SystemExit(
+            "no journal: pass --dir DIR or set durability.journal_dir "
+            "(DURABILITY_JOURNAL_DIR)"
+        )
+    if args.journal_cmd == "fsck":
+        report = journal_mod.fsck(root)
+        print(json.dumps(report, indent=1, sort_keys=True))
+        # exit contract mirrors rollout fsck: 0 clean, 1 torn bytes found
+        return 0 if report["ok"] else 1
+    if args.journal_cmd == "show":
+        n = 0
+        for seg, rec in journal_mod.iter_records(root):
+            print(json.dumps({"segment": seg, **rec}, sort_keys=True))
+            n += 1
+            if args.limit and n >= args.limit:
+                break
+        return 0
+    # compact: open (replays + truncates any torn tail) and rotate. The
+    # journal's single-writer flock refuses a directory a live
+    # scheduler is writing — compacting under a live writer would
+    # rotate its active segment out from underneath it.
+    try:
+        journal = journal_mod.DecisionJournal(root)
+    except journal_mod.JournalError as exc:
+        raise SystemExit(str(exc)) from exc
+    try:
+        stats = journal.compact()
+    finally:
+        journal.close()
+    print(json.dumps(stats, sort_keys=True))
+    return 0
 
 
 def _rollout_registry(args: argparse.Namespace, cfg: Config):
@@ -1788,7 +1917,7 @@ def cmd_trace(args: argparse.Namespace, cfg: Config) -> int:
                 since = int(trailer["next_cursor"])
             out_body = "".join(line + "\n" for line in lines)
             if args.out:
-                with open(args.out, "w", encoding="utf-8") as fh:
+                with open(args.out, "w", encoding="utf-8") as fh:  # graftlint: ok[nonatomic-state-write] — operator-requested trace export, not runtime state; a torn copy is re-runnable
                     fh.write(out_body)
                 print(f"wrote {len(lines)} trace(s) to {args.out}")
             else:
@@ -1858,6 +1987,18 @@ def cmd_fleet(args: argparse.Namespace, cfg: Config) -> int:
                 distinct_shapes=args.shapes,
             ):
                 cluster.add_pod(raw)
+            store = None
+            lease_path = cfg.get("durability.lease_store_path", None)
+            if lease_path:
+                # durable lease backend (fleet/lease.FileLeaseStore):
+                # same protocol, leases survive a demo restart
+                from k8s_llm_scheduler_tpu.fleet import FileLeaseStore
+
+                store = FileLeaseStore(
+                    lease_path,
+                    n_shards=int(cfg.get("fleet.n_shards")),
+                    ttl_s=float(cfg.get("fleet.lease_ttl_s")),
+                )
             fleet = Fleet(
                 cluster, cluster, lambda i: StubBackend(),
                 n_replicas=replicas,
@@ -1868,6 +2009,7 @@ def cmd_fleet(args: argparse.Namespace, cfg: Config) -> int:
                 l1_size=int(cfg.get("fleet.l1_size")),
                 l2_size=int(cfg.get("fleet.l2_size")),
                 list_pending=lambda: cluster.pending_pods(scheduler_name),
+                store=store,
             )
             t0 = time.perf_counter()
             await fleet.start()
@@ -2441,6 +2583,28 @@ def main(argv: list[str] | None = None) -> int:
     )
     p_creplay.add_argument("trace", help="trace file from `chaos run --trace`")
 
+    p_journal = sub.add_parser(
+        "journal",
+        help="durable decision journal: fsck/show/compact "
+             "(sched/journal.py; durability.* config block)",
+    )
+    jsub = p_journal.add_subparsers(dest="journal_cmd", required=True)
+    for name, help_text in (
+        ("fsck", "per-segment integrity report + the folded end state"),
+        ("show", "dump the record stream as JSONL"),
+        ("compact", "fold completed lifecycles into one fresh segment"),
+    ):
+        p_j = jsub.add_parser(name, help=help_text)
+        p_j.add_argument(
+            "--dir", default=None,
+            help="journal directory (default: durability.journal_dir)",
+        )
+        if name == "show":
+            p_j.add_argument(
+                "--limit", type=int, default=0,
+                help="stop after N records (0 = all)",
+            )
+
     p_rollout = sub.add_parser(
         "rollout",
         help="live policy rollout: checkpoint registry, canary gate, "
@@ -2742,6 +2906,7 @@ def main(argv: list[str] | None = None) -> int:
         "eval": cmd_eval,
         "sim": cmd_sim,
         "chaos": cmd_chaos,
+        "journal": cmd_journal,
         "rollout": cmd_rollout,
         "learn": cmd_learn,
         "fleet": cmd_fleet,

@@ -167,12 +167,27 @@ class KubeCluster:
         watch_timeout_seconds: int = 60,
         informer: bool = True,
         relist_interval_s: float = 30.0,
+        resume_rv: str | None = None,
+        rv_hook=None,
     ) -> None:
         try:
             k8s_config.load_incluster_config()
         except Exception:
             k8s_config.load_kube_config()
         self._v1 = k8s_client.CoreV1Api()
+        # Durable watch continuity (sched/journal.py): `resume_rv` seeds
+        # the FIRST pod watch stream with a journaled resourceVersion —
+        # events that arrived while the process was dead are delivered
+        # instead of skipped, and the informer's first snapshot pays one
+        # reconciling relist (it starts with no baseline, so the
+        # freshness check forces the relist by construction). An expired
+        # resume rv degrades through the normal 410 path: one fresh
+        # start plus a relist. `rv_hook(rv)` fires per pod-watch event
+        # (bookmarks included) so a journal can record the live resume
+        # point; node watches never feed it (their rv is a different
+        # resume space).
+        self._resume_rv = resume_rv
+        self.rv_hook = rv_hook
         self._watch_timeout = watch_timeout_seconds
         self._stop = threading.Event()
         # Informer cache: node facts + incremental pod->node placements
@@ -437,7 +452,8 @@ class KubeCluster:
         return getattr(exc, "status", None) == 410
 
     def _watch_cycle(
-        self, list_fn, rv: str | None, stopping, on_event, on_alive=None
+        self, list_fn, rv: str | None, stopping, on_event, on_alive=None,
+        on_rv=None,
     ) -> tuple[str | None, bool, str]:
         """ONE watch stream to completion — the rv/bookmark/410 state
         machine shared by the pod and node readers. `on_event(etype, obj)`
@@ -459,6 +475,11 @@ class KubeCluster:
                 new_rv = self._event_rv(obj)
                 if new_rv is not None:
                     rv = new_rv
+                    if on_rv is not None:
+                        try:
+                            on_rv(new_rv)
+                        except Exception:
+                            logger.exception("rv hook failed")
                 if not saw_event:
                     saw_event = True
                     if on_alive is not None:
@@ -517,12 +538,35 @@ class KubeCluster:
                         continue
 
         def reader() -> None:
-            rv: str | None = None
+            # journaled resume point (consumed exactly once: a later
+            # generator on the same cluster starts fresh — the journal's
+            # rv has gone stale the moment a live stream advanced it)
+            rv: str | None = self._resume_rv
+            self._resume_rv = None
+            if rv is not None:
+                # THE reconciling relist of the recovery protocol: the
+                # resumed stream replays only events AFTER the journaled
+                # rv, so pods already Pending before it — observed by
+                # the dead incarnation, never decided — would otherwise
+                # strand. One list re-offers current state; downstream
+                # is idempotent (the scheduler dedups in-flight pods,
+                # bound pods fail needs_scheduling), so the overlap
+                # between list and resumed stream is harmless.
+                try:
+                    for pod in self._v1.list_pod_for_all_namespaces().items:
+                        on_pod_event("ADDED", pod)
+                except Exception:
+                    logger.warning(
+                        "resume relist failed; degrading to a fresh "
+                        "watch start"
+                    )
+                    rv = None
             while not stopping():
                 was_fresh = rv is None
                 rv, saw_event, outcome = self._watch_cycle(
                     self._v1.list_pod_for_all_namespaces, rv, stopping,
                     on_pod_event, on_alive=self._mark_live,
+                    on_rv=self.rv_hook,
                 )
                 if outcome == "clean":
                     # Clean server-side timeout. With a concrete rv the
@@ -609,6 +653,41 @@ class KubeCluster:
 
     def close(self) -> None:
         self._stop.set()
+
+    def recovery_lookup(self):
+        """Recovery's cluster-truth probe (sched/recovery.PodLookup):
+        ONE list call snapshots every pod's spec.nodeName, and the
+        returned closure answers ("bound", node) / ("pending", None) /
+        ("gone", None) from it. One snapshot is correct for a whole
+        recovery pass: the restarting process is the only thing acting
+        on its open lifecycles, and each lifecycle is a distinct pod —
+        per-lookup listing would transfer the full pod set once per
+        open lifecycle for the same answers."""
+        try:
+            pods = self._v1.list_pod_for_all_namespaces().items
+        except Exception as exc:
+            raise RuntimeError(f"recovery lookup list failed: {exc}") from exc
+        nodes: dict[tuple[str, str], str | None] = {}
+        for pod in pods:
+            meta = getattr(pod, "metadata", None)
+            if meta is None:
+                continue
+            nodes[(meta.namespace, meta.name)] = pod.spec.node_name
+
+        def lookup(namespace: str, name: str) -> tuple[str, str | None]:
+            if (namespace, name) not in nodes:
+                return ("gone", None)
+            node = nodes[(namespace, name)]
+            return ("bound", node) if node else ("pending", None)
+
+        return lookup
+
+    def lookup_pod_node(
+        self, namespace: str, name: str
+    ) -> tuple[str, str | None]:
+        """One-off probe (same contract); spot checks and tests — a
+        recovery pass over many lifecycles uses recovery_lookup()."""
+        return self.recovery_lookup()(namespace, name)
 
     # ---------------------------------------------------------------- Binder
     def bind_pod_to_node(self, pod_name: str, namespace: str, node_name: str) -> bool:

@@ -347,6 +347,31 @@ DEFAULTS: dict[str, Any] = {
         # in virtual wave time)
         "tick_interval_s": 5.0,
     },
+    # Durable decision journal & crash-restart recovery (sched/journal.py,
+    # sched/recovery.py): an fsync'd write-ahead journal of the
+    # decide -> bind-intent -> bind-ack lifecycle plus the informer's
+    # watch position, replayed on start to reconcile open binds against
+    # the cluster WITHOUT re-deciding and to resume the watch from the
+    # journaled resourceVersion. Off by default: a journal-less replica
+    # is still exactly-once (the apiserver's 409 is the backstop) — the
+    # journal buys not-re-deciding, breaker continuity, and watch
+    # continuity across process death.
+    "durability": {
+        "enabled": False,
+        "journal_dir": None,
+        # "intent" fsyncs the bind-intent record (the write-ahead
+        # property binds need; ~0.7ms each) and flushes the rest;
+        # "always" fsyncs every record; "none" flushes only
+        "fsync": "intent",
+        # active-segment compaction threshold (journal rotation folds
+        # completed lifecycles away via write-aside + os.replace)
+        "segment_max_records": 4096,
+        # file-backed durable lease store (fleet/lease.FileLeaseStore)
+        # for fleet surfaces (`cli fleet demo`); null keeps the
+        # in-memory store. Production fleets map leases to k8s Lease
+        # objects instead.
+        "lease_store_path": None,
+    },
     # Multi-host JAX (parallel/distributed.py). On TPU pods the launcher
     # auto-detects coordinator/count/id (leave them null); set them
     # explicitly for manual/CPU launches. The control plane (watch/bind)
@@ -442,6 +467,11 @@ ENV_OVERRIDES: dict[str, str] = {
     "AUTOSCALE_UP_COOLDOWN_S": "autoscale.up_cooldown_s",
     "AUTOSCALE_DOWN_COOLDOWN_S": "autoscale.down_cooldown_s",
     "AUTOSCALE_TICK_INTERVAL_S": "autoscale.tick_interval_s",
+    "DURABILITY_ENABLED": "durability.enabled",
+    "DURABILITY_JOURNAL_DIR": "durability.journal_dir",
+    "DURABILITY_FSYNC": "durability.fsync",
+    "DURABILITY_SEGMENT_MAX_RECORDS": "durability.segment_max_records",
+    "DURABILITY_LEASE_STORE_PATH": "durability.lease_store_path",
     "LEARN_CORPUS_DIR": "learn.corpus_dir",
     "LEARN_REPLAY_FRACTION": "learn.replay_fraction",
     "LEARN_STEPS": "learn.steps",

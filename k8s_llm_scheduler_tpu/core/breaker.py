@@ -21,10 +21,13 @@ Improvements over the reference:
 from __future__ import annotations
 
 import enum
+import logging
 import random
 import threading
 import time
 from typing import Any, Callable, TypeVar
+
+logger = logging.getLogger(__name__)
 
 T = TypeVar("T")
 
@@ -85,6 +88,12 @@ class CircuitBreaker:
         # surfaced beside breaker state, never a state transition.
         self._slo_advisories = 0
         self._last_slo_trip: str | None = None
+        # Durable-state sink (sched/journal.py record_breaker): called
+        # with snapshot() OUTSIDE the lock after a trip or a close, so a
+        # rebooted replica can restore OPEN with its remaining cooldown
+        # instead of hammering a backend the fleet knows is down. None
+        # in non-durable deployments — one attribute read per edge.
+        self.journal_sink: Callable[[dict], None] | None = None
 
     def _set_state_locked(self, new: CircuitState) -> None:
         """THE state write (caller holds self._lock): fires on_transition
@@ -186,17 +195,23 @@ class CircuitBreaker:
 
     def record_success(self) -> None:
         with self._lock:
+            closed = False
             if self._effective_state_locked() is CircuitState.HALF_OPEN:
                 self._set_state_locked(CircuitState.CLOSED)
+                closed = True
             self._failure_count = 0
+        if closed:
+            self._journal_edge()
 
     def record_failure(self) -> None:
         with self._lock:
             self._failure_count += 1
             state = self._effective_state_locked()
+            tripped = False
             if state is CircuitState.HALF_OPEN or self._failure_count >= self.failure_threshold:
                 if self._state is not CircuitState.OPEN:
                     self.trip_count += 1
+                    tripped = True
                 self._set_state_locked(CircuitState.OPEN)
                 self._opened_at = self._clock()
                 # fresh jittered cooldown PER TRIP: re-drawing each time
@@ -205,6 +220,61 @@ class CircuitBreaker:
                 self._cooldown_s = self.timeout_seconds * (
                     1.0 + self.cooldown_jitter * self._rng.random()
                 )
+        if tripped:
+            self._journal_edge()
+
+    def _journal_edge(self) -> None:
+        """Ship a post-edge snapshot to the durable journal. Outside the
+        lock on purpose: the sink does file I/O, and snapshot() takes
+        the (non-reentrant) lock itself."""
+        sink = self.journal_sink
+        if sink is None:
+            return
+        try:
+            sink(self.snapshot())
+        except Exception:
+            # a full/closed journal must not take serving down with it
+            logger.exception("breaker journal sink failed")
+
+    def snapshot(self) -> dict[str, Any]:
+        """Restorable state: what a durable journal records on each trip
+        or close. OPEN carries its REMAINING (already-jittered) cooldown
+        so a restore resumes the countdown instead of restarting it."""
+        with self._lock:
+            state = self._effective_state_locked()
+            out: dict[str, Any] = {
+                "state": state.value,
+                "failure_count": self._failure_count,
+                "trip_count": self.trip_count,
+            }
+            if state is CircuitState.OPEN:
+                out["remaining_s"] = max(
+                    0.0,
+                    self._cooldown_s - (self._clock() - self._opened_at),
+                )
+            return out
+
+    def restore(self, snap: dict) -> None:
+        """Rehydrate from a snapshot() dict after a process restart.
+        Administrative like reset(): the restore edge is not a state-
+        machine transition, so it deliberately bypasses on_transition
+        (chaos/invariants.py judges only the machine's own walk). A
+        HALF_OPEN snapshot restores as OPEN with zero remaining
+        cooldown — the very next admission probes, which is exactly
+        what HALF_OPEN means."""
+        state = str(snap.get("state", "closed"))
+        with self._lock:
+            self._failure_count = int(snap.get("failure_count", 0))
+            self.trip_count = int(snap.get("trip_count", self.trip_count))
+            if state in (CircuitState.OPEN.value, CircuitState.HALF_OPEN.value):
+                self._state = CircuitState.OPEN
+                self._opened_at = self._clock()
+                self._cooldown_s = (
+                    max(0.0, float(snap.get("remaining_s", 0.0)))
+                    if state == CircuitState.OPEN.value else 0.0
+                )
+            else:
+                self._state = CircuitState.CLOSED
 
     def reset(self) -> None:
         with self._lock:

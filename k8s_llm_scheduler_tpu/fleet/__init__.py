@@ -28,6 +28,7 @@ from k8s_llm_scheduler_tpu.fleet.frontend import (
     PendingJoin,
 )
 from k8s_llm_scheduler_tpu.fleet.lease import (
+    FileLeaseStore,
     Lease,
     LeaseExpired,
     LeaseManager,
@@ -52,6 +53,7 @@ __all__ = [
     "AutoscaleSignals",
     "DECODE",
     "DisaggregatedBackend",
+    "FileLeaseStore",
     "Fleet",
     "FleetReplica",
     "JoinError",

@@ -144,6 +144,7 @@ class FleetReplica:
         max_concurrency: int = 64,
         snapshot_ttl_s: float = 1.0,
         list_pending: Callable[[], Sequence[RawPod]] | None = None,
+        journal: Any = None,
     ) -> None:
         self.replica_id = replica_id
         self.holder = f"replica-{replica_id}"
@@ -164,6 +165,23 @@ class FleetReplica:
             retry_delay=0.05,
         )
         n_shards = store.n_shards
+        # Durable decision journal (sched/journal.py): the bind chain
+        # becomes fence(journal(binder)) — INSIDE the fence, so a
+        # fenced-off bind never creates a recovery obligation — and the
+        # breaker journals its trips so a restart restores OPEN with its
+        # remaining cooldown. None (the default) costs nothing.
+        self.journal = journal
+        self._journaled_binder = None
+        if journal is not None:
+            from k8s_llm_scheduler_tpu.sched.recovery import JournaledBinder
+
+            self._journaled_binder = JournaledBinder(
+                binder, journal,
+                shard_fn=lambda ns, name: shard_of(ns, name, n_shards),
+                epoch_fn=self.manager.epoch_of,
+            )
+            binder = self._journaled_binder
+            self.client.breaker.journal_sink = journal.record_breaker
         self.scheduler = Scheduler(
             _ShardView(cluster, self.manager.owns, n_shards),
             _FencedBinder(
@@ -224,6 +242,34 @@ class FleetReplica:
                 self._task.cancel()
             self._task = None
         self.manager.stop(release=release_leases)
+
+    # ------------------------------------------------------------- recovery
+    async def recover(self, pod_lookup) -> dict:
+        """Crash-restart recovery (sched/recovery.py), run after a cold
+        rebuild and BEFORE start(): tick the lease manager once so the
+        fenced binder answers for our shards again (an unexpired own
+        lease renews at the SAME epoch; an expired one re-acquires under
+        a bumped epoch — either way the completion binds below run under
+        a live fence), then replay-reconcile every open journal
+        lifecycle against the cluster and restore the breaker.
+        `pod_lookup(ns, name) -> ("bound", node) | ("pending", None) |
+        ("gone", None)` is the cluster-truth probe (cluster/kube.py
+        lookup_pod_node; cluster/fake.py get_pod)."""
+        if self.journal is None:
+            return {}
+        from k8s_llm_scheduler_tpu.sched import recovery as recovery_mod
+
+        self.manager.tick()
+        crash_seam = getattr(self._journaled_binder, "crash_seam", None)
+        report = await asyncio.to_thread(
+            recovery_mod.recover,
+            self.journal,
+            pod_lookup=pod_lookup,
+            binder=self.scheduler.binder,
+            breaker=self.client.breaker,
+            crash_seam=crash_seam,
+        )
+        return report.to_dict()
 
     # -------------------------------------------------------------- rebind
     def _on_gain(self, shards: frozenset[int]) -> None:
@@ -350,6 +396,7 @@ class Fleet:
         snapshot_ttl_s: float = 1.0,
         clock=None,
         list_pending: Callable[[], Sequence[RawPod]] | None = None,
+        store: LeaseStore | None = None,
     ) -> None:
         if n_replicas < 1:
             raise ValueError("n_replicas must be >= 1")
@@ -359,7 +406,18 @@ class Fleet:
             n_shards = max(2 * n_replicas, 8)
         self.n_shards = n_shards
         kwargs = {} if clock is None else {"clock": clock}
-        self.store = LeaseStore(n_shards, ttl_s=lease_ttl_s, **kwargs)
+        if store is not None:
+            # pluggable backend (durability.lease_store_path wires a
+            # FileLeaseStore here): the caller's store must already be
+            # sized for this fleet's shard space
+            if store.n_shards != n_shards:
+                raise ValueError(
+                    f"injected lease store has {store.n_shards} shards, "
+                    f"fleet wants {n_shards}"
+                )
+            self.store = store
+        else:
+            self.store = LeaseStore(n_shards, ttl_s=lease_ttl_s, **kwargs)
         self.l2 = DecisionCache(ttl_seconds=l2_ttl_s, max_size=l2_size)
         self._backend_factory = backend_factory
         self._mk = dict(

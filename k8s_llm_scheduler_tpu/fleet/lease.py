@@ -32,10 +32,13 @@ from __future__ import annotations
 
 import dataclasses
 import hashlib
+import json
 import logging
 import math
+import os
 import threading
 import time
+from pathlib import Path
 from typing import Callable, Iterable
 
 logger = logging.getLogger(__name__)
@@ -112,6 +115,15 @@ class LeaseStore:
             "fence_rejections": 0,  # check_fence answered False
         }
 
+    # -------------------------------------------------------- backend hook
+    def _state_changed_locked(self) -> None:
+        """Called at the end of every mutating operation with self._lock
+        held — the ONE seam a durable backend overrides to persist. The
+        in-memory default is a no-op (zero cost on the test-default
+        store); FileLeaseStore writes its state file here, so both
+        backends share every line of protocol logic and can only
+        diverge in storage, never in semantics."""
+
     # ----------------------------------------------------------- chaos seam
     def _chaos_check(self, holder: str) -> None:
         """Partition gate for mutating ops: a partitioned holder's call
@@ -159,6 +171,7 @@ class LeaseStore:
             dead = [h for h, t in self._heartbeats.items() if t <= now]
             for h in dead:
                 del self._heartbeats[h]
+            self._state_changed_locked()
 
     def retract_heartbeat(self, holder: str) -> None:
         """Remove a holder's presence record immediately (clean
@@ -171,6 +184,7 @@ class LeaseStore:
         self._chaos_check(holder)
         with self._lock:
             self._heartbeats.pop(holder, None)
+            self._state_changed_locked()
 
     def live_holders(self) -> set[str]:
         """Replicas that are PRESENT: unexpired lease holders plus
@@ -247,12 +261,14 @@ class LeaseStore:
                 if lease.holder != holder:
                     return None
                 lease.expires_at = now + self.ttl_s
+                self._state_changed_locked()
                 return dataclasses.replace(lease)
             epoch = self._epochs.get(shard_id, 0) + 1
             self._epochs[shard_id] = epoch
             lease = Lease(shard_id, holder, epoch, now + self.ttl_s)
             self._leases[shard_id] = lease
             self.counters["acquisitions"] += 1
+            self._state_changed_locked()
             logger.debug(
                 "lease: shard %d -> %s (epoch %d)", shard_id, holder, epoch
             )
@@ -284,6 +300,7 @@ class LeaseStore:
                 # aging toward TTL expiry (a dropped apiserver write)
                 return dataclasses.replace(lease)
             lease.expires_at = now + self.ttl_s
+            self._state_changed_locked()
             return dataclasses.replace(lease)
 
     def release(self, shard_id: int, holder: str) -> bool:
@@ -296,6 +313,7 @@ class LeaseStore:
                 return False
             del self._leases[shard_id]
             self.counters["releases"] += 1
+            self._state_changed_locked()
             return True
 
     def gauges(self) -> dict:
@@ -317,6 +335,101 @@ class LeaseStore:
                 for h, n in sorted(holdings.items())
             },
         }
+
+
+class FileLeaseStore(LeaseStore):
+    """Durable LeaseStore backend: identical TTL/epoch-fencing semantics
+    (every protocol line is inherited — only storage differs), persisted
+    to one JSON state file with the registry's write-aside + os.replace
+    + fsync discipline (rollout/registry.py) on every mutation.
+
+    Restart semantics: epochs, leases, and heartbeats survive a process
+    death, so a restarted replica re-acquiring its own unexpired lease
+    RENEWS it (same epoch — its journaled bind intents stay fenced
+    valid), while a lease that expired during the outage re-acquires
+    under a BUMPED epoch exactly as a failover claim would. The clock
+    caveat is the caller's: lease expiry is judged on the injected
+    clock, so a durable deployment must inject a clock whose values
+    mean the same thing across restarts (the chaos harness injects its
+    virtual store clock; a production deployment maps this store to
+    Kubernetes coordination.k8s.io Lease objects, where the apiserver
+    owns the clock, and never reaches this file backend).
+
+    Mutation cost: one ~1KB atomic file write under the store lock —
+    the in-memory store stays the default everywhere latency matters;
+    this backend exists so crash-restart tests and single-node durable
+    deployments exercise the SAME semantics they would get from a
+    k8s-backed store."""
+
+    def __init__(
+        self,
+        path: str | Path,
+        n_shards: int,
+        ttl_s: float = 5.0,
+        # wall clock, NOT monotonic: persisted expires_at values must
+        # mean the same thing after a process restart or host reboot —
+        # a monotonic deadline from a long-uptime boot would read as
+        # unexpired for days on a freshly-booted host, freezing failover
+        # for the dead incarnation's shards. Tests inject virtual clocks
+        # as with the base store.
+        clock: Callable[[], float] = time.time,
+    ) -> None:
+        super().__init__(n_shards, ttl_s=ttl_s, clock=clock)
+        self.path = Path(path)
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        if self.path.exists():
+            self._load()
+
+    def _load(self) -> None:
+        with open(self.path, encoding="utf-8") as fh:
+            data = json.load(fh)
+        if int(data.get("n_shards", self.n_shards)) != self.n_shards:
+            raise ValueError(
+                f"lease store {self.path} was written for "
+                f"{data.get('n_shards')} shards, not {self.n_shards}"
+            )
+        with self._lock:
+            self._epochs = {
+                int(sid): int(epoch)
+                for sid, epoch in (data.get("epochs") or {}).items()
+            }
+            self._leases = {
+                int(sid): Lease(
+                    shard_id=int(sid),
+                    holder=rec["holder"],
+                    epoch=int(rec["epoch"]),
+                    expires_at=float(rec["expires_at"]),
+                )
+                for sid, rec in (data.get("leases") or {}).items()
+            }
+            self._heartbeats = {
+                h: float(t)
+                for h, t in (data.get("heartbeats") or {}).items()
+            }
+
+    def _state_changed_locked(self) -> None:
+        """Persist the whole (small) table atomically: write-aside,
+        fsync, one os.replace — a crash mid-write leaves the previous
+        state file intact, never a torn one."""
+        data = {
+            "n_shards": self.n_shards,
+            "epochs": {str(s): e for s, e in self._epochs.items()},
+            "leases": {
+                str(s): {
+                    "holder": l.holder,
+                    "epoch": l.epoch,
+                    "expires_at": l.expires_at,
+                }
+                for s, l in self._leases.items()
+            },
+            "heartbeats": dict(self._heartbeats),
+        }
+        tmp = self.path.with_name(self.path.name + ".tmp")
+        with open(tmp, "w", encoding="utf-8") as fh:
+            json.dump(data, fh, sort_keys=True)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, self.path)
 
 
 class LeaseManager:
@@ -532,8 +645,15 @@ class LeaseManager:
                 break
             if min_other is not None and n_held > min_other:
                 break
-            if self.store.holder_of(sid) is not None:
+            current = self.store.holder_of(sid)
+            if current is not None and current != self.holder:
                 continue
+            # free — or OUR OWN unexpired lease from a previous process
+            # incarnation (crash-restart under the same identity, found
+            # by the durable-state round): the store renews it at the
+            # SAME epoch, so journaled bind intents stay fence-valid
+            # across the restart instead of fencing off until TTL
+            # expiry re-grants the shard under a bumped epoch.
             lease = self.store.try_acquire(sid, self.holder)
             if lease is not None:
                 with self._lock:

@@ -42,6 +42,7 @@ from __future__ import annotations
 
 import json
 import logging
+import os
 import re
 from pathlib import Path
 from typing import Any
@@ -289,6 +290,27 @@ class CheckpointError(RuntimeError):
 
 
 # ------------------------------------------------------------------ orbax
+def _fsync_tree(root: Path) -> None:
+    """fsync every file and directory under `root` (and `root` itself):
+    a rename is only crash-safe once the renamed tree's CONTENT is on
+    disk — rename-then-crash with dirty pages can leave a torn tree
+    under the final name, which is exactly the window save_checkpoint's
+    bare renames used to carry (the registry's write-aside discipline,
+    rollout/registry.py, fsyncs before every publish rename)."""
+    for dirpath, _dirnames, filenames in os.walk(root):
+        for fname in filenames:
+            fd = os.open(os.path.join(dirpath, fname), os.O_RDONLY)
+            try:
+                os.fsync(fd)
+            finally:
+                os.close(fd)
+        fd = os.open(dirpath, os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+
+
 def save_checkpoint(path: str | Path, params: Params) -> None:
     """Write a native orbax checkpoint of the params pytree (overwrites —
     orbax's default refuses an existing dir AFTER a full training run has
@@ -297,7 +319,12 @@ def save_checkpoint(path: str | Path, params: Params) -> None:
     ATOMIC against crashes: orbax's force=True DELETES the existing dir
     before writing, so a save that wedges mid-transfer (measured on the
     tunneled bench host) would destroy the only snapshot a --resume run
-    depends on. Write aside, then swap."""
+    depends on. Write aside, fsync the staged tree, then swap — the
+    fsync matters as much as the rename order: a crash between a bare
+    rename and writeback would leave a TORN tree under the active name
+    (the durability round's journal/registry discipline, now here
+    too). The previous checkpoint survives as `.old` until the new one
+    is durably in place."""
     import shutil
 
     import orbax.checkpoint as ocp
@@ -309,12 +336,19 @@ def save_checkpoint(path: str | Path, params: Params) -> None:
     with ocp.StandardCheckpointer() as ckptr:
         ckptr.save(tmp, params, force=True)
         ckptr.wait_until_finished()
+    _fsync_tree(tmp)
     old = path.with_name(path.name + ".old")
     if old.exists():
         shutil.rmtree(old)
     if path.exists():
-        path.rename(old)
-    tmp.rename(path)
+        os.rename(path, old)
+    os.rename(tmp, path)
+    # make both renames durable before dropping the only fallback copy
+    fd = os.open(path.parent, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
     if old.exists():
         shutil.rmtree(old)
 

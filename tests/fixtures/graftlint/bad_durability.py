@@ -1,0 +1,46 @@
+"""Fixture corpus for the durability family: one true positive AND one
+pragma-suppressed case per rule (tests/test_graftlint.py enforces
+both)."""
+
+import json
+import os
+
+
+def writes_state_in_place(path, data):
+    with open(path, "w") as fh:  # true positive: nonatomic-state-write
+        json.dump(data, fh)
+
+
+def writes_state_in_place_suppressed(path, data):
+    with open(path, "w") as fh:  # graftlint: ok[nonatomic-state-write] — fixture: scratch file, loss is free
+        json.dump(data, fh)
+
+
+def renames_without_fsync(tmp, final):
+    os.replace(tmp, final)  # true positive: rename-without-fsync
+
+
+def path_renames_without_fsync(path, old):
+    path.rename(old)  # true positive: Path.rename shape
+
+
+def renames_without_fsync_suppressed(tmp, final):
+    os.replace(tmp, final)  # graftlint: ok[rename-without-fsync] — fixture: throwaway temp path
+
+
+def atomic_write_is_clean(path, data):
+    tmp = str(path) + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(data, fh)
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, path)
+
+
+def read_is_out_of_scope(path):
+    with open(path) as fh:
+        return json.load(fh)
+
+
+def str_replace_is_not_a_rename(name):
+    return name.replace("-", "_")

@@ -119,7 +119,7 @@ class TestFaultPlan:
     def test_every_regime_declares_a_known_mode(self):
         for name, info in REGIMES.items():
             assert info["mode"] in (
-                "single", "wire", "fleet", "autoscale"
+                "single", "wire", "fleet", "autoscale", "crash"
             ), name
 
     def test_every_regime_generates_at_minimum_waves(self):

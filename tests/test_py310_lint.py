@@ -78,6 +78,13 @@ class TestRepoIsClean:
         # class as the engine worker it extends
         assert "k8s_llm_scheduler_tpu/engine/admission/packer.py" in files
         assert "k8s_llm_scheduler_tpu/engine/admission/chunked.py" in files
+        # durability round: the decision journal + recovery protocol
+        # (thread/asyncio-crossing binder wrappers and to_thread
+        # recovery — the same 3.11+-API risk class as the scheduler
+        # loop they ride)
+        assert "k8s_llm_scheduler_tpu/sched/journal.py" in files
+        assert "k8s_llm_scheduler_tpu/sched/recovery.py" in files
+        assert "tests/test_durable.py" in files
         assert "k8s_llm_scheduler_tpu/engine/admission/pinned.py" in files
         assert "k8s_llm_scheduler_tpu/sched/delta.py" in files
         assert "tests/test_admission.py" in files

@@ -5,12 +5,14 @@ from __future__ import annotations
 
 from tools.graftlint.core import LintRule, RuleViolationError
 from tools.graftlint.rules.concurrency import CONCURRENCY_RULES
+from tools.graftlint.rules.durability import DURABILITY_RULES
 from tools.graftlint.rules.jaxpurity import JAX_RULES
 from tools.graftlint.rules.py310 import PY310_RULES
 from tools.graftlint.rules.resilience import RESILIENCE_RULES
 
 RULES: list[LintRule] = [
-    *CONCURRENCY_RULES, *JAX_RULES, *PY310_RULES, *RESILIENCE_RULES
+    *CONCURRENCY_RULES, *DURABILITY_RULES, *JAX_RULES, *PY310_RULES,
+    *RESILIENCE_RULES,
 ]
 
 

@@ -1,0 +1,160 @@
+"""durability rule family — state writes that a crash can tear.
+
+Grown alongside the durable decision journal (sched/journal.py): every
+rule here encodes a discipline the crash-restart chaos regimes prove at
+runtime, caught statically instead. The reference shape for both rules
+is rollout/registry.py — write aside, flush, ``os.fsync``, one
+``os.replace``.
+
+- **nonatomic-state-write**: an ``open(path, "w"/"wb")`` in a runtime
+  module whose enclosing function never calls
+  ``os.replace``/``os.rename``. Writing a state file in place means a
+  crash mid-write leaves a TORN file under the live name — the next
+  process reads half a JSON document and dies on parse, which is a
+  worse failure than losing the update entirely. The sanctioned shape
+  writes to a side name and publishes with one atomic rename; a
+  function containing the rename is taken to be that shape (the write
+  it contains is the write-aside half).
+- **rename-without-fsync**: an ``os.rename``/``os.replace``/
+  ``Path.rename`` call in a runtime-module function that never calls
+  ``os.fsync``/``os.fdatasync``. Rename alone orders METADATA, not
+  data: a crash after the rename but before writeback can leave a torn
+  tree under the final name (the exact window models/loader.py's
+  checkpoint swap carried until the durability round). Renames of
+  throwaway paths exist — suppress with a justified pragma.
+
+Scope: runtime modules (``k8s_llm_scheduler_tpu/``) plus the fixture
+corpus. Tests and tools write scratch files whose loss is free; bench
+output files are operator artifacts.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from tools.graftlint.core import (
+    FileContext,
+    Finding,
+    LintRule,
+    body_walk,
+    dotted_name,
+)
+
+_WRITE_MODES = ("w", "wb", "w+", "wb+", "wt")
+_RENAME_NAMES = ("os.rename", "os.replace")
+_FSYNC_NAMES = ("os.fsync", "os.fdatasync")
+
+
+def _in_scope(name: str) -> bool:
+    if name.startswith("k8s_llm_scheduler_tpu/"):
+        return True
+    # the fixture corpus stays in scope so the detectors stay testable
+    return "fixtures/graftlint" in name
+
+
+def _call_mode(node: ast.Call) -> str | None:
+    """The literal mode string of an open() call, else None."""
+    fn = dotted_name(node.func)
+    if fn not in ("open", "io.open"):
+        return None
+    mode_arg = None
+    if len(node.args) >= 2:
+        mode_arg = node.args[1]
+    for kw in node.keywords:
+        if kw.arg == "mode":
+            mode_arg = kw.value
+    if isinstance(mode_arg, ast.Constant) and isinstance(mode_arg.value, str):
+        return mode_arg.value
+    return None
+
+
+def _func_calls(func: ast.AST) -> list[ast.Call]:
+    return [n for n in body_walk(func) if isinstance(n, ast.Call)]
+
+
+def _has_call(calls: list[ast.Call], names: tuple[str, ...]) -> bool:
+    return any(dotted_name(c.func) in names for c in calls)
+
+
+class _NonAtomicStateWrite(LintRule):
+    id = "nonatomic-state-write"
+    family = "durability"
+    description = (
+        "open(path, 'w') in a runtime module with no os.replace/os.rename "
+        "in the same function — a crash mid-write tears the live file; "
+        "write aside and publish with one atomic rename"
+    )
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        if not _in_scope(ctx.name):
+            return
+        for func, _cls in ctx.functions():
+            calls = _func_calls(func)
+            if _has_call(calls, _RENAME_NAMES):
+                continue  # the write-aside half of an atomic publish
+            if any(
+                isinstance(c.func, ast.Attribute)
+                and c.func.attr in ("rename", "replace")
+                for c in calls
+            ):
+                continue  # Path.rename/replace counts as the publish too
+            for call in calls:
+                mode = _call_mode(call)
+                if mode in _WRITE_MODES:
+                    yield ctx.finding(
+                        self, call,
+                        "non-atomic state write: open(..., "
+                        f"{mode!r}) with no atomic rename in "
+                        "this function — a crash mid-write leaves a torn "
+                        "file under the live name (write aside + "
+                        "os.replace; see rollout/registry.py)",
+                    )
+
+
+class _RenameWithoutFsync(LintRule):
+    id = "rename-without-fsync"
+    family = "durability"
+    description = (
+        "os.rename/os.replace/Path.rename in a runtime-module function "
+        "with no os.fsync — rename orders metadata, not data; a crash "
+        "after the rename can leave a torn tree under the final name"
+    )
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        if not _in_scope(ctx.name):
+            return
+        for func, _cls in ctx.functions():
+            calls = _func_calls(func)
+            if _has_call(calls, _FSYNC_NAMES):
+                continue
+            # a function that delegates to a tree-fsync helper is the
+            # sanctioned shape too (models/loader._fsync_tree)
+            if any("fsync" in dotted_name(c.func) for c in calls):
+                continue
+            for call in calls:
+                fn = dotted_name(call.func)
+                # os.rename/os.replace by name; Path.rename by shape
+                # (attribute call named `rename`, exactly one positional
+                # target). Attribute `.replace` is deliberately NOT
+                # shape-matched: dataclasses.replace/str.replace share
+                # the name, and os.replace is already caught above.
+                is_rename = fn in _RENAME_NAMES or (
+                    isinstance(call.func, ast.Attribute)
+                    and call.func.attr == "rename"
+                    and len(call.args) == 1
+                    and not call.keywords
+                )
+                if is_rename:
+                    yield ctx.finding(
+                        self, call,
+                        "rename without fsync: the renamed data may not "
+                        "be on disk when the name changes — fsync the "
+                        "content first (rollout/registry.py discipline)",
+                    )
+
+
+DURABILITY_RULES: list[LintRule] = [
+    _NonAtomicStateWrite(),
+    _RenameWithoutFsync(),
+]
