@@ -70,6 +70,17 @@ def build_cfg(name: str):
             n_kv_heads=4, d_ff=1408, max_seq_len=65536, rope_theta=500000.0,
             tie_embeddings=True,
         )
+    if name == "bench-tp":
+        # The "bench" geometry with FULL kv heads: every point in the
+        # tp-serving table (2/4/8) must divide n_heads, n_kv_heads, d_ff
+        # and vocab (validate_specs_divisibility); "bench"'s kv4 caps the
+        # ladder at tp=4. Same layer count / widths otherwise, so the
+        # absolute numbers stay comparable to the rest of the suite.
+        return LlamaConfig(
+            name="bench-tp", vocab_size=1280, d_model=512, n_layers=6,
+            n_heads=8, n_kv_heads=8, d_ff=1408, max_seq_len=65536,
+            rope_theta=500000.0, tie_embeddings=True,
+        )
     return get_config(name)
 
 
@@ -211,6 +222,24 @@ PRESETS = {
     # RTT-paying sync boundaries per request — syncs/request is measured
     # for both arms and the ratio IS the dispatch-RTT reduction.
     "decode": {"pods": 64, "nodes": 32, "shapes": 8, "rounds": 3},
+    # GSPMD tensor-parallel serving plane (engine/sharded/): decisions/s
+    # + MFU table at tp = 1/2/4/8 over ONE geometry-compatible model
+    # ("bench-tp" — kv-heads widened to 8 so every point divides). Each
+    # point shards params via serving_param_specs and runs the REAL
+    # serving path (pinned prefix, paged KV, packed admission, fused
+    # decode, grammar sampling) under the mesh. rounds = measured
+    # pipelined waves per point. On a host-device mesh (CPU forced to 8
+    # devices) the absolute numbers measure XLA:CPU, not ICI — recorded
+    # as such — and the table's real assertion is the cross-tp greedy
+    # token digest, which must not drift when the layout changes.
+    "tp-serving": {"slots": 8, "rounds": 2, "max_new_tokens": 48,
+                   "temperature": 0.0},
+    # routed fast tier (sched/router.py): distill big + fast arms from
+    # the same spread-lookahead teacher (fast = half-width student),
+    # then arena-gate the routed hybrid against BOTH arms alone — the
+    # hybrid must be no worse than either arm on every gate axis, and
+    # the routing must actually MIX (both arms see decisions).
+    "router": {"rounds": 1},
 }
 
 
@@ -2037,9 +2066,9 @@ def model_throughput(
             "model": model,
             "weights": "random-init",  # architecture at random init
             "quantize": quantize,
-            # the EFFECTIVE impl: the engine silently falls back to dense
-            # on tp>1 meshes, and an A/B must not label two dense runs
-            # "dense" and "ragged"
+            # the EFFECTIVE impl (now equal to the requested one — the
+            # engine refuses ragged on tp>1 meshes at build time rather
+            # than silently serving dense under a "ragged" label)
             "decode_matmul": eng.decode_matmul,
             "slots": slots,
             "params_m": round(param_count(cfg) / 1e6, 1),
@@ -2064,6 +2093,284 @@ def model_throughput(
         out["extra"]["peak_bf16_tflops"] = peak_tflops
     del eng, params
     return out
+
+
+# ------------------------------------------------- tp serving plane (GSPMD)
+def tp_serving_bench(args) -> dict:
+    """`--preset tp-serving`: decisions/s + MFU table for the sharded
+    serving plane (engine/sharded/) at tp = 1/2/4/8.
+
+    Every point builds a FRESH engine from the same seed: params placed
+    via serving_param_specs + shard_params, paged/pinned KV
+    head-sharded, and the full serving path — prefix prefill, grammar
+    build, packed-wave admission, fused on-device decode — running
+    under the mesh. tp=1 is the unsharded engine (mesh=None), the
+    single-device baseline the sharded rows are read against.
+
+    MFU divides by tp x per-chip peak: the sharded program owns tp
+    chips, so perfect scaling holds MFU flat while decode tok/s grows.
+    On a host-device mesh there is no published peak (mfu omitted,
+    host_device_mesh recorded) and the table's load-bearing column is
+    the greedy token digest — byte-identical emissions across every tp
+    layout, the same contract tests/test_sharded.py pins at micro
+    scale."""
+    import hashlib
+
+    import jax
+
+    from k8s_llm_scheduler_tpu.engine.constrained import build_decision_dfa
+    from k8s_llm_scheduler_tpu.engine.engine import InferenceEngine
+    from k8s_llm_scheduler_tpu.engine.sharded import serving_param_specs
+    from k8s_llm_scheduler_tpu.engine.tokenizer import ByteTokenizer
+    from k8s_llm_scheduler_tpu.models.llama import init_params
+    from k8s_llm_scheduler_tpu.parallel.mesh import make_mesh
+    from k8s_llm_scheduler_tpu.parallel.sharding import shard_params
+
+    cfg = build_cfg("bench-tp")
+    tok = ByteTokenizer(vocab_size=max(512, cfg.vocab_size))
+    peak_tflops, device_kind = detect_peak_tflops(args.peak_tflops)
+    n_dev = jax.device_count()
+    host_mesh = jax.devices()[0].platform != "tpu"
+
+    slots = args.slots or 8
+    max_new = args.max_new_tokens or 48
+    n_waves = max(1, args.rounds or 2)
+    prefill_n = 1024
+    suffix_n = 200
+    names = [f"bench-node-{i:03d}" for i in range(16)]
+
+    rows = []
+    digests: list[str] = []
+    for tp in (1, 2, 4, 8):
+        if tp > n_dev:
+            rows.append({"tp": tp, "skipped": f"only {n_dev} devices"})
+            continue
+        mesh = make_mesh({"tp": tp}) if tp > 1 else None
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        if mesh is not None:
+            params = shard_params(params, mesh, serving_param_specs(cfg))
+        eng = InferenceEngine(
+            params, cfg, tok,
+            num_pages=64, page_size=64, max_slots=slots,
+            max_pages_per_seq=16,
+            prefill_buckets=(128, 256, 512, 1024),
+            chunk_steps=8, prefix_chunk=512,
+            temperature=0.0, mesh=mesh,
+        )
+        # Tiny jitted probe forces the queued chain without fetching the
+        # KV (model_throughput's sync idiom).
+        probe = jax.jit(lambda a: a[0, :1, 0, 0])
+
+        def sync_prefix():
+            jax.device_get(probe(eng._prefix.k))
+
+        eng.set_prefix(tok.encode(_synthetic_text(1, prefill_n)))  # compiles
+        sync_prefix()
+        n_prefills = 2
+        t0 = time.perf_counter()
+        for i in range(n_prefills):
+            eng.set_prefix(tok.encode(_synthetic_text(2 + i, prefill_n)))
+        sync_prefix()
+        prefill_dt = (time.perf_counter() - t0) / n_prefills
+        prefill_flops = prefill_n * (
+            matmul_flops_per_token(cfg) + attn_flops_per_token(cfg, prefill_n / 2)
+        )
+
+        eng.set_grammar(build_decision_dfa(tok, names, max_reason_tokens=40))
+        suffixes = [
+            tok.encode(_synthetic_text(100 + i, suffix_n)) for i in range(slots)
+        ]
+        eng.decide_wave(suffixes, max_new_tokens=max_new)  # compile + warm
+        c0 = dict(eng.stats)
+        t0 = time.perf_counter()
+        handles = [
+            eng.submit_wave(suffixes, max_new_tokens=max_new)
+            for _ in range(n_waves)
+        ]
+        finished = [f for h in handles for f in eng.harvest_wave(h)]
+        decode_dt = time.perf_counter() - t0
+        decode_tokens = eng.stats["decode_tokens"] - c0.get("decode_tokens", 0)
+        ctx = eng.prefix_len + suffix_n + max_new // 2
+        decode_flops = decode_tokens * (
+            matmul_flops_per_token(cfg) + attn_flops_per_token(cfg, ctx)
+        )
+        assert all(f.token_ids for f in finished), f"empty decision at tp={tp}"
+        # Order-independent digest of every emitted token sequence: the
+        # cross-tp identity column (greedy + deterministic grammar, so
+        # every layout must emit the same bytes).
+        digest = hashlib.sha256(
+            json.dumps(sorted(list(f.token_ids) for f in finished)).encode()
+        ).hexdigest()[:16]
+        digests.append(digest)
+
+        row = {
+            "tp": tp,
+            "decisions_per_s": round(len(finished) / decode_dt, 2),
+            "decode_tok_per_s": round(decode_tokens / decode_dt, 1),
+            "prefill_tok_per_s": round(prefill_n / prefill_dt, 1),
+            "wave_avg_ms": round(decode_dt / n_waves * 1000.0, 2),
+            "token_digest": digest,
+            "kv_spec": str(eng.kv.k.sharding.spec) if mesh is not None else None,
+        }
+        if peak_tflops:
+            peak = peak_tflops * 1e12 * tp  # the program owns tp chips
+            row["mfu_prefill"] = round(prefill_flops / prefill_dt / peak, 4)
+            row["mfu_decode"] = round(decode_flops / decode_dt / peak, 4)
+        rows.append(row)
+        del eng, params
+
+    measured = [r for r in rows if "skipped" not in r]
+    assert measured, "no tp point fit the device count"
+    token_identity = len(set(digests)) == 1
+    best = measured[-1]
+    return {
+        "metric": "tp_serving",
+        "value": best["decisions_per_s"],
+        "unit": f"decisions_per_s@tp{best['tp']}",
+        "extra": {
+            "model": "bench-tp",
+            "weights": "random-init",
+            "params_m": round(param_count(cfg) / 1e6, 1),
+            "device_kind": device_kind,
+            "host_device_mesh": host_mesh,
+            "n_devices": n_dev,
+            "slots": slots,
+            "max_new_tokens": max_new,
+            "waves": n_waves,
+            "prefill_tokens": prefill_n,
+            "token_identity": token_identity,
+            "peak_bf16_tflops_per_chip": peak_tflops,
+            "table": rows,
+        },
+    }
+
+
+# ------------------------------------------------------- routed hybrid gate
+def router_bench(args) -> dict:
+    """`--preset router`: distill the two serving tiers and arena-gate
+    the routed hybrid against BOTH arms alone (sched/router.py).
+
+    The big arm is the learn-micro-class config distilled from the
+    spread-lookahead teacher; the fast arm is a half-width student
+    distilled from the SAME teacher (the production shape — same
+    knowledge, less compute per decision). The hybrid routes per
+    decision class (constraint complexity, deadline budget, snapshot
+    warmth) and the preset FAILS unless it is no worse than EITHER arm
+    alone on every gate axis AND the routing actually mixed — a gate
+    where one arm never fires is an arm-vs-itself comparison, not a
+    hybrid verdict. value is the hybrid's big-route fraction."""
+    import shutil
+    import tempfile
+
+    import jax.numpy as jnp
+
+    from k8s_llm_scheduler_tpu.engine.local import build_local_backend
+    from k8s_llm_scheduler_tpu.engine.tokenizer import build_builtin_tokenizer
+    from k8s_llm_scheduler_tpu.models.configs import LlamaConfig
+    from k8s_llm_scheduler_tpu.rollout import GateConfig
+    from k8s_llm_scheduler_tpu.sched.router import (
+        RoutedBackend,
+        RouterPolicy,
+        distill_fast_checkpoint,
+        run_hybrid_gate,
+    )
+
+    seed = args.seed if args.seed is not None else 0
+    steps = int(getattr(args, "learn_steps", None) or 240)
+    tokenizer_name = "numeric"
+    big_base = LlamaConfig(
+        name="router-big", vocab_size=512, d_model=64, n_layers=2,
+        n_heads=2, n_kv_heads=1, d_ff=128, max_seq_len=4096,
+        rope_theta=10000.0, dtype=jnp.float32, tie_embeddings=True,
+    )
+    fast_base = LlamaConfig(
+        name="router-fast", vocab_size=512, d_model=32, n_layers=1,
+        n_heads=2, n_kv_heads=1, d_ff=64, max_seq_len=4096,
+        rope_theta=10000.0, dtype=jnp.float32, tie_embeddings=True,
+    )
+    _tok, big_cfg = build_builtin_tokenizer(tokenizer_name, big_base)
+    _tok, fast_cfg = build_builtin_tokenizer(tokenizer_name, fast_base)
+    work = Path(tempfile.mkdtemp(prefix="bench-router-"))
+    cache_dir = str(Path(__file__).resolve().parent / ".xla_cache")
+
+    def make_backend(cfg, ckpt):
+        return build_local_backend(
+            cfg=cfg, checkpoint_path=str(ckpt),
+            tokenizer_name=tokenizer_name,
+            temperature=0.0,  # the arena determinism contract
+            max_slots=4, num_pages=128, page_size=64,
+            max_pages_per_seq=32,
+            prefill_buckets=(256, 512, 1024, 2048),
+            chunk_steps=4, compile_cache_dir=cache_dir,
+        )
+
+    try:
+        t0 = time.perf_counter()
+        big_ckpt = distill_fast_checkpoint(
+            big_base, str(work / "big"), steps=steps, seed=seed,
+            batch_size=8, seq_len=1536, lr=1e-3,
+        )
+        fast_ckpt = distill_fast_checkpoint(
+            fast_base, str(work / "fast"), steps=steps, seed=seed + 1,
+            batch_size=8, seq_len=1536, lr=1e-3,
+        )
+        distill_s = time.perf_counter() - t0
+
+        # Arena snapshots are all cold and carry no deadline budget:
+        # zero the cold surcharge so the route splits on constraint
+        # complexity (selector pods -> big, uniform pods -> fast) —
+        # the per-decision-class axis this gate is exercising.
+        policy = RouterPolicy(big_cold_extra_ms=0.0, complexity_threshold=1)
+        hybrids: list = []
+
+        def make_hybrid():
+            rb = RoutedBackend(
+                make_backend(big_cfg, big_ckpt),
+                make_backend(fast_cfg, fast_ckpt),
+                policy,
+            )
+            hybrids.append(rb)
+            return rb
+
+        gate_cfg = GateConfig(
+            seed=seed, nodes=8, pods=24, shapes=6, waves=2,
+            spread_tolerance=0.05, wave_timeout_s=300.0,
+        )
+        t0 = time.perf_counter()
+        verdict = run_hybrid_gate(
+            lambda: make_backend(big_cfg, big_ckpt),
+            lambda: make_backend(fast_cfg, fast_ckpt),
+            make_hybrid,
+            gate_cfg,
+        )
+        gate_s = time.perf_counter() - t0
+
+        stats = dict(hybrids[0].stats_counters) if hybrids else {}
+        routed = stats.get("routed_big", 0) + stats.get("routed_fast", 0)
+        assert verdict["pass"], f"hybrid gate failed: {verdict['checks']}"
+        assert stats.get("routed_big") and stats.get("routed_fast"), (
+            f"routing did not mix (gate degenerates to arm-vs-itself): {stats}"
+        )
+        return {
+            "metric": "router_gate",
+            "value": round(stats["routed_big"] / routed, 3),
+            "unit": "big_route_frac",
+            "extra": {
+                "seed": seed,
+                "steps": steps,
+                "gate_pass": verdict["pass"],
+                "checks": verdict["checks"],
+                "scores": verdict["scores"],
+                "routing": stats,
+                "big_params_m": round(param_count(big_cfg) / 1e6, 2),
+                "fast_params_m": round(param_count(fast_cfg) / 1e6, 2),
+                "distill_s": round(distill_s, 1),
+                "gate_s": round(gate_s, 1),
+                "model": "router-big/router-fast (teacher-distilled)",
+            },
+        }
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
 
 
 # ------------------------------------------------------------ spec-vs-fused A/B
@@ -2847,6 +3154,12 @@ def main() -> None:
         return
     if args.preset == "decode":
         _emit(asyncio.run(decode_bench(args)))
+        return
+    if args.preset == "tp-serving":
+        _emit(tp_serving_bench(args))
+        return
+    if args.preset == "router":
+        _emit(router_bench(args))
         return
     result = asyncio.run(bench_preset(args))
     result["extra"]["dispatch_rtt_ms"] = measure_dispatch_rtt_ms()

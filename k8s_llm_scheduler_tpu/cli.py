@@ -112,6 +112,11 @@ def _build_stack(cfg: Config, cluster) -> Any:
     # fleet/pools.py). Wraps the (possibly fanned-out) backend so
     # admission and continuation route to distinct worker pools.
     backend = _maybe_disaggregate(backend, cfg)
+    # Per-decision routing between the big arm (everything built above)
+    # and a distilled fast tier, when configured (router.*;
+    # sched/router.py). Outermost so routing sees the decision BEFORE
+    # any pool/fan-out machinery spends big-arm capacity on it.
+    backend = _maybe_router(backend, cfg)
 
     cache = (
         DecisionCache(
@@ -605,6 +610,46 @@ def _maybe_disaggregate(backend, cfg: Config):
         prepack_max_batch=int(cfg.get("fleet.prepack_max_batch")),
         prepack_window_s=float(cfg.get("fleet.prepack_window_ms")) / 1000.0,
     )
+
+
+def _maybe_router(backend, cfg: Config):
+    """Wrap the backend in a RoutedBackend when router.enabled: the big
+    arm is whatever stack was built above (sharded local engine, fan-out,
+    disaggregated pools); the fast arm is a small distilled model served
+    locally (router.fast_model / router.fast_checkpoint). No-op when the
+    big arm is a stub — routing a stub to a stub measures nothing."""
+    if not cfg.get("router.enabled"):
+        return backend
+    if cfg.get("llm.backend") == "stub":
+        logger.warning("router.enabled ignored: llm.backend is stub")
+        return backend
+    from k8s_llm_scheduler_tpu.engine.local import build_local_backend
+    from k8s_llm_scheduler_tpu.models.configs import get_config
+    from k8s_llm_scheduler_tpu.sched.router import RoutedBackend, RouterPolicy
+
+    fast = build_local_backend(**_backend_kwargs(
+        cfg,
+        model=cfg.get("router.fast_model", "tiny"),
+        # the fast arm is deliberately single-device: its whole point is
+        # no cross-chip collectives on the latency path
+        mesh_axes=None,
+        checkpoint_path=cfg.get("router.fast_checkpoint"),
+        tokenizer_name=cfg.get("router.fast_tokenizer", "numeric"),
+        quantize=None,
+    ))
+    policy = RouterPolicy(
+        big_min_budget_ms=float(cfg.get("router.big_min_budget_ms", 120.0)),
+        big_cold_extra_ms=float(cfg.get("router.big_cold_extra_ms", 250.0)),
+        complexity_threshold=int(cfg.get("router.complexity_threshold", 2)),
+        prewarm_on_cold=bool(cfg.get("router.prewarm_on_cold", True)),
+    )
+    logger.info(
+        "routing decisions: big=%s fast=%s (min budget %.0fms, "
+        "complexity >= %d)",
+        cfg.get("llm.model", "tiny"), cfg.get("router.fast_model", "tiny"),
+        policy.big_min_budget_ms, policy.complexity_threshold,
+    )
+    return RoutedBackend(backend, fast, policy)
 
 
 def cmd_demo(args: argparse.Namespace, cfg: Config) -> int:

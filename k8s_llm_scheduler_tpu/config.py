@@ -74,7 +74,8 @@ DEFAULTS: dict[str, Any] = {
         "tokenizer": "byte",
         # block-decode matmul impl: "dense" (XLA einsums) or "ragged"
         # (ops/ragged_matmul.py — skips DFA-decided F-width padding;
-        # single-device only, tp meshes fall back to dense)
+        # single-device only: a tp>1 mesh REJECTS it at build time,
+        # use "dense" for tensor-parallel serving)
         "decode_matmul": "dense",
         # decision JSON field order: "direct" (reference order) or "cot"
         # (reasoning before the constrained node choice — the parsed
@@ -376,6 +377,22 @@ DEFAULTS: dict[str, Any] = {
     # auto-detects coordinator/count/id (leave them null); set them
     # explicitly for manual/CPU launches. The control plane (watch/bind)
     # runs only on process 0 — see SCALING.md "Multi-host".
+    "router": {
+        # Per-decision routing (sched/router.py) between the sharded big
+        # arm (the llm block's model/mesh) and a distilled fast arm.
+        "enabled": False,
+        # Fast-arm serving config + checkpoint (train/distill.py output;
+        # router.distill_fast_checkpoint publishes via the rollout
+        # registry). No checkpoint = random-init fast arm (tests only).
+        "fast_model": "tiny",
+        "fast_checkpoint": None,
+        "fast_tokenizer": "numeric",
+        # Routing thresholds (sched/router.RouterPolicy).
+        "big_min_budget_ms": 120.0,
+        "big_cold_extra_ms": 250.0,
+        "complexity_threshold": 2,
+        "prewarm_on_cold": True,
+    },
     "distributed": {
         "enabled": False,
         "coordinator": None,  # e.g. "10.0.0.2:8476"
@@ -459,6 +476,11 @@ ENV_OVERRIDES: dict[str, str] = {
     "FLEET_PREPACK_WINDOW_MS": "fleet.prepack_window_ms",
     "FLEET_PREFILL_ADDRS": "fleet.prefill_addrs",
     "FLEET_DECODE_ADDRS": "fleet.decode_addrs",
+    "ROUTER_ENABLED": "router.enabled",
+    "ROUTER_FAST_MODEL": "router.fast_model",
+    "ROUTER_FAST_CHECKPOINT": "router.fast_checkpoint",
+    "ROUTER_BIG_MIN_BUDGET_MS": "router.big_min_budget_ms",
+    "ROUTER_COMPLEXITY_THRESHOLD": "router.complexity_threshold",
     "AUTOSCALE_ENABLED": "autoscale.enabled",
     "AUTOSCALE_MIN_REPLICAS": "autoscale.min_replicas",
     "AUTOSCALE_MAX_REPLICAS": "autoscale.max_replicas",

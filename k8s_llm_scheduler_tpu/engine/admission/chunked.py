@@ -51,6 +51,7 @@ def packed_admit_step(
     constrained: bool,  # static
     prefix_impl: str | None = None,  # static
     vocab_limit: int | None = None,  # static
+    shardings=None,  # engine/sharded EngineShardings | None (tp constraints)
 ):
     """One packed admission chunk, one device program.
 
@@ -59,6 +60,13 @@ def packed_admit_step(
     slot's decode state exactly as _admit_impl does; padding end rows
     land in the reserved trash row and never activate.
     """
+    if shardings is not None:
+        # tp serving (engine/sharded): pages rank-5 / prefix + pack
+        # carry rank-4, all kv-head-sharded — pin the layout so the
+        # packed prefill partitions instead of replicating the caches.
+        k_cache, v_cache = shardings.kv5(k_cache), shardings.kv5(v_cache)
+        prefix_k, prefix_v = shardings.kv4(prefix_k), shardings.kv4(prefix_v)
+        carry_k, carry_v = shardings.kv4(carry_k), shardings.kv4(carry_v)
     end_logits, carry_k, carry_v, carry_seg, k_cache, v_cache = (
         forward_prefill_packed(
             params, cfg, tokens, seg, positions,
@@ -69,6 +77,8 @@ def packed_admit_step(
         )
     )
     E = end_idx.shape[0]
+    if shardings is not None:
+        end_logits = shardings.logits2(end_logits)
     start_vec = jnp.full((E,), dfa_start, dtype=jnp.int32)
     if constrained:
         first_new, st_new = _sample_sparse(

@@ -146,14 +146,26 @@ def _admit_impl(
     constrained: bool,  # static
     prefix_impl: str | None = None,  # static
     vocab_limit: int | None = None,  # static — see _sample_unconstrained
+    shardings=None,  # engine/sharded EngineShardings | None (tp constraints)
 ):
     """Batched admission: suffix prefill + KV scatter + first-token sample,
     one device program. Rows scatter into their slot's state; padding rows
     land in the reserved trash row (index M) and stay inactive."""
+    if shardings is not None:
+        # Pin the tp layout at the program boundary: pages and prefix KV
+        # stay kv-head-sharded through the suffix prefill + scatter —
+        # GSPMD must partition, never replicate-and-slice.
+        k_cache, v_cache = shardings.kv5(k_cache), shardings.kv5(v_cache)
+        prefix_k, prefix_v = shardings.kv4(prefix_k), shardings.kv4(prefix_v)
     last_logits, k_cache, v_cache = forward_prefill_suffix(
         params, cfg, tokens, suffix_lens, prefix_k, prefix_v, prefix_len,
         k_cache, v_cache, page_ids, prefix_impl=prefix_impl,
     )
+    if shardings is not None:
+        # Logits leave the (vocab-sharded) lm head already split on V;
+        # the constraint keeps sampling's gathers on the sharded axis
+        # instead of forcing an all-gather of [R, V] first.
+        last_logits = shardings.logits2(last_logits)
     R = tokens.shape[0]
     start_vec = jnp.full((R,), dfa_start, dtype=jnp.int32)
     if constrained:
@@ -192,6 +204,7 @@ def _decode_chunk_impl(
     paged_attn: str = "gather",  # static: "gather" | "pallas"
     shmap=None,  # static ShardedAttnImpl | None (tp-sharded paged kernel)
     vocab_limit: int | None = None,  # static — see _sample_unconstrained
+    shardings=None,  # engine/sharded EngineShardings | None (tp constraints)
 ):
     """`n_steps` decode iterations fused into one program. Emits the sampled
     token per step; finished/exhausted/idle slots emit pad_id and idle.
@@ -211,6 +224,9 @@ def _decode_chunk_impl(
     ps = k_cache.shape[2]
     n_kv, hd = cfg.n_kv_heads, cfg.head_dim
 
+    if shardings is not None:
+        k_cache, v_cache = shardings.kv5(k_cache), shardings.kv5(v_cache)
+        prefix_k, prefix_v = shardings.kv4(prefix_k), shardings.kv4(prefix_v)
     own_start = pos - prefix_len  # [M] tokens already in own pages
     if paged_attn == "pallas":
         k_own, v_own = k_cache, v_cache  # [L, num_pages, ps, n_kv, hd]
@@ -218,8 +234,14 @@ def _decode_chunk_impl(
         # Frozen own-page KV for the whole chunk: [L, M, P*ps, n_kv, hd].
         k_own = k_cache[:, page_tables].reshape(-1, M, P * ps, n_kv, hd)
         v_own = v_cache[:, page_tables].reshape(-1, M, P * ps, n_kv, hd)
+        if shardings is not None:
+            # The page gather keeps the kv-head axis intact (axis 3 both
+            # sides) — constrain so it stays a LOCAL gather per shard.
+            k_own, v_own = shardings.kv5(k_own), shardings.kv5(v_own)
     ck = jnp.zeros((cfg.n_layers, M, n_steps, n_kv, hd), k_cache.dtype)
     cv = jnp.zeros_like(ck)
+    if shardings is not None:
+        ck, cv = shardings.kv5(ck), shardings.kv5(cv)
 
     def step(carry, _):
         ck, cv, tail, tok, pos, act, st, budget, key = carry
@@ -231,6 +253,8 @@ def _decode_chunk_impl(
             own_impl="pallas" if paged_attn == "pallas" else "dense",
             shmap=shmap,
         )
+        if shardings is not None:
+            logits = shardings.logits2(logits)
         key, sub = jax.random.split(key)
         if constrained:
             nxt, new_st = _sample_sparse(
@@ -291,6 +315,7 @@ def _wave_impl(
     prefix_impl: str | None = None,  # static
     vocab_limit: int | None = None,  # static — see _sample_unconstrained
     ragged_decode: bool = False,  # static — ragged-M decode matmuls
+    shardings=None,  # engine/sharded EngineShardings | None (tp constraints)
 ):
     """One whole decision wave in ONE device program, with
     GRAMMAR-ACCELERATED BLOCK DECODING.
@@ -321,12 +346,19 @@ def _wave_impl(
     Returns (emitted [R, n_iters*F] with pad_id holes, active [R],
     iters_run scalar int32 — the number of model calls actually executed).
     """
+    if shardings is not None:
+        prefix_k, prefix_v = shardings.kv4(prefix_k), shardings.kv4(prefix_v)
     last_logits, k_sfx, v_sfx = forward_prefill_suffix_dense(
         params, cfg, tokens, suffix_lens, prefix_k, prefix_v, prefix_len,
         prefix_impl=prefix_impl,
     )
     R = tokens.shape[0]
     n_kv, hd = cfg.n_kv_heads, cfg.head_dim
+    if shardings is not None:
+        # Suffix KV [L, R, Ss, n_kv, hd] and (below) the generated-KV
+        # buffers share the rank-5 kv-head layout with the paged cache.
+        k_sfx, v_sfx = shardings.kv5(k_sfx), shardings.kv5(v_sfx)
+        last_logits = shardings.logits2(last_logits)
     st = jnp.full((R,), dfa_start, dtype=jnp.int32)
     act = suffix_lens > 0
     # emitted doubles as the generated-KV write tail: waves start with an
@@ -336,6 +368,8 @@ def _wave_impl(
 
     gk = jnp.zeros((cfg.n_layers, R, cap + 1, n_kv, hd), prefix_k.dtype)
     gv = jnp.zeros_like(gk)
+    if shardings is not None:
+        gk, gv = shardings.kv5(gk), shardings.kv5(gv)
     jcol = jnp.arange(F)
 
     def iteration(carry):
@@ -385,6 +419,9 @@ def _wave_impl(
             prefix_k, prefix_v, prefix_len, prefix_impl=prefix_impl,
             ragged=ragged_decode,
         )
+        if shardings is not None:
+            new_logits = shardings.logits2(new_logits)
+            gk, gv = shardings.kv5(gk), shardings.kv5(gv)
         carry = (
             gk, gv, s_cur, alive, emitted + blk_len,
             pos_next + blk_len, new_logits, key,
@@ -532,12 +569,28 @@ class InferenceEngine:
             if self.tokenizer.vocab_size < cfg.vocab_size
             else None
         )
+        # Kept for components that must restore/replace params with the
+        # SAME placement serving booted with (rollout/hotswap.py).
+        self.mesh = mesh
+        tp_size = mesh.shape.get("tp", 1) if mesh is not None else 1
+        # The tp serving plane (engine/sharded/plane.py): the placement +
+        # constraint authority for every device buffer this constructor
+        # allocates and every jitted program it builds. None off-mesh —
+        # all plane hooks below degrade to the single-device layout.
+        from k8s_llm_scheduler_tpu.engine.sharded import build_plane
+
+        self.plane = build_plane(mesh)
+        shardings = (
+            self.plane.engine_shardings() if self.plane is not None else None
+        )
+        self._shardings = shardings
         self.kv = PagedKVCache(
             cfg,
             num_pages=num_pages,
             page_size=page_size,
             max_slots=max_slots,
             max_pages_per_seq=max_pages_per_seq,
+            sharding=self.plane.kv_pages if self.plane is not None else None,
         )
         bad = [bkt for bkt in prefill_buckets if bkt % page_size]
         if bad:
@@ -571,10 +624,6 @@ class InferenceEngine:
                 f"unknown prefix attention impl {prefix_attn_impl!r} "
                 f"(expected 'auto', 'xla', or 'pallas')"
             )
-        # Kept for components that must restore/replace params with the
-        # SAME placement serving booted with (rollout/hotswap.py).
-        self.mesh = mesh
-        tp_size = mesh.shape.get("tp", 1) if mesh is not None else 1
         if tp_size > 1:
             from k8s_llm_scheduler_tpu.ops.attention import ShardedAttnImpl
 
@@ -588,13 +637,19 @@ class InferenceEngine:
                 f"(expected 'dense' or 'ragged')"
             )
         if decode_matmul == "ragged" and tp_size > 1:
-            # GSPMD cannot partition the pallas_call; the dense einsum
-            # path partitions fine, so multi-device serving keeps it
-            logger.info(
-                "decode_matmul='ragged' is single-device; tp=%d mesh "
-                "falls back to the dense decode path", tp_size,
+            # GSPMD cannot partition a pallas_call, so the ragged kernel
+            # cannot run over a tp-sharded activation. This used to log
+            # and silently serve the dense path — a config asking for the
+            # ragged kernel got ~none of it and no signal. Refuse at
+            # build time instead: the operator either drops the knob or
+            # serves single-device, but never ships a mesh believing the
+            # ragged path is live.
+            raise ValueError(
+                f"decode_matmul='ragged' is single-device-only (the "
+                f"pallas kernel cannot be partitioned by GSPMD) but the "
+                f"serving mesh has tp={tp_size}; use decode_matmul="
+                f"'dense' for tensor-parallel serving"
             )
-            decode_matmul = "dense"
         self.decode_matmul = decode_matmul
         chunk_shmap = (
             prefix_attn_impl
@@ -614,6 +669,7 @@ class InferenceEngine:
                 _admit_impl,
                 prefix_impl=prefix_attn_impl,
                 vocab_limit=self._vocab_limit,
+                shardings=shardings,
             ),
             static_argnums=(1, 26),
             donate_argnums=(7, 8, 11, 12, 13, 14, 15, 16),
@@ -623,6 +679,7 @@ class InferenceEngine:
                 _decode_chunk_impl,
                 shmap=chunk_shmap,
                 vocab_limit=self._vocab_limit,
+                shardings=shardings,
             ),
             static_argnums=(1, 20, 21, 22),
             donate_argnums=(2, 3, 8, 9, 10, 11, 12),
@@ -652,6 +709,7 @@ class InferenceEngine:
                 fused_decode_chunk_impl,
                 shmap=chunk_shmap,
                 vocab_limit=self._vocab_limit,
+                shardings=shardings,
             ),
             static_argnums=(1, 19, 20, 21, 22),
             donate_argnums=(2, 3, 8, 9, 10, 11, 12),
@@ -669,6 +727,7 @@ class InferenceEngine:
                 prefix_impl=prefix_attn_impl,
                 vocab_limit=self._vocab_limit,
                 ragged_decode=(decode_matmul == "ragged"),
+                shardings=shardings,
             ),
             static_argnums=(1, 18, 19, 20, 21),
         )
@@ -855,6 +914,16 @@ class InferenceEngine:
         self._grammar_wave_iters = wave_iterations(dfa, self.wave_block)
 
     # -------------------------------------------------------------- prefix
+    def _place_prefix(self, k: jax.Array, v: jax.Array) -> tuple[jax.Array, jax.Array]:
+        """Pin a dense prefix KV stack to the tp plane's head-sharded
+        layout (no-op off-mesh). Every _PrefixKV the engine caches or
+        pins goes through here, so pin/evict/truncate/rollback all
+        operate on mesh-resident buffers and the jitted programs'
+        prefix constraints are placement-true from the first dispatch."""
+        if self.plane is None:
+            return k, v
+        return self.plane.place_prefix(k), self.plane.place_prefix(v)
+
     def _get_empty_prefix(self) -> _PrefixKV:
         if self._empty_prefix is None:
             shape = (
@@ -863,9 +932,13 @@ class InferenceEngine:
                 self.cfg.n_kv_heads,
                 self.cfg.head_dim,
             )
+            k, v = self._place_prefix(
+                jnp.zeros(shape, dtype=self.cfg.dtype),
+                jnp.zeros(shape, dtype=self.cfg.dtype),
+            )
             self._empty_prefix = _PrefixKV(
-                k=jnp.zeros(shape, dtype=self.cfg.dtype),
-                v=jnp.zeros(shape, dtype=self.cfg.dtype),
+                k=k,
+                v=v,
                 length=0,
                 token_ids=(),
             )
@@ -921,6 +994,7 @@ class InferenceEngine:
             k, v = self._prefill_prefix_chunked(prompt_ids, seed=seed)
             if seed is not None:
                 prefilled = n - seed[2]  # reused tokens were not re-prefilled
+            k, v = self._place_prefix(k, v)
             pfx = _PrefixKV(k=k, v=v, length=n, token_ids=key)
         else:
             bucket = self._bucket_for(n)
@@ -930,7 +1004,8 @@ class InferenceEngine:
             _, k_all, v_all = self._prefill_kv(
                 self.params, self.cfg, jnp.asarray(tokens), jnp.asarray([n])
             )
-            pfx = _PrefixKV(k=k_all[:, 0], v=v_all[:, 0], length=n, token_ids=key)
+            k, v = self._place_prefix(k_all[:, 0], v_all[:, 0])
+            pfx = _PrefixKV(k=k, v=v, length=n, token_ids=key)
         self._prefix_cache[key] = pfx
 
         def nbytes(p: _PrefixKV) -> int:
@@ -1335,6 +1410,7 @@ class InferenceEngine:
                     packed_admit_step,
                     prefix_impl=self.prefix_attn_impl,
                     vocab_limit=self._vocab_limit,
+                    shardings=self._shardings,
                 ),
                 static_argnums=(1, 35),
                 donate_argnums=(8, 9, 10, 12, 13, 21, 22, 23, 24, 25, 26),

@@ -50,6 +50,7 @@ def fused_decode_chunk_impl(
     paged_attn: str = "gather",  # static: "gather" | "pallas"
     shmap=None,        # static ShardedAttnImpl | None
     vocab_limit: int | None = None,  # static
+    shardings=None,    # engine/sharded EngineShardings | None (tp constraints)
 ):
     """Up to `n_steps` fused decode iterations with early exit; one device
     program, zero host syncs. Returns (k_cache, v_cache, tok, pos, act,
@@ -64,14 +65,25 @@ def fused_decode_chunk_impl(
     ps = k_cache.shape[2]
     n_kv, hd = cfg.n_kv_heads, cfg.head_dim
 
+    if shardings is not None:
+        # tp serving (engine/sharded): every KV buffer the loop touches
+        # is kv-head-sharded; pinning the layout here keeps the whole
+        # while_loop partitioned — GSPMD must not replicate the pages
+        # into the loop carry.
+        k_cache, v_cache = shardings.kv5(k_cache), shardings.kv5(v_cache)
+        prefix_k, prefix_v = shardings.kv4(prefix_k), shardings.kv4(prefix_v)
     own_start = pos - prefix_len  # [M] tokens already in own pages
     if paged_attn == "pallas":
         k_own, v_own = k_cache, v_cache  # [L, num_pages, ps, n_kv, hd]
     else:
         k_own = k_cache[:, page_tables].reshape(-1, M, P * ps, n_kv, hd)
         v_own = v_cache[:, page_tables].reshape(-1, M, P * ps, n_kv, hd)
+        if shardings is not None:
+            k_own, v_own = shardings.kv5(k_own), shardings.kv5(v_own)
     ck = jnp.zeros((cfg.n_layers, M, n_steps, n_kv, hd), k_cache.dtype)
     cv = jnp.zeros_like(ck)
+    if shardings is not None:
+        ck, cv = shardings.kv5(ck), shardings.kv5(cv)
     out0 = jnp.full((M, n_steps), pad_id, dtype=jnp.int32)
 
     def cond(state):
@@ -88,6 +100,11 @@ def fused_decode_chunk_impl(
             own_impl="pallas" if paged_attn == "pallas" else "dense",
             shmap=shmap,
         )
+        if shardings is not None:
+            # Vocab-sharded logits: the dense grammar gather and top-k
+            # run on the sharded axis (sample_fused's reductions become
+            # the only cross-shard traffic of the sampling step).
+            logits = shardings.logits2(logits)
         key, sub = jax.random.split(key)
         nxt, new_st = sample_fused(
             logits, st, dense_next, sub, temperature, top_k,

@@ -66,6 +66,7 @@ class PagedKVCache:
         max_slots: int = 8,
         max_pages_per_seq: int = 64,
         dtype=None,
+        sharding=None,  # jax.sharding.NamedSharding | None — kv-head spec
     ) -> None:
         self.cfg = cfg
         self.num_pages = int(num_pages)
@@ -74,8 +75,20 @@ class PagedKVCache:
         self.max_pages_per_seq = int(max_pages_per_seq)
         dtype = dtype or cfg.dtype
         shape = (cfg.n_layers, num_pages, page_size, cfg.n_kv_heads, cfg.head_dim)
-        self.k = jnp.zeros(shape, dtype=dtype)
-        self.v = jnp.zeros(shape, dtype=dtype)
+        # On a tp mesh the pages are BORN head-sharded (parallel/sharding.
+        # kv_cache_spec via engine/sharded): each chip holds n_kv/tp heads
+        # of every page, so KV capacity scales with the group instead of
+        # replicating. The jitted mutators donate k/v, and donated outputs
+        # keep their input sharding, so placement here is placement for
+        # the cache's whole life — the host free-list/page-table
+        # bookkeeping below never looks at device layout and is unchanged.
+        self.sharding = sharding
+        if sharding is not None:
+            self.k = jax.device_put(jnp.zeros(shape, dtype=dtype), sharding)
+            self.v = jax.device_put(jnp.zeros(shape, dtype=dtype), sharding)
+        else:
+            self.k = jnp.zeros(shape, dtype=dtype)
+            self.v = jnp.zeros(shape, dtype=dtype)
         # Host-side state. Page 0 is scratch — never allocated.
         self._free = list(range(num_pages - 1, 0, -1))
         self._refcount = np.zeros(num_pages, dtype=np.int32)

@@ -1214,6 +1214,23 @@ def _attach_spec(
     )
 
 
+def _pin_quantized(params, cfg, mesh):
+    """Re-pin an int8 `{"q", "scale"}` tree to the serving plane's
+    quantization-aware specs (engine/sharded.serving_param_specs).
+
+    quantize_params runs AFTER shard_params on the tp path, and GSPMD
+    leaves the reduction-produced scale tensors wherever its solver put
+    them — layout-compatible but unspecified. Serving needs the layout
+    pinned: hot-swap restores and param donation both compare against
+    the booted placement, and an unpinned scale would make tp swaps
+    reshard on every rollout."""
+    from k8s_llm_scheduler_tpu.engine.sharded import serving_param_specs
+
+    return shard_params(
+        params, mesh, serving_param_specs(cfg, quantized=True)
+    )
+
+
 def build_local_backend(
     model: str = "tiny",
     mesh_axes: dict[str, int] | None = None,
@@ -1329,6 +1346,8 @@ def build_local_backend(
                 from k8s_llm_scheduler_tpu.models.quant import quantize_params
 
                 params = quantize_params(params)
+                if multi:
+                    params = _pin_quantized(params, cfg, mesh)
     elif multi:
         # shard bf16 first (param_specs match the unquantized tree), then
         # quantize in place — per-device bf16 residency is already 1/N
@@ -1338,6 +1357,7 @@ def build_local_backend(
             from k8s_llm_scheduler_tpu.models.quant import quantize_params
 
             params = quantize_params(params)
+            params = _pin_quantized(params, cfg, mesh)
     elif quantize == "int8":
         # single device: init + quantize HOST-SIDE, ship only int8 — even
         # per-weight bf16 device transients overflow a 16 GB chip at 8B

@@ -1,0 +1,29 @@
+"""engine/sharded — the tensor-parallel serving plane.
+
+plane.py: per-engine placement authority (KV/prefix/logits shardings,
+constraint bundle for the jitted programs, quantization-aware param
+specs). geometry.py: fleet-level slice geometry (device-group sizes
+driving the disaggregated pool split).
+"""
+
+from k8s_llm_scheduler_tpu.engine.sharded.geometry import (
+    FleetGeometry,
+    member_tp,
+)
+from k8s_llm_scheduler_tpu.engine.sharded.plane import (
+    EngineShardings,
+    ServingPlane,
+    build_plane,
+    constrain,
+    serving_param_specs,
+)
+
+__all__ = [
+    "EngineShardings",
+    "FleetGeometry",
+    "ServingPlane",
+    "build_plane",
+    "constrain",
+    "member_tp",
+    "serving_param_specs",
+]

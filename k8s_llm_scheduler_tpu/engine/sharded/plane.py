@@ -1,0 +1,189 @@
+"""The tensor-parallel serving plane: one place that knows WHERE every
+serving-side array lives on the tp mesh.
+
+The training side already had a sharding story (parallel/sharding.py
+param specs + train_step's explicit state shardings); serving grew up
+single-device and the multi-device path worked by accident of GSPMD
+propagation — params were placed, everything else (paged KV pages,
+pinned prefix KV, the fused decode chunk buffers, logits) was wherever
+XLA's solver happened to leave it, which in practice meant replicated
+KV: every chip held every head's cache, so tp=8 bought compute scaling
+but ZERO KV capacity scaling, and the 70B operating point (BASELINE
+config 3) needs both.
+
+`ServingPlane` is constructed once per engine from (mesh, axis) and
+hands out:
+
+- placements (`NamedSharding`) for the engine's device-resident state:
+  paged KV pages, prefix/pinned KV, per-slot decode scalars — used with
+  `jax.device_put` at allocation time so buffers are BORN sharded
+  instead of resharded on first touch;
+- `EngineShardings`, a frozen bundle of constraint appliers that the
+  jitted impls (`_admit_impl`, `_decode_chunk_impl`,
+  `fused_decode_chunk_impl`, `_wave_impl`, `packed_admit_step`) bind as
+  a closure constant and apply via `with_sharding_constraint` — pinning
+  the layout GSPMD must honor inside each program rather than trusting
+  propagation per-op;
+- `serving_param_specs`, the quantization-aware extension of
+  parallel/sharding.param_specs: int8 leaves are `{"q", "scale"}` dicts
+  (models/quant.py) whose q shards like the bf16 weight and whose
+  per-output-channel scale shards on the output axis only (its input
+  axis is size 1 and cannot shard).
+
+Axis convention (parallel/sharding.py): KV tensors shard on the kv-head
+axis — pages `[L, pages, page, n_kv, hd]` and chunk/own buffers
+`[L, M, S, n_kv, hd]` at axis 3, prefix `[L, S, n_kv, hd]` and packed-
+admission carry `[L, CAP, n_kv, hd]` at axis 2. Logits `[rows, V]`
+shard on vocab (the lm head / tied embedding is vocab-sharded, so this
+is the layout the matmul already produces — the constraint stops XLA
+from inserting an all-gather before sampling; the gather/argmax
+collectives run on the sharded vocab axis instead).
+
+Head-divisibility is validated up front by
+parallel/sharding.validate_specs_divisibility — a plane is only built
+for geometries that passed it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from k8s_llm_scheduler_tpu.models.quant import QUANT_KEYS
+from k8s_llm_scheduler_tpu.parallel.sharding import kv_cache_spec, param_specs
+
+Params = dict[str, Any]
+
+
+def constrain(x: jax.Array, sharding: NamedSharding | None) -> jax.Array:
+    """`with_sharding_constraint` that is a no-op off-mesh (sharding=None)."""
+    if sharding is None:
+        return x
+    return jax.lax.with_sharding_constraint(x, sharding)
+
+
+def serving_param_specs(cfg, *, quantized: bool = False, tp: str = "tp"):
+    """param_specs extended over the int8 `{"q", "scale"}` leaf structure.
+
+    The q tensor keeps the dense weight's spec ([L, in, out] — column- or
+    row-parallel per parallel/sharding.py). The per-output-channel scale
+    is [L, 1, out]: the input axis collapsed to 1 in the quantizing
+    reduction, so only the OUTPUT axis's placement survives — sharding
+    the size-1 axis would be degenerate and XLA rejects uneven size-1
+    splits on tp>1.
+    """
+    specs = param_specs(cfg, tp=tp, fsdp=None)
+    if not quantized:
+        return specs
+    layers = dict(specs["layers"])
+    for key in QUANT_KEYS:
+        parts = tuple(layers[key])
+        layers[key] = {
+            "q": layers[key],
+            "scale": P(*parts[:-2], None, parts[-1]),
+        }
+    out = dict(specs)
+    out["layers"] = layers
+    return out
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineShardings:
+    """Constraint bundle bound into the jitted serving programs.
+
+    Frozen + hashable (NamedSharding hashes) so it can ride in a
+    functools.partial closure without perturbing static_argnums
+    bookkeeping. Each apply method is a `with_sharding_constraint`:
+    it documents AND enforces the layout at that point of the program.
+    """
+
+    kv: NamedSharding         # rank-5, kv-head axis 3: pages/chunk/own/sfx
+    prefix: NamedSharding     # rank-4, kv-head axis 2: prefix + packed carry
+    logits: NamedSharding     # rank-2, vocab axis 1
+    replicated: NamedSharding  # per-slot scalar state [M]
+
+    def kv5(self, x: jax.Array) -> jax.Array:
+        return jax.lax.with_sharding_constraint(x, self.kv)
+
+    def kv4(self, x: jax.Array) -> jax.Array:
+        return jax.lax.with_sharding_constraint(x, self.prefix)
+
+    def logits2(self, x: jax.Array) -> jax.Array:
+        return jax.lax.with_sharding_constraint(x, self.logits)
+
+
+@dataclasses.dataclass(frozen=True)
+class ServingPlane:
+    """Per-engine placement authority for tp-sharded serving."""
+
+    mesh: Mesh
+    tp_axis: str = "tp"
+
+    @property
+    def tp(self) -> int:
+        return int(self.mesh.shape.get(self.tp_axis, 1))
+
+    # ---------------------------------------------------------- shardings
+    def sharding(self, spec: P) -> NamedSharding:
+        return NamedSharding(self.mesh, spec)
+
+    @property
+    def kv_pages(self) -> NamedSharding:
+        """Paged KV `[L, pages, page, n_kv, hd]` (parallel/sharding.py)."""
+        return self.sharding(kv_cache_spec(self.tp_axis))
+
+    @property
+    def prefix_kv(self) -> NamedSharding:
+        """Dense prefix / pinned-snapshot KV `[L, S, n_kv, hd]`."""
+        return self.sharding(P(None, None, self.tp_axis, None))
+
+    @property
+    def logits(self) -> NamedSharding:
+        """Row-batched logits `[rows, V]` — vocab-sharded like the lm head."""
+        return self.sharding(P(None, self.tp_axis))
+
+    @property
+    def replicated(self) -> NamedSharding:
+        return self.sharding(P())
+
+    def engine_shardings(self) -> EngineShardings:
+        return EngineShardings(
+            kv=self.kv_pages,
+            prefix=self.prefix_kv,
+            logits=self.logits,
+            replicated=self.replicated,
+        )
+
+    # ---------------------------------------------------------- placement
+    def place_kv(self, x: jax.Array) -> jax.Array:
+        """Place a paged KV buffer head-sharded at allocation time."""
+        return jax.device_put(x, self.kv_pages)
+
+    def place_prefix(self, x: jax.Array) -> jax.Array:
+        """Place (or re-pin) a dense prefix KV stack head-sharded."""
+        return jax.device_put(x, self.prefix_kv)
+
+    def place_replicated(self, x: jax.Array) -> jax.Array:
+        return jax.device_put(x, self.replicated)
+
+    # ------------------------------------------------------------- params
+    def place_params(self, params: Params, cfg, *, quantized: bool = False) -> Params:
+        """Shard a (possibly int8-quantized) param tree onto the mesh."""
+        from k8s_llm_scheduler_tpu.parallel.sharding import shard_params
+
+        specs = serving_param_specs(cfg, quantized=quantized, tp=self.tp_axis)
+        return shard_params(params, self.mesh, specs)
+
+
+def build_plane(mesh: Mesh | None, tp_axis: str = "tp") -> ServingPlane | None:
+    """The engine's constructor hook: a plane iff the mesh has a real tp
+    axis; single-device and tp=1 meshes serve unsharded (None)."""
+    if mesh is None:
+        return None
+    if int(mesh.shape.get(tp_axis, 1)) <= 1:
+        return None
+    return ServingPlane(mesh=mesh, tp_axis=tp_axis)

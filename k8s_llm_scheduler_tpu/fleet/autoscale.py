@@ -437,9 +437,19 @@ class AutoscaleController:
         if load <= 0:
             return
         share = occ["prefill"] / load
-        want_prefill = min(
-            max(1, round(total_members * share)), total_members - 1
-        )
+        split_for_share = getattr(pools, "split_for_share", None)
+        if split_for_share is not None:
+            # Device-weighted on heterogeneous fleets (engine/sharded
+            # slice geometry): the share buys whole tp groups' worth of
+            # chips, and the split lands on a device-group boundary
+            # instead of treating a tp=8 slice as one unit of capacity.
+            want_prefill = min(
+                max(1, int(split_for_share(share))), total_members - 1
+            )
+        else:
+            want_prefill = min(
+                max(1, round(total_members * share)), total_members - 1
+            )
         if want_prefill == len(pools.prefill_pool):
             return
         split = pools.set_split(want_prefill)

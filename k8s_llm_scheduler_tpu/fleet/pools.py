@@ -429,12 +429,39 @@ class DisaggregatedBackend:
                 "decode": round(mean(self.decode_pool), 4),
             }
 
+    def fleet_geometry(self) -> "FleetGeometry":
+        """The roster's slice geometry (engine/sharded/geometry.py): one
+        tp-group size per member, prefill-pool members first. Members
+        advertise via `slice_tp` or a live engine mesh; unknown = 1."""
+        from k8s_llm_scheduler_tpu.engine.sharded import FleetGeometry
+
+        with self._lock:
+            roster = [*self.prefill_pool, *self.decode_pool]
+        return FleetGeometry.of(roster)
+
+    def split_for_share(self, share: float) -> int:
+        """Prefill member count for a target DEVICE share of the fleet.
+
+        The autoscaler steers the split by occupancy share; on a
+        heterogeneous fleet a member is not a unit of capacity — a tp=8
+        slice is eight chips. This converts the share to device counts
+        and snaps to the nearest whole device-group boundary (a split
+        can move whole tp groups between pools, never a fraction of
+        one), walking the prefill-affinity ordering so the chosen
+        prefix is the same set set_split will select."""
+        return self.fleet_geometry().split_for_device_share(share)
+
     def set_split(self, n_prefill: int) -> dict[str, int]:
         """Rebalance the prefill<->decode split over the SAME member
-        roster (autoscale output #2). The roster order is stable
-        (prefill members first, then decode, as currently assigned), so
-        the same `n_prefill` always produces the same assignment —
-        membership moves are deterministic, not load-timing-chosen.
+        roster (autoscale output #2). On a heterogeneous fleet the
+        roster is ordered by slice geometry first — largest tp groups
+        take the prefill slots (prefill is compute-bound and scales
+        with group width; decode's small per-step matmuls waste wide
+        slices), stable within a size class. A uniform fleet keeps the
+        historical stable order (prefill members first, then decode, as
+        currently assigned), so in both cases the same `n_prefill`
+        always produces the same assignment — membership moves are
+        deterministic, not load-timing-chosen.
         `n_prefill` clamps to [1, members] (admission must always have
         somewhere to land; 0 decode members degrades to a pure prefill
         fleet, the pre-disaggregation behavior). Members exposing a
@@ -442,8 +469,13 @@ class DisaggregatedBackend:
         gate (check_pool_role) stays consistent with the router's view.
         In-flight work is untouched: classification is per-decision, so
         the new split applies from the next admission on."""
+        from k8s_llm_scheduler_tpu.engine.sharded import FleetGeometry
+
         with self._lock:
             roster = [*self.prefill_pool, *self.decode_pool]
+            geometry = FleetGeometry.of(roster)
+            if not geometry.uniform:
+                roster = [roster[i] for i in geometry.prefill_order()]
             n_prefill = max(1, min(int(n_prefill), len(roster)))
             new_prefill = roster[:n_prefill]
             new_decode = roster[n_prefill:]

@@ -137,7 +137,7 @@ def _decode_kernel(
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
-def paged_decode_attention_pallas(
+def paged_decode_attention_pallas(  # graftlint: ok[unconstrained-sharding] — single-device pallas kernel: the engine refuses this path on tp>1 meshes, there is nothing for GSPMD to partition
     q: jax.Array,  # [B, n_heads, head_dim] — one new token per sequence
     k_cache: jax.Array,  # [num_pages, page_size, n_kv, head_dim]
     v_cache: jax.Array,
@@ -166,7 +166,7 @@ def paged_decode_attention_pallas(
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
-def paged_decode_attention_parts(
+def paged_decode_attention_parts(  # graftlint: ok[unconstrained-sharding] — single-device pallas kernel: the engine refuses this path on tp>1 meshes, there is nothing for GSPMD to partition
     q: jax.Array,  # [B, n_heads, head_dim]
     k_cache: jax.Array,  # [num_pages, page_size, n_kv, head_dim]
     v_cache: jax.Array,

@@ -229,7 +229,7 @@ def causal_attention_supported(
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
-def flash_causal_attention_parts(
+def flash_causal_attention_parts(  # graftlint: ok[unconstrained-sharding] — single-device pallas kernel: the engine refuses this path on tp>1 meshes, there is nothing for GSPMD to partition
     q: jax.Array,  # [B, S, n_heads, hd] post-RoPE queries (UNscaled)
     k: jax.Array,  # [B, S, n_kv, hd]
     v: jax.Array,
@@ -302,7 +302,7 @@ def flash_causal_attention_parts(
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
-def flash_prefix_attention_parts(
+def flash_prefix_attention_parts(  # graftlint: ok[unconstrained-sharding] — single-device pallas kernel: the engine refuses this path on tp>1 meshes, there is nothing for GSPMD to partition
     q: jax.Array,  # [B, S, n_heads, hd] post-RoPE queries (UNscaled)
     prefix_k: jax.Array,  # [Sp, n_kv, hd] shared dense prefix KV
     prefix_v: jax.Array,

@@ -885,7 +885,7 @@ def make_agreement_probe(
         targets[i] = target
 
     @jax.jit
-    def _predict(params, tokens, lens, cand_toks):
+    def _predict(params, tokens, lens, cand_toks):  # graftlint: ok[unconstrained-sharding] — probe jit: inputs inherit the committed params' placement (shard_params at setup), no serving-path constraint needed
         logits, _, _ = forward_prefill(params, cfg, tokens, lens)
         last = logits[jnp.arange(tokens.shape[0]), lens - 1]  # [N, V]
         cand_logits = jnp.take_along_axis(
@@ -977,7 +977,7 @@ def make_cot_diagnostics(
     kind_arr = np.asarray(pos_kind)
 
     @jax.jit
-    def _preds(params, tokens, lens, row_idx, col_idx):
+    def _preds(params, tokens, lens, row_idx, col_idx):  # graftlint: ok[unconstrained-sharding] — probe jit: inputs inherit the committed params' placement (shard_params at setup), no serving-path constraint needed
         logits, _, _ = forward_prefill(params, cfg, tokens, lens)
         sel = logits[row_idx, col_idx - 1]  # predicting token at col
         return jnp.argmax(sel, axis=-1), tokens[row_idx, col_idx]

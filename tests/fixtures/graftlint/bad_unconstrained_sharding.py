@@ -1,0 +1,64 @@
+"""TRUE-POSITIVE fixture: unconstrained-sharding.
+
+Reproduces the pre-sharded-plane serving shape: a module that BUILDS a
+tp mesh (mesh-context markers present) but jits its serving programs
+with no sharding evidence anywhere — no with_sharding_constraint, no
+in_/out_shardings, no bound sharding bundle. GSPMD's default for every
+unconstrained input is REPLICATE: the program compiles, runs, and
+quietly serves each decision on every chip at tp=1 speed. The shipped
+engine threads an EngineShardings bundle through functools.partial into
+every jitted impl (engine/engine.py) — that idiom is the suppressed
+case below.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def build_serving_mesh(devices):
+    return Mesh(devices, axis_names=("tp",))
+
+
+def _decode_impl(params, tokens):
+    # BAD: runs under the tp mesh, never states a sharding — every
+    # input replicates and the matmuls never partition
+    hidden = jnp.dot(tokens, params["embed"])
+    return jnp.dot(hidden, params["head"])
+
+
+_decode = jax.jit(_decode_impl)
+
+
+def _host_sample_impl(logits, rng):
+    return jax.random.categorical(rng, logits)
+
+
+# Suppressed: the sampler consumes the decode program's ALREADY-SHARDED
+# logits; constraining again here would be a no-op — the pragma records
+# that judgment (shipped engines bind shardings= via partial instead).
+_sample = jax.jit(_host_sample_impl)  # graftlint: ok[unconstrained-sharding] — fixture: inputs arrive pre-sharded from the decode program's constrained outputs
+
+
+def _constrained_impl(params, tokens, shardings=None):
+    hidden = jnp.dot(tokens, params["embed"])
+    if shardings is not None:
+        hidden = shardings.kv4(hidden)
+    return hidden
+
+
+def good_bound_bundle(mesh, shardings):
+    """The shipped idiom: the sharding bundle rides the partial."""
+    return jax.jit(functools.partial(_constrained_impl, shardings=shardings))
+
+
+def _logits_impl(params, hidden):
+    return jnp.dot(hidden, params["head"])
+
+
+def good_out_shardings(mesh):
+    """Explicit out_shardings on the jit site is also evidence."""
+    spec = NamedSharding(mesh, P(None, "tp"))
+    return jax.jit(_logits_impl, out_shardings=spec)

@@ -596,10 +596,132 @@ class DonatedBufferReuse(LintRule):
             )
 
 
+# Names whose presence marks a module as MESH-CONTEXT: it builds or
+# consumes a device mesh, so its jitted programs run under GSPMD and
+# every per-op default is "replicate" unless somebody says otherwise.
+_MESH_MARKERS = frozenset({
+    "Mesh", "NamedSharding", "PartitionSpec", "make_mesh",
+    "mesh_from_config", "shard_map", "shard_params", "build_plane",
+    "kv_cache_spec", "serving_param_specs", "EngineShardings",
+})
+# Calls that constitute sharding evidence inside a traced function.
+_CONSTRAINT_CALLS = frozenset({
+    "with_sharding_constraint", "constrain", "device_put",
+})
+
+
+class UnconstrainedSharding(LintRule):
+    id = "unconstrained-sharding"
+    family = "jax"
+    description = (
+        "a jit root in a mesh-context module whose inputs never see a "
+        "sharding constraint — GSPMD defaults every unconstrained "
+        "intermediate to replicated, silently serializing the tp mesh"
+    )
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        # Runtime modules only (+ the fixture corpus): tests/tools jit
+        # abstract shapes whose shardings ride in ShapeDtypeStructs the
+        # AST cannot see.
+        if not _loop_scope(ctx.name):
+            return
+        if not self._mesh_context(ctx):
+            return
+        graph = _graph(ctx)
+        if not graph.roots:
+            return
+        # Local jit call sites: in_/out_shardings kwargs, or a
+        # functools.partial binding a sharding bundle by keyword
+        # (`jax.jit(functools.partial(_impl, shardings=...))` — the
+        # engine's idiom) are constraint evidence for the wrapped name.
+        constrained: set[str] = set()
+        sites: dict[str, ast.Call] = {}
+        for node in ctx.all_nodes():
+            if isinstance(node, ast.Call) and _is_jit_call(node) and node.args:
+                bare = _wrapped_bare_name(node.args[0])
+                if not bare:
+                    continue
+                if self._site_constrained(node):
+                    constrained.add(bare)
+                else:
+                    sites.setdefault(bare, node)
+        for qual in sorted(graph.roots):
+            bare = qual.rsplit(".", 1)[-1]
+            if bare in constrained:
+                continue
+            if self._reaches_constraint(graph, qual):
+                continue
+            site = sites.get(bare, graph.funcs[qual])
+            yield ctx.finding(
+                self, site,
+                f"jit root `{qual}` in a mesh-context module never "
+                f"constrains a sharding (no with_sharding_constraint/"
+                f"constrain/device_put reachable, no in_/out_shardings, "
+                f"no bound sharding bundle) — GSPMD will replicate every "
+                f"input across the mesh; thread an EngineShardings bundle "
+                f"or justify via pragma",
+            )
+
+    @staticmethod
+    def _mesh_context(ctx: FileContext) -> bool:
+        for node in ctx.all_nodes():
+            if isinstance(node, ast.ImportFrom):
+                if any(a.name in _MESH_MARKERS for a in node.names):
+                    return True
+            elif isinstance(node, (ast.Name, ast.Attribute)):
+                name = dotted_name(node)
+                if name and name.rsplit(".", 1)[-1] in _MESH_MARKERS:
+                    return True
+        return False
+
+    @staticmethod
+    def _site_constrained(call: ast.Call) -> bool:
+        if any(
+            kw.arg in ("in_shardings", "out_shardings", "in_specs", "out_specs")
+            for kw in call.keywords
+        ):
+            return True
+        wrapped = call.args[0]
+        if isinstance(wrapped, ast.Call) and dotted_name(wrapped.func) in (
+            "partial", "functools.partial",
+        ):
+            return any(
+                kw.arg and "shard" in kw.arg for kw in wrapped.keywords
+            )
+        return False
+
+    @staticmethod
+    def _reaches_constraint(graph: _ModuleGraph, root: str) -> bool:
+        seen: set[str] = set()
+        stack = [root]
+        while stack:
+            cur = stack.pop()
+            if cur in seen:
+                continue
+            seen.add(cur)
+            func = graph.funcs.get(cur)
+            if func is None:
+                continue
+            for node in body_walk(func):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = dotted_name(node.func)
+                if not name:
+                    continue
+                if name.rsplit(".", 1)[-1] in _CONSTRAINT_CALLS:
+                    return True
+                # method call on a sharding bundle: shardings.kv5(x)
+                if "shard" in name.split(".", 1)[0]:
+                    return True
+            stack.extend(graph.edges.get(cur, ()))
+        return False
+
+
 JAX_RULES: list[LintRule] = [
     HostSyncInJit(),
     ClosureMutationInJit(),
     NonHashableStatic(),
     DeviceSyncInLoop(),
     DonatedBufferReuse(),
+    UnconstrainedSharding(),
 ]
