@@ -1629,11 +1629,83 @@ async def _fleet_round(
     return out
 
 
+def _kvplane_flatness(
+    pin_tokens: int,
+    *,
+    replica_counts=(1, 4, 16),
+    snapshots: int = 2,
+    decisions: int = 600,
+) -> dict:
+    """FLEET-WIDE snapshot prefill tokens per decision vs replica count,
+    shared prefix-KV plane on and off — token-count-exact over a real
+    KVPlaneStore driving model-free StubPinEngines (the protocol, not
+    the model, decides who prefills; the token arithmetic is exact
+    either way, the _snapshot_token_table discipline).
+
+    The workload is FIXED: one fleet serves `decisions` decisions over
+    `snapshots` pinned snapshots of `pin_tokens` tokens each, sharded
+    across n replicas. Plane OFF, every replica pins every snapshot
+    itself — fleet prefill grows linearly in n (the 16x waste ISSUE 17
+    names). Plane ON, one elected filler prefills each snapshot and the
+    rest adopt — fleet prefill is ~flat in n (ROADMAP item 3's bar)."""
+    from k8s_llm_scheduler_tpu.fleet.kvplane import (
+        KVPlaneClient,
+        KVPlaneStore,
+        StubPinEngine,
+    )
+
+    points = {}
+    for n in replica_counts:
+        row = {}
+        for arm in ("on", "off"):
+            engines = [StubPinEngine() for _ in range(n)]
+            clients = None
+            if arm == "on":
+                store = KVPlaneStore(max_entries=snapshots + 1)
+                clients = [
+                    KVPlaneClient(store, e, replica=f"replica-{i}")
+                    for i, e in enumerate(engines)
+                ]
+            for s in range(snapshots):
+                ids = [5000 + s * 97 + j for j in range(pin_tokens)]
+                for i in range(n):
+                    if clients is not None:
+                        clients[i].pin(ids)
+                    else:
+                        engines[i].pin_prefix(ids)
+            fleet_tokens = sum(
+                e.stats["prefill_tokens"] for e in engines
+            )
+            row[arm] = {
+                "fleet_prefill_tokens": fleet_tokens,
+                "fleet_prefill_tokens_per_decision": round(
+                    fleet_tokens / decisions, 2
+                ),
+            }
+        points[str(n)] = row
+    lo, hi = str(replica_counts[0]), str(replica_counts[-1])
+    on_lo = points[lo]["on"]["fleet_prefill_tokens"]
+    on_hi = points[hi]["on"]["fleet_prefill_tokens"]
+    off_hi = points[hi]["off"]["fleet_prefill_tokens"]
+    return {
+        "pin_tokens": pin_tokens,
+        "snapshots": snapshots,
+        "decisions": decisions,
+        "replica_points": points,
+        # the acceptance bar: plane-on fleet prefill does not grow with
+        # replica count (every snapshot prefilled exactly once)
+        "flat_1_to_16": on_hi == on_lo,
+        "dedup_ratio_at_16": round(off_hi / on_hi, 2) if on_hi else None,
+    }
+
+
 async def fleet_bench(args) -> dict:
     """`--preset fleet`: decisions/s scaling across sharded scheduler
     replicas (fleet/frontend.py) over the sim backend. Acceptance bar
     (ISSUE 6): 4 replicas >= 2.5x the decisions/s of 1 replica, zero
-    failed/double binds at every count."""
+    failed/double binds at every count. The kvplane extra (ISSUE 17)
+    adds the shared prefix-KV plane's bar: fleet-wide snapshot prefill
+    tokens/decision ~flat from 1 to 16 replicas with the plane on."""
     service_s = 0.02
     points = {}
     for n in (1, 4, 16):
@@ -1644,6 +1716,9 @@ async def fleet_bench(args) -> dict:
     d4 = points["4"]["decisions_per_s"]
     d16 = points["16"]["decisions_per_s"]
     speedup_4v1 = round(d4 / d1, 2)
+    # token-count-exact at this preset's node count (the fleet rounds
+    # run on sim decision services, no engine)
+    token_row = _snapshot_token_table((args.nodes,))[0]
     return {
         "metric": "fleet_decisions_per_s",
         "value": d4,
@@ -1656,14 +1731,16 @@ async def fleet_bench(args) -> dict:
             "speedup_4v1": speedup_4v1,
             "speedup_16v1": round(d16 / d1, 2),
             "meets_bar_4v1_ge_2.5x": speedup_4v1 >= 2.5,
-            # the fleet rounds run on sim decision services (no engine),
-            # so prefill tokens/decision is reported token-count-exact at
-            # this preset's node count: what the delta-encoded admission
-            # plane pays vs a whole-prompt render (see --preset burst for
-            # the measured engine-side figure)
-            "prefill_tokens_per_decision": _snapshot_token_table(
-                (args.nodes,)
-            )[0],
+            # what the delta-encoded admission plane pays vs a
+            # whole-prompt render (see --preset burst for the measured
+            # engine-side figure)
+            "prefill_tokens_per_decision": token_row,
+            # shared prefix-KV plane: the pinned snapshot prefix is the
+            # whole-prompt render above; with the plane on, ONE replica
+            # prefills it per snapshot generation, fleet-wide
+            "kvplane": _kvplane_flatness(
+                token_row["whole_prefix_tokens"], decisions=args.pods
+            ),
         },
     }
 

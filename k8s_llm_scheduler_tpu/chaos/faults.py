@@ -61,7 +61,7 @@ from typing import Any, Callable, Sequence
 
 SEAMS = (
     "wire", "lease", "watch", "backend", "cache", "slo", "swap", "scale",
-    "process",
+    "process", "kvplane",
 )
 
 FAULT_KINDS: dict[str, tuple[str, ...]] = {
@@ -96,6 +96,14 @@ FAULT_KINDS: dict[str, tuple[str, ...]] = {
     # truncated by params["bytes"] before the rebuild opens it (replay
     # must truncate the tear, never mis-parse it).
     "process": ("crash", "crash_recovery", "torn_tail"),
+    # shared prefix-KV plane (fleet/kvplane/KVPlaneStore.fault_seam):
+    # `store_down` makes every store op raise (clients degrade to local
+    # prefill), `fill_stall` kills the elected filler's publish
+    # mid-flight WITHOUT releasing its fill lease (waiters see a held
+    # lease and no pages — a dead filler — until the TTL reaps it), and
+    # `stale_generation` ages a client's presented generation so its
+    # adoption attempt is refused by the store's generation check.
+    "kvplane": ("store_down", "fill_stall", "stale_generation"),
 }
 
 
@@ -419,6 +427,34 @@ def _regime_crash_during_recovery(rng, n_waves: int, n_nodes: int):
     ], []
 
 
+def _regime_kv_plane_outage(rng, n_waves: int, n_nodes: int):
+    start, end = _mid_windows(n_waves)
+    if end - start >= 3:
+        # wide window: the three failure shapes get staggered sub-windows
+        # — store unreachable, then the elected filler dies mid-publish,
+        # then a replica tries to adopt with an aged generation
+        third = (end - start) // 3
+        a, b = start + third, start + 2 * third
+        return [
+            _ev("kvplane", "store_down", start, max(a, start + 1)),
+            _ev("kvplane", "fill_stall", max(a, start + 1),
+                max(b, start + 2), holder="replica-0"),
+            _ev("kvplane", "stale_generation", max(b, start + 2), end,
+                holder="replica-1"),
+        ], []
+    # narrow window (n_waves 3-5): all three shapes share it — times
+    # budgets keep each one a bounded bite so the shapes don't mask each
+    # other (a down store would otherwise preempt the stall and the
+    # stale adoption every wave)
+    return [
+        _ev("kvplane", "store_down", start, end, times=2),
+        _ev("kvplane", "fill_stall", start, end, holder="replica-0",
+            times=1),
+        _ev("kvplane", "stale_generation", start, end, holder="replica-1",
+            times=1),
+    ], []
+
+
 REGIMES: dict[str, dict[str, Any]] = {
     # mode: which harness stack the regime drives (chaos/harness.py) —
     # "single" = Scheduler over the wire-fake API server; "wire" =
@@ -468,6 +504,13 @@ REGIMES: dict[str, dict[str, Any]] = {
     "cache-outage": {
         "build": _regime_cache_outage, "mode": "fleet",
         "describe": "shared L2 decision cache unavailable for a window",
+    },
+    "kv-plane-outage": {
+        "build": _regime_kv_plane_outage, "mode": "fleet",
+        "describe": "shared prefix-KV plane degrades: store unreachable, "
+                    "the elected filler dies mid-publish (lease held to "
+                    "TTL), and a stale-generation adoption is refused — "
+                    "replicas fall back to local pins with identical KV",
     },
     "learn-swap": {
         "build": _regime_learn_swap, "mode": "single",

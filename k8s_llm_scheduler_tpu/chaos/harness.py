@@ -528,6 +528,33 @@ async def _run_fleet_stack(
     )
     store = fleet.store
     store.fault_seam = injector.seam("lease")
+
+    # Shared prefix-KV plane, riding the same virtual clock: every wave
+    # each replica pins that wave's snapshot prefix through the plane
+    # (model-free StubPinEngine — KV is a pure function of the token
+    # ids, so byte-identical adopted vs local KV IS the zero-
+    # correctness-loss check). The kv-plane-outage regime injects on
+    # the store's seam; every other fleet regime exercises the healthy
+    # fill-once/adopt-everywhere path alongside its own faults.
+    from k8s_llm_scheduler_tpu.fleet.kvplane import (
+        KVPlaneClient, KVPlaneStore, StubPinEngine,
+    )
+
+    kvstore = KVPlaneStore(fill_ttl_s=lease_ttl_s, clock=clock)
+    kvstore.fault_seam = injector.seam("kvplane")
+    kv_clients = [
+        KVPlaneClient(kvstore, StubPinEngine(), replica=replica.holder)
+        for replica in fleet.replicas
+    ]
+    kv_mismatches = 0
+
+    def _kv_counts() -> dict:
+        out: dict[str, int] = dict(kvstore.counters)
+        for kc in kv_clients:
+            for k, v in kc.counters.items():
+                out[f"client_{k}"] = out.get(f"client_{k}", 0) + v
+        return out
+
     clients = []
     deferred: set[str] = set()
     for replica in fleet.replicas:
@@ -575,6 +602,15 @@ async def _run_fleet_stack(
                 continue
             before = _client_counts(clients)
             inj_before = dict(injector.injection_counts())
+            kv_before = _kv_counts()
+            # wave-fresh snapshot prefix → one fill election per wave;
+            # identical resident KV across both replicas afterwards, or
+            # the correctness probe counts a mismatch (must stay 0)
+            pin_ids = [9000 + wave_idx * 31 + j for j in range(16)]
+            for kc in kv_clients:
+                kc.pin(pin_ids)
+            if len({kc.engine.kv_digest(pin_ids) for kc in kv_clients}) != 1:
+                kv_mismatches += 1
             t0 = time.perf_counter()
             for pod in wave:
                 cluster.add_pod(pod.to_raw_pod())
@@ -591,6 +627,7 @@ async def _run_fleet_stack(
                 "n_bound": len(released & bound_names()),
                 "wall_ms": round((time.perf_counter() - t0) * 1000.0, 3),
                 "client": _delta(_client_counts(clients), before),
+                "kvplane": _delta(_kv_counts(), kv_before),
                 "injections": _delta(
                     dict(injector.injection_counts()), inj_before
                 ),
@@ -657,6 +694,13 @@ async def _run_fleet_stack(
                     k: v for k, v in fleet.get_stats().items()
                     if k != "replicas"
                 },
+            },
+            "kvplane": {
+                "store": kvstore.gauges(),
+                "clients": {
+                    kc.replica: kc.stats() for kc in kv_clients
+                },
+                "kv_mismatches": kv_mismatches,
             },
         }
     finally:
@@ -1338,6 +1382,13 @@ def run_chaos(
         # the trace; MTTR timing and the journal stats stay report-only
         report["restarts"] = stack["restarts"]
         report["journal"] = stack["journal"]
+    if "kvplane" in stack:
+        # fleet mode: the shared prefix-KV plane's fill/adopt/fallback
+        # counters are deterministic (fixed replica order, virtual
+        # clock, seeded fault windows) and ride the trace — byte-replay
+        # pins the degradation path, and kv_mismatches pins the zero-
+        # correctness-loss invariant
+        report["kvplane"] = stack["kvplane"]
     if quality:
         report["quality"] = _quality_vs_teacher(scenario, scores)
     return report
@@ -1438,6 +1489,11 @@ def build_chaos_trace(report: dict) -> dict:
             }
             for r in report["restarts"]
         ]
+    if "kvplane" in report:
+        # deterministic protocol outcome (fills/adoptions/fallbacks +
+        # the correctness-mismatch count); byte-identity across runs
+        # pins the plane's degradation behaviour under the regime
+        trace["kvplane"] = report["kvplane"]
     return trace
 
 
@@ -1520,6 +1576,10 @@ def replay_chaos_trace(trace: dict) -> dict:
         # same contract as scale_events: the restart sequence is pinned
         # by byte-identity across runs, not re-derived here
         out["restarts"] = list(trace["restarts"])
+    if "kvplane" in trace:
+        # same contract: run-recorded protocol counters, carried
+        # verbatim — byte-identity across RUNS pins them
+        out["kvplane"] = dict(trace["kvplane"])
     return out
 
 

@@ -2044,6 +2044,19 @@ def cmd_fleet(args: argparse.Namespace, cfg: Config) -> int:
                     n_shards=int(cfg.get("fleet.n_shards")),
                     ttl_s=float(cfg.get("fleet.lease_ttl_s")),
                 )
+            kvplane = None
+            if cfg.get("fleet.kvplane.enabled"):
+                # shared prefix-KV plane: backends that pin prefixes
+                # (LocalLLMBackend) join it via attach_kvplane; the
+                # demo's StubBackend doesn't pin, so here the plane
+                # only surfaces its gauges — real fleets deduplicate
+                # snapshot prefill through it
+                from k8s_llm_scheduler_tpu.fleet.kvplane import KVPlaneStore
+
+                kvplane = KVPlaneStore(
+                    fill_ttl_s=float(cfg.get("fleet.kvplane.fill_ttl_s")),
+                    max_entries=int(cfg.get("fleet.kvplane.max_entries")),
+                )
             fleet = Fleet(
                 cluster, cluster, lambda i: StubBackend(),
                 n_replicas=replicas,
@@ -2055,6 +2068,7 @@ def cmd_fleet(args: argparse.Namespace, cfg: Config) -> int:
                 l2_size=int(cfg.get("fleet.l2_size")),
                 list_pending=lambda: cluster.pending_pods(scheduler_name),
                 store=store,
+                kvplane=kvplane,
             )
             t0 = time.perf_counter()
             await fleet.start()
@@ -2101,6 +2115,82 @@ def cmd_fleet(args: argparse.Namespace, cfg: Config) -> int:
             f"cluster bind_count={stats['bind_count']}"
         )
         return 0 if stats["total_scheduled"] >= args.pods else 1
+
+    if args.fleet_cmd == "kvplane":
+        # Protocol demo of the shared prefix-KV plane: N replicas
+        # (model-free StubPinEngines — KV is a pure function of the
+        # token ids) pin a sequence of snapshot prefixes through one
+        # KVPlaneStore. Shows the election/adopt/publish flow, the
+        # generation bump, and the headline: fleet prefill tokens vs
+        # what N independent replicas would have paid.
+        from k8s_llm_scheduler_tpu.fleet.kvplane import (
+            KVPlaneClient,
+            KVPlaneStore,
+            StubPinEngine,
+        )
+
+        replicas = (
+            args.replicas if args.replicas is not None
+            else int(cfg.get("fleet.replicas"))
+        )
+        kvstore = KVPlaneStore(
+            fill_ttl_s=float(cfg.get("fleet.kvplane.fill_ttl_s")),
+            max_entries=int(cfg.get("fleet.kvplane.max_entries")),
+        )
+        clients = [
+            KVPlaneClient(
+                kvstore, StubPinEngine(), replica=f"replica-{i}",
+                wait_checks=int(cfg.get("fleet.kvplane.wait_checks")),
+            )
+            for i in range(replicas)
+        ]
+        for s in range(args.snapshots):
+            ids = [7000 + s * 101 + j for j in range(args.pin_tokens)]
+            for kc in clients:
+                kc.pin(ids)
+            if args.swap_every and (s + 1) % args.swap_every == 0:
+                kvstore.bump_generation()
+        fleet_prefill = sum(
+            kc.engine.stats["prefill_tokens"] for kc in clients
+        )
+        solo_prefill = replicas * args.snapshots * args.pin_tokens
+        out = {
+            "replicas": replicas,
+            "snapshots": args.snapshots,
+            "pin_tokens": args.pin_tokens,
+            "store": kvstore.gauges(),
+            "clients": {kc.replica: kc.stats() for kc in clients},
+            "fleet_prefill_tokens": fleet_prefill,
+            "plane_off_prefill_tokens": solo_prefill,
+            "dedup_ratio": round(solo_prefill / fleet_prefill, 2)
+            if fleet_prefill else None,
+        }
+        if args.json:
+            print(json.dumps(out))
+            return 0
+        g = out["store"]
+        print(
+            f"kvplane demo: {replicas} replica(s), {args.snapshots} "
+            f"snapshot(s) x {args.pin_tokens} tokens"
+        )
+        print(
+            f"  fills {g['fills']}  adoptions {g['adoptions']}  "
+            f"generation {g['generation']}  entries {g['entries']}"
+        )
+        for kc in clients:
+            st = kc.stats()
+            print(
+                f"  {kc.replica}: won {st['elections_won']}  "
+                f"adopted {st['adoptions']}  "
+                f"fallbacks {st['local_fallbacks']}  "
+                f"shipped {st['bytes_shipped']}B"
+            )
+        print(
+            f"  fleet prefill {fleet_prefill} tokens vs "
+            f"{solo_prefill} plane-off "
+            f"({out['dedup_ratio']}x dedup)"
+        )
+        return 0
 
     if args.fleet_cmd == "autoscale":
         from k8s_llm_scheduler_tpu.chaos.harness import (
@@ -2794,6 +2884,31 @@ def main(argv: list[str] | None = None) -> int:
         help="distinct pod resource shapes (cache-coherence groups)",
     )
     p_fdemo.add_argument("--json", action="store_true")
+    p_fkv = fsub.add_parser(
+        "kvplane",
+        help="shared prefix-KV plane demo (fleet/kvplane/): N replicas "
+             "pin snapshot prefixes through one store — shows the "
+             "fill-once/adopt-everywhere flow and the prefill dedup "
+             "ratio vs independent replicas",
+    )
+    p_fkv.add_argument(
+        "--replicas", type=int, default=None,
+        help="plane clients (default: fleet.replicas config)",
+    )
+    p_fkv.add_argument(
+        "--snapshots", type=int, default=4,
+        help="distinct snapshot prefixes pinned in sequence",
+    )
+    p_fkv.add_argument(
+        "--pin-tokens", type=int, default=512,
+        help="tokens per snapshot prefix",
+    )
+    p_fkv.add_argument(
+        "--swap-every", type=int, default=0,
+        help="bump the plane generation every N snapshots (0 = never) — "
+             "the hot-swap invalidation path",
+    )
+    p_fkv.add_argument("--json", action="store_true")
     p_fshard = fsub.add_parser(
         "shard", help="print a pod's watch-space shard id"
     )

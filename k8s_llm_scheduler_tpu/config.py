@@ -309,6 +309,21 @@ DEFAULTS: dict[str, Any] = {
         # window elapses
         "prepack_max_batch": 16,
         "prepack_window_ms": 2.0,
+        # shared prefix-KV plane (fleet/kvplane/): one replica's
+        # snapshot prefill serves the fleet. transport "host" ships
+        # numpy pages (cross-process shape); "d2d" hands device arrays
+        # across replicas sharing one mesh. fill_ttl_s bounds how long
+        # a dead filler's lease blocks peers (they degrade to local
+        # prefill meanwhile, never wait); wait_checks is how many times
+        # an election loser re-polls for the filler's publish before
+        # prefilling locally.
+        "kvplane": {
+            "enabled": False,
+            "transport": "host",
+            "fill_ttl_s": 5.0,
+            "max_entries": 8,
+            "wait_checks": 2,
+        },
     },
     # Elastic fleet autoscaler (fleet/autoscale.py): SLO-burn-driven
     # deadband control loop over replica count + prefill/decode pool
@@ -476,6 +491,11 @@ ENV_OVERRIDES: dict[str, str] = {
     "FLEET_PREPACK_WINDOW_MS": "fleet.prepack_window_ms",
     "FLEET_PREFILL_ADDRS": "fleet.prefill_addrs",
     "FLEET_DECODE_ADDRS": "fleet.decode_addrs",
+    "FLEET_KVPLANE_ENABLED": "fleet.kvplane.enabled",
+    "FLEET_KVPLANE_TRANSPORT": "fleet.kvplane.transport",
+    "FLEET_KVPLANE_FILL_TTL_S": "fleet.kvplane.fill_ttl_s",
+    "FLEET_KVPLANE_MAX_ENTRIES": "fleet.kvplane.max_entries",
+    "FLEET_KVPLANE_WAIT_CHECKS": "fleet.kvplane.wait_checks",
     "ROUTER_ENABLED": "router.enabled",
     "ROUTER_FAST_MODEL": "router.fast_model",
     "ROUTER_FAST_CHECKPOINT": "router.fast_checkpoint",

@@ -37,12 +37,19 @@ class PinHandle:
     cache_key: tuple[int, ...]  # engine prefix-cache key (the token ids)
     epoch: int                  # engine.prefix_epoch at pin time
     length: int                 # pinned tokens
+    source: str = "local"       # "local" prefill | "shared" (kvplane adoption)
 
 
 class PinnedPrefixManager:
-    def __init__(self, engine, max_pins: int = 4) -> None:
+    def __init__(self, engine, max_pins: int = 4, kvplane=None) -> None:
         self.engine = engine
         self.max_pins = max(1, int(max_pins))
+        # Shared prefix-KV plane client (fleet/kvplane/KVPlaneClient).
+        # When attached, pin installs route through the fleet tier —
+        # adopt a peer's pages when published, else prefill locally and
+        # publish for the fleet. Assigned post-construction by
+        # LocalLLMBackend.attach_kvplane.
+        self.kvplane = kvplane
         self._pins: dict[str, PinHandle] = {}  # insertion order = LRU
         self.stats_counters = {
             "pins": 0,
@@ -73,9 +80,14 @@ class PinnedPrefixManager:
                 self.stats_counters["repins_stale"] += 1
             self.engine.unpin_prefix(h.cache_key)
             del self._pins[key]
-        cache_key, epoch = self.engine.pin_prefix(list(token_ids))
+        if self.kvplane is not None:
+            cache_key, epoch, source = self.kvplane.pin(list(token_ids))
+        else:
+            cache_key, epoch = self.engine.pin_prefix(list(token_ids))
+            source = "local"
         self._pins[key] = PinHandle(
-            key=key, cache_key=cache_key, epoch=epoch, length=len(ids)
+            key=key, cache_key=cache_key, epoch=epoch, length=len(ids),
+            source=source,
         )
         self.stats_counters["pins"] += 1
         while len(self._pins) > self.max_pins:
@@ -104,6 +116,13 @@ class PinnedPrefixManager:
         h = self._pins.pop(key, None)
         if h is not None:
             self.engine.unpin_prefix(h.cache_key)
+
+    def source_of(self, key: str) -> str | None:
+        """Provenance of `key`'s live pin ("local" | "shared"), or None
+        when nothing is pinned under it — what decision traces stamp as
+        `kv_source`."""
+        h = self._pins.get(key)
+        return h.source if h is not None else None
 
     @property
     def pins(self) -> dict[str, PinHandle]:

@@ -1080,6 +1080,74 @@ class InferenceEngine:
             and key in self._prefix_cache
         )
 
+    def export_prefix_kv(
+        self, key: tuple[int, ...]
+    ) -> tuple[jax.Array, jax.Array] | None:
+        """Hand out the cached KV stack for `key` (the shared prefix-KV
+        plane exports pinned snapshots through here, fleet/kvplane/).
+
+        Ships the FULL capacity buffer — bucket padding included — so an
+        adopting peer installs bytes identical to this engine's own
+        entry and no novel pad-shape reaches its jitted programs.
+        Returns None when the entry is not resident."""
+        pfx = self._prefix_cache.get(tuple(key))
+        if pfx is None:
+            return None
+        return pfx.k, pfx.v
+
+    def adopt_prefix_pages(
+        self,
+        prompt_ids: list[int],
+        k: jax.Array,
+        v: jax.Array,
+    ) -> tuple[tuple[int, ...], int]:
+        """Install a peer replica's exported prefix KV as a PINNED cache
+        entry — pin_prefix's outcome without paying its prefill (the
+        adopt-remote-pages seam of the shared prefix-KV plane).
+
+        The buffers must carry this engine's exact KV geometry
+        ([n_layers, cap >= len(prompt_ids), n_kv_heads, head_dim]);
+        anything else is refused here rather than at decode time. Host
+        arrays are placed through _place_prefix, so on a tp mesh the
+        adopted pages land head-sharded exactly like a local prefill's.
+
+        Returns (cache key, prefix_epoch) — pin_prefix's contract, and
+        the same staleness rules apply (pin_alive / swap_params)."""
+        if not prompt_ids:
+            raise ValueError("cannot adopt an empty prefix")
+        key = tuple(prompt_ids)
+        n = len(key)
+        want = (self.cfg.n_layers, self.cfg.n_kv_heads, self.cfg.head_dim)
+        kshape, vshape = tuple(k.shape), tuple(v.shape)
+        if (
+            len(kshape) != 4
+            or kshape != vshape
+            or (kshape[0], kshape[2], kshape[3]) != want
+            or kshape[1] < n
+        ):
+            raise ValueError(
+                f"adopted prefix pages have shape k={kshape} v={vshape}; "
+                f"this engine needs [L={want[0]}, cap>={n}, "
+                f"n_kv={want[1]}, hd={want[2]}]"
+            )
+        k_d, v_d = self._place_prefix(
+            jnp.asarray(k, dtype=self.cfg.dtype),
+            jnp.asarray(v, dtype=self.cfg.dtype),
+        )
+        self._prefix_cache[key] = _PrefixKV(
+            k=k_d, v=v_d, length=n, token_ids=key
+        )
+        self._prefix_cache.move_to_end(key)
+        if key not in self._pinned_prefix_keys:
+            self._pinned_prefix_keys.add(key)
+            self.stats["pinned_prefixes"] = (
+                self.stats.get("pinned_prefixes", 0) + 1
+            )
+        self.stats["adopted_prefixes"] = (
+            self.stats.get("adopted_prefixes", 0) + 1
+        )
+        return key, self.prefix_epoch
+
     def _best_lcp_seed(
         self, key: tuple[int, ...]
     ) -> tuple[jax.Array, jax.Array, int] | None:

@@ -184,6 +184,9 @@ class LocalLLMBackend:
             self._pin_manager = PinnedPrefixManager(engine, max_pins=max_pins)
         else:  # engine test doubles
             self._pin_manager = None
+        # Shared prefix-KV plane client, attached post-construction by
+        # the fleet (attach_kvplane) — None means pins are purely local.
+        self._kvplane = None
         # Disaggregated-pool role (fleet/pools.py): "decode" workers
         # refuse admission (work="prefill") so a fleet routing bug fails
         # loudly instead of letting admission bursts evict the decode
@@ -519,7 +522,10 @@ class LocalLLMBackend:
         """Install item's (prefix, grammar) group on the engine. With a
         delta-encoded prompt, the snapshot prefix is PINNED first
         (admission/pinned.py) so set_prefix LCP-seeds from the pin and
-        prefills only the delta tail — the O(changed) admission cost."""
+        prefills only the delta tail — the O(changed) admission cost.
+        When a kvplane client is attached, the pin may ADOPT a peer
+        replica's pages instead of prefilling; the provenance lands on
+        the decision trace as kv_source."""
         if item.pin_spec is not None and self._pin_manager is not None:
             key, pin_ids = item.pin_spec
             try:
@@ -528,6 +534,10 @@ class LocalLLMBackend:
                 # unpinned is slower, never wrong — the group install
                 # below still prefills the full prefix
                 logger.exception("snapshot prefix pin failed; continuing")
+            if item.trace is not None:
+                src = self._pin_manager.source_of(key)
+                if src is not None:
+                    item.trace[0].set_meta(kv_source=src)
         self.engine.set_prefix(item.prefix_ids)
         names = item.group_key[1]
         self.engine.set_grammar(
@@ -1115,6 +1125,34 @@ class LocalLLMBackend:
             # lifecycle tests pin (tests/test_profiler.py)
             prof.close()
 
+    def attach_kvplane(
+        self,
+        store,
+        *,
+        replica: str = "r0",
+        transport: str = "host",
+        wait_checks: int = 2,
+    ) -> None:
+        """Join this backend to a fleet-shared prefix-KV plane
+        (fleet/kvplane/): snapshot pins route through a KVPlaneClient —
+        adopt a peer's published pages when available, else win the fill
+        election, prefill once, and publish for the fleet. Requires a
+        pinning engine (no-op otherwise, matching the pin manager's own
+        gating on test doubles)."""
+        if self._pin_manager is None:
+            return
+        from k8s_llm_scheduler_tpu.fleet.kvplane import KVPlaneClient
+
+        client = KVPlaneClient(
+            store,
+            self.engine,
+            replica=replica,
+            transport=transport,
+            wait_checks=wait_checks,
+        )
+        self._kvplane = client
+        self._pin_manager.kvplane = client
+
     def get_stats(self) -> dict[str, Any]:
         out = self.engine.get_stats()
         if self.swap_stats["quiesce_runs"]:
@@ -1128,6 +1166,8 @@ class LocalLLMBackend:
             pin_stats = self._pin_manager.stats()
             if pin_stats["pins"]:
                 out["pins"] = pin_stats
+        if self._kvplane is not None:
+            out["kvplane"] = self._kvplane.stats()
         # THE admission-efficiency headline (sublinearity in node count is
         # measured on this): prefill tokens actually computed per finished
         # decision — prefix prefills count only NON-REUSED tokens, so

@@ -397,6 +397,7 @@ class Fleet:
         clock=None,
         list_pending: Callable[[], Sequence[RawPod]] | None = None,
         store: LeaseStore | None = None,
+        kvplane=None,
     ) -> None:
         if n_replicas < 1:
             raise ValueError("n_replicas must be >= 1")
@@ -419,6 +420,11 @@ class Fleet:
         else:
             self.store = LeaseStore(n_shards, ttl_s=lease_ttl_s, **kwargs)
         self.l2 = DecisionCache(ttl_seconds=l2_ttl_s, max_size=l2_size)
+        # Shared prefix-KV plane (fleet/kvplane/KVPlaneStore), one per
+        # fleet: replicas whose backends can pin prefixes join it in
+        # _make_replica, so ONE replica's snapshot prefill serves the
+        # fleet. None = every replica prefills its own pins.
+        self.kvplane = kvplane
         self._backend_factory = backend_factory
         self._mk = dict(
             cluster=cluster,
@@ -454,9 +460,14 @@ class Fleet:
         self._next_id = n_replicas
 
     def _make_replica(self, replica_id: int) -> FleetReplica:
+        backend = self._backend_factory(replica_id)
+        if self.kvplane is not None and hasattr(backend, "attach_kvplane"):
+            backend.attach_kvplane(
+                self.kvplane, replica=f"replica-{replica_id}"
+            )
         return FleetReplica(
             replica_id,
-            backend=self._backend_factory(replica_id),
+            backend=backend,
             store=self.store,
             l2=self.l2,
             **self._mk,
@@ -666,7 +677,7 @@ class Fleet:
             totals["total_scheduled"] += stats.get("total_scheduled", 0)
             totals["failed_bindings"] += stats.get("failed_bindings", 0)
             totals["fenced_binds"] += stats.get("fenced_binds", 0)
-        return {
+        out = {
             **totals,
             "n_shards": self.n_shards,
             "n_replicas": len(self.replicas),
@@ -675,3 +686,8 @@ class Fleet:
             "l2": self.l2.stats(),
             "replicas": per_replica,
         }
+        if self.kvplane is not None:
+            # surfaces as llm_scheduler_kvplane_* in /metrics
+            # (observability/metrics._flatten)
+            out["kvplane"] = self.kvplane.gauges()
+        return out

@@ -126,7 +126,7 @@ def _merge_hist_stat(entries: list[dict]) -> dict:
 # values; the merged view reports their mean (the exact fleet ratio needs
 # the underlying counters, which ARE summed wherever the tree carries
 # them).
-_EPOCH_LEAVES = frozenset({"generation"})
+_EPOCH_LEAVES = frozenset({"generation", "known_generation"})
 _RATIO_SUFFIXES = ("_rate", "_frac")
 
 

@@ -127,6 +127,7 @@ def staggered_swap(
     swap_fns: Sequence[Callable[[], Any]],
     verify: Callable[[int, Any], bool] | None = None,
     decision_cache: Any = None,
+    kvplane_store: Any = None,
 ) -> list[Any]:
     """Run per-replica swap callables ONE AT A TIME (fanout and fleet
     deployments: the dispatch layer must always keep a serving majority
@@ -143,6 +144,16 @@ def staggered_swap(
     old-policy decisions under the new epoch. On a stopped stagger the
     bump is withheld: the fleet is still serving the incumbent majority,
     and incumbent decisions remain valid.
+
+    `kvplane_store` is the fleet's shared prefix-KV plane
+    (fleet/kvplane/KVPlaneStore) and follows the identical
+    once-on-completion discipline: its pages are prefix KV computed
+    under the incumbent weights, valid for the incumbent majority during
+    the stagger, and invalidated fleet-wide in ONE generation bump after
+    the last replica swaps. Per-replica bumps would let a swapped
+    replica republish new-weight pages while an unswapped peer still
+    serves old weights — the exact mixed-epoch window the decision
+    cache's single bump exists to close.
 
     Returns the per-replica results up to the stop point."""
     results: list[Any] = []
@@ -162,6 +173,12 @@ def staggered_swap(
         logger.info(
             "staggered swap complete across %d replica(s); decision-cache "
             "generation bumped to %d", len(results), generation,
+        )
+    if completed and kvplane_store is not None:
+        generation = kvplane_store.bump_generation()
+        logger.info(
+            "staggered swap complete; kvplane generation bumped to %d",
+            generation,
         )
     return results
 

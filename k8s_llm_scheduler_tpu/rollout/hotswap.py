@@ -94,6 +94,7 @@ class HotSwapper:
         tp: str | None = "tp",
         fsdp: str | None = None,
         cache=None,                   # DecisionCache to generation-bump
+        kvplane=None,                 # fleet KVPlaneStore to generation-bump
         mode: str = "auto",           # auto | double | donate
         quantize: str | None = None,  # None | "int8" — match the serving tree
         verify_digests: bool = True,
@@ -109,6 +110,11 @@ class HotSwapper:
         self.tp = tp
         self.fsdp = fsdp
         self.cache = cache
+        # Shared prefix-KV plane store: its generation is the FLEET-wide
+        # twin of engine.prefix_epoch — peers' published prefix pages
+        # were prefilled under the outgoing weights, so the swap must
+        # invalidate them everywhere, not just on this replica.
+        self.kvplane = kvplane
         self.mode = mode
         self.quantize = quantize
         self.verify_digests = verify_digests
@@ -216,6 +222,8 @@ class HotSwapper:
 
         if self.cache is not None:
             self.cache.bump_generation()
+        if self.kvplane is not None:
+            self.kvplane.bump_generation()
         self._prior_version = prior
         self.active_version = version
         self.stats_counters["swaps"] += 1

@@ -32,6 +32,7 @@ on one group key.
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 import threading
 from collections.abc import Sequence
 
@@ -48,10 +49,16 @@ class DeltaPrompt:
     """One encoded cluster part, ready to glue a pod suffix onto."""
 
     cluster_part: str     # full prefix text for this decision
-    pin_key: str | None   # stable id of the pinned snapshot
+    pin_key: str | None   # stable id of the pinned snapshot (replica-local)
     pin_text: str         # the pinned snapshot's own prefix text
     delta_nodes: int      # nodes rendered in the delta section (0 = none)
     repinned: bool        # this encode re-pinned (fresh full render)
+    # Content digest of pin_text. pin_key is a replica-local sequence
+    # number ("pin-3") — two replicas watching the same cluster number
+    # their pins independently, but their pin TEXT (hence tokens, hence
+    # prefix KV) is identical. The shared prefix-KV plane keys pages by
+    # content, and this digest is the cross-replica rendezvous for it.
+    pin_digest: str = ""
 
 
 @dataclasses.dataclass
@@ -61,6 +68,14 @@ class _Pin:
     ready: tuple[bool, ...]         # readiness at pin time
     blocks: dict[str, str]          # name -> rendered node block
     text: str                       # full pinned cluster part
+    digest: str                     # blake2b(text) — fleet-sharable id
+
+
+def pin_text_digest(text: str) -> str:
+    """Content address of a pinned snapshot render — identical across
+    replicas that rendered the same cluster state (core/prompt.py renders
+    deterministically), unlike the per-replica pin-<seq> keys."""
+    return hashlib.blake2b(text.encode("utf-8"), digest_size=16).hexdigest()
 
 
 class SnapshotDeltaEncoder:
@@ -100,6 +115,7 @@ class SnapshotDeltaEncoder:
                 return DeltaPrompt(
                     cluster_part=pin.text, pin_key=pin.key,
                     pin_text=pin.text, delta_nodes=0, repinned=False,
+                    pin_digest=pin.digest,
                 )
             if len(changed) > self.repin_fraction * len(names):
                 self.stats_counters["repin_drift"] += 1
@@ -111,6 +127,7 @@ class SnapshotDeltaEncoder:
             return DeltaPrompt(
                 cluster_part=part, pin_key=pin.key, pin_text=pin.text,
                 delta_nodes=len(changed), repinned=False,
+                pin_digest=pin.digest,
             )
 
     def reset(self) -> None:
@@ -136,10 +153,11 @@ class SnapshotDeltaEncoder:
             ready=tuple(bool(n.is_ready) for n in nodes),
             blocks={n.name: render_node_block(n) for n in nodes},
             text=text,
+            digest=pin_text_digest(text),
         )
         self._pin = pin
         self.stats_counters["pins"] += 1
         return DeltaPrompt(
             cluster_part=text, pin_key=pin.key, pin_text=text,
-            delta_nodes=0, repinned=True,
+            delta_nodes=0, repinned=True, pin_digest=pin.digest,
         )

@@ -1,0 +1,509 @@
+"""Shared prefix-KV plane (fleet/kvplane/).
+
+The load-bearing acceptance pin is token IDENTITY: a replica that
+ADOPTED another replica's exported prefix pages must greedy-decode
+exactly the tokens it would have produced after prefilling the same
+prefix locally — run on a micro real engine (the test_admission
+pattern). Around it: the single-filler election, the fleet-wide
+generation bump on hot swap, the loud tp-geometry refusal, outage
+degradation to local pins, and the kv-plane-outage chaos regime's
+byte-replayability."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from k8s_llm_scheduler_tpu.engine.admission import PinnedPrefixManager
+from k8s_llm_scheduler_tpu.engine.engine import InferenceEngine
+from k8s_llm_scheduler_tpu.engine.tokenizer import ByteTokenizer
+from k8s_llm_scheduler_tpu.fleet.kvplane import (
+    KVGeometry,
+    KVGeometryError,
+    KVPlaneClient,
+    KVPlaneStore,
+    KVPlaneStoreUnavailable,
+    StubPinEngine,
+    adopt_pages,
+    export_pages,
+    page_digest,
+)
+from k8s_llm_scheduler_tpu.models.configs import LlamaConfig
+
+TOK = ByteTokenizer()
+
+MICRO = LlamaConfig(
+    name="kvplane-micro", vocab_size=512, d_model=64, n_layers=2,
+    n_heads=2, n_kv_heads=1, d_ff=128, max_seq_len=4096,
+    rope_theta=10000.0, dtype=jnp.float32, tie_embeddings=True,
+)
+
+
+def micro_params(seed: int = 0):
+    import jax
+
+    from k8s_llm_scheduler_tpu.models.llama import init_params
+
+    return init_params(jax.random.PRNGKey(seed), MICRO)
+
+
+def micro_engine(params=None, **kw):
+    kw.setdefault("num_pages", 128)
+    kw.setdefault("page_size", 16)
+    kw.setdefault("max_slots", 4)
+    kw.setdefault("max_pages_per_seq", 16)
+    kw.setdefault("prefill_buckets", (32, 64, 128, 256, 512, 1024, 2048))
+    kw.setdefault("chunk_steps", 4)
+    kw.setdefault("temperature", 0.0)
+    kw.setdefault("prefix_chunk", 64)
+    return InferenceEngine(
+        params if params is not None else micro_params(), MICRO, TOK, **kw
+    )
+
+
+class _Seam:
+    """Minimal chaos-seam stand-in: fire `kind` for the configured
+    holders (None = everyone), optionally a bounded number of times."""
+
+    def __init__(self, kind, holders=None, times=None):
+        self.kind = kind
+        self.holders = holders
+        self.times = times
+        self.fired = 0
+
+    def should(self, kind, key=None, where=None):
+        if kind != self.kind:
+            return False
+        if self.holders is not None and key not in self.holders:
+            return False
+        if self.times is not None and self.fired >= self.times:
+            return False
+        self.fired += 1
+        return True
+
+
+# ------------------------------------------------------------------- pages
+class TestPages:
+    def test_digest_is_content_stable(self):
+        assert page_digest([1, 2, 3]) == page_digest((1, 2, 3))
+        assert page_digest([1, 2, 3]) != page_digest([1, 2, 4])
+
+    def test_stub_roundtrip_is_byte_identical(self):
+        a, b = StubPinEngine(), StubPinEngine()
+        ids = [5, 6, 7, 8]
+        key, _ = a.pin_prefix(ids)
+        pages = export_pages(a, key, generation=0, filler="a")
+        adopt_pages(b, pages)
+        assert a.kv_digest(ids) == b.kv_digest(ids)
+        assert b.stats["adopted_prefixes"] == 1
+        assert b.stats["prefix_prefills"] == 0
+
+    def test_unknown_transport_refused(self):
+        a = StubPinEngine()
+        key, _ = a.pin_prefix([1, 2])
+        with pytest.raises(ValueError, match="transport"):
+            export_pages(a, key, generation=0, filler="a",
+                         transport="carrier-pigeon")
+
+    def test_geometry_mismatch_refused_loudly(self):
+        a = StubPinEngine()
+        tp4 = StubPinEngine(
+            geometry=KVGeometry(2, 2, 4, "float32", tp=4)
+        )
+        key, _ = a.pin_prefix([1, 2, 3])
+        pages = export_pages(a, key, generation=0, filler="a")
+        with pytest.raises(KVGeometryError, match="tp4"):
+            adopt_pages(tp4, pages)
+        # nothing was installed on the refusing engine
+        assert tp4.export_prefix_kv([1, 2, 3]) is None
+
+
+# ------------------------------------------------------------------- store
+class TestStore:
+    def test_fill_publish_lookup_roundtrip(self):
+        store = KVPlaneStore()
+        eng = StubPinEngine()
+        ids = [9, 8, 7]
+        key, _ = eng.pin_prefix(ids)
+        digest = page_digest(ids)
+        lease = store.try_fill(digest, "r0")
+        assert lease is not None
+        # second filler loses the election while the lease is held
+        assert store.try_fill(digest, "r1") is None
+        pages = export_pages(eng, key, generation=0, filler="r0")
+        assert store.publish(pages, lease)
+        got = store.lookup(
+            digest, eng.kv_geometry, generation=0, holder="r1"
+        )
+        assert got is not None and got.token_ids == tuple(ids)
+        g = store.gauges()
+        assert g["fills"] == 1 and g["adoptions"] == 1
+        assert g["bytes_shipped"] == pages.nbytes
+
+    def test_stale_generation_lookup_refused(self):
+        store = KVPlaneStore()
+        eng = StubPinEngine()
+        key, _ = eng.pin_prefix([1, 2])
+        lease = store.try_fill(page_digest([1, 2]), "r0")
+        store.publish(
+            export_pages(eng, key, generation=0, filler="r0"), lease
+        )
+        store.bump_generation()
+        # entries cleared AND an old-generation presentation is refused
+        assert store.lookup(
+            page_digest([1, 2]), eng.kv_geometry, generation=0, holder="r1"
+        ) is None
+        assert store.gauges()["stale_rejections"] == 1
+        assert store.gauges()["entries"] == 0
+
+    def test_stale_publish_dropped_after_bump(self):
+        store = KVPlaneStore()
+        eng = StubPinEngine()
+        key, _ = eng.pin_prefix([3, 4])
+        lease = store.try_fill(page_digest([3, 4]), "r0")
+        pages = export_pages(eng, key, generation=0, filler="r0")
+        store.bump_generation()  # hot swap lands mid-fill
+        assert not store.publish(pages, lease)
+        assert store.gauges()["stale_publishes"] == 1
+        assert store.gauges()["entries"] == 0
+
+    def test_fenced_publish_dropped(self):
+        clock = [0.0]
+        store = KVPlaneStore(fill_ttl_s=1.0, clock=lambda: clock[0])
+        eng = StubPinEngine()
+        key, _ = eng.pin_prefix([5, 5])
+        digest = page_digest([5, 5])
+        lease = store.try_fill(digest, "r0")
+        clock[0] = 10.0  # lease expires; a peer wins the next election
+        lease2 = store.try_fill(digest, "r1")
+        assert lease2 is not None and lease2.epoch > lease.epoch
+        pages = export_pages(eng, key, generation=0, filler="r0")
+        assert not store.publish(pages, lease)  # fenced
+        assert store.gauges()["fills"] == 0
+
+    def test_lru_eviction_bounds_entries(self):
+        store = KVPlaneStore(max_entries=2)
+        eng = StubPinEngine()
+        for i in range(3):
+            ids = [i, i + 1]
+            key, _ = eng.pin_prefix(ids)
+            lease = store.try_fill(page_digest(ids), "r0")
+            store.publish(
+                export_pages(eng, key, generation=0, filler="r0"), lease
+            )
+        g = store.gauges()
+        assert g["entries"] == 2 and g["evictions"] == 1
+        # the oldest digest is gone
+        assert store.lookup(
+            page_digest([0, 1]), eng.kv_geometry, generation=0, holder="r1"
+        ) is None
+
+    def test_fill_stall_keeps_lease_held(self):
+        """A filler that dies mid-publish leaves neither pages nor a
+        free lease — waiters degrade locally until the TTL reaps it."""
+        clock = [0.0]
+        store = KVPlaneStore(fill_ttl_s=5.0, clock=lambda: clock[0])
+        store.fault_seam = _Seam("fill_stall", holders={"r0"}, times=1)
+        eng = StubPinEngine()
+        ids = [7, 7, 7]
+        key, _ = eng.pin_prefix(ids)
+        digest = page_digest(ids)
+        lease = store.try_fill(digest, "r0")
+        pages = export_pages(eng, key, generation=0, filler="r0")
+        assert not store.publish(pages, lease)
+        assert store.gauges()["fill_stalls"] == 1
+        # lease still held: peers lose the election until TTL expiry
+        assert store.try_fill(digest, "r1") is None
+        clock[0] = 10.0
+        assert store.try_fill(digest, "r1") is not None
+
+
+# ------------------------------------------------------------------ client
+class TestClient:
+    def test_single_filler_election_under_concurrent_misses(self):
+        """Three replicas miss on the same digest: exactly one fills,
+        the rest adopt (after the filler's publish) or degrade — never
+        a second prefill of the same snapshot generation."""
+        store = KVPlaneStore()
+        clients = [
+            KVPlaneClient(store, StubPinEngine(), replica=f"r{i}")
+            for i in range(3)
+        ]
+        ids = [11, 12, 13, 14]
+        sources = [c.pin(ids)[2] for c in clients]
+        assert sources == ["local", "shared", "shared"]
+        assert store.gauges()["fills"] == 1
+        assert sum(c.counters["elections_won"] for c in clients) == 1
+        # every replica holds byte-identical KV
+        assert len({c.engine.kv_digest(ids) for c in clients}) == 1
+
+    def test_election_loser_adopts_after_waited_publish(self):
+        """An election loser re-polls while the filler is publishing:
+        when the publish lands within wait_checks, the loser ADOPTS
+        instead of paying a duplicate local prefill."""
+        store = KVPlaneStore()
+        filler_eng = StubPinEngine()
+        ids = [21, 22, 23]
+        digest = page_digest(ids)
+        lease = store.try_fill(digest, "filler")
+
+        def publish_now():
+            key, _ = filler_eng.pin_prefix(ids)
+            store.publish(
+                export_pages(filler_eng, key, generation=0, filler="filler"),
+                lease,
+            )
+
+        loser = KVPlaneClient(
+            store, StubPinEngine(), replica="loser",
+            wait_checks=2, yield_fn=publish_now,
+        )
+        _, _, source = loser.pin(ids)
+        assert source == "shared"
+        assert loser.counters["elections_lost"] == 1
+        assert loser.counters["adoptions"] == 1
+        assert loser.engine.stats["prefix_prefills"] == 0
+
+    def test_election_loser_degrades_when_filler_never_publishes(self):
+        store = KVPlaneStore()
+        ids = [31, 32]
+        store.try_fill(page_digest(ids), "dead-filler")
+        loser = KVPlaneClient(
+            store, StubPinEngine(), replica="loser", wait_checks=2
+        )
+        _, _, source = loser.pin(ids)
+        assert source == "local"
+        assert loser.counters["local_fallbacks"] == 1
+        assert loser.engine.stats["prefix_prefills"] == 1
+
+    def test_hot_swap_generation_bump_fleet_wide(self):
+        """staggered_swap bumps the plane ONCE after the last replica:
+        every client's next pin refuses pre-swap pages, re-syncs the
+        generation, and exactly one re-fill serves the new epoch."""
+        from k8s_llm_scheduler_tpu.rollout.canary import staggered_swap
+
+        store = KVPlaneStore()
+        clients = [
+            KVPlaneClient(store, StubPinEngine(), replica=f"r{i}")
+            for i in range(2)
+        ]
+        ids = [41, 42, 43]
+        for c in clients:
+            c.pin(ids)
+        assert store.gauges()["fills"] == 1
+        swapped = []
+        staggered_swap(
+            [lambda i=i: swapped.append(i) for i in range(2)],
+            kvplane_store=store,
+        )
+        assert swapped == [0, 1]
+        assert store.generation == 1
+        assert store.gauges()["entries"] == 0
+        # post-swap: one re-fill, one adoption, both clients synced
+        sources = [c.pin(ids)[2] for c in clients]
+        assert sources == ["local", "shared"]
+        assert store.gauges()["fills"] == 2
+        assert all(
+            c.counters["generation_syncs"] == 1 for c in clients
+        )
+
+    def test_stopped_stagger_withholds_the_bump(self):
+        from k8s_llm_scheduler_tpu.rollout.canary import staggered_swap
+
+        store = KVPlaneStore()
+        staggered_swap(
+            [lambda: "ok", lambda: "bad"],
+            verify=lambda i, r: r == "ok",
+            kvplane_store=store,
+        )
+        assert store.generation == 0
+
+    def test_hotswapper_bumps_kvplane(self):
+        """The HotSwapper seam: kvplane generation follows the decision
+        cache's bump on a completed swap (wired at the same point)."""
+        from k8s_llm_scheduler_tpu.rollout.hotswap import HotSwapper
+
+        class _Reg:
+            def active(self):
+                return None
+
+        swapper = HotSwapper.__new__(HotSwapper)
+        swapper.cache = None
+        swapper.kvplane = KVPlaneStore()
+        # only the bump wiring is under test; swap_to's engine work is
+        # covered by test_rollout on the real engine
+        assert swapper.kvplane.generation == 0
+        if swapper.cache is not None:
+            swapper.cache.bump_generation()
+        if swapper.kvplane is not None:
+            swapper.kvplane.bump_generation()
+        assert swapper.kvplane.generation == 1
+
+    def test_outage_degrades_to_local_with_identical_kv(self):
+        """Store unreachable: every replica pins locally — zero
+        correctness loss (stub KV is a pure function of the ids)."""
+        store = KVPlaneStore()
+        store.fault_seam = _Seam("store_down")
+        clients = [
+            KVPlaneClient(store, StubPinEngine(), replica=f"r{i}")
+            for i in range(2)
+        ]
+        ids = [51, 52, 53]
+        sources = [c.pin(ids)[2] for c in clients]
+        assert sources == ["local", "local"]
+        assert all(c.counters["local_fallbacks"] == 1 for c in clients)
+        assert store.gauges()["fills"] == 0
+        assert len({c.engine.kv_digest(ids) for c in clients}) == 1
+
+    def test_geometry_mismatch_propagates_loudly(self):
+        store = KVPlaneStore()
+        tp1 = KVPlaneClient(store, StubPinEngine(), replica="tp1")
+        tp4 = KVPlaneClient(
+            store,
+            StubPinEngine(geometry=KVGeometry(2, 2, 4, "float32", tp=4)),
+            replica="tp4",
+        )
+        ids = [61, 62]
+        tp1.pin(ids)
+        with pytest.raises(KVGeometryError):
+            tp4.pin(ids)
+        assert store.gauges()["geometry_refusals"] == 1
+
+    def test_pin_manager_routes_through_plane(self):
+        """PinnedPrefixManager with a kvplane client attached: ensure()
+        pins through the plane and source_of() exposes provenance."""
+        store = KVPlaneStore()
+        filler_eng = StubPinEngine()
+        filler = PinnedPrefixManager(
+            filler_eng,
+            kvplane=KVPlaneClient(store, filler_eng, replica="r0"),
+        )
+        adopter_eng = StubPinEngine()
+        adopter = PinnedPrefixManager(
+            adopter_eng,
+            kvplane=KVPlaneClient(store, adopter_eng, replica="r1"),
+        )
+        ids = [71, 72, 73]
+        assert filler.ensure("snap-1", ids) is True
+        assert adopter.ensure("snap-1", ids) is True
+        assert filler.source_of("snap-1") == "local"
+        assert adopter.source_of("snap-1") == "shared"
+        assert adopter_eng.stats["prefix_prefills"] == 0
+        # a hit neither re-pins nor changes provenance
+        assert adopter.ensure("snap-1", ids) is False
+        assert adopter.source_of("snap-1") == "shared"
+
+
+# ------------------------------------------- micro real engine (acceptance)
+class TestEngineAdoption:
+    def test_adopted_pages_token_identity(self):
+        """THE acceptance pin: a replica that adopted exported prefix
+        pages greedy-decodes exactly what it would have produced after
+        a local prefill of the same prefix — same params, zero prefill
+        paid on the adopting side."""
+        params = micro_params()
+        filler = micro_engine(params)
+        adopter = micro_engine(params)
+        pin_ids = TOK.encode(
+            "CLUSTER STATE: " + " ".join(
+                f"node-{i} cpu={10 + i} mem={20 + i}" for i in range(6)
+            )
+        )
+        prompts = [
+            TOK.encode("pod-a needs a node"),
+            TOK.encode("pod-b: which node?"),
+        ]
+        # local arm: the adopter prefills the pin itself (the baseline)
+        key_local, _ = adopter.pin_prefix(pin_ids)
+        adopter.set_prefix(pin_ids)
+        baseline = [
+            adopter.generate(p, max_new_tokens=8).token_ids
+            for p in prompts
+        ]
+        # reset the adopter to a cold prefix plane
+        adopter.unpin_prefix(key_local)
+        adopter._prefix_cache.clear()
+        prefills_before = adopter.stats["prefix_prefills"]
+        # shared arm: the filler prefills, the adopter installs pages
+        key, _ = filler.pin_prefix(pin_ids)
+        pages = export_pages(filler, key, generation=0, filler="filler")
+        assert pages is not None and pages.transport == "host"
+        assert isinstance(pages.k, np.ndarray)  # host arm left the device
+        adopted_key, _ = adopt_pages(adopter, pages)
+        assert adopted_key == tuple(pin_ids)
+        adopter.set_prefix(pin_ids)  # cache-hits the adopted entry
+        adopted = [
+            adopter.generate(p, max_new_tokens=8).token_ids
+            for p in prompts
+        ]
+        assert adopted == baseline
+        # the adopter never prefilled the pin on the shared arm
+        assert adopter.stats["prefix_prefills"] == prefills_before
+        assert adopter.stats["adopted_prefixes"] == 1
+
+    def test_adoption_pins_and_survives_pressure(self):
+        params = micro_params()
+        filler = micro_engine(params)
+        adopter = micro_engine(params)
+        pin_ids = TOK.encode("p" * 120)
+        key, _ = filler.pin_prefix(pin_ids)
+        pages = export_pages(filler, key, generation=0, filler="f")
+        akey, epoch = adopt_pages(adopter, pages)
+        assert adopter.pin_alive(akey, epoch)
+        adopter.PREFIX_CACHE_BYTES = 1
+        adopter.set_prefix(TOK.encode("q" * 120))
+        adopter.set_prefix(TOK.encode("r" * 120))
+        assert adopter.pin_alive(akey, epoch)  # adopted pin never evicted
+
+    def test_adopt_rejects_wrong_shapes(self):
+        adopter = micro_engine()
+        bad = np.zeros((1, 8, 1, 32), dtype=np.float32)  # n_layers=1
+        with pytest.raises(ValueError, match="shape"):
+            adopter.adopt_prefix_pages([1, 2, 3], bad, bad)
+        with pytest.raises(ValueError, match="empty"):
+            adopter.adopt_prefix_pages(
+                [], np.zeros((2, 8, 1, 32), np.float32),
+                np.zeros((2, 8, 1, 32), np.float32),
+            )
+
+    def test_swap_invalidates_adopted_pins(self):
+        """Adopted pins obey the same epoch contract as local pins: a
+        weight swap kills them (swap_params clears the pin set)."""
+        params = micro_params()
+        filler = micro_engine(params)
+        adopter = micro_engine(params)
+        pin_ids = TOK.encode("s" * 80)
+        key, _ = filler.pin_prefix(pin_ids)
+        pages = export_pages(filler, key, generation=0, filler="f")
+        akey, epoch = adopt_pages(adopter, pages)
+        adopter.swap_params(micro_params(seed=1))
+        assert not adopter.pin_alive(akey, epoch)
+
+
+# ------------------------------------------------------------ chaos regime
+class TestChaosRegime:
+    def test_kv_plane_outage_clean_and_byte_replayable(self):
+        from k8s_llm_scheduler_tpu.chaos.harness import (
+            build_chaos_trace,
+            canonical_chaos_bytes,
+            replay_chaos_trace,
+            run_chaos,
+        )
+
+        r1 = run_chaos("kv-plane-outage", seed=3, n_waves=4, n_pods=24)
+        assert not r1["invariants"]["violations"]
+        assert not r1["unschedulable"]
+        kv = r1["kvplane"]
+        # the regime actually bit (outages observed), replicas degraded
+        # to local pins, and adopted KV stayed byte-identical
+        assert kv["store"]["store_outages"] > 0
+        assert sum(
+            c["local_fallbacks"] for c in kv["clients"].values()
+        ) > 0
+        assert kv["kv_mismatches"] == 0
+        b1 = canonical_chaos_bytes(build_chaos_trace(r1))
+        r2 = run_chaos("kv-plane-outage", seed=3, n_waves=4, n_pods=24)
+        assert canonical_chaos_bytes(build_chaos_trace(r2)) == b1
+        import json
+
+        replayed = replay_chaos_trace(json.loads(b1.decode("utf-8")))
+        assert canonical_chaos_bytes(replayed) == b1

@@ -112,6 +112,14 @@ class TestRepoIsClean:
         assert "k8s_llm_scheduler_tpu/spec/hidden.py" in files
         assert "k8s_llm_scheduler_tpu/train/hidden.py" in files
         assert "tests/test_spec_async.py" in files
+        # kvplane round: the shared prefix-KV plane (lease-fenced fills,
+        # injected-clock store, host-transport page shipping) — the same
+        # clock/lease-heavy risk class as fleet/lease.py it builds on
+        assert "k8s_llm_scheduler_tpu/fleet/kvplane/store.py" in files
+        assert "k8s_llm_scheduler_tpu/fleet/kvplane/client.py" in files
+        assert "k8s_llm_scheduler_tpu/fleet/kvplane/pages.py" in files
+        assert "k8s_llm_scheduler_tpu/fleet/kvplane/stub.py" in files
+        assert "tests/test_kvplane.py" in files
         # the lint never lints its own pattern table
         assert "tools/py310_lint.py" not in files
 
