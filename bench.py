@@ -234,6 +234,15 @@ PRESETS = {
     # token digest, which must not drift when the layout changes.
     "tp-serving": {"slots": 8, "rounds": 2, "max_new_tokens": 48,
                    "temperature": 0.0},
+    # persistent serving loop (engine/persistent/) TRUTH ROUND: the full
+    # composed stack (watch -> prompt -> grammar decode -> bind) at the
+    # burst1000 operating shape, A/B'd persistent-loop ON vs OFF on
+    # otherwise identical backends, plus one arrival-paced steady round
+    # per arm for the burst-vs-steady ratio. Headline figures: RAW burst
+    # p50 (not net-of-RTT) vs the 200 ms target, burst/steady vs the
+    # 1.5x bar, the profiler's dispatches_per_decision gauge per arm
+    # (the zero-dispatch proof), and fused/persistent MFU books.
+    "serving": {"pods": 1000, "nodes": 64, "shapes": 32, "rounds": 1},
     # routed fast tier (sched/router.py): distill big + fast arms from
     # the same spread-lookahead teacher (fast = half-width student),
     # then arena-gate the routed hybrid against BOTH arms alone — the
@@ -296,7 +305,12 @@ BPE_FIXTURE = str(
 )
 
 
-def build_backend(args, delta_prompts: bool = False):
+def build_backend(
+    args,
+    delta_prompts: bool = False,
+    persistent_loop: bool = False,
+    request_timeout_s: float | None = None,
+):
     from k8s_llm_scheduler_tpu.engine.local import build_local_backend
 
     cfg = build_cfg(args.model)
@@ -323,6 +337,24 @@ def build_backend(args, delta_prompts: bool = False):
         max_new_tokens=args.max_new_tokens,
         quantize=getattr(args, "quantize", None),
         delta_prompts=delta_prompts,
+        persistent_loop=persistent_loop,
+        # BPE decision suffixes run ~100-150 tokens at bench shapes; the
+        # default bucket (smallest prefill bucket, 128) would route a
+        # fraction of admissions to the fallback dispatch path and the
+        # A/B would measure the fallback churn, not the resident loop.
+        persistent_suffix_bucket=256 if persistent_loop else None,
+        # Bench rounds compile sibling geometries WHILE the loop is
+        # resident; on a CPU harness a compile storm can starve the
+        # resident thread's heartbeat past the 30s production default and
+        # false-wedge the arm (latching persistent OFF mid-A/B). The bench
+        # proves serving economics, not wedge detection — the chaos
+        # persistent-wedge regime owns that — so give it headroom.
+        persistent_wedge_timeout_s=600.0,
+        **(
+            {"request_timeout_s": request_timeout_s}
+            if request_timeout_s is not None
+            else {}
+        ),
         # repo-local persistent compile cache: the bench re-runs every
         # round; geometries compiled in ANY earlier run load in ~100ms
         compile_cache_dir=str(Path(__file__).resolve().parent / ".xla_cache"),
@@ -638,6 +670,163 @@ async def burst_bench(args) -> dict:
             "baseline_note": (
                 "delta prefill tokens/decision must stay ~flat in node "
                 "count while whole-prompt grows linearly (ROADMAP item 2)"
+            ),
+        },
+    }
+
+
+# ------------------------------------------------------ persistent serving
+async def serving_bench(args) -> dict:
+    """`--preset serving`: the persistent-loop TRUTH ROUND.
+
+    Two identically configured backends, A/B'd:
+
+    - persistent ON: after the first admission the engine parks inside ONE
+      long-lived XLA program (engine/persistent/loop.py); steady-state
+      decisions ride the host->device CommandRing in and the
+      device->host TokenRing out — ZERO per-decision XLA dispatches;
+    - persistent OFF: every decision pays the dispatch path (admission
+      dispatch + fused decode dispatches), the pre-ISSUE-18 serving plane.
+
+    Per arm: the full composed stack (watch -> snapshot prompt -> grammar
+    decode -> bind) at the burst1000 operating shape, plus one
+    arrival-paced steady round for the burst-vs-steady ratio. Headlines:
+
+    - RAW burst p50 on the persistent arm (wall clock at the scheduler,
+      NOT net-of-RTT) vs the 200 ms target;
+    - burst p50 / steady p50 vs the ~1.5x bar;
+    - the profiler's windowed `dispatches_per_decision` gauge per arm
+      (the structural zero-dispatch proof — on a host where dispatch is
+      nearly free the LATENCY delta understates the win; the gauge does
+      not) plus the raw steady-round dispatch-counter delta per LLM
+      decision as a second, window-free measurement;
+    - `fused_mfu_decode` when a device peak is known (null on the CPU
+      harness — carried from the TPU books otherwise).
+    """
+    from k8s_llm_scheduler_tpu.observability.profiler import EngineProfiler
+
+    peak_tflops, device_kind = detect_peak_tflops(
+        getattr(args, "peak_tflops", None)
+    )
+
+    async def one_arm(persistent: bool) -> dict:
+        # A cold first decision pays the compile, and on the CPU harness
+        # compile alone outruns the 60s production request timeout —
+        # shedding it to the breaker would replace the measured model
+        # round with heuristic fallbacks. The timeout is a reliability
+        # knob, not part of the measured claim; size it to the harness.
+        backend = build_backend(
+            args, persistent_loop=persistent, request_timeout_s=300.0
+        )
+        eng = backend.engine
+        prof = EngineProfiler(build_cfg(args.model), peak_tflops=peak_tflops)
+        eng.attach_profiler(prof)
+        try:
+            burst = await bench_preset(args, backend=backend)
+            steady_args = argparse.Namespace(**vars(args))
+            steady_args.arrival_rate = 100.0
+            steady_args.perturb_idle = 0.0
+            steady_args.pods = min(args.pods, 128)
+            steady_args.rounds = 1
+            # Raw-counter A/B over the steady round: the windowed gauge
+            # answers "recently", the delta answers "this round, exactly".
+            disp_before = eng.stats["dispatches"]
+            steady = await bench_preset(steady_args, backend=backend)
+            disp_delta = eng.stats["dispatches"] - disp_before
+            gauges = prof.gauges()
+            snap = prof.snapshot()
+            stats = dict(eng.stats)
+        finally:
+            backend.close()
+        decisions = steady["extra"]["llm_decisions"] or 0
+        return {
+            "burst": burst,
+            "steady": steady,
+            "gauges": gauges,
+            "snapshot": snap,
+            "stats": stats,
+            "steady_dispatches": disp_delta,
+            "steady_llm_decisions": decisions,
+            "steady_dispatches_per_llm_decision": (
+                round(disp_delta / decisions, 3) if decisions else None
+            ),
+        }
+
+    arm_on = await one_arm(True)
+    arm_off = await one_arm(False)
+
+    def _arm_block(arm: dict) -> dict:
+        g, s = arm["gauges"], arm["stats"]
+        seg = arm["snapshot"].get("persistent")
+        if seg:
+            # the aggregates carry the story; the per-harvest window ring
+            # is thousands of entries of idle 20ms polls — not publishable
+            seg = {k: v for k, v in seg.items() if k != "ring"}
+        return {
+            "burst_p50_ms": arm["burst"]["value"],
+            "burst_p99_ms": arm["burst"]["extra"]["p99_ms"],
+            "burst_p50_cold_ms": arm["burst"]["extra"]["p50_cold_ms"],
+            "steady_p50_ms": arm["steady"]["value"],
+            "pods_per_sec": arm["burst"]["extra"]["pods_per_sec"],
+            # windowed gauge (recent completion windows): 0.0 on the ON
+            # arm is the zero-dispatch steady state, measured not asserted
+            "dispatches_per_decision_gauge": g.get("dispatches_per_decision"),
+            "steady_dispatches": arm["steady_dispatches"],
+            "steady_llm_decisions": arm["steady_llm_decisions"],
+            "steady_dispatches_per_llm_decision": arm[
+                "steady_dispatches_per_llm_decision"
+            ],
+            "fused_mfu_decode": g.get("fused_mfu_decode"),
+            "persistent_stats": {
+                k: s.get(k, 0)
+                for k in (
+                    "persistent_launches", "persistent_admissions",
+                    "persistent_fallbacks", "persistent_wedges",
+                    "persistent_steps", "persistent_chunks",
+                )
+            },
+            # ring/segment books from the profiler's persistent plane
+            # (ring_wait vs loop_resident vs harvest fractions)
+            "persistent_segments": seg,
+        }
+
+    burst_on = arm_on["burst"]["value"]
+    steady_on = arm_on["steady"]["value"]
+    ratio = round(burst_on / steady_on, 3) if steady_on else None
+    return {
+        "metric": "p50_decision_latency_ms",
+        "value": burst_on,
+        "unit": "ms",
+        "vs_baseline": round(TARGET_P50_MS / burst_on, 3),
+        "extra": {
+            "target_ms": TARGET_P50_MS,
+            "target_met": bool(burst_on < TARGET_P50_MS),
+            # the truth-round framing: earlier rounds argued from
+            # net-of-RTT decide time; this is the scheduler-observed wall
+            "latency_basis": "raw burst p50, persistent arm (NOT net-of-RTT)",
+            "dispatch_rtt_ms": measure_dispatch_rtt_ms(),
+            "burst_over_steady": ratio,
+            "burst_over_steady_bar": "burst p50 within ~1.5x of steady p50",
+            "burst_over_steady_bar_met": bool(
+                ratio is not None and ratio <= 1.5
+            ),
+            "pods": args.pods,
+            "nodes": args.nodes,
+            "shapes": args.shapes,
+            "model": args.model,
+            "weights": "random-init",
+            "device_kind": device_kind,
+            "peak_bf16_tflops": peak_tflops,
+            "persistent_on": _arm_block(arm_on),
+            "persistent_off": _arm_block(arm_off),
+            "ab_burst_p50_delta_ms": round(
+                arm_off["burst"]["value"] - burst_on, 2
+            ),
+            "baseline_note": (
+                "reference publishes no numbers; target p50<200ms "
+                "(BASELINE.md). On a free-dispatch host the A/B latency "
+                "delta understates the persistent win — the per-arm "
+                "dispatches-per-decision figures are the structural claim."
             ),
         },
     }
@@ -3228,6 +3417,9 @@ def main() -> None:
         return
     if args.preset == "burst":
         _emit(asyncio.run(burst_bench(args)))
+        return
+    if args.preset == "serving":
+        _emit(asyncio.run(serving_bench(args)))
         return
     if args.preset == "decode":
         _emit(asyncio.run(decode_bench(args)))

@@ -70,6 +70,11 @@ def _backend_kwargs(cfg: Config, **overrides) -> dict:
         # fused on-device decode runtime (engine/fused/)
         fused_decode=bool(cfg.get("llm.fused_decode", True)),
         top_k=int(cfg.get("llm.top_k", 0)),
+        # persistent device-resident serving loop (engine/persistent/)
+        persistent_loop=bool(cfg.get("llm.persistent_loop", False)),
+        persistent_suffix_bucket=cfg.get(
+            "llm.persistent_suffix_bucket", None
+        ),
         # delta-prefill admission plane (engine/admission/, sched/delta.py)
         packed_admission=bool(cfg.get("admission.packed", True)),
         admission_chunk_tokens=int(cfg.get("admission.chunk_tokens", 256)),

@@ -123,6 +123,16 @@ DEFAULTS: dict[str, Any] = {
         # top-k sampling cut applied INSIDE the fused loop (0 = full
         # distribution; greedy decode is unaffected by construction)
         "top_k": 0,
+        # --- persistent device-resident serving loop (engine/persistent/):
+        # ONE long-lived program subsumes admission prefill + fused decode
+        # micro-chunks; steady-state decisions pay zero XLA dispatches.
+        # Off by default until the truth round lands it as the default
+        # serving mode. ---
+        "persistent_loop": False,
+        # admission suffix bucket of the resident loop's fixed-shape
+        # ADMIT (None = smallest prefill bucket; must be a page-size
+        # multiple — suffixes past it fall back to the dispatch path)
+        "persistent_suffix_bucket": None,
     },
     # Delta-prefill admission plane (engine/admission/ + sched/delta.py):
     # packed chunked admission for batch surfaces, and snapshot-delta
@@ -448,6 +458,7 @@ ENV_OVERRIDES: dict[str, str] = {
     "SPEC_ARM": "llm.spec_arm",
     "FUSED_DECODE": "llm.fused_decode",
     "LLM_TOP_K": "llm.top_k",
+    "PERSISTENT_LOOP": "llm.persistent_loop",
     "SPEC_K": "llm.spec_k",
     "SPEC_DRAFT_MODEL": "llm.spec_draft_model",
     "SPEC_DRAFT_CHECKPOINT": "llm.spec_draft_checkpoint",

@@ -158,6 +158,7 @@ class LocalLLMBackend:
         delta_prompts: bool = False,
         repin_fraction: float = 0.25,
         max_pins: int = 4,
+        persistent_loop: bool = False,
     ) -> None:
         self.engine = engine
         # Admission plane (engine/admission/): batch-surface decisions
@@ -167,6 +168,19 @@ class LocalLLMBackend:
         self._packed_admission = bool(packed_admission) and hasattr(
             engine, "admit_packed"
         )
+        # Persistent device-resident serving (engine/persistent/): when
+        # on, the worker FEEDS THE LOOP'S RINGS instead of submitting
+        # waves — admissions enqueue on the CommandRing (engine.
+        # add_requests routes there while the loop is resident) and
+        # completions drain off the TokenRing via step_persistent. The
+        # backend flag is authoritative: it arms the engine gate too.
+        self._persistent_loop = bool(persistent_loop) and hasattr(
+            engine, "enter_persistent"
+        )
+        if self._persistent_loop:
+            engine.persistent_loop = True
+        # In-flight resident-loop decisions: req_id -> (item, submitted_at)
+        self._pers_items: dict[int, tuple[_WorkItem, float]] = {}
         if delta_prompts:
             from k8s_llm_scheduler_tpu.sched.delta import SnapshotDeltaEncoder
 
@@ -656,8 +670,20 @@ class LocalLLMBackend:
             through engine.admit_packed instead: one packed
             block-diagonal prefill for the whole batch, bounded by the
             engine's free paged slots (leftovers wait for slots to
-            drain). A lone marked straggler just rides a wave."""
-            if self._packed_admission:
+            drain). A lone marked straggler just rides a wave.
+
+            With the persistent loop on, current-group items that fit
+            its admission bucket feed the CommandRing first — zero
+            dispatches each. Leftovers (oversized, or parked on
+            backpressure) fall through; while the loop is resident the
+            packed branch is SKIPPED (admit_packed would drain the loop
+            — oversized items ride waves, which never touch the paged
+            cache and run beside the loop)."""
+            if self._persistent_loop:
+                items = self._route_persistent(items, rest, bool(packs))
+            if self._packed_admission and not getattr(
+                self.engine, "persistent_active", False
+            ):
                 # The paged pack path is page-table-bounded, tighter than
                 # the wave bound: an oversized suffix rides a wave rather
                 # than failing its pack (or poisoning its batchmates).
@@ -719,11 +745,12 @@ class LocalLLMBackend:
         if not others:
             return rest
 
-        if packs:
+        if packs or self._pers_items:
             # Paged slots are mid-flight against the CURRENT prefix
             # pointer — set_prefix requires a drained engine, so a group
-            # switch must wait for the packs to finish decoding (bounded:
-            # the device-side budget guarantees pack completion).
+            # switch must wait for the packs (and resident-loop
+            # decisions) to finish decoding (bounded: the device-side
+            # budget guarantees completion).
             rest.extend(others)
             return rest
         oldest = min(others, key=lambda i: i.enqueued_at)
@@ -798,7 +825,10 @@ class LocalLLMBackend:
         waves: deque[tuple[Any, list[_WorkItem]]] = deque()
         packs: list[dict] = []  # in-flight packed admissions
         while not self._stopped.is_set():
-            block = not pending and not waves and not packs
+            block = (
+                not pending and not waves and not packs
+                and not self._pers_items
+            )
             if block and self._prewarm_backlog() > 0:
                 # Idle with compiles owed: park only for the grace period;
                 # if still idle after it, compile ONE sibling geometry,
@@ -816,6 +846,7 @@ class LocalLLMBackend:
             self._drain_queue(pending, block=block)
             if self._stopped.is_set() or (
                 not pending and not waves and not packs
+                and not self._pers_items
             ):
                 continue
             # Nothing below may kill the engine-owner thread — a dead worker
@@ -831,12 +862,20 @@ class LocalLLMBackend:
                 for pk in packs:
                     for item in pk["items"].values():
                         item.fail(BackendError(str(exc)))
-                if packs:
-                    # the failed packs' requests still hold _by_slot
-                    # entries and KV pages — without an abort they leak
-                    # forever (nothing steps an empty packs list) and
-                    # free_slots shrinks until no pack can ever admit
+                for _item, _t in self._pers_items.values():
+                    _item.fail(BackendError(str(exc)))
+                if packs or self._pers_items:
+                    # the failed packs'/resident-loop requests still hold
+                    # _by_slot entries and KV pages — without an abort
+                    # they leak forever (nothing steps an empty packs
+                    # list) and free_slots shrinks until nothing admits
                     packs.clear()
+                    self._pers_items.clear()
+                    try:
+                        if getattr(self.engine, "persistent_active", False):
+                            self.engine.exit_persistent()
+                    except Exception:  # pragma: no cover - best effort
+                        logger.exception("persistent exit after failed tick")
                     try:
                         self.engine.abort_all()
                     except Exception:  # pragma: no cover - best effort
@@ -847,16 +886,115 @@ class LocalLLMBackend:
                     ctl.fail(BackendError(str(exc)))
                 self._held_controls = []
                 pending = []
-        # Shutdown: fail anything still queued or in flight.
+        # Shutdown: fail anything still queued or in flight, and retire
+        # the resident loop (its daemon thread must not outlive the
+        # backend holding a donated view of the engine's buffers).
+        try:
+            if getattr(self.engine, "persistent_active", False):
+                self.engine.exit_persistent()
+        except Exception:  # pragma: no cover - best effort
+            logger.exception("persistent loop exit at shutdown failed")
         self._drain_queue(pending, block=False)
         for _, items in waves:
             pending.extend(items)
         for pk in packs:
             pending.extend(pk["items"].values())
+        pending.extend(item for item, _t in self._pers_items.values())
+        self._pers_items.clear()
         pending.extend(self._held_controls)
         self._held_controls = []
         for item in pending:
             item.fail(BackendError("backend closed"))
+
+    def _route_persistent(
+        self, items: list[_WorkItem], rest: list[_WorkItem],
+        packs_busy: bool,
+    ) -> list[_WorkItem]:
+        """Feed current-group items that fit the resident loop's admission
+        bucket onto its CommandRing (entering the loop lazily); returns
+        the items that must take the dispatch path instead. Ring-full and
+        slot exhaustion PARK the item in `rest` (backpressure: retry next
+        tick) — they are flow control, not failures."""
+        eng = self.engine
+        limit = eng.persistent_suffix_limit(self.max_new_tokens)
+        if not any(len(i.suffix_ids) <= limit for i in items):
+            return items
+        if not eng.persistent_active:
+            if packs_busy:
+                # launching would donate paged buffers mid-pack; the
+                # packs drain within their decode budget — wait them out
+                return items
+            try:
+                if not eng.enter_persistent():
+                    return items  # unsupported / wedge-latched
+            except Exception:
+                logger.exception("persistent loop launch failed")
+                return items
+        from k8s_llm_scheduler_tpu.engine.persistent.ring import RingFull
+
+        leftover: list[_WorkItem] = []
+        for item in items:
+            if len(item.suffix_ids) > limit:
+                leftover.append(item)
+                continue
+            if eng.free_slots <= 0:
+                rest.append(item)
+                continue
+            try:
+                (req_id,) = eng.add_requests(
+                    [item.suffix_ids], self.max_new_tokens
+                )
+            except RingFull:
+                rest.append(item)  # admission backpressure
+            except Exception as exc:
+                item.fail(BackendError(str(exc)))
+            else:
+                self._pers_items[req_id] = (item, time.perf_counter())
+        return leftover
+
+    def _resolve_fins(self, fins, packs: "list[dict]") -> None:
+        """Match finished engine decisions to their in-flight items —
+        resident-loop admissions (_pers_items) and packed admissions
+        share the paged slots, so ONE resolution seam serves both."""
+        now = time.perf_counter()
+        for fin in fins:
+            entry = self._pers_items.pop(fin.req_id, None)
+            if entry is not None:
+                item, submitted_at = entry
+                handle = SimpleNamespace(submitted_at=submitted_at)
+                self._attach_item_spans(item, handle, fin, now)
+                item.resolve(fin.text)
+                continue
+            for pk in packs:
+                item = pk["items"].pop(fin.req_id, None)
+                if item is not None:
+                    handle = SimpleNamespace(submitted_at=pk["submitted_at"])
+                    self._attach_item_spans(item, handle, fin, now)
+                    item.resolve(fin.text)
+                    break
+        packs[:] = [pk for pk in packs if pk["items"]]
+
+    def _fail_paged_inflight(
+        self, packs: "list[dict]", exc: Exception
+    ) -> None:
+        """Fail every in-flight paged decision (packs + resident-loop
+        items) and abort the engine so their slots/pages don't leak."""
+        for pk in packs:
+            for item in pk["items"].values():
+                item.fail(BackendError(str(exc)))
+        packs.clear()
+        for item, _t in self._pers_items.values():
+            item.fail(BackendError(str(exc)))
+        self._pers_items.clear()
+        try:
+            if getattr(self.engine, "persistent_active", False):
+                self.engine.exit_persistent()
+        except Exception:  # pragma: no cover - best-effort cleanup
+            logger.exception("persistent exit after failed step")
+        try:
+            self.engine.abort_all()
+        except Exception:  # pragma: no cover - best-effort cleanup
+            logger.exception("engine abort after failed step")
 
     def _drive_packs(self, packs: "list[dict]") -> None:
         """Advance in-flight packed admissions by one decode step and
@@ -871,25 +1009,27 @@ class LocalLLMBackend:
             fins = step_fused() if step_fused is not None else self.engine.step()
         except Exception as exc:
             logger.exception("packed decode step failed")
-            for pk in packs:
-                for item in pk["items"].values():
-                    item.fail(BackendError(str(exc)))
-            packs.clear()
-            try:
-                self.engine.abort_all()
-            except Exception:  # pragma: no cover - best-effort cleanup
-                logger.exception("engine abort after failed pack step")
+            self._fail_paged_inflight(packs, exc)
             return
-        now = time.perf_counter()
-        for fin in fins:
-            for pk in packs:
-                item = pk["items"].pop(fin.req_id, None)
-                if item is not None:
-                    handle = SimpleNamespace(submitted_at=pk["submitted_at"])
-                    self._attach_item_spans(item, handle, fin, now)
-                    item.resolve(fin.text)
-                    break
-        packs[:] = [pk for pk in packs if pk["items"]]
+        self._resolve_fins(fins, packs)
+
+    def _drive_persistent(self, packs: "list[dict]") -> None:
+        """Drain the resident loop's TokenRing and resolve finished
+        decisions. After a wedge drain (or a quiesce that didn't resume)
+        the surviving slots keep decoding on the dispatch path — the
+        fused step continues them token-identically."""
+        eng = self.engine
+        try:
+            if eng.persistent_active:
+                fins = eng.step_persistent(timeout_s=0.02)
+            else:
+                step_fused = getattr(eng, "step_fused", None)
+                fins = step_fused() if step_fused is not None else eng.step()
+        except Exception as exc:
+            logger.exception("persistent serving step failed")
+            self._fail_paged_inflight(packs, exc)
+            return
+        self._resolve_fins(fins, packs)
 
     def _worker_tick(
         self,
@@ -914,8 +1054,14 @@ class LocalLLMBackend:
         if packs:
             # Packed admissions decode via the paged path: advance them
             # (and harvest piggybacked emissions) every tick so their
-            # decisions resolve while waves pipeline alongside.
+            # decisions resolve while waves pipeline alongside. The
+            # resolve seam covers resident-loop items too, so a single
+            # step never strands a Finished.
             self._drive_packs(packs)
+        elif self._pers_items:
+            # Resident-loop decisions: harvest the TokenRing (or, after
+            # a drain, continue their slots on the dispatch path).
+            self._drive_persistent(packs)
         if waves:
             handle, items = waves[0]
             # While the oldest wave executes, keep feeding the pipeline:
@@ -944,10 +1090,10 @@ class LocalLLMBackend:
             deadline = (
                 max(handle.submitted_at, self._last_harvest_t) + 0.5 * ema
             )
-            if packs:
-                # in-flight packed decodes must not starve behind the
-                # straggler poll — harvest this wave blockingly and get
-                # back to stepping the packs
+            if packs or self._pers_items:
+                # in-flight packed/resident-loop decodes must not starve
+                # behind the straggler poll — harvest this wave
+                # blockingly and get back to stepping them
                 deadline = 0.0
             while (
                 not handle.is_ready()
@@ -1006,12 +1152,23 @@ class LocalLLMBackend:
                 for fin, item in zip(fins, items):
                     self._attach_item_spans(item, handle, fin, now)
                     item.resolve(fin.text)
-        if self._held_controls and not waves and not packs:
+        if (
+            self._held_controls and not waves and not packs
+            and not self._pers_items
+        ):
             # Wave barrier reached (everything in flight harvested above —
-            # waves AND packed admissions — admissions held since the
-            # control arrived): run the quiesced actions on this — the
-            # engine-owner — thread. Held work in `pending` resumes on
-            # the next tick.
+            # waves, packed admissions AND resident-loop decisions —
+            # admissions held since the control arrived): run the
+            # quiesced actions on this — the engine-owner — thread. Held
+            # work in `pending` resumes on the next tick. The resident
+            # loop exits FIRST: its donated buffers make the engine
+            # unusable to an arbitrary quiesced fn, and engine-side
+            # drains (swap_params etc.) expect the dispatch-path state.
+            if getattr(self.engine, "persistent_active", False):
+                try:
+                    self.engine.exit_persistent()
+                except Exception:
+                    logger.exception("persistent exit at control barrier")
             controls, self._held_controls = self._held_controls, []
             for ctl in controls:
                 try:
@@ -1315,6 +1472,9 @@ def build_local_backend(
     max_pins: int = 4,
     fused_decode: bool = True,
     top_k: int = 0,
+    persistent_loop: bool = False,
+    persistent_suffix_bucket: int | None = None,
+    persistent_wedge_timeout_s: float = 30.0,
 ) -> LocalLLMBackend:
     """Construct the full local stack: params (from an HF safetensors or
     orbax checkpoint when checkpoint_path is set, random-init otherwise —
@@ -1439,6 +1599,9 @@ def build_local_backend(
         admission_chunk_tokens=admission_chunk_tokens,
         fused_decode=fused_decode,
         top_k=top_k,
+        persistent_loop=persistent_loop,
+        persistent_suffix_bucket=persistent_suffix_bucket,
+        persistent_wedge_timeout_s=persistent_wedge_timeout_s,
     )
     if spec_enabled:
         if multi:
@@ -1470,4 +1633,5 @@ def build_local_backend(
         delta_prompts=delta_prompts,
         repin_fraction=repin_fraction,
         max_pins=max_pins,
+        persistent_loop=persistent_loop,
     )

@@ -1,0 +1,56 @@
+"""Persistent device-resident serving loop (engine/persistent/).
+
+One long-lived XLA program subsumes admission prefill chunks and fused
+decode micro-chunks: slot state lives in the loop carry, a host->device
+COMMAND RING feeds admissions/aborts/quiesce through an ordered
+io_callback polled once per micro-chunk, and a device->host TOKEN RING
+streams emissions (with exact `steps_run` books) back out. Steady-state
+serving pays ZERO per-decision XLA dispatches — the launch is the only
+dispatch, and it is amortized over the loop's whole residency.
+
+Layout:
+- ring.py   — CommandRing / TokenRing / Heartbeat: the bounded,
+  thread-safe host side of both callbacks, with blocking backpressure
+  (zero lost tokens by construction) and wedge detection.
+- loop.py   — persistent_serve_impl: the while_loop program. The decode
+  micro-chunk is the EXACT inner body of engine/fused/loop.py and the
+  in-loop admission is forward_prefill_suffix + sample_fused — greedy
+  token identity vs the dispatch path is structural, not coincidental.
+- server.py — PersistentServer: owns the dedicated resident thread (a
+  jitted program containing io_callbacks executes synchronously in the
+  dispatching thread on the CPU backend — the launch call does not
+  return until quiesce), ring plumbing, watchdog, and drain.
+"""
+
+from k8s_llm_scheduler_tpu.engine.persistent.ring import (
+    OP_ABORT,
+    OP_ADMIT,
+    OP_NOOP,
+    OP_QUIESCE,
+    Command,
+    CommandRing,
+    Heartbeat,
+    HarvestBatch,
+    RingClosed,
+    RingFull,
+    TokenRing,
+)
+
+
+def __getattr__(name: str):
+    # server.py imports jax at module scope; the rings are pure
+    # numpy/threading and the chaos harness drives them JAX-free —
+    # keep the heavyweight half of the package lazy
+    if name == "PersistentServer":
+        from k8s_llm_scheduler_tpu.engine.persistent.server import (
+            PersistentServer,
+        )
+
+        return PersistentServer
+    raise AttributeError(name)
+
+__all__ = [
+    "OP_NOOP", "OP_ADMIT", "OP_ABORT", "OP_QUIESCE",
+    "Command", "CommandRing", "TokenRing", "HarvestBatch",
+    "Heartbeat", "RingFull", "RingClosed", "PersistentServer",
+]

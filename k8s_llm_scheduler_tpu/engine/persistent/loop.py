@@ -1,0 +1,232 @@
+"""The persistent serving loop: ONE long-lived `lax.while_loop` program.
+
+Structure of each outer iteration (one "micro-chunk"):
+
+1. POLL — one ordered io_callback asks the host CommandRing for the next
+   command (fixed shapes: the ADMIT payload rides along even for NOOP,
+   zero-filled). Ordered callbacks serialize with the push below, so the
+   host observes a strict poll/push alternation.
+2. ADMIT (lax.cond) — suffix prefill via forward_prefill_suffix against
+   the launch-pinned shared prefix, first token via sample_fused over the
+   SAME dense grammar table the fused dispatch path gathers from, state
+   scattered into the carried slot rows. This is the dispatch path's
+   `_admit_impl` re-expressed inside the loop; greedy identity follows
+   from using the same forward and the same argmax-over-allowed-set.
+3. DECODE — one fused micro-chunk: the inner while_loop is the EXACT
+   body of engine/fused/loop.fused_decode_chunk_impl (same
+   forward_decode_fused_body cascade, same sample_fused, same chunk-KV
+   buffer + one page-scatter flush), over the post-admission page gather
+   so a freshly admitted slot decodes in the same iteration — exactly
+   like the first fused chunk after a dispatch-path admission.
+4. PUSH — one ordered io_callback streams the [M, n_steps] emission
+   buffer + exact `steps_run` + post-chunk (act, budget, pos) books +
+   the admission's (slot, first token) to the host TokenRing. The
+   callback BLOCKS when the ring is full — emission backpressure stalls
+   the device loop instead of dropping tokens — and its return value is
+   the host's stop vote (watchdog-forced drain).
+
+The loop exits on OP_QUIESCE (or a push stop vote) and returns the full
+carry, so the host rebinds every donated buffer (paged KV, page tables,
+slot state) and the dispatch path resumes exactly where the loop left
+off — that handoff is what lets hot swap, spec on_swap and group
+switches compose: they all quiesce, act, and relaunch.
+
+Steady state pays ZERO XLA dispatches per decision: admission, decode
+and emission all happen inside the one resident program.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import io_callback
+
+from k8s_llm_scheduler_tpu.engine.fused.sampler import sample_fused
+from k8s_llm_scheduler_tpu.engine.persistent.ring import (
+    OP_ABORT,
+    OP_ADMIT,
+    OP_QUIESCE,
+)
+from k8s_llm_scheduler_tpu.models.llama import (
+    forward_decode_fused_body,
+    forward_prefill_suffix,
+)
+
+
+def persistent_serve_impl(
+    params,
+    cfg,                # static
+    k_cache, v_cache,   # donated paged caches
+    page_tables,        # [M, P] donated (admissions update rows in-loop)
+    prefix_k, prefix_v,  # launch-pinned shared dense prefix KV
+    prefix_len,         # scalar int32
+    tok, pos, act, st, budget,  # donated per-slot state [M]
+    dense_next,         # [S, V] dense grammar table ([1,1] unconstrained)
+    done_state, eos_id, pad_id,
+    rng, temperature,
+    *,
+    poll,               # host callback: steps -> fixed-shape command block
+    push,               # host callback: emissions -> int32 stop vote
+    n_steps: int,       # static — micro-chunk length (engine.chunk_steps)
+    constrained: bool,  # static
+    top_k: int,         # static
+    suffix_bucket: int,  # static — admission suffix width Sb
+    dfa_start: int,     # static
+    vocab_limit: int | None = None,  # static
+    prefix_impl: str | None = None,  # static
+):
+    """Serve until quiesced; returns the final carry for host rebinding:
+    (k_cache, v_cache, page_tables, tok, pos, act, st, budget, rng,
+    total_steps)."""
+    M, P = page_tables.shape
+    ps = k_cache.shape[2]
+    n_kv, hd = cfg.n_kv_heads, cfg.head_dim
+    Sb = suffix_bucket
+    n_blocks = Sb // ps
+
+    poll_shapes = (
+        jax.ShapeDtypeStruct((), jnp.int32),          # op
+        jax.ShapeDtypeStruct((1, Sb), jnp.int32),     # admit tokens
+        jax.ShapeDtypeStruct((1,), jnp.int32),        # suffix len
+        jax.ShapeDtypeStruct((1,), jnp.int32),        # slot (ABORT reuses)
+        jax.ShapeDtypeStruct((1,), jnp.int32),        # budget
+        jax.ShapeDtypeStruct((1, n_blocks), jnp.int32),  # prefill page ids
+        jax.ShapeDtypeStruct((1, P), jnp.int32),      # full page-table row
+    )
+
+    def outer_body(carry):
+        (k, v, pages, tok, pos, act, st, budget, key, running, total) = carry
+        op, a_tok, a_len, a_slot, a_budget, a_ppages, a_prow = io_callback(
+            poll, poll_shapes, total, ordered=True
+        )
+        is_admit = op == OP_ADMIT
+        sl = a_slot[0]
+
+        # ---- ABORT: deactivate one slot (sl >= 0) or everything (sl < 0)
+        is_abort = op == OP_ABORT
+        kill_all = is_abort & (sl < 0)
+        kill_one = is_abort & (sl >= 0)
+        act = jnp.where(kill_all, jnp.zeros_like(act), act)
+        budget = jnp.where(kill_all, jnp.zeros_like(budget), budget)
+        # sl is -1 on kill_all; the .at write then lands on the trash row
+        # guarded by kill_one=False — a no-op by construction.
+        act = act.at[sl].set(jnp.where(kill_one, False, act[sl]))
+        budget = budget.at[sl].set(jnp.where(kill_one, 0, budget[sl]))
+
+        # ---- ADMIT: the dispatch path's _admit_impl, in-loop
+        def do_admit(ops):
+            k, v, pages, tok, pos, act, st, budget, key = ops
+            pages = pages.at[sl].set(a_prow[0])
+            last_logits, k, v = forward_prefill_suffix(
+                params, cfg, a_tok, a_len, prefix_k, prefix_v, prefix_len,
+                k, v, a_ppages, prefix_impl=prefix_impl,
+            )
+            key, sub = jax.random.split(key)
+            st0 = jnp.full((1,), dfa_start, dtype=jnp.int32)
+            first, st1 = sample_fused(
+                last_logits, st0, dense_next, sub, temperature, top_k,
+                constrained, pad_id, vocab_limit,
+            )
+            finished = (first[0] == eos_id) | (st1[0] == done_state)
+            real = a_len[0] > 0
+            tok = tok.at[sl].set(first[0])
+            pos = pos.at[sl].set(prefix_len + a_len[0])
+            act = act.at[sl].set(real & ~finished)
+            st = st.at[sl].set(st1[0])
+            budget = budget.at[sl].set(a_budget[0])
+            return (k, v, pages, tok, pos, act, st, budget, key), first[0]
+
+        def no_admit(ops):
+            return ops, pad_id
+
+        (k, v, pages, tok, pos, act, st, budget, key), first_tok = (
+            jax.lax.cond(
+                is_admit, do_admit, no_admit,
+                (k, v, pages, tok, pos, act, st, budget, key),
+            )
+        )
+        admit_slot = jnp.where(is_admit, sl, jnp.int32(-1))
+
+        # ---- DECODE micro-chunk: the fused chunk body, pages re-gathered
+        # after the admission so a fresh slot decodes this same iteration.
+        own_start = pos - prefix_len
+        k_own = k[:, pages].reshape(-1, M, P * ps, n_kv, hd)
+        v_own = v[:, pages].reshape(-1, M, P * ps, n_kv, hd)
+        ck = jnp.zeros((cfg.n_layers, M, n_steps, n_kv, hd), k.dtype)
+        cv = jnp.zeros_like(ck)
+        out0 = jnp.full((M, n_steps), pad_id, dtype=jnp.int32)
+        run_chunk = op != OP_QUIESCE
+
+        def cond(state):
+            i, _out, _ck, _cv, _tail, _tok, _pos, act, _st, budget, _key = state
+            return run_chunk & (i < n_steps) & jnp.any(act & (budget > 0))
+
+        def body(state):
+            i, out, ck, cv, tail, tok, pos, act, st, budget, key = state
+            act_eff = act & (budget > 0)
+            logits, ck, cv = forward_decode_fused_body(
+                params, cfg, tok, pos, k_own, v_own, own_start,
+                ck, cv, tail, prefix_k, prefix_v, prefix_len,
+                page_tables=pages, own_impl="dense",
+            )
+            key, sub = jax.random.split(key)
+            nxt, new_st = sample_fused(
+                logits, st, dense_next, sub, temperature, top_k,
+                constrained, pad_id, vocab_limit,
+            )
+            emitted = jnp.where(act_eff, nxt, pad_id)
+            new_st = jnp.where(act_eff, new_st, st)
+            finished = (new_st == done_state) | (nxt == eos_id)
+            new_act = act_eff & ~finished
+            new_budget = jnp.where(act_eff, budget - 1, budget)
+            new_pos = jnp.where(act_eff, pos + 1, pos)
+            new_tail = jnp.where(act_eff, tail + 1, tail)
+            out = jax.lax.dynamic_update_slice(out, emitted[:, None], (0, i))
+            return (
+                i + 1, out, ck, cv, new_tail, emitted, new_pos, new_act,
+                new_st, new_budget, key,
+            )
+
+        tail0 = jnp.zeros(M, dtype=jnp.int32)
+        steps_run, out, ck, cv, tail, tok, pos, act, st, budget, key = (
+            jax.lax.while_loop(
+                cond, body,
+                (jnp.int32(0), out0, ck, cv, tail0, tok, pos, act, st,
+                 budget, key),
+            )
+        )
+
+        # Flush the chunk buffer into pages — identical to the fused path.
+        j = jnp.arange(n_steps)
+        own_pos = own_start[:, None] + j[None, :]
+        valid = j[None, :] < tail[:, None]
+        page_slot = jnp.clip(own_pos // ps, 0, P - 1)
+        page_ids = jnp.take_along_axis(pages, page_slot, axis=1)
+        page_ids = jnp.where(valid, page_ids, 0)
+        offs = jnp.where(valid, own_pos % ps, 0)
+        k = k.at[:, page_ids, offs].set(ck)
+        v = v.at[:, page_ids, offs].set(cv)
+
+        # ---- PUSH: stream this micro-chunk's outcome; blocking on a full
+        # token ring is the emission backpressure, the int32 return is the
+        # host's stop vote (watchdog drain).
+        stop_vote = io_callback(
+            push, jax.ShapeDtypeStruct((), jnp.int32),
+            out, steps_run, act, budget, pos, admit_slot, first_tok,
+            ordered=True,
+        )
+        running = running & (op != OP_QUIESCE) & (stop_vote == 0)
+        return (k, v, pages, tok, pos, act, st, budget, key, running,
+                total + steps_run)
+
+    def outer_cond(carry):
+        return carry[9]
+
+    (k_cache, v_cache, page_tables, tok, pos, act, st, budget, rng,
+     _running, total_steps) = jax.lax.while_loop(
+        outer_cond, outer_body,
+        (k_cache, v_cache, page_tables, tok, pos, act, st, budget, rng,
+         jnp.bool_(True), jnp.int32(0)),
+    )
+    return (k_cache, v_cache, page_tables, tok, pos, act, st, budget, rng,
+            total_steps)

@@ -1,0 +1,385 @@
+"""PersistentServer: host-side owner of one resident serving loop.
+
+Threading model — the load-bearing constraint: a jitted program that
+contains io_callbacks executes SYNCHRONOUSLY in the dispatching thread
+on the CPU backend (the launch call does not return until the loop
+exits). The server therefore launches the program on a DEDICATED
+RESIDENT THREAD; the engine-owner thread only ever touches the two
+rings (admit/abort/quiesce feed the CommandRing, harvest drains the
+TokenRing) and never blocks on the device program itself.
+
+Steady-state discipline (enforced by graftlint's
+`dispatch-in-persistent-path` rule): the feeder/harvest methods that run
+per decision — everything named `*_steady*` here — contain NO jax
+dispatches and no device syncs. The ONLY dispatch is `launch()`, paid
+once per residency; `quiesce()` retrieves the final carry the resident
+thread already holds.
+
+Buffer ownership: launch() donates the engine's paged KV, page tables
+and slot-state arrays into the loop and nulls the engine's references —
+any dispatch-path use while resident is a loud error, not silent
+corruption. quiesce() hands them back (the final carry), which is what
+makes hot swap / spec on_swap / group switches compose: drain, act,
+relaunch from the rebound state.
+"""
+
+from __future__ import annotations
+
+import atexit
+import functools
+import logging
+import threading
+import time
+import weakref
+from typing import TYPE_CHECKING, Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from k8s_llm_scheduler_tpu.engine.persistent.ring import (
+    OP_ABORT,
+    OP_ADMIT,
+    OP_NOOP,
+    OP_QUIESCE,
+    Command,
+    CommandRing,
+    Heartbeat,
+    HarvestBatch,
+    TokenRing,
+)
+
+if TYPE_CHECKING:  # pragma: no cover - import cycle guard
+    from k8s_llm_scheduler_tpu.engine.engine import InferenceEngine
+
+logger = logging.getLogger(__name__)
+
+# Loops still resident at interpreter shutdown must be stopped BEFORE
+# Python finalizes: the resident thread is inside a jitted XLA call whose
+# io_callbacks re-enter Python, and a daemon thread doing that during
+# finalization is a hard crash (GIL released under a finalizing runtime),
+# not a clean exit. launch() registers each server here; the hook votes
+# stop and joins briefly.
+_LIVE: "weakref.WeakSet[PersistentServer]" = weakref.WeakSet()
+
+
+@atexit.register
+def _stop_resident_loops() -> None:  # pragma: no cover - process teardown
+    for srv in list(_LIVE):
+        if srv._running and not srv._done.is_set():
+            srv.force_stop()
+            srv._done.wait(5.0)
+
+
+class PersistentServer:
+    """One resident loop over one engine's buffers. Engine-owner thread
+    calls launch/admit/abort/quiesce/harvest; the resident thread runs
+    the device program and services its two callbacks."""
+
+    def __init__(
+        self,
+        engine: "InferenceEngine",
+        *,
+        suffix_bucket: int | None = None,
+        cmd_capacity: int = 64,
+        token_capacity: int = 64,
+        wedge_timeout_s: float = 30.0,
+        poll_idle_s: float = 0.002,
+    ) -> None:
+        self.engine = engine
+        self.suffix_bucket = int(
+            suffix_bucket
+            if suffix_bucket is not None
+            else engine.prefill_buckets[0]
+        )
+        if self.suffix_bucket % engine.kv.page_size:
+            raise ValueError(
+                f"suffix bucket {self.suffix_bucket} must be a multiple of "
+                f"the page size {engine.kv.page_size}"
+            )
+        self.cmd_capacity = int(cmd_capacity)
+        self.token_capacity = int(token_capacity)
+        self.wedge_timeout_s = float(wedge_timeout_s)
+        self.poll_idle_s = float(poll_idle_s)
+
+        self.commands = CommandRing(self.cmd_capacity)
+        self.tokens = TokenRing(self.token_capacity)
+        self.heartbeat = Heartbeat()
+        self._thread: threading.Thread | None = None
+        self._final: tuple | None = None
+        self._error: BaseException | None = None
+        self._done = threading.Event()
+        self._force_stop = False
+        self._any_active = False   # device-truth mirror from the last push
+        self._running = False
+        self._launched_at = 0.0
+        self._jitted = None
+        self._jit_key: tuple | None = None
+
+    # ------------------------------------------------------------ launch
+    @property
+    def running(self) -> bool:
+        return self._running
+
+    def launch(self) -> None:
+        """Donate the engine's buffers into a fresh resident loop. ONE
+        XLA dispatch; everything after it is ring traffic."""
+        if self._running:
+            raise RuntimeError("persistent loop already resident")
+        from k8s_llm_scheduler_tpu.engine.persistent.loop import (
+            persistent_serve_impl,
+        )
+
+        eng = self.engine
+        prefix = eng._prefix or eng._get_empty_prefix()
+        eng._prefix = prefix
+        table = (
+            eng.dense_grammar() if eng._constrained else eng._fused_dummy
+        )
+        if eng._constrained and table is None:
+            raise RuntimeError(
+                "grammar has no dense table — persistent loop unsupported"
+            )
+        key = (
+            self.suffix_bucket, eng.chunk_steps, eng._constrained,
+            eng.top_k, eng._vocab_limit, eng._dfa_start,
+        )
+        if self._jitted is None or self._jit_key != key:
+            self._jitted = jax.jit(
+                functools.partial(
+                    persistent_serve_impl,
+                    poll=self._device_poll,
+                    push=self._device_push,
+                    n_steps=eng.chunk_steps,
+                    constrained=eng._constrained,
+                    top_k=eng.top_k,
+                    suffix_bucket=self.suffix_bucket,
+                    dfa_start=eng._dfa_start,
+                    vocab_limit=eng._vocab_limit,
+                    prefix_impl=eng.prefix_attn_impl,
+                ),
+                static_argnums=(1,),
+                donate_argnums=(2, 3, 4, 8, 9, 10, 11, 12),
+            )
+            self._jit_key = key
+
+        eng._rng, sub = jax.random.split(eng._rng)
+        operands = (
+            eng.params, eng.cfg,
+            eng.kv.k, eng.kv.v, eng._padded_tables(),
+            prefix.k, prefix.v, jnp.int32(prefix.length),
+            eng._tok_d, eng._pos_d, eng._act_d, eng._st_d, eng._budget_d,
+            table, eng._done_state,
+            jnp.int32(eng.tokenizer.eos_id), jnp.int32(eng.tokenizer.pad_id),
+            sub, jnp.float32(eng.temperature),
+        )
+        # The buffers above are DONATED: null the engine's references so
+        # a dispatch-path touch while the loop is resident fails loudly.
+        eng.kv.k = eng.kv.v = None
+        eng._tables_src = eng._tables_padded = None
+        eng._tok_d = eng._pos_d = eng._act_d = None
+        eng._st_d = eng._budget_d = None
+
+        self._final = None
+        self._error = None
+        self._done.clear()
+        self._force_stop = False
+        self._any_active = bool(
+            (eng._act_np & (eng._budget_np > 0)).any()
+        )
+        self._running = True
+        self._launched_at = time.monotonic()
+        self.heartbeat.beat()
+        _LIVE.add(self)
+        self._thread = threading.Thread(
+            target=self._run_resident, args=(operands,),
+            name="persistent-loop", daemon=True,
+        )
+        self._thread.start()
+
+    def _run_resident(self, operands: tuple) -> None:
+        """The resident thread: blocks in here until quiesce. The jitted
+        call alone is NOT the blocking point — async dispatch (always on
+        TPU, and on CPU with forced multi-device meshes) returns
+        future-backed output arrays immediately while the loop keeps
+        serving callbacks from runtime threads. _done must mean "the
+        program exited", not "the dispatch returned": wedged() and
+        quiesce() both read it, so block on the outputs explicitly."""
+        try:
+            out = self._jitted(*operands)
+            jax.block_until_ready(out)
+            self._final = out
+        except BaseException as exc:  # noqa: BLE001 - published, not dropped
+            logger.exception("persistent loop died")
+            self._error = exc
+        finally:
+            self._done.set()
+
+    # ----------------------------------------------------- device callbacks
+    def _device_poll(self, total_steps):
+        """Ordered io_callback: one command per micro-chunk. Parks
+        briefly when the loop is idle (no live slots, no commands) so an
+        idle residency doesn't busy-spin a host core."""
+        self.heartbeat.beat()
+        cmd = self.commands.take()
+        if cmd is None and not self._any_active and not self._force_stop:
+            self.commands.wait_nonempty(self.poll_idle_s)
+            cmd = self.commands.take()
+        if self._force_stop and (cmd is None or cmd.op != OP_QUIESCE):
+            cmd = Command(op=OP_QUIESCE)
+        Sb = self.suffix_bucket
+        ps = self.engine.kv.page_size
+        P = self._page_width
+        if cmd is None:
+            cmd = Command(op=OP_NOOP)
+        tokens = (
+            cmd.tokens
+            if cmd.tokens is not None
+            else np.zeros((1, Sb), dtype=np.int32)
+        )
+        ppages = (
+            cmd.prefill_pages
+            if cmd.prefill_pages is not None
+            else np.zeros((1, Sb // ps), dtype=np.int32)
+        )
+        prow = (
+            cmd.page_row[None, :]
+            if cmd.page_row is not None
+            else np.zeros((1, P), dtype=np.int32)
+        )
+        return (
+            np.int32(cmd.op),
+            tokens,
+            np.asarray([cmd.suffix_len], dtype=np.int32),
+            np.asarray([cmd.slot], dtype=np.int32),
+            np.asarray([cmd.budget], dtype=np.int32),
+            ppages,
+            prow,
+        )
+
+    def _device_push(
+        self, emitted, steps_run, act, budget, pos, admit_slot, first_tok
+    ):
+        """Ordered io_callback: one emission batch per micro-chunk.
+        Blocks on a full token ring (zero lost tokens); returns the stop
+        vote the watchdog uses to force a drain."""
+        self.heartbeat.beat()
+        batch = HarvestBatch(
+            seq=0,
+            emitted=np.asarray(emitted),
+            steps_run=int(steps_run),
+            act=np.asarray(act),
+            budget=np.asarray(budget),
+            pos=np.asarray(pos),
+            admit_slot=int(admit_slot),
+            first_tok=int(first_tok),
+        )
+        self._any_active = bool((batch.act & (batch.budget > 0)).any())
+        ok = self.tokens.put(batch, stop_check=lambda: self._force_stop)
+        return np.int32(0 if ok and not self._force_stop else 1)
+
+    @property
+    def _page_width(self) -> int:
+        return int(self.engine.kv.max_pages_per_seq)
+
+    # ------------------------------------------------- steady-state feeders
+    def admit_steady(
+        self,
+        suffix_ids: list[int],
+        slot: int,
+        budget: int,
+        prefill_pages: np.ndarray,
+        page_row: np.ndarray,
+        timeout_s: float = 5.0,
+    ) -> None:
+        """Feed one admission through the command ring. NO dispatches —
+        this is the zero-dispatch steady-state admission path."""
+        Sb = self.suffix_bucket
+        if len(suffix_ids) > Sb:
+            raise ValueError(
+                f"suffix of {len(suffix_ids)} tokens exceeds the loop's "
+                f"bucket {Sb} — route via the dispatch path"
+            )
+        tokens = np.full((1, Sb), self.engine.tokenizer.pad_id, dtype=np.int32)
+        tokens[0, : len(suffix_ids)] = suffix_ids
+        self.commands.put(
+            Command(
+                op=OP_ADMIT, tokens=tokens, suffix_len=len(suffix_ids),
+                slot=int(slot), budget=int(budget),
+                prefill_pages=np.asarray(prefill_pages, dtype=np.int32),
+                page_row=np.asarray(page_row, dtype=np.int32),
+            ),
+            timeout_s=timeout_s,
+        )
+        self._any_active = True
+
+    def abort_steady(self, slot: int = -1, timeout_s: float = 5.0) -> None:
+        """Deactivate one slot (or all, slot=-1) via the command ring."""
+        self.commands.put(Command(op=OP_ABORT, slot=int(slot)), timeout_s)
+
+    def harvest_steady(self, timeout_s: float = 0.0) -> list[HarvestBatch]:
+        """Drain the token ring (blocking up to timeout for the first
+        batch). NO dispatches, no device syncs — pure ring traffic."""
+        return self.tokens.drain(timeout_s)
+
+    def clear_parked(self) -> int:
+        """Drop undelivered emission batches (abort_all path)."""
+        return self.tokens.clear_parked()
+
+    # --------------------------------------------------------- drain paths
+    def wedged(self) -> bool:
+        """True when the resident loop stopped servicing callbacks for
+        wedge_timeout_s while still marked running."""
+        return (
+            self._running
+            and not self._done.is_set()
+            and self.heartbeat.wedged(self.wedge_timeout_s)
+        )
+
+    def force_stop(self) -> None:
+        """Watchdog drain: make the next poll return QUIESCE and the next
+        push vote stop, then unblock a push stalled on the full token
+        ring by leaving its contents for harvest."""
+        self._force_stop = True
+        with self.commands._cond:
+            self.commands._cond.notify_all()
+
+    def quiesce(self, timeout_s: float = 60.0) -> tuple:
+        """Stop the loop and return the final carry for engine rebinding:
+        (k, v, page_tables, tok, pos, act, st, budget, rng, total_steps).
+        Raises on loop error or a drain timeout (truly wedged loop)."""
+        if not self._running:
+            raise RuntimeError("persistent loop not resident")
+        try:
+            self.commands.put(Command(op=OP_QUIESCE), timeout_s=timeout_s)
+        except Exception:
+            self.force_stop()
+        deadline = time.monotonic() + timeout_s
+        while not self._done.is_set():
+            if time.monotonic() >= deadline:
+                self.force_stop()
+                if not self._done.wait(5.0):
+                    raise RuntimeError(
+                        "persistent loop failed to drain (wedged past "
+                        "force_stop) — engine buffers are lost"
+                    )
+            else:
+                self._done.wait(0.05)
+        self._running = False
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        if self._error is not None:
+            raise RuntimeError("persistent loop died") from self._error
+        assert self._final is not None
+        return self._final
+
+    def stats(self) -> dict[str, Any]:
+        return {
+            "persistent_resident": self._running,
+            "persistent_cmd_stalls": self.commands.stalls,
+            "persistent_token_stalls": self.tokens.stalls,
+            "persistent_cmd_depth": self.commands.qsize(),
+            "persistent_token_depth": self.tokens.qsize(),
+            "persistent_heartbeats": self.heartbeat.beats,
+        }

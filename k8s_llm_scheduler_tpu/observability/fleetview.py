@@ -127,7 +127,10 @@ def _merge_hist_stat(entries: list[dict]) -> dict:
 # the underlying counters, which ARE summed wherever the tree carries
 # them).
 _EPOCH_LEAVES = frozenset({"generation", "known_generation"})
-_RATIO_SUFFIXES = ("_rate", "_frac")
+# `*_per_decision` is a derived per-replica ratio like the others —
+# summing N replicas' dispatches_per_decision would report a fleet that
+# pays N times the per-decision cost it actually does.
+_RATIO_SUFFIXES = ("_rate", "_frac", "_per_decision")
 
 
 def _merge_stats(trees: list[dict]) -> dict:

@@ -107,6 +107,21 @@ FUSED_SEGMENTS = (
     "unattributed",
 )
 
+# Persistent-loop segments (engine/persistent/ — the device-resident
+# serving loop): telescoping over each step_persistent harvest's host
+# wall with the same sum==wall identity. ring_wait is the host blocking
+# on TokenRing.drain (waiting for the loop to push), harvest the host-
+# side booking of drained batches, loop_resident everything else — the
+# window where the device loop ran with NO host involvement at all. The
+# whole point of the subsystem is that loop_resident dominates while
+# dispatches_per_decision (the flow books below) reads zero.
+PERSISTENT_SEGMENTS = (
+    "ring_wait",
+    "loop_resident",
+    "harvest",
+    "unattributed",
+)
+
 # Speculative-decoding segments (spec/decoder.py — the async
 # propose/verify pipeline): telescoping over each spec REQUEST's host
 # wall with the same sum==wall identity. draft covers propose dispatches
@@ -250,6 +265,25 @@ class EngineProfiler:
         self._spec_overlapped = 0
         self._spec_tokens = 0
         self.spec_profiled = 0
+        # Persistent-loop books (engine/persistent/): per-harvest records
+        # with telescoping PERSISTENT_SEGMENTS — the residency proof the
+        # zero-dispatch loop is measured against.
+        self._pers_ring: deque[dict] = deque(maxlen=self.window)
+        self._pers_totals = {name: 0.0 for name in PERSISTENT_SEGMENTS}
+        self._pers_totals["wall"] = 0.0
+        self._pers_steps = 0
+        self._pers_tokens = 0
+        self.persistent_profiled = 0
+        # Decision-flow books: (XLA dispatches, decisions completed)
+        # deltas booked at each completion window (engine.
+        # _book_decision_flow). The windowed ratio is THE zero-dispatch
+        # headline: 0.0 in persistent steady state, >= 1 on the dispatch
+        # path. Dispatch deltas telescope exactly — every stats
+        # "dispatches" bump lands in exactly one window — so the lifetime
+        # sum of d_dispatches equals the engine's dispatch counter.
+        self._flow_ring: deque[tuple[int, int]] = deque(maxlen=self.window)
+        self._flow_dispatches = 0
+        self._flow_decisions = 0
         self.closed = False
 
     # ------------------------------------------------------------- fences
@@ -596,6 +630,96 @@ class EngineProfiler:
             self._spec_overlapped += int(overlapped_rounds)
             self._spec_tokens += int(tokens)
 
+    def on_persistent(
+        self,
+        *,
+        wall_s: float,
+        ring_wait_s: float,
+        harvest_s: float,
+        loop_resident_s: float,
+        steps: int,
+        tokens: int,
+        batches: int,
+    ) -> None:
+        """One persistent-loop harvest window closed (engine.
+        step_persistent): wall is the time since the previous harvest,
+        ring_wait the TokenRing.drain block, harvest the host-side batch
+        booking, loop_resident the remainder — device-resident serving
+        with zero host involvement. The engine pre-clamps the measured
+        segments to the wall, so sum(PERSISTENT_SEGMENTS) == wall holds
+        exactly and the acceptance test pins it."""
+        wall = max(float(wall_s), 0.0)
+        seg = {
+            "ring_wait": max(float(ring_wait_s), 0.0),
+            "harvest": max(float(harvest_s), 0.0),
+            "loop_resident": max(float(loop_resident_s), 0.0),
+        }
+        seg["unattributed"] = max(wall - sum(seg.values()), 0.0)
+        record = {
+            "harvest": 0,  # stamped under the lock below
+            "batches": int(batches),
+            "steps": int(steps),
+            "tokens": int(tokens),
+            "wall_ms": wall * 1000.0,
+            "segments_ms": {k: v * 1000.0 for k, v in seg.items()},
+        }
+        with self._lock:
+            self.persistent_profiled += 1
+            record["harvest"] = self.persistent_profiled
+            if len(self._pers_ring) == self._pers_ring.maxlen:
+                old = self._pers_ring[0]
+                for name in PERSISTENT_SEGMENTS:
+                    self._pers_totals[name] = max(
+                        self._pers_totals[name]
+                        - old["segments_ms"].get(name, 0.0) / 1000.0,
+                        0.0,
+                    )
+                self._pers_totals["wall"] = max(
+                    self._pers_totals["wall"] - old["wall_ms"] / 1000.0, 0.0
+                )
+                self._pers_steps = max(self._pers_steps - old["steps"], 0)
+                self._pers_tokens = max(
+                    self._pers_tokens - old["tokens"], 0
+                )
+            self._pers_ring.append(record)
+            for name in PERSISTENT_SEGMENTS:
+                self._pers_totals[name] += seg.get(name, 0.0)
+            self._pers_totals["wall"] += wall
+            self._pers_steps += int(steps)
+            self._pers_tokens += int(tokens)
+
+    def on_decision_flow(self, d_dispatches: int, d_decisions: int) -> None:
+        """Book one completion window's (dispatch delta, decision delta).
+        The engine calls this whenever decisions complete, with the XLA
+        dispatches issued since the PREVIOUS completion window — deltas
+        telescope, so the windowed ratio charges every dispatch to
+        exactly one batch of decisions."""
+        d_disp = max(int(d_dispatches), 0)
+        d_done = max(int(d_decisions), 0)
+        if d_done <= 0:
+            return
+        with self._lock:
+            if len(self._flow_ring) == self._flow_ring.maxlen:
+                old_disp, old_done = self._flow_ring[0]
+                self._flow_dispatches = max(
+                    self._flow_dispatches - old_disp, 0
+                )
+                self._flow_decisions = max(
+                    self._flow_decisions - old_done, 0
+                )
+            self._flow_ring.append((d_disp, d_done))
+            self._flow_dispatches += d_disp
+            self._flow_decisions += d_done
+
+    def dispatches_per_decision(self) -> float | None:
+        """Windowed XLA dispatches per completed decision — 0.0 in
+        persistent steady state (the zero-dispatch pin), >= 1 on the
+        dispatch path. None until a completion window has been booked."""
+        with self._lock:
+            if self._flow_decisions <= 0:
+                return None
+            return round(self._flow_dispatches / self._flow_decisions, 4)
+
     def _prefill_tokens_per_decision_locked(self) -> float | None:
         """Windowed prefill tokens per decision: (wave suffix tokens +
         packed tokens + prefix tokens actually prefilled) / decisions.
@@ -698,6 +822,13 @@ class EngineProfiler:
             spec_overlapped = self._spec_overlapped
             spec_tokens = self._spec_tokens
             spec = self.spec_profiled
+            pers_ring = list(self._pers_ring)
+            pers_totals = dict(self._pers_totals)
+            pers_steps = self._pers_steps
+            pers_tokens = self._pers_tokens
+            pers = self.persistent_profiled
+            flow_disp = self._flow_dispatches
+            flow_done = self._flow_decisions
             tpd = self._prefill_tokens_per_decision_locked()
         wall = totals["wall"]
         n_warm = sum(1 for r in ring if not r["cold_compile"])
@@ -819,6 +950,34 @@ class EngineProfiler:
             if spec_wall > 0:
                 spec_out["tokens_per_s"] = round(spec_tokens / spec_wall, 1)
             out["spec"] = spec_out
+        if pers:
+            pers_wall = pers_totals["wall"]
+            pers_out: dict[str, Any] = {
+                "harvests_profiled": pers,
+                "steps": pers_steps,
+                "tokens": pers_tokens,
+                "wall_ms_total": round(pers_wall * 1000.0, 3),
+                "segments_ms_total": {
+                    name: round(pers_totals[name] * 1000.0, 3)
+                    for name in PERSISTENT_SEGMENTS
+                },
+                "segment_frac": {
+                    name: (
+                        round(pers_totals[name] / pers_wall, 4)
+                        if pers_wall > 0
+                        else 0.0
+                    )
+                    for name in PERSISTENT_SEGMENTS
+                },
+                "ring": pers_ring,
+            }
+            if pers_wall > 0:
+                pers_out["tokens_per_s"] = round(pers_tokens / pers_wall, 1)
+            out["persistent"] = pers_out
+        if flow_done > 0:
+            out["dispatches_per_decision"] = round(
+                flow_disp / flow_done, 4
+            )
         if tpd is not None:
             out["prefill_tokens_per_decision"] = round(tpd, 2)
         return out
@@ -840,6 +999,10 @@ class EngineProfiler:
             spec_rounds = self._spec_rounds
             spec_overlapped = self._spec_overlapped
             spec = self.spec_profiled
+            pers_totals = dict(self._pers_totals)
+            pers = self.persistent_profiled
+            flow_disp = self._flow_dispatches
+            flow_done = self._flow_decisions
             tpd = self._prefill_tokens_per_decision_locked()
         wall = totals["wall"]
         out: dict[str, float] = {"waves_profiled": float(waves)}
@@ -886,6 +1049,19 @@ class EngineProfiler:
                 round(spec_overlapped / spec_rounds, 4)
                 if spec_rounds > 0
                 else 0.0
+            )
+        if pers:
+            out["persistent_profiled"] = float(pers)
+            pers_wall = pers_totals["wall"]
+            for name in PERSISTENT_SEGMENTS:
+                out[f"persistent_{name}_frac"] = (
+                    round(pers_totals[name] / pers_wall, 4)
+                    if pers_wall > 0
+                    else 0.0
+                )
+        if flow_done > 0:
+            out["dispatches_per_decision"] = round(
+                flow_disp / flow_done, 4
             )
         if tpd is not None:
             out["prefill_tokens_per_decision"] = round(tpd, 2)

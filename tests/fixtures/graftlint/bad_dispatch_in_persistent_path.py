@@ -1,0 +1,67 @@
+"""TRUE-POSITIVE fixture: dispatch-in-persistent-path.
+
+Reproduces the hazard the persistent serving loop exists to remove: an
+XLA dispatch hiding on the STEADY-STATE path. Once the resident loop is
+launched, every per-decision interaction must be ring traffic (numpy in,
+numpy out) — a jnp.* call, a jitted-program invocation, or a
+.block_until_ready() inside a `*_steady` feeder or a `_device_poll` /
+`_device_push` callback body silently reinstates the per-decision
+dispatch cost the whole subsystem was built to amortize away.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _pad_impl(x):
+    return x
+
+
+class LeakyServer:
+    def __init__(self):
+        self.commands = []
+        self.tokens = []
+        self._jitted = jax.jit(_pad_impl)
+
+    def admit_steady(self, suffix_ids, slot):
+        # BAD: device-side padding on the admission feeder — one XLA
+        # dispatch per admitted decision
+        tokens = jnp.zeros((1, 64), dtype=jnp.int32)
+        self.commands.append((tokens, suffix_ids, slot))
+
+    def harvest_steady(self):
+        # BAD: invoking the jitted program per harvest re-enters the
+        # dispatch path the ring was supposed to replace
+        return [self._jitted(b) for b in self.tokens]
+
+    def _device_poll(self, total_steps):
+        if not self.commands:
+            return np.int32(0)
+        cmd = self.commands.pop(0)
+        # BAD: a poll callback runs once per micro-chunk — blocking on
+        # device state here serializes the resident loop on the host
+        cmd[0].block_until_ready()
+        return cmd
+
+    def _device_push(self, emitted):
+        # BAD: jax.device_put inside the push callback is a per-chunk
+        # host->device transfer on the zero-dispatch path
+        self.tokens.append(jax.device_put(emitted))
+        return np.int32(0)
+
+    def abort_steady(self, slot):
+        # Suppressed: the drain boundary is ALLOWED to touch the device —
+        # the pragma records the judgment that this is the launch/quiesce
+        # edge, not steady serving.
+        carry = jnp.zeros((4,))  # graftlint: ok[dispatch-in-persistent-path] — fixture: abort here doubles as the quiesce boundary, one dispatch at drain is the documented cost
+        self.tokens.clear()
+        return carry
+
+
+def good_steady_feeder(commands, suffix_ids, slot, pad_id):
+    """The shipped discipline: pure numpy into the ring, nothing else."""
+    tokens = np.full((1, 64), pad_id, dtype=np.int32)
+    tokens[0, : len(suffix_ids)] = suffix_ids
+    commands.append((tokens, slot))
+    return tokens

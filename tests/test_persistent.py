@@ -1,0 +1,500 @@
+"""Persistent device-resident serving loop (engine/persistent/).
+
+Ring tests are pure host logic (no jit, fast). Engine tests run the
+micro real engine (f32, 2 layers — the test_fused pattern, compiles in
+seconds). The load-bearing acceptance pins: greedy persistent serving is
+TOKEN-IDENTICAL to serial whole-prompt generate() (unconstrained and
+constrained), steady state pays ZERO XLA dispatches per decision
+(engine.stats["dispatches"] frozen across a full admit->complete window
+and the profiler gauge reads 0.0), the hot-swap exit rebinds mid-stream
+slots token-identically onto the dispatch path, fallback routing
+(oversized suffix, wedge latch, flag off, spec attached), abort_all's
+parked-emission clear, and the profiler's persistent-segment telescoping
+(sum == wall).
+"""
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from k8s_llm_scheduler_tpu.engine.constrained import build_decision_dfa
+from k8s_llm_scheduler_tpu.engine.engine import InferenceEngine
+from k8s_llm_scheduler_tpu.engine.persistent import (
+    Command,
+    CommandRing,
+    HarvestBatch,
+    Heartbeat,
+    OP_ADMIT,
+    RingFull,
+    TokenRing,
+)
+from k8s_llm_scheduler_tpu.engine.tokenizer import ByteTokenizer
+from k8s_llm_scheduler_tpu.models.configs import LlamaConfig
+from k8s_llm_scheduler_tpu.observability.profiler import (
+    PERSISTENT_SEGMENTS,
+    EngineProfiler,
+)
+
+TOK = ByteTokenizer()
+
+MICRO = LlamaConfig(
+    name="persistent-micro", vocab_size=512, d_model=64, n_layers=2,
+    n_heads=2, n_kv_heads=1, d_ff=128, max_seq_len=4096,
+    rope_theta=10000.0, dtype=jnp.float32, tie_embeddings=True,
+)
+
+_PARAMS = None
+
+
+def micro_params():
+    global _PARAMS
+    if _PARAMS is None:
+        from k8s_llm_scheduler_tpu.models.llama import init_params
+
+        _PARAMS = init_params(jax.random.PRNGKey(0), MICRO)
+    return _PARAMS
+
+
+def micro_engine(**kw):
+    kw.setdefault("num_pages", 128)
+    kw.setdefault("page_size", 16)
+    kw.setdefault("max_slots", 4)
+    kw.setdefault("max_pages_per_seq", 16)
+    kw.setdefault("prefill_buckets", (32, 64, 128, 256, 512))
+    kw.setdefault("chunk_steps", 4)
+    kw.setdefault("temperature", 0.0)
+    kw.setdefault("prefix_chunk", 64)
+    kw.setdefault("persistent_loop", True)
+    return InferenceEngine(micro_params(), MICRO, TOK, **kw)
+
+
+def drain_persistent(engine, n):
+    out = {}
+    deadline = time.monotonic() + 120
+    while len(out) < n:
+        assert time.monotonic() < deadline, "persistent serving wedged"
+        for fin in engine.step_persistent(timeout_s=0.05):
+            out[fin.req_id] = fin.token_ids
+    return out
+
+
+def drain_chunked(engine, n):
+    out = {}
+    deadline = time.monotonic() + 120
+    while len(out) < n:
+        assert time.monotonic() < deadline, "chunked decode wedged"
+        for fin in engine.step():
+            out[fin.req_id] = fin.token_ids
+    return out
+
+
+def drain_fused(engine, n):
+    out = {}
+    deadline = time.monotonic() + 120
+    while len(out) < n:
+        assert time.monotonic() < deadline, "fused decode wedged"
+        for fin in engine.step_fused():
+            out[fin.req_id] = fin.token_ids
+    return out
+
+
+def make_batch(slots=4, steps=2):
+    return HarvestBatch(
+        seq=-1,
+        emitted=np.full((slots, steps), -1, dtype=np.int32),
+        steps_run=steps,
+        act=np.zeros(slots, dtype=bool),
+        budget=np.zeros(slots, dtype=np.int32),
+        pos=np.zeros(slots, dtype=np.int32),
+        admit_slot=-1,
+        first_tok=0,
+    )
+
+
+# ------------------------------------------------------------- ring plane
+class TestCommandRing:
+    def test_backpressure_times_out_loudly(self):
+        ring = CommandRing(capacity=2)
+        ring.put(Command(op=OP_ADMIT, slot=0), timeout_s=0.1)
+        ring.put(Command(op=OP_ADMIT, slot=1), timeout_s=0.1)
+        with pytest.raises(RingFull):
+            ring.put(Command(op=OP_ADMIT, slot=2), timeout_s=0.05)
+        assert ring.stalls == 1
+        assert ring.enqueued == 2
+
+    def test_blocked_put_unblocks_when_loop_drains(self):
+        ring = CommandRing(capacity=1)
+        ring.put(Command(op=OP_ADMIT, slot=0))
+        taken = []
+
+        def consumer():
+            time.sleep(0.05)
+            taken.append(ring.take())
+
+        t = threading.Thread(target=consumer)
+        t.start()
+        # Blocks on the full ring until the consumer drains — admission
+        # backpressure, not loss.
+        ring.put(Command(op=OP_ADMIT, slot=1), timeout_s=5.0)
+        t.join()
+        assert taken[0].slot == 0
+        assert ring.take().slot == 1
+        assert ring.take() is None
+        assert ring.stalls == 1
+
+    def test_wait_nonempty_parks_and_wakes(self):
+        ring = CommandRing(capacity=4)
+        t0 = time.monotonic()
+        assert ring.wait_nonempty(0.02) is False
+        assert time.monotonic() - t0 >= 0.015
+        ring.put(Command(op=OP_ADMIT, slot=0))
+        assert ring.wait_nonempty(0.02) is True
+
+
+class TestTokenRing:
+    def test_seq_assigned_and_verified_in_order(self):
+        ring = TokenRing(capacity=8)
+        for _ in range(3):
+            assert ring.put(make_batch()) is True
+        out = ring.drain()
+        assert [b.seq for b in out] == [0, 1, 2]
+        assert ring.pushed == 3
+
+    def test_lost_batch_is_a_loud_protocol_error(self):
+        ring = TokenRing(capacity=8)
+        ring.put(make_batch())
+        # Simulate loss: batch 0 vanishes without the take cursor moving.
+        with ring._cond:
+            ring._items.clear()
+        ring.put(make_batch())  # seq 1
+        with pytest.raises(RuntimeError, match="sequence break"):
+            ring.drain()
+
+    def test_full_ring_blocks_device_push_until_harvest(self):
+        ring = TokenRing(capacity=1)
+        ring.put(make_batch())
+        done = []
+
+        def pusher():
+            done.append(ring.put(make_batch()))
+
+        t = threading.Thread(target=pusher)
+        t.start()
+        time.sleep(0.05)
+        assert not done  # emission backpressure: the push is parked
+        first = ring.drain()
+        t.join()
+        assert done == [True]
+        assert [b.seq for b in first] == [0]
+        assert [b.seq for b in ring.drain()] == [1]
+        assert ring.stalls == 1
+
+    def test_stop_check_unwedges_a_parked_push(self):
+        ring = TokenRing(capacity=1)
+        ring.put(make_batch())
+        assert ring.put(make_batch(), stop_check=lambda: True) is False
+
+    def test_clear_parked_advances_cursor_not_breaks_seq(self):
+        ring = TokenRing(capacity=8)
+        for _ in range(3):
+            ring.put(make_batch())
+        assert ring.clear_parked() == 3
+        ring.put(make_batch())  # seq 3 — must drain cleanly past the drop
+        assert [b.seq for b in ring.drain()] == [3]
+
+    def test_heartbeat_wedge_detection(self):
+        hb = Heartbeat()
+        hb.beat()
+        assert hb.beats == 1
+        assert not hb.wedged(5.0)
+        assert hb.wedged(-1.0)  # any idle time at all trips a <0 timeout
+
+
+# --------------------------------------------------------- token identity
+class TestPersistentIdentity:
+    def test_greedy_identity_unconstrained(self):
+        """THE acceptance pin: ring-admitted persistent serving emits the
+        same greedy stream as serial whole-prompt generate()."""
+        engine = micro_engine()
+        engine.set_prefix(TOK.encode("CLUSTER STATE: " + " ".join(
+            f"node-{i} cpu={10 + i}" for i in range(6)
+        )))
+        prompts = [
+            TOK.encode("pod-a needs a node"),
+            TOK.encode("pod-b second line"),
+            TOK.encode("p-c"),
+        ]
+        serial = [
+            engine.generate(p, max_new_tokens=10).token_ids for p in prompts
+        ]
+        assert engine.enter_persistent()
+        ids = engine.add_requests(prompts, max_new_tokens=10)
+        out = drain_persistent(engine, len(prompts))
+        engine.exit_persistent()
+        assert [out[i] for i in ids] == serial
+        assert engine.stats["persistent_admissions"] == len(prompts)
+        assert engine.stats["persistent_fallbacks"] == 0
+        assert engine.stats["persistent_launches"] == 1
+        assert engine.stats["persistent_chunks"] >= 1
+
+    def test_constrained_identity_and_decision_shape(self):
+        """Grammar arm: the resident loop emits the same decision JSON as
+        sparse chunked decode, token for token."""
+        engine = micro_engine()
+        engine.set_prefix(TOK.encode("shared cluster prefix"))
+        engine.set_grammar(build_decision_dfa(
+            TOK, ["node-a", "node-b2"], max_reason_tokens=6
+        ))
+        prompts = [TOK.encode("pod-a"), TOK.encode("pod-b longer")]
+        ids = engine.add_requests(prompts, max_new_tokens=60)
+        chunked = drain_chunked(engine, 2)
+        assert engine.enter_persistent()
+        ids2 = engine.add_requests(prompts, max_new_tokens=60)
+        pers = drain_persistent(engine, 2)
+        engine.exit_persistent()
+        assert [pers[i] for i in ids2] == [chunked[i] for i in ids]
+        text = engine.tokenizer.decode(pers[ids2[0]])
+        assert text.startswith('{"selected_node": ')
+
+    def test_hot_swap_exit_resumes_mid_stream_token_identically(self):
+        """exit_persistent rebinds the donated carry so a slot mid-decode
+        finishes on the dispatch path with an UNCHANGED stream — the
+        run_quiesced / hot-swap composition."""
+        engine = micro_engine()
+        engine.set_prefix(TOK.encode("hot swap prefix"))
+        prompt = TOK.encode("pod-swap request")
+        serial = engine.generate(prompt, max_new_tokens=40).token_ids
+        assert engine.enter_persistent()
+        ids = engine.add_requests([prompt], max_new_tokens=40)
+        # Let the admission land on the device (first emitted chunk
+        # harvested) so the exit catches the request genuinely mid-stream.
+        out = {}
+        deadline = time.monotonic() + 60
+        while engine.stats["persistent_steps"] < 1:
+            assert time.monotonic() < deadline, "loop never emitted"
+            for fin in engine.step_persistent(timeout_s=0.05):
+                out[fin.req_id] = fin.token_ids
+        engine.exit_persistent()
+        assert not engine.persistent_active
+        # Final-harvest completions park in _pending_finished; an
+        # inactive step_persistent flushes them, step_fused finishes the
+        # remainder on the dispatch path.
+        for fin in engine.step_persistent(timeout_s=0.0):
+            out[fin.req_id] = fin.token_ids
+        if ids[0] not in out:
+            out.update(drain_fused(engine, 1))
+        assert out[ids[0]] == serial
+
+    def test_relaunch_after_exit_serves_again(self):
+        """Residency is re-enterable: exit then enter serves a second
+        admission wave identically (two launches, two dispatches)."""
+        engine = micro_engine()
+        engine.set_prefix(TOK.encode("relaunch prefix"))
+        prompt = TOK.encode("pod-again")
+        serial = engine.generate(prompt, max_new_tokens=8).token_ids
+        for _ in range(2):
+            assert engine.enter_persistent()
+            ids = engine.add_requests([prompt], max_new_tokens=8)
+            out = drain_persistent(engine, 1)
+            engine.exit_persistent()
+            assert out[ids[0]] == serial
+        assert engine.stats["persistent_launches"] == 2
+
+
+# -------------------------------------------------------- fallback routing
+class TestFallbackRouting:
+    def test_oversized_suffix_drains_loop_and_uses_dispatch_path(self):
+        """A suffix past the loop's static admission bucket can't ride
+        the ring: the whole batch drains the loop and decodes correctly
+        on the dispatch path (persistent_fallbacks counts it)."""
+        engine = micro_engine()  # admission bucket = prefill_buckets[0] = 32
+        engine.set_prefix(TOK.encode("fallback prefix"))
+        prompts = [TOK.encode("pod-small"), TOK.encode("p" * 40)]
+        serial = [
+            engine.generate(p, max_new_tokens=8).token_ids for p in prompts
+        ]
+        assert engine.enter_persistent()
+        ids = engine.add_requests(prompts, max_new_tokens=8)
+        assert not engine.persistent_active
+        assert engine.stats["persistent_fallbacks"] == 1
+        assert engine.stats["persistent_admissions"] == 0
+        out = drain_fused(engine, 2)
+        assert [out[i] for i in ids] == serial
+
+    def test_suffix_bucket_widens_the_ring_limit(self):
+        engine = micro_engine(persistent_suffix_bucket=64)
+        engine.set_prefix(TOK.encode("wide bucket prefix"))
+        prompt = TOK.encode("p" * 40)  # fits 64, not the default 32
+        serial = engine.generate(prompt, max_new_tokens=8).token_ids
+        assert engine.enter_persistent()
+        assert engine.persistent_suffix_limit(8) >= 40
+        ids = engine.add_requests([prompt], max_new_tokens=8)
+        assert engine.persistent_active
+        out = drain_persistent(engine, 1)
+        engine.exit_persistent()
+        assert out[ids[0]] == serial
+        assert engine.stats["persistent_fallbacks"] == 0
+
+    def test_flag_off_is_unsupported(self):
+        engine = micro_engine(persistent_loop=False)
+        assert engine.persistent_supported() is False
+        assert engine.enter_persistent() is False
+        assert not engine.persistent_active
+
+    def test_spec_attached_is_unsupported(self):
+        """A speculative decoder drives slots externally — it composes
+        with the dispatch path only, so the gate must refuse."""
+        from k8s_llm_scheduler_tpu.spec.decoder import SpeculativeDecoder
+
+        engine = micro_engine(num_pages=256)
+        assert engine.persistent_supported() is True
+        spec = SpeculativeDecoder(engine, micro_params(), MICRO, k=2)
+        engine.attach_spec(spec)
+        assert engine.persistent_supported() is False
+        assert engine.enter_persistent() is False
+
+    def test_wedge_watchdog_latches_and_finishes_on_dispatch_path(self):
+        """A loop that stops beating gets force-drained: the wedge
+        latches (no relaunch thrash) and the in-flight stream finishes
+        token-identically on the dispatch path — no token lost."""
+        engine = micro_engine()
+        engine.set_prefix(TOK.encode("wedge prefix"))
+        prompt = TOK.encode("pod-wedge")
+        serial = engine.generate(prompt, max_new_tokens=16).token_ids
+        assert engine.enter_persistent()
+        ids = engine.add_requests([prompt], max_new_tokens=16)
+        out = {}
+        deadline = time.monotonic() + 60
+        while engine.stats["persistent_steps"] < 1:
+            assert time.monotonic() < deadline, "loop never emitted"
+            for fin in engine.step_persistent(timeout_s=0.05):
+                out[fin.req_id] = fin.token_ids
+        # Any idle at all now reads as wedged: the next tick is the
+        # watchdog path (force_stop + drain + latch).
+        engine._persistent.wedge_timeout_s = -1.0
+        for fin in engine.step_persistent(timeout_s=0.0):
+            out[fin.req_id] = fin.token_ids
+        assert engine.stats["persistent_wedges"] == 1
+        assert not engine.persistent_active
+        assert engine.persistent_supported() is False  # latched
+        assert engine.enter_persistent() is False
+        if ids[0] not in out:
+            out.update(drain_fused(engine, 1))
+        assert out[ids[0]] == serial
+
+
+# ------------------------------------------------- abort + parked emissions
+class TestAbortParkedEmissions:
+    def test_abort_all_never_leaks_parked_tokens_into_slot_reuse(self):
+        """Parked (undelivered) TokenRing batches belong to the aborted
+        occupant: after abort_all, a request reusing the slot must emit
+        EXACTLY its own serial stream — the clear_parked regression."""
+        engine = micro_engine()
+        engine.set_prefix(TOK.encode("abort prefix"))
+        after = TOK.encode("pod-after abort")
+        serial = engine.generate(after, max_new_tokens=10).token_ids
+        assert engine.enter_persistent()
+        engine.add_requests([TOK.encode("pod-doomed")], max_new_tokens=30)
+        srv = engine._persistent
+        deadline = time.monotonic() + 60
+        while srv.tokens.qsize() == 0:  # emissions park, un-harvested
+            assert time.monotonic() < deadline, "loop never emitted"
+            time.sleep(0.005)
+        engine.abort_all()
+        assert engine.free_slots == engine.max_slots
+        assert engine.persistent_active  # loop stays resident for new work
+        ids = engine.add_requests([after], max_new_tokens=10)
+        out = drain_persistent(engine, 1)
+        engine.exit_persistent()
+        assert out[ids[0]] == serial
+
+
+# --------------------------------------------------------- zero dispatches
+class TestZeroDispatch:
+    def test_steady_state_pays_zero_dispatches_per_decision(self):
+        """THE subsystem's reason to exist, pinned: a full admit ->
+        decode -> complete window moves engine.stats['dispatches'] by
+        ZERO, and the profiler's windowed gauge reads exactly 0.0."""
+        engine = micro_engine()
+        engine.set_prefix(TOK.encode("zero dispatch prefix"))
+        prompts = [TOK.encode("pod-a"), TOK.encode("pod-b request")]
+        serial = [
+            engine.generate(p, max_new_tokens=12).token_ids for p in prompts
+        ]
+        # Attach AFTER the serial baseline: the flow window must contain
+        # only the steady-state residency, not the dispatch-path warmup.
+        prof = EngineProfiler(MICRO, peak_tflops=100.0)
+        engine.attach_profiler(prof)
+        assert engine.enter_persistent()
+        base = engine.stats["dispatches"]
+        ids = engine.add_requests(prompts, max_new_tokens=12)
+        out = drain_persistent(engine, 2)
+        assert engine.stats["dispatches"] == base
+        assert [out[i] for i in ids] == serial
+        assert prof.dispatches_per_decision() == 0.0
+        gauges = prof.gauges()
+        assert gauges["dispatches_per_decision"] == 0.0
+        assert gauges["persistent_profiled"] >= 1.0
+        snap = prof.snapshot()["persistent"]
+        seg_sum = sum(
+            snap["segments_ms_total"][name] for name in PERSISTENT_SEGMENTS
+        )
+        # to per-segment rounding noise (each figure rounds to 1us)
+        assert seg_sum == pytest.approx(snap["wall_ms_total"], abs=0.05)
+        assert snap["tokens"] >= 1
+        engine.exit_persistent()
+
+    def test_persistent_segments_telescope_unit(self):
+        """sum(PERSISTENT_SEGMENTS) == wall, exactly (injected times)."""
+        prof = EngineProfiler(MICRO, peak_tflops=0.01)
+        assert prof.dispatches_per_decision() is None  # no window yet
+        prof.on_persistent(
+            wall_s=0.020, ring_wait_s=0.005, harvest_s=0.003,
+            loop_resident_s=0.012, steps=16, tokens=16, batches=4,
+        )
+        snap = prof.snapshot()["persistent"]
+        seg_sum = sum(
+            snap["segments_ms_total"][name] for name in PERSISTENT_SEGMENTS
+        )
+        assert seg_sum == pytest.approx(snap["wall_ms_total"], abs=1e-6)
+        assert snap["tokens"] == 16
+        assert snap["steps"] == 16
+        gauges = prof.gauges()
+        assert gauges["persistent_profiled"] == 1.0
+        frac_sum = sum(
+            gauges[f"persistent_{name}_frac"] for name in PERSISTENT_SEGMENTS
+        )
+        assert frac_sum == pytest.approx(1.0, abs=0.01)
+
+
+# --------------------------------------------------- worker-plane serving
+class TestLocalBackendPersistent:
+    def test_backend_serves_decisions_through_the_resident_loop(self):
+        """LocalLLMBackend(persistent_loop=True) feeds the rings instead
+        of submitting waves: a real grammar-constrained decision admits
+        via the CommandRing, drains off the TokenRing, and close() exits
+        the loop cleanly."""
+        from tests.test_local_worker import make_nodes, make_pod
+
+        from k8s_llm_scheduler_tpu.engine.local import LocalLLMBackend
+
+        eng = micro_engine(
+            persistent_suffix_bucket=512, num_pages=256,
+            max_pages_per_seq=32,
+        )
+        backend = LocalLLMBackend(
+            eng, tokenizer=TOK, max_new_tokens=80, persistent_loop=True,
+        )
+        try:
+            nodes = make_nodes(3)
+            decision = backend.get_scheduling_decision(make_pod(0), nodes)
+            assert decision.selected_node in {n.name for n in nodes}
+            assert eng.stats["persistent_admissions"] >= 1
+            assert eng.stats["persistent_fallbacks"] == 0
+        finally:
+            backend.close()
+        assert not eng.persistent_active

@@ -717,6 +717,100 @@ class UnconstrainedSharding(LintRule):
         return False
 
 
+# The persistent serving plane's ZERO-DISPATCH steady-state contract
+# (engine/persistent/): once the resident loop is launched, every
+# per-decision interaction is ring traffic — numpy in, numpy out. A
+# function is a declared steady-path function when its name ends in
+# `_steady` (the feeder/harvester naming convention server.py
+# established) or is one of the ordered-io_callback bodies; anything
+# reachable from one inside its module is on the steady path too.
+_STEADY_CALLBACK_NAMES = frozenset({"_device_poll", "_device_push"})
+
+
+def _steady_roots(graph: _ModuleGraph) -> set[str]:
+    return {
+        qual
+        for qual in graph.funcs
+        if qual.rsplit(".", 1)[-1].endswith("_steady")
+        or qual.rsplit(".", 1)[-1] in _STEADY_CALLBACK_NAMES
+    }
+
+
+class DispatchInPersistentPath(LintRule):
+    id = "dispatch-in-persistent-path"
+    family = "jax"
+    description = (
+        "an XLA dispatch (jax.*/jnp.* call, a jitted program, or "
+        ".block_until_ready) inside the persistent loop's steady-state "
+        "path — the path whose whole contract is zero per-decision "
+        "dispatches"
+    )
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        if not _loop_scope(ctx.name):
+            return
+        graph = _graph(ctx)
+        steady = _steady_roots(graph)
+        if not steady:
+            return
+        # `name = jax.jit(...)` assignment targets anywhere in the module
+        # (`self._jitted = jax.jit(...)`): calling one re-enters the
+        # dispatch path even though the name itself is not jax.*
+        jitted_names: set[str] = set()
+        for node in ctx.all_nodes():
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call) \
+                    and _is_jit_call(node.value):
+                for t in node.targets:
+                    tn = dotted_name(t)
+                    if tn:
+                        jitted_names.add(tn)
+        reachable: set[str] = set()
+        stack = list(steady)
+        while stack:
+            cur = stack.pop()
+            if cur in reachable:
+                continue
+            reachable.add(cur)
+            stack.extend(graph.edges.get(cur, ()))
+        for qual in sorted(reachable):
+            for node in body_walk(graph.funcs[qual]):
+                if not isinstance(node, ast.Call):
+                    continue
+                msg = self._classify(node, graph, jitted_names)
+                if msg:
+                    yield ctx.finding(
+                        self, node,
+                        f"{msg} inside `{qual}`, which is on the "
+                        f"persistent loop's steady-state path — steady "
+                        f"serving must be pure ring traffic (numpy + "
+                        f"threading), or the zero-dispatch-per-decision "
+                        f"contract is silently broken; route device work "
+                        f"through the launch/quiesce boundary or justify "
+                        f"via pragma",
+                    )
+
+    @staticmethod
+    def _classify(
+        call: ast.Call, graph: _ModuleGraph, jitted_names: set[str]
+    ) -> str | None:
+        if isinstance(call.func, ast.Attribute) \
+                and call.func.attr == "block_until_ready":
+            return "device sync `.block_until_ready()`"
+        name = dotted_name(call.func)
+        if not name:
+            return None
+        if name in jitted_names:
+            return f"call to jitted program `{name}`"
+        head = name.split(".", 1)[0]
+        if head in ("jax", "jnp"):
+            return f"XLA dispatch `{name}(...)`"
+        bare = name.rsplit(".", 1)[-1]
+        for qual in graph.by_bare.get(bare, ()):
+            if qual in graph.roots:
+                return f"call to jit-rooted `{bare}`"
+        return None
+
+
 JAX_RULES: list[LintRule] = [
     HostSyncInJit(),
     ClosureMutationInJit(),
@@ -724,4 +818,5 @@ JAX_RULES: list[LintRule] = [
     DeviceSyncInLoop(),
     DonatedBufferReuse(),
     UnconstrainedSharding(),
+    DispatchInPersistentPath(),
 ]
