@@ -1338,10 +1338,19 @@ class _StubResidentLoop:
             Heartbeat,
             TokenRing,
         )
+        from k8s_llm_scheduler_tpu.observability.resident import BlackBox
 
         self.commands = CommandRing(capacity=_CMD_CAPACITY)
         self.tokens = TokenRing(capacity=_TOK_CAPACITY)
         self.heartbeat = Heartbeat()
+        # Wedge black-box, chaos flavour: the real loop's box records
+        # full iteration snapshots (observability/resident.py), but
+        # iteration cadence here is thread timing — so this box records
+        # only PROTOCOL events (command uptake, FIFO order fixed by the
+        # plan), keeping the dump byte-identical across replay. Depth 16
+        # < the regime's ~36 admits, so boundedness is exercised, not
+        # just declared.
+        self.blackbox = BlackBox(depth=16)
         self.pause_polls = False
         self.wedged = False
         self._stop = False
@@ -1376,13 +1385,23 @@ class _StubResidentLoop:
                 cmd = self.commands.take()
             if cmd is not None:
                 if cmd.op == OP_QUIESCE:
+                    self.blackbox.record({"event": "quiesce"})
                     return
                 if cmd.op == OP_ABORT:
+                    self.blackbox.record(
+                        {"event": "abort", "slot": int(cmd.slot)}
+                    )
                     if cmd.slot < 0:
                         self._act[:] = False
                     else:
                         self._act[cmd.slot] = False
                 elif cmd.op == OP_ADMIT:
+                    self.blackbox.record({
+                        "event": "admit",
+                        "slot": int(cmd.slot),
+                        "seed": int(cmd.tokens[0, 0]),
+                        "budget": int(cmd.budget),
+                    })
                     self._seed[cmd.slot] = int(cmd.tokens[0, 0])
                     self._pos[cmd.slot] = 0
                     self._budget[cmd.slot] = cmd.budget
@@ -1578,6 +1597,18 @@ async def _run_persistent_stack(
         P["wedges"] += 1
         loop._stop = True
         loop._thread.join(2.0)
+        # Black-box dump at the latch, before any drain mutates state —
+        # the same order the real server uses (force_stop dumps first).
+        # Parked work is deterministic here (wedge windows are ordered
+        # before stall windows, so the plane settled), and the dump
+        # rides report["persistent"] into the byte-replayable trace.
+        loop.blackbox.record({
+            "event": "wedge_drain",
+            "parked": sorted(
+                req.pod.name for req in slot_req.values()
+            ),
+        })
+        P["blackbox"] = loop.blackbox.dump(reason="wedge")
         for batch in loop.tokens.drain(0.0):
             book_batch(batch)
         while True:

@@ -74,6 +74,14 @@ from k8s_llm_scheduler_tpu.engine.constrained import (
 from k8s_llm_scheduler_tpu.observability import spans
 from k8s_llm_scheduler_tpu.engine.kv_cache import PagedKVCache
 from k8s_llm_scheduler_tpu.engine.persistent.ring import OP_ADMIT
+from k8s_llm_scheduler_tpu.observability.resident import (
+    CTR_ADMITS,
+    CTR_IDLE_CHUNKS,
+    CTR_ITERS,
+    CTR_STEPS,
+    N_COUNTERS,
+    counters_dict,
+)
 from k8s_llm_scheduler_tpu.engine.tokenizer import ByteTokenizer, Tokenizer
 from k8s_llm_scheduler_tpu.models.configs import LlamaConfig
 from k8s_llm_scheduler_tpu.models.llama import (
@@ -559,6 +567,9 @@ class InferenceEngine:
         persistent_loop: bool = False,
         persistent_suffix_bucket: int | None = None,
         persistent_wedge_timeout_s: float = 30.0,
+        persistent_telemetry: bool = True,
+        persistent_stats_every: int = 8,
+        persistent_blackbox_depth: int = 64,
     ) -> None:
         self.cfg = cfg
         self.params = params
@@ -786,6 +797,19 @@ class InferenceEngine:
         self._persistent = None  # PersistentServer | None
         self._persistent_wedged = False
         self._pers_tok_last = 0.0  # profiler wall anchor for step_persistent
+        # Device-resident telemetry plane (observability/resident.py): the
+        # loop carries an in-loop counter block exported through the
+        # StatsRing; step_persistent decomposes loop_resident from the
+        # counter DELTAS between windows (baselines below), books the new
+        # persistent sub-segments, and keeps an EWMA of in-loop
+        # per-decision latency for the scheduler's synthetic spans.
+        self.persistent_telemetry = bool(persistent_telemetry)
+        self.persistent_stats_every = int(persistent_stats_every)
+        self.persistent_blackbox_depth = int(persistent_blackbox_depth)
+        self._pers_ctr_last = np.zeros(N_COUNTERS, dtype=np.int64)
+        self._pers_stall_last = 0
+        self._pers_ctr_final: dict[str, int] | None = None
+        self._resident_latency_ms: float | None = None
         # Completions recovered by an implicit drain (exit_persistent
         # inside a dispatch-path entry point) park here until the next
         # harvesting call returns them — never silently dropped.
@@ -2412,8 +2436,17 @@ class InferenceEngine:
                 self,
                 suffix_bucket=self.persistent_suffix_bucket,
                 wedge_timeout_s=self.persistent_wedge_timeout_s,
+                telemetry=self.persistent_telemetry,
+                stats_every=self.persistent_stats_every,
+                blackbox_depth=self.persistent_blackbox_depth,
             )
         self._persistent.launch()
+        # Fresh residency, fresh counter baselines: the device counter
+        # block restarts at zero each launch, so the host delta books
+        # must too.
+        self._pers_ctr_last = np.zeros(N_COUNTERS, dtype=np.int64)
+        self._pers_stall_last = self._persistent.tokens.stalls
+        self._pers_ctr_final = None
         self.stats["persistent_launches"] += 1
         self.stats["dispatches"] += 1
         # Re-baseline the decision-flow books at the mode transition: the
@@ -2436,7 +2469,14 @@ class InferenceEngine:
             return
         srv = self._persistent
         final = srv.quiesce()
-        (k, v, _pages, tok, pos, act, st, budget, rng, _total) = final
+        (k, v, _pages, tok, pos, act, st, budget, rng, _total,
+         ctr, _slot_tok, _admit_iter, _first_emit) = final
+        # The final carry holds the residency's EXACT device counter
+        # totals (the StatsRing only samples every stats_every pushes):
+        # book them for the reconciliation pin — emitted must equal the
+        # decode tokens harvested off the ring, token for token.
+        self._pers_ctr_final = counters_dict(np.asarray(ctr))
+        srv.stats_ring.clear_parked()
         self.kv.k, self.kv.v = k, v
         # The loop's carried page tables mirror the host allocator row for
         # row (admissions wrote the same rows from the same allocation),
@@ -2496,6 +2536,17 @@ class InferenceEngine:
             self.stats["persistent_wedges"] += 1
             self._persistent_wedged = True
             srv.force_stop()
+            # The wedge black-box (force_stop just dumped it) rides a
+            # synthetic flight-recorder trace so `cli trace show` and
+            # /debug/export carry the forensics beside the decisions the
+            # wedge stranded.
+            if srv.telemetry and spans.enabled():
+                with spans.start_trace("persistent-wedge") as tr:
+                    if tr is not None:
+                        tr.set_meta(
+                            blackbox=srv.blackbox_dump(),
+                            wedge_timeout_s=srv.wedge_timeout_s,
+                        )
             self.exit_persistent()
             out.extend(self._pending_finished)
             self._pending_finished = []
@@ -2512,17 +2563,114 @@ class InferenceEngine:
             wall = max(now - self._pers_tok_last, 0.0)
             ring_wait = min(t1 - t0, wall)
             harvest = min(now - t1, wall - ring_wait)
+            loop_resident = max(wall - ring_wait - harvest, 0.0)
             prof.on_persistent(
                 wall_s=wall,
                 ring_wait_s=ring_wait,
                 harvest_s=harvest,
-                loop_resident_s=max(wall - ring_wait - harvest, 0.0),
+                loop_resident_s=loop_resident,
                 steps=self.stats["persistent_steps"] - step_before,
                 tokens=self.stats["decode_tokens"] - tok_before,
                 batches=len(batches),
+                loop_segments=self._decompose_loop_resident(
+                    srv, loop_resident
+                ),
             )
             self._pers_tok_last = now
         return out
+
+    def _decompose_loop_resident(
+        self, srv, loop_resident_s: float
+    ) -> dict[str, float] | None:
+        """Counter-delta attribution of the opaque `loop_resident` window
+        into PERSISTENT_LOOP_SEGMENTS (admit/decode/ring_stall/idle) —
+        pure ring traffic, zero dispatches.
+
+        Drains the StatsRing and splits the window proportionally to the
+        counter DELTAS since the previous window: decode steps run,
+        admissions taken, token-ring backpressure stalls (a HOST book —
+        the device blocks inside its push callback and cannot count the
+        wait), and idle chunks (iterations whose decode ran zero steps).
+        The split telescopes by construction — the last segment is the
+        exact remainder — so sum == loop_resident holds to float
+        precision and the identity test pins it. Proportional weights
+        are the honest choice HERE: the device cannot timestamp inside
+        one XLA program without paying the dispatch boundaries this
+        subsystem exists to delete, so relative event counts are the
+        only in-loop signal that costs nothing. Also feeds the
+        resident-latency EWMA (admission-to-first-emission iterations x
+        mean iteration wall) the scheduler attaches as synthetic spans.
+        Returns None (sub-books unchanged) when telemetry is off or no
+        snapshot landed this window."""
+        if not srv.telemetry:
+            return None
+        snaps = srv.stats_ring.drain(0.0)
+        if not snaps:
+            return None
+        last = snaps[-1]
+        cur = np.asarray(last.counters, dtype=np.int64)
+        iters_start = int(self._pers_ctr_last[CTR_ITERS])
+        d = cur - self._pers_ctr_last
+        d_stalls = max(int(last.token_stalls) - self._pers_stall_last, 0)
+        self._pers_ctr_last = cur
+        self._pers_stall_last = int(last.token_stalls)
+        d_iters = int(d[CTR_ITERS])
+        weights = {
+            "admit": float(max(int(d[CTR_ADMITS]), 0)),
+            "decode": float(max(int(d[CTR_STEPS]), 0)),
+            "ring_stall": float(d_stalls),
+            "idle": float(max(int(d[CTR_IDLE_CHUNKS]), 0)),
+        }
+        total_w = sum(weights.values())
+        seg: dict[str, float] = {}
+        remaining = max(float(loop_resident_s), 0.0)
+        if total_w <= 0:
+            # A window with no counted events is a parked loop: idle.
+            seg = {"admit": 0.0, "decode": 0.0, "ring_stall": 0.0}
+        else:
+            for name in ("admit", "decode", "ring_stall"):
+                share = loop_resident_s * weights[name] / total_w
+                share = min(share, remaining)
+                seg[name] = share
+                remaining -= share
+        seg["idle"] = remaining  # exact remainder: sum == loop_resident
+        if d_iters > 0:
+            mean_iter_ms = loop_resident_s / d_iters * 1000.0
+            a_it = np.asarray(last.admit_iter)
+            f_em = np.asarray(last.first_emit)
+            fresh = (a_it >= iters_start) & (f_em >= a_it)
+            if fresh.any():
+                lat_iters = float((f_em[fresh] - a_it[fresh] + 1).mean())
+                lat_ms = lat_iters * mean_iter_ms
+                if self._resident_latency_ms is None:
+                    self._resident_latency_ms = lat_ms
+                else:
+                    self._resident_latency_ms = (
+                        0.7 * self._resident_latency_ms + 0.3 * lat_ms
+                    )
+        return seg
+
+    def resident_decision_latency(self) -> float | None:
+        """EWMA of in-loop per-decision latency (ms): admission-to-first-
+        emission iterations x mean resident iteration wall, derived from
+        the counter deltas. None until a ring-served admission completed
+        a telemetry window. sched/loop.py attaches this as a synthetic
+        `loop_resident` span so traces explain ring-served decisions."""
+        return self._resident_latency_ms
+
+    def persistent_counter_totals(self) -> dict[str, int] | None:
+        """Exact device counter totals of the last drained residency
+        (from the final carry, not the sampled StatsRing) — the
+        reconciliation pin: `emitted` equals the decode tokens harvested
+        off the token ring for that residency."""
+        return self._pers_ctr_final
+
+    def persistent_blackbox(self) -> dict | None:
+        """Latest wedge/quiesce black-box dump (what /debug/blackbox
+        serves); None before the first residency or with telemetry off."""
+        if self._persistent is None or not self._persistent.telemetry:
+            return None
+        return self._persistent.blackbox_dump()
 
     def _persistent_harvest(self, batches) -> list[Finished]:
         """Book a sequence of ring batches (in push order) into request
@@ -2807,6 +2955,15 @@ class InferenceEngine:
             )
         if dpd is not None:
             out["dispatches_per_decision"] = dpd
+        # Resident-loop gauge family as a subtree: flows through
+        # backend.get_stats into the fleet merge, so `cli fleet top` can
+        # read per-replica resident tok/s and the aggregator can export
+        # llm_scheduler_persistent_* without scraping each process.
+        if self.profiler is not None and (
+            self.profiler.persistent_profiled
+            or self.stats.get("persistent_launches")
+        ):
+            out["persistent"] = self.profiler.persistent_gauges()
         if self.spec is not None:
             out["spec"] = self.spec.stats.snapshot()
         return out

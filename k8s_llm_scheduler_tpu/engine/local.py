@@ -1475,6 +1475,9 @@ def build_local_backend(
     persistent_loop: bool = False,
     persistent_suffix_bucket: int | None = None,
     persistent_wedge_timeout_s: float = 30.0,
+    persistent_telemetry: bool = True,
+    persistent_stats_every: int = 8,
+    persistent_blackbox_depth: int = 64,
 ) -> LocalLLMBackend:
     """Construct the full local stack: params (from an HF safetensors or
     orbax checkpoint when checkpoint_path is set, random-init otherwise —
@@ -1602,6 +1605,9 @@ def build_local_backend(
         persistent_loop=persistent_loop,
         persistent_suffix_bucket=persistent_suffix_bucket,
         persistent_wedge_timeout_s=persistent_wedge_timeout_s,
+        persistent_telemetry=persistent_telemetry,
+        persistent_stats_every=persistent_stats_every,
+        persistent_blackbox_depth=persistent_blackbox_depth,
     )
     if spec_enabled:
         if multi:

@@ -45,7 +45,17 @@ from k8s_llm_scheduler_tpu.engine.fused.sampler import sample_fused
 from k8s_llm_scheduler_tpu.engine.persistent.ring import (
     OP_ABORT,
     OP_ADMIT,
+    OP_NOOP,
     OP_QUIESCE,
+)
+from k8s_llm_scheduler_tpu.observability.resident import (
+    CTR_ADMITS,
+    CTR_EMITTED,
+    CTR_EMPTY_POLLS,
+    CTR_IDLE_CHUNKS,
+    CTR_ITERS,
+    CTR_STEPS,
+    N_COUNTERS,
 )
 from k8s_llm_scheduler_tpu.models.llama import (
     forward_decode_fused_body,
@@ -74,10 +84,21 @@ def persistent_serve_impl(
     dfa_start: int,     # static
     vocab_limit: int | None = None,  # static
     prefix_impl: str | None = None,  # static
+    telemetry: bool = True,          # static — in-loop counter block
 ):
     """Serve until quiesced; returns the final carry for host rebinding:
     (k_cache, v_cache, page_tables, tok, pos, act, st, budget, rng,
-    total_steps)."""
+    total_steps, counters, slot_tokens, admit_iter, first_emit).
+
+    With `telemetry` on, a device-resident counter block rides in the
+    carry (observability/resident.py index order) plus per-slot token
+    counts and admission/first-emission iteration stamps. Updates are
+    pure carried-array arithmetic inside the traced program and the
+    block leaves the device by PIGGYBACKING on the push callback —
+    telemetry adds ZERO dispatches and ZERO extra callbacks (an ordered
+    io_callback under `lax.cond` is exactly what this loop's design
+    forbids). With telemetry off the arrays still ride the carry and
+    the push signature (fixed shapes) but stay zero/-1."""
     M, P = page_tables.shape
     ps = k_cache.shape[2]
     n_kv, hd = cfg.n_kv_heads, cfg.head_dim
@@ -95,12 +116,14 @@ def persistent_serve_impl(
     )
 
     def outer_body(carry):
-        (k, v, pages, tok, pos, act, st, budget, key, running, total) = carry
+        (k, v, pages, tok, pos, act, st, budget, key, running, total,
+         ctr, s_tok, a_it, f_em) = carry
         op, a_tok, a_len, a_slot, a_budget, a_ppages, a_prow = io_callback(
             poll, poll_shapes, total, ordered=True
         )
         is_admit = op == OP_ADMIT
         sl = a_slot[0]
+        cur_iter = ctr[CTR_ITERS]  # this iteration's index (pre-increment)
 
         # ---- ABORT: deactivate one slot (sl >= 0) or everything (sl < 0)
         is_abort = op == OP_ABORT
@@ -146,6 +169,20 @@ def persistent_serve_impl(
             )
         )
         admit_slot = jnp.where(is_admit, sl, jnp.int32(-1))
+
+        if telemetry:
+            ctr = ctr.at[CTR_ITERS].add(1)
+            ctr = ctr.at[CTR_EMPTY_POLLS].add(
+                jnp.where(op == OP_NOOP, 1, 0)
+            )
+            ctr = ctr.at[CTR_ADMITS].add(jnp.where(is_admit, 1, 0))
+            # Admission resets the slot's telemetry row — same trash-row
+            # .at[sl] + where(is_admit, ...) guard as the abort above.
+            s_tok = s_tok.at[sl].set(jnp.where(is_admit, 0, s_tok[sl]))
+            a_it = a_it.at[sl].set(jnp.where(is_admit, cur_iter, a_it[sl]))
+            f_em = f_em.at[sl].set(
+                jnp.where(is_admit, jnp.int32(-1), f_em[sl])
+            )
 
         # ---- DECODE micro-chunk: the fused chunk body, pages re-gathered
         # after the admission so a fresh slot decodes this same iteration.
@@ -207,26 +244,48 @@ def persistent_serve_impl(
         k = k.at[:, page_ids, offs].set(ck)
         v = v.at[:, page_ids, offs].set(cv)
 
+        if telemetry:
+            # Chunk emissions (pad-filtered) mirror the host's booking in
+            # _persistent_harvest EXACTLY: the admission's first token
+            # rides `first_tok`, not the buffer, on both sides — so the
+            # emitted counter reconciles token-for-token with the
+            # harvested decode_tokens books (test-pinned).
+            chunk_counts = jnp.sum(out != pad_id, axis=1).astype(jnp.int32)
+            ctr = ctr.at[CTR_STEPS].add(steps_run)
+            ctr = ctr.at[CTR_EMITTED].add(jnp.sum(chunk_counts))
+            ctr = ctr.at[CTR_IDLE_CHUNKS].add(
+                jnp.where(steps_run == 0, 1, 0)
+            )
+            s_tok = s_tok + chunk_counts
+            f_em = jnp.where(
+                (f_em < 0) & (chunk_counts > 0), cur_iter, f_em
+            )
+
         # ---- PUSH: stream this micro-chunk's outcome; blocking on a full
         # token ring is the emission backpressure, the int32 return is the
-        # host's stop vote (watchdog drain).
+        # host's stop vote (watchdog drain). The counter block piggybacks
+        # here — telemetry export costs no extra callback.
         stop_vote = io_callback(
             push, jax.ShapeDtypeStruct((), jnp.int32),
             out, steps_run, act, budget, pos, admit_slot, first_tok,
+            ctr, s_tok, a_it, f_em,
             ordered=True,
         )
         running = running & (op != OP_QUIESCE) & (stop_vote == 0)
         return (k, v, pages, tok, pos, act, st, budget, key, running,
-                total + steps_run)
+                total + steps_run, ctr, s_tok, a_it, f_em)
 
     def outer_cond(carry):
         return carry[9]
 
+    ctr0 = jnp.zeros((N_COUNTERS,), dtype=jnp.int32)
+    s_tok0 = jnp.zeros((M,), dtype=jnp.int32)
+    stamp0 = jnp.full((M,), -1, dtype=jnp.int32)
     (k_cache, v_cache, page_tables, tok, pos, act, st, budget, rng,
-     _running, total_steps) = jax.lax.while_loop(
+     _running, total_steps, ctr, s_tok, a_it, f_em) = jax.lax.while_loop(
         outer_cond, outer_body,
         (k_cache, v_cache, page_tables, tok, pos, act, st, budget, rng,
-         jnp.bool_(True), jnp.int32(0)),
+         jnp.bool_(True), jnp.int32(0), ctr0, s_tok0, stamp0, stamp0),
     )
     return (k_cache, v_cache, page_tables, tok, pos, act, st, budget, rng,
-            total_steps)
+            total_steps, ctr, s_tok, a_it, f_em)

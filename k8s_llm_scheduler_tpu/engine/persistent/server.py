@@ -48,6 +48,13 @@ from k8s_llm_scheduler_tpu.engine.persistent.ring import (
     HarvestBatch,
     TokenRing,
 )
+from k8s_llm_scheduler_tpu.observability.resident import (
+    BlackBox,
+    StatsRing,
+    StatsSnapshot,
+    counters_dict,
+    liveness_bitmap,
+)
 
 if TYPE_CHECKING:  # pragma: no cover - import cycle guard
     from k8s_llm_scheduler_tpu.engine.engine import InferenceEngine
@@ -85,6 +92,9 @@ class PersistentServer:
         token_capacity: int = 64,
         wedge_timeout_s: float = 30.0,
         poll_idle_s: float = 0.002,
+        telemetry: bool = True,
+        stats_every: int = 8,
+        blackbox_depth: int = 64,
     ) -> None:
         self.engine = engine
         self.suffix_bucket = int(
@@ -102,8 +112,20 @@ class PersistentServer:
         self.wedge_timeout_s = float(wedge_timeout_s)
         self.poll_idle_s = float(poll_idle_s)
 
+        self.telemetry = bool(telemetry)
+        self.stats_every = max(1, int(stats_every))
         self.commands = CommandRing(self.cmd_capacity)
         self.tokens = TokenRing(self.token_capacity)
+        # Telemetry plane (observability/resident.py): the StatsRing is
+        # published from the push callback via put_latest — drop-oldest,
+        # counted — so an undrained telemetry consumer can never
+        # backpressure-stall the serving loop. The BlackBox keeps the
+        # last-N per-push iteration snapshots for the wedge watchdog.
+        self.stats_ring = StatsRing(64)
+        self.blackbox = BlackBox(blackbox_depth)
+        self._push_count = 0
+        self._last_blackbox: dict | None = None
+        self._bb_dumped = False
         self.heartbeat = Heartbeat()
         self._thread: threading.Thread | None = None
         self._final: tuple | None = None
@@ -142,7 +164,7 @@ class PersistentServer:
             )
         key = (
             self.suffix_bucket, eng.chunk_steps, eng._constrained,
-            eng.top_k, eng._vocab_limit, eng._dfa_start,
+            eng.top_k, eng._vocab_limit, eng._dfa_start, self.telemetry,
         )
         if self._jitted is None or self._jit_key != key:
             self._jitted = jax.jit(
@@ -157,6 +179,7 @@ class PersistentServer:
                     dfa_start=eng._dfa_start,
                     vocab_limit=eng._vocab_limit,
                     prefix_impl=eng.prefix_attn_impl,
+                    telemetry=self.telemetry,
                 ),
                 static_argnums=(1,),
                 donate_argnums=(2, 3, 4, 8, 9, 10, 11, 12),
@@ -184,6 +207,14 @@ class PersistentServer:
         self._error = None
         self._done.clear()
         self._force_stop = False
+        # Fresh residency, fresh forensics: stale stats windows from the
+        # drained predecessor must not book against this loop, and the
+        # black-box ring must describe THIS residency only (_last_blackbox
+        # keeps the previous dump until a new one supersedes it).
+        self._push_count = 0
+        self._bb_dumped = False
+        self.stats_ring.clear_parked()
+        self.blackbox.clear()
         self._any_active = bool(
             (eng._act_np & (eng._budget_np > 0)).any()
         )
@@ -258,11 +289,17 @@ class PersistentServer:
         )
 
     def _device_push(
-        self, emitted, steps_run, act, budget, pos, admit_slot, first_tok
+        self, emitted, steps_run, act, budget, pos, admit_slot, first_tok,
+        ctr, slot_tok, admit_iter, first_emit,
     ):
         """Ordered io_callback: one emission batch per micro-chunk.
         Blocks on a full token ring (zero lost tokens); returns the stop
-        vote the watchdog uses to force a drain."""
+        vote the watchdog uses to force a drain. The device counter block
+        piggybacks here: every push records a black-box iteration
+        snapshot, and every `stats_every`-th push publishes a cumulative
+        StatsSnapshot to the StatsRing (put_latest — telemetry never
+        stalls the loop). Everything this path reaches is pure numpy +
+        threading (graftlint dispatch-in-persistent-path)."""
         self.heartbeat.beat()
         batch = HarvestBatch(
             seq=0,
@@ -275,6 +312,36 @@ class PersistentServer:
             first_tok=int(first_tok),
         )
         self._any_active = bool((batch.act & (batch.budget > 0)).any())
+        if self.telemetry:
+            self._push_count += 1
+            self.blackbox.record(
+                {
+                    "push": self._push_count,
+                    "counters": counters_dict(np.asarray(ctr)),
+                    "act_bits": liveness_bitmap(batch.act),
+                    "admit_slot": batch.admit_slot,
+                    "steps_run": batch.steps_run,
+                    "cmd_depth": self.commands.qsize(),
+                    "token_depth": self.tokens.qsize(),
+                    "cmd_cursor": self.commands.enqueued,
+                    "token_cursor": self.tokens.pushed,
+                }
+            )
+            if self._push_count % self.stats_every == 0:
+                self.stats_ring.put_latest(
+                    StatsSnapshot(
+                        seq=0,
+                        counters=np.asarray(ctr).astype(np.int64),
+                        slot_tokens=np.asarray(slot_tok),
+                        admit_iter=np.asarray(admit_iter),
+                        first_emit=np.asarray(first_emit),
+                        pushes=self.tokens.pushed,
+                        token_stalls=self.tokens.stalls,
+                        cmd_stalls=self.commands.stalls,
+                        cmd_depth=self.commands.qsize(),
+                        token_depth=self.tokens.qsize(),
+                    )
+                )
         ok = self.tokens.put(batch, stop_check=lambda: self._force_stop)
         return np.int32(0 if ok and not self._force_stop else 1)
 
@@ -339,7 +406,12 @@ class PersistentServer:
     def force_stop(self) -> None:
         """Watchdog drain: make the next poll return QUIESCE and the next
         push vote stop, then unblock a push stalled on the full token
-        ring by leaving its contents for harvest."""
+        ring by leaving its contents for harvest. Dumps the wedge
+        black-box FIRST — the forced drain is exactly the moment the
+        last-N iteration snapshots explain."""
+        if self.telemetry and self._running and not self._bb_dumped:
+            self._last_blackbox = self.blackbox.dump(reason="wedge")
+            self._bb_dumped = True
         self._force_stop = True
         with self.commands._cond:
             self.commands._cond.notify_all()
@@ -369,10 +441,21 @@ class PersistentServer:
         if self._thread is not None:
             self._thread.join(timeout=5.0)
             self._thread = None
+        if self.telemetry and not self._bb_dumped:
+            self._last_blackbox = self.blackbox.dump(reason="quiesce")
+            self._bb_dumped = True
         if self._error is not None:
             raise RuntimeError("persistent loop died") from self._error
         assert self._final is not None
         return self._final
+
+    def blackbox_dump(self) -> dict[str, Any]:
+        """Latest black-box dump: the wedge/quiesce dump once one was
+        taken, else a live view of the current residency's ring — what
+        /debug/blackbox serves."""
+        if self._last_blackbox is not None:
+            return self._last_blackbox
+        return self.blackbox.dump(reason="live")
 
     def stats(self) -> dict[str, Any]:
         return {
@@ -381,5 +464,16 @@ class PersistentServer:
             "persistent_token_stalls": self.tokens.stalls,
             "persistent_cmd_depth": self.commands.qsize(),
             "persistent_token_depth": self.tokens.qsize(),
+            # _frac suffix on purpose: the fleet merge averages ratio
+            # leaves (fleetview._RATIO_SUFFIXES) — fleet ring occupancy
+            # is a mean, not a sum.
+            "persistent_ring_occupancy_frac": round(
+                self.tokens.qsize() / self.token_capacity, 4
+            ),
             "persistent_heartbeats": self.heartbeat.beats,
+            "persistent_telemetry": self.telemetry,
+            "persistent_stats_published": self.stats_ring.pushed,
+            "persistent_stats_drops": self.stats_ring.dropped,
+            "persistent_stats_depth": self.stats_ring.qsize(),
+            "persistent_blackbox_recorded": self.blackbox.recorded,
         }

@@ -763,6 +763,26 @@ class TestPersistentWedgeRegime:
         assert report["invariants"]["checks"]["exactly_once_bind"] == 36
         assert report["scores"]["bound_frac"] == 1.0
 
+    def test_wedge_dumps_bounded_blackbox(self):
+        report = run_chaos("persistent-wedge", **self._KW)
+        p = report["persistent"]
+        # the watchdog latch dumped the black-box (same order as the
+        # real server: dump first, then drain)
+        bb = p.get("blackbox")
+        assert bb is not None and bb["reason"] == "wedge"
+        # BOUNDED: depth 16 < the regime's admit count, so the ring
+        # genuinely evicted — recorded counts everything, snapshots
+        # hold only the last N
+        assert len(bb["snapshots"]) <= bb["depth"] == 16
+        assert bb["recorded"] > len(bb["snapshots"])
+        # the latch event is the newest snapshot, and admissions
+        # preceding the wedge are present in FIFO order
+        assert bb["snapshots"][-1]["event"] == "wedge_drain"
+        admits = [s for s in bb["snapshots"] if s["event"] == "admit"]
+        assert admits and all(
+            s["budget"] > 0 and s["slot"] >= 0 for s in admits
+        )
+
     def test_regime_trace_replays_byte_identically(self, tmp_path):
         r1 = run_chaos("persistent-wedge", **self._KW)
         r2 = run_chaos("persistent-wedge", **self._KW)

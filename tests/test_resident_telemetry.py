@@ -1,0 +1,188 @@
+"""Device-resident telemetry plane primitives (observability/resident.py).
+
+The StatsRing carries the resident loop's telemetry windows under the
+SAME protocol discipline the TokenRing carries its emissions — so this
+suite mirrors TestTokenRing case for case (seq assignment/verification,
+loud loss, full-ring backpressure, stop_check unwedging, clear_parked
+cursor advance), then pins the telemetry-specific extension: put_latest's
+counted drop-oldest eviction, which is what lets the server publish from
+the push callback without ever letting an undrained consumer stall the
+serving loop. BlackBox gets its boundedness and byte-canonical dump
+contract pinned here; the end-to-end dumps (watchdog latch, quiesce,
+chaos replay) live in test_persistent.py / test_chaos_plane.py.
+"""
+
+import sys
+import threading
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import numpy as np
+import pytest
+
+from k8s_llm_scheduler_tpu.observability.resident import (
+    COUNTER_NAMES,
+    CTR_ADMITS,
+    CTR_EMITTED,
+    N_COUNTERS,
+    BlackBox,
+    StatsRing,
+    StatsSnapshot,
+    canonical_blackbox_bytes,
+    counters_dict,
+    liveness_bitmap,
+)
+
+
+def make_snap(**kw):
+    kw.setdefault("seq", -1)
+    kw.setdefault("counters", np.zeros(N_COUNTERS, dtype=np.int64))
+    kw.setdefault("slot_tokens", np.zeros(4, dtype=np.int32))
+    kw.setdefault("admit_iter", np.full(4, -1, dtype=np.int32))
+    kw.setdefault("first_emit", np.full(4, -1, dtype=np.int32))
+    return StatsSnapshot(**kw)
+
+
+# ------------------------------------------------------------ counter block
+class TestCounterBlock:
+    def test_names_cover_every_index(self):
+        assert len(COUNTER_NAMES) == N_COUNTERS
+
+    def test_counters_dict_names_by_index(self):
+        ctr = np.arange(N_COUNTERS, dtype=np.int64) * 10
+        d = counters_dict(ctr)
+        assert d["iters"] == 0
+        assert d["admits"] == CTR_ADMITS * 10
+        assert d["emitted"] == CTR_EMITTED * 10
+        assert all(isinstance(v, int) for v in d.values())
+
+    def test_liveness_bitmap_lsb_is_slot_zero(self):
+        assert liveness_bitmap(np.array([True, False, True, False])) == 0b101
+        assert liveness_bitmap(np.zeros(8, dtype=bool)) == 0
+        assert liveness_bitmap(np.ones(3, dtype=bool)) == 0b111
+
+
+# ---------------------------------------------------------------- StatsRing
+class TestStatsRing:
+    """TestTokenRing's protocol suite, applied to the telemetry stream."""
+
+    def test_seq_assigned_and_verified_in_order(self):
+        ring = StatsRing(capacity=8)
+        for _ in range(3):
+            assert ring.put(make_snap()) is True
+        out = ring.drain()
+        assert [s.seq for s in out] == [0, 1, 2]
+        assert ring.pushed == 3
+
+    def test_lost_snapshot_is_a_loud_protocol_error(self):
+        ring = StatsRing(capacity=8)
+        ring.put(make_snap())
+        # Simulate loss: snapshot 0 vanishes without the cursor moving.
+        with ring._cond:
+            ring._items.clear()
+        ring.put(make_snap())  # seq 1
+        with pytest.raises(RuntimeError, match="sequence break"):
+            ring.drain()
+
+    def test_full_ring_blocks_put_until_drain(self):
+        ring = StatsRing(capacity=1)
+        ring.put(make_snap())
+        done = []
+
+        def pusher():
+            done.append(ring.put(make_snap()))
+
+        t = threading.Thread(target=pusher)
+        t.start()
+        time.sleep(0.05)
+        assert not done  # the blocking publish is parked, not dropped
+        first = ring.drain()
+        t.join()
+        assert done == [True]
+        assert [s.seq for s in first] == [0]
+        assert [s.seq for s in ring.drain()] == [1]
+        assert ring.stalls == 1
+
+    def test_stop_check_unwedges_a_parked_put(self):
+        ring = StatsRing(capacity=1)
+        ring.put(make_snap())
+        assert ring.put(make_snap(), stop_check=lambda: True) is False
+
+    def test_clear_parked_advances_cursor_not_breaks_seq(self):
+        ring = StatsRing(capacity=8)
+        for _ in range(3):
+            ring.put(make_snap())
+        assert ring.clear_parked() == 3
+        ring.put(make_snap())  # seq 3 — must drain cleanly past the drop
+        assert [s.seq for s in ring.drain()] == [3]
+
+    def test_put_latest_drops_oldest_counted_and_seq_clean(self):
+        """The server's publish path: a full ring evicts the OLDEST
+        window (freshest-wins for cumulative stats), counts the drop,
+        and advances the take cursor so drain stays seq-verified — the
+        loop can NEVER be stalled by an undrained telemetry consumer."""
+        ring = StatsRing(capacity=2)
+        for _ in range(5):
+            ring.put_latest(make_snap())
+        assert ring.dropped == 3
+        assert ring.stalls == 0  # never blocked
+        out = ring.drain()  # must not raise despite the evictions
+        assert [s.seq for s in out] == [3, 4]
+
+    def test_closed_ring_raises_on_publish(self):
+        ring = StatsRing(capacity=2)
+        ring.close()
+        with pytest.raises(RuntimeError, match="closed"):
+            ring.put_latest(make_snap())
+        with pytest.raises(RuntimeError, match="closed"):
+            ring.put(make_snap())
+
+    def test_capacity_validated(self):
+        with pytest.raises(ValueError):
+            StatsRing(capacity=0)
+
+
+# ----------------------------------------------------------------- BlackBox
+class TestBlackBox:
+    def test_bounded_last_n_with_total_recorded(self):
+        box = BlackBox(depth=4)
+        for i in range(10):
+            box.record({"iter": i})
+        dump = box.dump(reason="wedge")
+        assert dump["reason"] == "wedge"
+        assert dump["depth"] == 4
+        assert dump["recorded"] == 10
+        # last-N, oldest evicted silently (this ring is forensics, not
+        # a delivery channel — boundedness IS the contract)
+        assert [s["iter"] for s in dump["snapshots"]] == [6, 7, 8, 9]
+
+    def test_dump_is_byte_canonical(self):
+        """Two boxes fed the same snapshot sequence dump byte-identical
+        payloads — the property the chaos persistent-wedge regime pins
+        end-to-end across replays."""
+        def fill(box):
+            for i in range(7):
+                box.record({
+                    "push": i,
+                    "counters": {"iters": i * 3, "emitted": i},
+                    "act_bits": liveness_bitmap(
+                        np.array([i % 2 == 0, True, False])
+                    ),
+                })
+            return canonical_blackbox_bytes(box.dump(reason="quiesce"))
+
+        assert fill(BlackBox(depth=4)) == fill(BlackBox(depth=4))
+
+    def test_clear_resets_books(self):
+        box = BlackBox(depth=2)
+        box.record({"a": 1})
+        box.clear()
+        dump = box.dump()
+        assert dump["recorded"] == 0 and dump["snapshots"] == []
+        assert box.recorded == 0
+
+    def test_depth_validated(self):
+        with pytest.raises(ValueError):
+            BlackBox(depth=0)
