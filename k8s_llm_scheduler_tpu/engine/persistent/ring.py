@@ -121,7 +121,8 @@ class CommandRing:
         self._cond = threading.Condition()
         self._closed = False
         self.stalls = 0       # puts that had to wait on a full ring
-        self.enqueued = 0
+        self.enqueued = 0     # host-side producer cursor
+        self.taken = 0        # device-side consumer cursor (loop progress)
 
     def put(self, cmd: Command, timeout_s: float = 5.0) -> None:
         deadline = time.monotonic() + timeout_s
@@ -150,6 +151,7 @@ class CommandRing:
             if not self._items:
                 return None
             cmd = self._items.popleft()
+            self.taken += 1
             self._cond.notify_all()
             return cmd
 

@@ -314,6 +314,11 @@ class PersistentServer:
         self._any_active = bool((batch.act & (batch.budget > 0)).any())
         if self.telemetry:
             self._push_count += 1
+            # Black-box snapshots ride the chaos trace and must stay a
+            # pure function of the served sequence (the BlackBox
+            # contract): only the loop's OWN cursors qualify. Ring
+            # depths and the feeder's enqueue cursor are host-thread
+            # timing — they live in StatsSnapshot (telemetry), not here.
             self.blackbox.record(
                 {
                     "push": self._push_count,
@@ -321,9 +326,7 @@ class PersistentServer:
                     "act_bits": liveness_bitmap(batch.act),
                     "admit_slot": batch.admit_slot,
                     "steps_run": batch.steps_run,
-                    "cmd_depth": self.commands.qsize(),
-                    "token_depth": self.tokens.qsize(),
-                    "cmd_cursor": self.commands.enqueued,
+                    "cmd_cursor": self.commands.taken,
                     "token_cursor": self.tokens.pushed,
                 }
             )

@@ -21,6 +21,18 @@ from __future__ import annotations
 import dataclasses
 from typing import Any, Iterable, Sequence
 
+# The declared mesh-axes table: every named axis a PartitionSpec anywhere
+# in this codebase may mention. parallel/mesh.py builds meshes in this
+# order (tp innermost so its collectives ride ICI neighbors), and
+# graftlint's `unknown-mesh-axis` rule validates PartitionSpec string
+# literals against this tuple STATICALLY — a typo'd axis name
+# (P("tensor") for P("tp")) is not an error to GSPMD, it just silently
+# replicates the tensor, so the lint is the only thing that catches it
+# before a bench does. Adding an axis here is a declaration reviewed like
+# an API change; the lint reads this assignment via AST, so keep it a
+# plain tuple of string literals.
+MESH_AXES = ("dp", "pp", "fsdp", "sp", "tp")
+
 
 def member_tp(member: Any) -> int:
     """Devices in `member`'s tensor-parallel group (>= 1).

@@ -422,7 +422,7 @@ class IncidentCorpus:
         version = int(ptr["next_version"])
         record = {
             "version": version,
-            "created_at": time.time(),  # graftlint: ok[raw-clock] — wall-clock metadata for operators, never compared against durations
+            "created_at": time.time(),  # graftlint: ok[raw-clock, wall-clock-in-replay] — wall-clock metadata for operators, never compared against durations
             "checkpoint_version": checkpoint_version,
             "note": note,
             "per_class": per_class_counts(sources),

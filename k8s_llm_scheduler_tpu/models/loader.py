@@ -172,7 +172,7 @@ def load_hf_checkpoint(
             )()
         return jnp.zeros(shapes[name], dtype)
 
-    set_layer = jax.jit(
+    set_layer = jax.jit(  # graftlint: ok[donated-buffer-escape] — pure index update: in/out shardings are identical by construction, so XLA aliases the donation without a bundle
         lambda buf, x, i: jax.lax.dynamic_update_index_in_dim(buf, x, i, 0),
         donate_argnums=(0,),
     )

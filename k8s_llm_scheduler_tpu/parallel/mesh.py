@@ -24,7 +24,12 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
-AXIS_ORDER = ("dp", "pp", "fsdp", "sp", "tp")
+from k8s_llm_scheduler_tpu.engine.sharded.geometry import MESH_AXES
+
+# Mesh construction order == the declared axes table (one source of
+# truth: engine/sharded/geometry.MESH_AXES, which graftlint's
+# unknown-mesh-axis rule also validates PartitionSpec literals against).
+AXIS_ORDER = MESH_AXES
 
 
 def make_mesh(

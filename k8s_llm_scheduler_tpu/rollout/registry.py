@@ -215,7 +215,7 @@ class CheckpointRegistry:
                 ),
                 config_fingerprint=config_fingerprint(cfg) if cfg else "",
                 tokenizer=tokenizer,
-                created_at=time.time(),  # graftlint: ok[raw-clock] — wall-clock metadata for operators, never compared against durations
+                created_at=time.time(),  # graftlint: ok[raw-clock, wall-clock-in-replay] — wall-clock metadata for operators, never compared against durations
                 parent=parent if parent is not None else self.active(),
                 scores=dict(scores or {}),
                 note=note,

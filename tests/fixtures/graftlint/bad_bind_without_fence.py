@@ -1,0 +1,25 @@
+"""TRUE-POSITIVE fixture: bind-without-fence-check.
+
+The lease-fencing protocol (fleet/lease.py, sched/journal.py) demands
+that a binder verify ownership before the bind POST; a bind with no
+reachable fence check is exactly the zombie-scheduler double-bind the
+fences exist to prevent. Fixtures stand in for binder modules.
+"""
+
+
+class Binder:
+    def __init__(self, api, lease):
+        self.api = api
+        self.lease = lease
+
+    def bad_bind(self, pod, node):
+        # BAD: a deposed scheduler can reach this POST
+        self.api.bind_pod_to_node(pod, node)
+
+    def good_bind(self, pod, node):
+        if not self.lease.owns():
+            raise RuntimeError("lost the lease — refusing to bind")
+        self.api.bind_pod_to_node(pod, node)
+
+    def suppressed_bind(self, pod, node):
+        self.api.bind_pod_to_node(pod, node)  # graftlint: ok[bind-without-fence-check] — fixture: single-scheduler test harness, no lease plane exists

@@ -1,0 +1,85 @@
+"""TRUE-POSITIVE fixtures: the determinism family.
+
+Four quiet ways to break the byte-replay contract, each in its
+pre-discipline shape: set iteration, id()-derived keys, and raw clock
+reads inside functions that reach a canonical writer (json.dumps with
+sort_keys=True / a fed hashlib digest — the repo's conventions), plus
+the interpreter-global RNG in what stands in for a runtime module.
+Suppressed variants record the judgments the shipped tree actually
+makes (report-only timing, in-memory-only address keys).
+"""
+
+import hashlib
+import json
+import random
+import time
+
+import numpy as np
+
+
+def bad_set_payload(decisions):
+    # BAD: set order is hash-randomized per process — two identical
+    # runs serialize different bytes
+    names = {d.pod for d in decisions}
+    payload = [n for n in names]
+    return json.dumps(payload, sort_keys=True).encode()
+
+
+def good_sorted_payload(decisions):
+    names = {d.pod for d in decisions}
+    # sorted() consumes the generator order-insensitively: the fix
+    payload = sorted(n for n in names)
+    return json.dumps(payload, sort_keys=True).encode()
+
+
+def suppressed_set_payload(decisions):
+    names = {d.pod for d in decisions}
+    count = 0
+    for _ in names:  # graftlint: ok[unordered-set-in-canonical] — fixture: only the COUNT is serialized, order never escapes
+        count += 1
+    return json.dumps({"n": count}, sort_keys=True).encode()
+
+
+def bad_jitter():
+    # BAD: interpreter-global RNG — replay cannot pin its state
+    return random.uniform(0.0, 0.5)
+
+
+def bad_np_jitter():
+    return np.random.uniform(0.0, 1.0)  # BAD: numpy legacy global RNG
+
+
+def suppressed_jitter():
+    return random.random()  # graftlint: ok[unseeded-random] — fixture: demo-only pacing jitter, never replay-compared
+
+
+def good_seeded_jitter(rng):
+    return rng.random()
+
+
+def bad_id_keyed(decisions):
+    # BAD: id() is an address — ASLR baked into the artifact
+    ranked = sorted(decisions, key=id)
+    table = {id(d): d.score for d in decisions}
+    return json.dumps(
+        {"order": [d.pod for d in ranked], "n": len(table)}, sort_keys=True
+    )
+
+
+def suppressed_id_keyed(decisions):
+    dedup = {}
+    for d in decisions:
+        dedup[id(d)] = d  # graftlint: ok[id-keyed-ordering] — fixture: in-memory dedup only; the serialized view re-keys by pod name
+    return json.dumps(sorted(x.pod for x in dedup.values()), sort_keys=True)
+
+
+def bad_stamped_trace(events):
+    # BAD: a raw clock value lands in the digested payload
+    payload = {"events": events, "stamp": time.time()}
+    return hashlib.sha256(json.dumps(payload, sort_keys=True).encode())
+
+
+def suppressed_stamped_trace(events):
+    wall = time.monotonic()  # graftlint: ok[wall-clock-in-replay] — fixture: timing rides the report only, stripped before canonicalizing
+    payload = {"events": events}
+    return json.dumps(payload, sort_keys=True), wall

@@ -1,0 +1,36 @@
+"""TRUE-POSITIVE fixture: donated-buffer-escape.
+
+XLA can only alias a donated input into an output whose sharding
+matches; a `donate_argnums` jit site in a mesh-context module that
+declares no shardings (no in_/out_shardings, no bound bundle) escapes
+the EngineShardings discipline — the donation silently degrades to a
+copy while the caller still treats the buffer as dead. The impl body
+constrains its output, so this is ONLY the donation escaping, not
+unconstrained-sharding.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding  # noqa: F401  (mesh-context marker)
+
+
+def _append_impl(buf, tok, spec=None):
+    out = jnp.concatenate([buf, tok])
+    return jax.lax.with_sharding_constraint(out, spec)
+
+
+# BAD: position 0 donated, no shardings anywhere at the site — the
+# alias depends on in/out shardings the compiler was never told
+_append = jax.jit(_append_impl, donate_argnums=(0,))
+
+
+def good_bundle(shardings):
+    return jax.jit(
+        _append_impl,
+        donate_argnums=(0,),
+        in_shardings=shardings.kv,
+        out_shardings=shardings.kv,
+    )
+
+
+_append_boot = jax.jit(_append_impl, donate_argnums=(0,))  # graftlint: ok[donated-buffer-escape] — fixture: single-device boot path, in/out shardings identical by construction

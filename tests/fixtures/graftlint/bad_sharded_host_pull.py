@@ -1,0 +1,29 @@
+"""TRUE-POSITIVE fixture: sharded-host-pull.
+
+The filename carries "sharded", so this corpus file stands in for an
+engine/sharded/ plane module: every function here seeds the tp>1
+serving path. A `jax.device_get` (or a placement-free
+`jax.device_put`, which implicitly reshards onto the default device)
+reachable from those seeds gathers the full distributed value through
+one host — the all-gather the sharded plane exists to avoid. The ONE
+per-decision result pull at the serving boundary is the suppressed
+judgment.
+"""
+
+import jax
+
+
+def bad_harvest(logits):
+    return jax.device_get(logits)  # BAD: full-mesh gather through one host
+
+
+def bad_implicit_reshard(x):
+    return jax.device_put(x)  # BAD: placement-free — reshards to device 0
+
+
+def good_placed(x, sharding):
+    return jax.device_put(x, sharding)
+
+
+def suppressed_result_pull(decision):
+    return jax.device_get(decision)  # graftlint: ok[sharded-host-pull] — fixture: the ONE per-decision result pull at the serving boundary

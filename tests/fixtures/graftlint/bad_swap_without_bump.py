@@ -1,0 +1,31 @@
+"""TRUE-POSITIVE fixture: swap-without-epoch-bump.
+
+Swapping serving parameters invalidates every cached decision and every
+pinned prefix-KV snapshot; the coherence story only holds if the swap
+path also reaches bump evidence (a bump_generation call or an augmented
+assignment to an epoch/generation counter). The bad path swaps with no
+bump reachable — a warm cache keeps serving the OLD model's decisions,
+no crash, wrong answers.
+"""
+
+
+class HotSwapper:
+    def __init__(self, engine, cache):
+        self.engine = engine
+        self.cache = cache
+        self.generation = 0
+
+    def bad_swap(self, params):
+        # BAD: no generation/epoch bump reachable from this path
+        self.engine.swap_params(params)
+
+    def good_swap(self, params):
+        self.engine.swap_params(params)
+        self.generation += 1
+
+    def good_bump_call(self, params):
+        self.engine.swap_params(params)
+        self.cache.bump_generation()
+
+    def suppressed_swap(self, params):
+        self.engine.swap_params(params)  # graftlint: ok[swap-without-epoch-bump] — fixture: cold-boot load, no cache exists to invalidate yet

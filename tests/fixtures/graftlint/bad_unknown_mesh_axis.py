@@ -1,0 +1,25 @@
+"""TRUE-POSITIVE fixture: unknown-mesh-axis.
+
+A PartitionSpec axis name is just a string: GSPMD treats an axis the
+mesh never declared as "replicate", so ``P("tensor")`` where the mesh
+says ``tp`` is a silent 8x regression, not an error. The fixture
+carries its own mesh-axes table (standalone files may; the shipped one
+lives in engine/sharded/geometry.py) and typos an axis against it.
+"""
+
+from jax.sharding import PartitionSpec as P
+
+# The declared table the rule validates literals against.
+MESH_AXES = ("dp", "tp")
+
+
+def bad_spec():
+    return P("dp", "tensor")  # BAD: the mesh declares "tp", not "tensor"
+
+
+def good_spec():
+    return P(None, "tp")
+
+
+def suppressed_spec():
+    return P("expert")  # graftlint: ok[unknown-mesh-axis] — fixture: staging spec for the mesh revision that adds the axis

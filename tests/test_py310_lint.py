@@ -125,6 +125,14 @@ class TestRepoIsClean:
         # the same risk class as the token ring they mirror)
         assert "k8s_llm_scheduler_tpu/observability/resident.py" in files
         assert "tests/test_resident_telemetry.py" in files
+        # interprocedural-graftlint round: the analysis engine's test
+        # file rides the normal scan; the engine's OWN tree is excluded
+        # here (rule modules are pattern tables) and covered instead by
+        # the self-sweep in tests/test_graftlint.py
+        assert "tests/test_graftlint.py" in files
+        assert "tools/graftlint/repograph.py" not in files
+        assert "tools/graftlint/core.py" not in files
+        assert not any(f.startswith("tests/fixtures/graftlint") for f in files)
         # the lint never lints its own pattern table
         assert "tools/py310_lint.py" not in files
 

@@ -3,11 +3,18 @@
 Exit codes: 0 = clean (suppressed findings allowed), 1 = unsuppressed
 findings, 2 = usage or internal error. The same runner backs ``cli lint``
 and the pytest gate (tests/test_graftlint.py::test_repo_is_clean).
+
+``--changed [REF]`` lints only the first-party files that differ from
+REF (default HEAD) plus untracked ones — the pre-commit shape. The
+interprocedural graph is still built over the WHOLE tree (reachability
+must not depend on which files you are reporting on); only the findings
+are filtered to the changed set.
 """
 
 from __future__ import annotations
 
 import argparse
+import subprocess
 import sys
 from pathlib import Path
 
@@ -17,8 +24,48 @@ _ROOT = Path(__file__).resolve().parent.parent.parent
 if str(_ROOT) not in sys.path:
     sys.path.insert(0, str(_ROOT))
 
-from tools.graftlint.core import RuleViolationError, run_repo  # noqa: E402
+from tools.graftlint.core import (  # noqa: E402
+    REPO_ROOT,
+    RuleViolationError,
+    iter_repo_files,
+    run_repo,
+)
 from tools.graftlint.rules import RULES, rules_by_selector  # noqa: E402
+
+
+def changed_files(ref: str, root: Path | None = None) -> list[Path]:
+    """First-party files that differ from `ref`, plus untracked ones,
+    intersected with the scan set (deleted files drop out via is_file)."""
+    root = root or REPO_ROOT
+    names: set[str] = set()
+    for cmd in (
+        ["git", "diff", "--name-only", ref, "--"],
+        ["git", "ls-files", "--others", "--exclude-standard"],
+    ):
+        proc = subprocess.run(
+            cmd, cwd=root, capture_output=True, text=True, check=False,
+        )
+        if proc.returncode != 0:
+            raise RuleViolationError(
+                f"--changed: `{' '.join(cmd)}` failed: "
+                f"{proc.stderr.strip() or proc.stdout.strip()}"
+            )
+        names.update(line.strip() for line in proc.stdout.splitlines() if line.strip())
+    scan_set = {str(p.relative_to(root)): p for p in iter_repo_files(root)}
+    return [scan_set[n] for n in sorted(names) if n in scan_set]
+
+
+def list_rules_grouped() -> str:
+    """The rule catalog grouped by family, one line per rule."""
+    by_family: dict[str, list] = {}
+    for rule in RULES:
+        by_family.setdefault(rule.family, []).append(rule)
+    lines: list[str] = []
+    for family in sorted(by_family):
+        lines.append(f"{family}:")
+        for rule in sorted(by_family[family], key=lambda r: r.id):
+            lines.append(f"  {rule.id:28s} {rule.description}")
+    return "\n".join(lines)
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -35,16 +82,25 @@ def main(argv: list[str] | None = None) -> int:
         help="comma-separated rule ids or families (default: all)",
     )
     parser.add_argument(
+        "--changed", nargs="?", const="HEAD", default=None, metavar="REF",
+        help="lint only first-party files differing from REF (default "
+        "HEAD) plus untracked ones — the pre-commit mode",
+    )
+    parser.add_argument(
         "--format", choices=("human", "jsonl"), default="human",
     )
     parser.add_argument(
-        "--list-rules", action="store_true", help="print the rule catalog",
+        "--list-rules", action="store_true",
+        help="print the rule catalog grouped by family",
+    )
+    parser.add_argument(
+        "--no-cache", action="store_true",
+        help="ignore and do not write the on-disk analysis cache",
     )
     args = parser.parse_args(argv)
 
     if args.list_rules:
-        for rule in RULES:
-            print(f"{rule.id:24s} [{rule.family}] {rule.description}")
+        print(list_rules_grouped())
         return 0
 
     try:
@@ -54,12 +110,24 @@ def main(argv: list[str] | None = None) -> int:
         )
         rules = rules_by_selector(selectors)
         paths = args.paths or None
+        if args.changed is not None:
+            if paths:
+                print(
+                    "graftlint: --changed and explicit paths are mutually "
+                    "exclusive", file=sys.stderr,
+                )
+                return 2
+            paths = changed_files(args.changed)
+            if not paths:
+                print(f"graftlint: OK (no first-party files differ from "
+                      f"{args.changed})")
+                return 0
         if paths:
             missing = [p for p in paths if not p.is_file()]
             if missing:
                 print(f"graftlint: no such file(s): {missing}", file=sys.stderr)
                 return 2
-        report = run_repo(rules, paths=paths)
+        report = run_repo(rules, paths=paths, use_cache=not args.no_cache)
     except RuleViolationError as exc:
         print(f"graftlint: {exc}", file=sys.stderr)
         return 2

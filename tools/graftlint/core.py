@@ -17,6 +17,21 @@ suppress (the finding survives, annotated) — a silenced checker with no
 recorded reason is how suppressions rot. The py310 family additionally
 honors the historical ``# py310-ok`` pragma (with or without a reason)
 so every existing call site keeps working.
+
+Interprocedural rules see the whole repo through ``ctx.repo`` — a
+:class:`tools.graftlint.repograph.RepoGraph` built once per run (and
+served from the content-hash cache on disk). The graph-construction
+policy keeps fixtures self-contained:
+
+- ``run_repo`` over the first-party tree (or any subset of it, e.g.
+  ``--changed``) builds ONE whole-tree graph and lints the requested
+  files against it — cross-module reachability is always computed over
+  the full repo, never just the files being reported on;
+- explicit paths OUTSIDE the scan set (the fixture corpus, ad-hoc
+  files) each get a single-file graph, so a deliberately-bad fixture
+  can never borrow innocence (or guilt) from its neighbors;
+- ``lint_text`` builds a single-file graph lazily on first
+  ``ctx.repo`` access.
 """
 
 from __future__ import annotations
@@ -27,6 +42,8 @@ import json
 import re
 from pathlib import Path
 from typing import Iterable, Iterator
+
+from tools.graftlint.repograph import CACHE_BASENAME, RepoGraph, iter_file_funcs
 
 REPO_ROOT = Path(__file__).resolve().parent.parent.parent
 
@@ -77,9 +94,10 @@ class Pragma:
 class FileContext:
     """Everything a rule needs about one file, computed once."""
 
-    def __init__(self, name: str, text: str) -> None:
+    def __init__(self, name: str, text: str, repo: RepoGraph | None = None) -> None:
         self.name = name
         self.text = text
+        self._repo = repo
         self.lines = text.splitlines()
         self.pragmas: dict[int, Pragma] = {}
         for lineno, line in enumerate(self.lines, start=1):
@@ -103,6 +121,7 @@ class FileContext:
         # dominated the full-repo wall clock (the <10s fast-tier budget)
         self._all_nodes: list[ast.AST] | None = None
         self._functions: list | None = None
+        self._graph_funcs: list | None = None
 
     def all_nodes(self) -> list[ast.AST]:
         """Flat ast.walk of the whole tree, computed once per file."""
@@ -115,6 +134,27 @@ class FileContext:
         if self._functions is None:
             self._functions = list(iter_funcs(self.tree))
         return self._functions
+
+    @property
+    def repo(self) -> RepoGraph:
+        """The whole-repo call graph (or, for a standalone file, a graph
+        of just this file). Shared across every file of a run_repo pass;
+        rules key reachability questions on `ctx.gqual(local_qual)`."""
+        if self._repo is None:
+            self._repo = RepoGraph.from_texts({self.name: self.text})
+        return self._repo
+
+    def gqual(self, local_qual: str) -> str:
+        """This file's `local_qual` as a repo-global function id."""
+        return f"{self.name}::{local_qual}"
+
+    def graph_funcs(self) -> list:
+        """[(local qual, def node, owning class name | None), ...] using
+        the SAME qual scheme as the repo index, so a rule can pair the
+        live AST node with its graph entry. Memoized per file."""
+        if self._graph_funcs is None:
+            self._graph_funcs = list(iter_file_funcs(self.tree))
+        return self._graph_funcs
 
     def finding(
         self, rule: "LintRule", node: ast.AST | int, message: str
@@ -203,9 +243,10 @@ def _family_of(rule_id: str) -> str:
 
 
 def lint_text(
-    text: str, name: str, rules: Iterable[LintRule]
+    text: str, name: str, rules: Iterable[LintRule],
+    repo: RepoGraph | None = None,
 ) -> LintReport:
-    ctx = FileContext(name, text)
+    ctx = FileContext(name, text, repo=repo)
     raw: list[Finding] = []
     rules = list(rules)
     for rule in rules:
@@ -231,27 +272,61 @@ def lint_text(
     return LintReport(findings, suppressed, files_scanned=1)
 
 
-def lint_file(path: Path, rules: Iterable[LintRule], root: Path | None = None) -> LintReport:
+def lint_file(
+    path: Path, rules: Iterable[LintRule], root: Path | None = None,
+    repo: RepoGraph | None = None,
+) -> LintReport:
     root = root or REPO_ROOT
     try:
         name = str(path.resolve().relative_to(root))
     except ValueError:
         name = str(path)
-    return lint_text(path.read_text(), name, rules)
+    return lint_text(path.read_text(), name, rules, repo=repo)
+
+
+def build_repo_graph(
+    root: Path | None = None,
+    files: Iterable[Path] | None = None,
+    use_cache: bool = True,
+) -> RepoGraph:
+    """The whole-tree interprocedural graph, content-hash cached at
+    `<root>/.graftlint_cache.json` (gitignored; safe to delete any time
+    — it only makes the next run cold)."""
+    root = root or REPO_ROOT
+    files = list(files) if files is not None else iter_repo_files(root)
+    cache_path = (root / CACHE_BASENAME) if use_cache else None
+    return RepoGraph.build(files, root, cache_path=cache_path)
 
 
 def run_repo(
     rules: Iterable[LintRule],
     root: Path | None = None,
     paths: Iterable[Path] | None = None,
+    use_cache: bool = True,
 ) -> LintReport:
-    """Lint explicit `paths`, or the whole first-party tree."""
+    """Lint explicit `paths`, or the whole first-party tree.
+
+    Graph policy: paths inside the scan set are linted against the
+    WHOLE-TREE graph (reachability must not depend on which files you
+    asked to see — `--changed` linting one file still knows the jit
+    roots two modules away); paths outside it (fixtures) each get a
+    single-file graph so deliberately-bad corpora stay self-contained.
+    """
     rules = list(rules)
-    files = list(paths) if paths is not None else iter_repo_files(root)
+    root = root or REPO_ROOT
+    repo_files = iter_repo_files(root)
+    files = list(paths) if paths is not None else repo_files
+    in_scan_set = {p.resolve() for p in repo_files}
+    shared: RepoGraph | None = None
     findings: list[Finding] = []
     suppressed: list[Finding] = []
     for path in files:
-        rep = lint_file(path, rules, root=root)
+        repo = None
+        if path.resolve() in in_scan_set:
+            if shared is None:
+                shared = build_repo_graph(root, repo_files, use_cache=use_cache)
+            repo = shared
+        rep = lint_file(path, rules, root=root, repo=repo)
         findings.extend(rep.findings)
         suppressed.extend(rep.suppressed)
     return LintReport(findings, suppressed, files_scanned=len(files))

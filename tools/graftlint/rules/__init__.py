@@ -1,18 +1,22 @@
 """Rule registry. A selector is a rule id (``lock-across-await``) or a
-family name (``concurrency``, ``jax``, ``py310``)."""
+family name (``concurrency``, ``determinism``, ``jax``, ``protocol``,
+``sharding``, ``py310``)."""
 
 from __future__ import annotations
 
 from tools.graftlint.core import LintRule, RuleViolationError
 from tools.graftlint.rules.concurrency import CONCURRENCY_RULES
+from tools.graftlint.rules.determinism import DETERMINISM_RULES
 from tools.graftlint.rules.durability import DURABILITY_RULES
 from tools.graftlint.rules.jaxpurity import JAX_RULES
+from tools.graftlint.rules.protocol import PROTOCOL_RULES
 from tools.graftlint.rules.py310 import PY310_RULES
 from tools.graftlint.rules.resilience import RESILIENCE_RULES
+from tools.graftlint.rules.sharding import SHARDING_RULES
 
 RULES: list[LintRule] = [
-    *CONCURRENCY_RULES, *DURABILITY_RULES, *JAX_RULES, *PY310_RULES,
-    *RESILIENCE_RULES,
+    *CONCURRENCY_RULES, *DETERMINISM_RULES, *DURABILITY_RULES, *JAX_RULES,
+    *PROTOCOL_RULES, *PY310_RULES, *RESILIENCE_RULES, *SHARDING_RULES,
 ]
 
 

@@ -16,12 +16,17 @@ ground rule); these rules keep the jit boundary honest:
 - a buffer passed at a **donate_argnums** position is dead after the
   call — reusing it reads deallocated (or aliased-output) memory.
 
-Reachability is per-module and name-based: decorated functions, names
-wrapped by `jax.jit(...)` / `shard_map*(...)` assignments, then a
-call-graph walk over bare-name calls and same-class `self.method()`
-calls. Cross-module reachability is out of scope on purpose — per-module
-keeps the analysis O(file) and false-positive-poor; the jit roots and
-their helpers live together in this codebase (engine/, models/, ops/).
+Reachability rides the whole-repo interprocedural graph (``ctx.repo``,
+tools/graftlint/repograph.py) under STRICT dispatch: jit roots are
+decorated defs plus every def whose bare name any module wraps in
+`jax.jit(...)`/`shard_map(...)` — the module that DEFINES a jitted
+function is usually not the one that jits it (engine/engine.py jits
+models/llama.py's forwards), and with one graph the llama helpers are
+analyzed no matter which file asked. Strict dispatch never guesses an
+unannotated receiver, which keeps "reachable from a jit root"
+false-positive-poor. The sharding-specific rules that used to live here
+moved to the ``sharding`` family (rules/sharding.py) when they went
+interprocedural.
 """
 
 from __future__ import annotations
@@ -49,7 +54,7 @@ def _is_jit_call(call: ast.Call) -> bool:
     return name in _JIT_WRAPPERS or name in _SHMAP_WRAPPERS
 
 
-def _wrapped_bare_name(node: ast.AST) -> str:
+def _wrapped_bare_name_of(node: ast.AST) -> str:
     """The bare function name a jit/shard_map call wraps, seeing through
     `functools.partial(fn, ...)` (the engine's idiom for binding closure
     constants: `jax.jit(functools.partial(_wave_impl, ...))`)."""
@@ -74,104 +79,24 @@ def _is_jit_decorator(dec: ast.AST) -> bool:
     return False
 
 
-class _ModuleGraph:
-    """Per-module function table, jit roots, and reachability.
-
-    `extra_root_names`: bare function names jitted ANYWHERE in the scanned
-    tree — a def with one of those names is a root even if its own module
-    never jits it (engine/engine.py jits `forward_prefill` that
-    models/llama.py defines; llama's helpers must still be analyzed)."""
-
-    def __init__(
-        self, ctx: FileContext, extra_root_names: frozenset[str] = frozenset()
-    ) -> None:
-        # qualified name ("fn" or "Class.method") -> def node
-        self.funcs: dict[str, ast.AST] = {}
-        self.by_bare: dict[str, list[str]] = {}
-        for func, cls in ctx.functions():
-            qual = f"{cls.name}.{func.name}" if cls is not None else func.name
-            self.funcs.setdefault(qual, func)
-            self.by_bare.setdefault(func.name, []).append(qual)
-
-        self.roots: set[str] = set()
-        for func, cls in ctx.functions():
-            if any(_is_jit_decorator(d) for d in getattr(func, "decorator_list", [])) \
-                    or func.name in extra_root_names:
-                qual = f"{cls.name}.{func.name}" if cls is not None else func.name
-                self.roots.add(qual)
-        # jax.jit(fn, ...) / shard_map(fn, ...) value positions anywhere
-        for node in ctx.all_nodes():
-            if isinstance(node, ast.Call) and _is_jit_call(node) and node.args:
-                for qual in self.by_bare.get(_wrapped_bare_name(node.args[0]), []):
-                    self.roots.add(qual)
-
-        self.edges: dict[str, set[str]] = {q: set() for q in self.funcs}
-        for qual, func in self.funcs.items():
-            cls_prefix = qual.rsplit(".", 1)[0] + "." if "." in qual else ""
-            for node in body_walk(func):
-                if not isinstance(node, ast.Call):
-                    continue
-                name = dotted_name(node.func)
-                if not name:
-                    continue
-                if name in self.funcs:
-                    self.edges[qual].add(name)
-                elif "." not in name and name in self.by_bare:
-                    for cand in self.by_bare[name]:
-                        if "." not in cand:
-                            self.edges[qual].add(cand)
-                elif name.startswith(("self.", "cls.")):
-                    meth = cls_prefix + name.split(".", 1)[1]
-                    if meth in self.funcs:
-                        self.edges[qual].add(meth)
-
-        self.reachable: set[str] = set()
-        stack = list(self.roots)
-        while stack:
-            cur = stack.pop()
-            if cur in self.reachable:
-                continue
-            self.reachable.add(cur)
-            stack.extend(self.edges.get(cur, ()))
-
-    def reachable_funcs(self) -> Iterator[tuple[str, ast.AST]]:
-        for qual in sorted(self.reachable):
-            yield qual, self.funcs[qual]
-
-
-_global_jit_names_cache: frozenset[str] | None = None
-
-
-def _global_jit_names() -> frozenset[str]:
-    """Bare names passed to jax.jit/shard_map anywhere in the first-party
-    tree (one cached prepass). Makes cross-module jit roots visible: the
-    module that DEFINES a jitted function is usually not the one that
-    jits it (engine/engine.py jits models/llama.py's forwards)."""
-    global _global_jit_names_cache
-    if _global_jit_names_cache is None:
-        from tools.graftlint.core import iter_repo_files
-
-        names: set[str] = set()
-        for path in iter_repo_files():
-            try:
-                tree = ast.parse(path.read_text())
-            except (SyntaxError, OSError):
-                continue
-            for node in ast.walk(tree):
-                if isinstance(node, ast.Call) and _is_jit_call(node) and node.args:
-                    bare = _wrapped_bare_name(node.args[0])
-                    if bare:
-                        names.add(bare)
-        _global_jit_names_cache = frozenset(names)
-    return _global_jit_names_cache
-
-
-def _graph(ctx: FileContext) -> _ModuleGraph:
-    cached = getattr(ctx, "_jax_graph", None)
-    if cached is None:
-        cached = _ModuleGraph(ctx, extra_root_names=_global_jit_names())
-        ctx._jax_graph = cached
-    return cached
+def jit_reachable_here(ctx: FileContext) -> list[tuple[str, ast.AST]]:
+    """This file's functions (local qual + def node) that the whole-repo
+    graph says are reachable from a jit/shard_map root, memoized per
+    file. The graph walks callees across modules; the AST walk for the
+    actual hazard classification stays local to this file."""
+    cached = getattr(ctx, "_jit_reachable_here", None)
+    if cached is not None:
+        return cached
+    repo = ctx.repo
+    roots = repo.jit_roots()
+    out: list[tuple[str, ast.AST]] = []
+    if roots:
+        reach = repo.reachable(roots, dispatch="strict")
+        for qual, node, _cls in ctx.graph_funcs():
+            if ctx.gqual(qual) in reach:
+                out.append((qual, node))
+    ctx._jit_reachable_here = out
+    return out
 
 
 _HOST_SYNC_METHODS = ("item", "tolist", "numpy", "block_until_ready")
@@ -191,10 +116,7 @@ class HostSyncInJit(LintRule):
     )
 
     def check(self, ctx: FileContext) -> Iterable[Finding]:
-        graph = _graph(ctx)
-        if not graph.roots:
-            return
-        for qual, func in graph.reachable_funcs():
+        for qual, func in jit_reachable_here(ctx):
             for node in body_walk(func):
                 if not isinstance(node, ast.Call):
                     continue
@@ -240,10 +162,7 @@ class ClosureMutationInJit(LintRule):
     )
 
     def check(self, ctx: FileContext) -> Iterable[Finding]:
-        graph = _graph(ctx)
-        if not graph.roots:
-            return
-        for qual, func in graph.reachable_funcs():
+        for qual, func in jit_reachable_here(ctx):
             local = self._local_names(func)
             for node in body_walk(func):
                 if isinstance(node, (ast.Global, ast.Nonlocal)):
@@ -376,7 +295,12 @@ class NonHashableStatic(LintRule):
     )
 
     def check(self, ctx: FileContext) -> Iterable[Finding]:
-        graph = _graph(ctx)
+        # this file's defs by bare name (the wrapped def and its jit wrap
+        # normally share a module; cross-module default-checking is the
+        # repo graph's job and not worth the noise here)
+        by_bare: dict[str, list[ast.AST]] = {}
+        for func, _cls in ctx.functions():
+            by_bare.setdefault(func.name, []).append(func)
         # jitted-name -> (static positions, static names); covers
         # `name = jax.jit(fn, static_argnums=...)` and
         # `self._x = jax.jit(fn, ...)` assignments.
@@ -395,7 +319,8 @@ class NonHashableStatic(LintRule):
                 if tn and (nums or names):
                     jitted[tn] = (nums, names)
             # mutable default on a static parameter of the wrapped fn
-            yield from self._check_defaults(ctx, graph, bare, nums, names, offset)
+            for func in by_bare.get(bare, []):
+                yield from self._check_func_defaults(ctx, func, nums, names, offset)
         # decorated functions: defaults + direct call sites by name
         for func, cls in ctx.functions():
             for dec in getattr(func, "decorator_list", []):
@@ -429,14 +354,6 @@ class NonHashableStatic(LintRule):
                         f"`{kw.arg}` of jitted `{name}` — pass a tuple or a "
                         f"scalar",
                     )
-
-    def _check_defaults(
-        self, ctx, graph, bare, nums, names, offset=0
-    ) -> Iterator[Finding]:
-        for qual in graph.by_bare.get(bare, []):
-            yield from self._check_func_defaults(
-                ctx, graph.funcs[qual], nums, names, offset
-            )
 
     def _check_func_defaults(
         self, ctx, func, nums, names, offset=0
@@ -596,146 +513,6 @@ class DonatedBufferReuse(LintRule):
             )
 
 
-# Names whose presence marks a module as MESH-CONTEXT: it builds or
-# consumes a device mesh, so its jitted programs run under GSPMD and
-# every per-op default is "replicate" unless somebody says otherwise.
-_MESH_MARKERS = frozenset({
-    "Mesh", "NamedSharding", "PartitionSpec", "make_mesh",
-    "mesh_from_config", "shard_map", "shard_params", "build_plane",
-    "kv_cache_spec", "serving_param_specs", "EngineShardings",
-})
-# Calls that constitute sharding evidence inside a traced function.
-_CONSTRAINT_CALLS = frozenset({
-    "with_sharding_constraint", "constrain", "device_put",
-})
-
-
-class UnconstrainedSharding(LintRule):
-    id = "unconstrained-sharding"
-    family = "jax"
-    description = (
-        "a jit root in a mesh-context module whose inputs never see a "
-        "sharding constraint — GSPMD defaults every unconstrained "
-        "intermediate to replicated, silently serializing the tp mesh"
-    )
-
-    def check(self, ctx: FileContext) -> Iterable[Finding]:
-        # Runtime modules only (+ the fixture corpus): tests/tools jit
-        # abstract shapes whose shardings ride in ShapeDtypeStructs the
-        # AST cannot see.
-        if not _loop_scope(ctx.name):
-            return
-        if not self._mesh_context(ctx):
-            return
-        graph = _graph(ctx)
-        if not graph.roots:
-            return
-        # Local jit call sites: in_/out_shardings kwargs, or a
-        # functools.partial binding a sharding bundle by keyword
-        # (`jax.jit(functools.partial(_impl, shardings=...))` — the
-        # engine's idiom) are constraint evidence for the wrapped name.
-        constrained: set[str] = set()
-        sites: dict[str, ast.Call] = {}
-        for node in ctx.all_nodes():
-            if isinstance(node, ast.Call) and _is_jit_call(node) and node.args:
-                bare = _wrapped_bare_name(node.args[0])
-                if not bare:
-                    continue
-                if self._site_constrained(node):
-                    constrained.add(bare)
-                else:
-                    sites.setdefault(bare, node)
-        for qual in sorted(graph.roots):
-            bare = qual.rsplit(".", 1)[-1]
-            if bare in constrained:
-                continue
-            if self._reaches_constraint(graph, qual):
-                continue
-            site = sites.get(bare, graph.funcs[qual])
-            yield ctx.finding(
-                self, site,
-                f"jit root `{qual}` in a mesh-context module never "
-                f"constrains a sharding (no with_sharding_constraint/"
-                f"constrain/device_put reachable, no in_/out_shardings, "
-                f"no bound sharding bundle) — GSPMD will replicate every "
-                f"input across the mesh; thread an EngineShardings bundle "
-                f"or justify via pragma",
-            )
-
-    @staticmethod
-    def _mesh_context(ctx: FileContext) -> bool:
-        for node in ctx.all_nodes():
-            if isinstance(node, ast.ImportFrom):
-                if any(a.name in _MESH_MARKERS for a in node.names):
-                    return True
-            elif isinstance(node, (ast.Name, ast.Attribute)):
-                name = dotted_name(node)
-                if name and name.rsplit(".", 1)[-1] in _MESH_MARKERS:
-                    return True
-        return False
-
-    @staticmethod
-    def _site_constrained(call: ast.Call) -> bool:
-        if any(
-            kw.arg in ("in_shardings", "out_shardings", "in_specs", "out_specs")
-            for kw in call.keywords
-        ):
-            return True
-        wrapped = call.args[0]
-        if isinstance(wrapped, ast.Call) and dotted_name(wrapped.func) in (
-            "partial", "functools.partial",
-        ):
-            return any(
-                kw.arg and "shard" in kw.arg for kw in wrapped.keywords
-            )
-        return False
-
-    @staticmethod
-    def _reaches_constraint(graph: _ModuleGraph, root: str) -> bool:
-        seen: set[str] = set()
-        stack = [root]
-        while stack:
-            cur = stack.pop()
-            if cur in seen:
-                continue
-            seen.add(cur)
-            func = graph.funcs.get(cur)
-            if func is None:
-                continue
-            for node in body_walk(func):
-                if not isinstance(node, ast.Call):
-                    continue
-                name = dotted_name(node.func)
-                if not name:
-                    continue
-                if name.rsplit(".", 1)[-1] in _CONSTRAINT_CALLS:
-                    return True
-                # method call on a sharding bundle: shardings.kv5(x)
-                if "shard" in name.split(".", 1)[0]:
-                    return True
-            stack.extend(graph.edges.get(cur, ()))
-        return False
-
-
-# The persistent serving plane's ZERO-DISPATCH steady-state contract
-# (engine/persistent/): once the resident loop is launched, every
-# per-decision interaction is ring traffic — numpy in, numpy out. A
-# function is a declared steady-path function when its name ends in
-# `_steady` (the feeder/harvester naming convention server.py
-# established) or is one of the ordered-io_callback bodies; anything
-# reachable from one inside its module is on the steady path too.
-_STEADY_CALLBACK_NAMES = frozenset({"_device_poll", "_device_push"})
-
-
-def _steady_roots(graph: _ModuleGraph) -> set[str]:
-    return {
-        qual
-        for qual in graph.funcs
-        if qual.rsplit(".", 1)[-1].endswith("_steady")
-        or qual.rsplit(".", 1)[-1] in _STEADY_CALLBACK_NAMES
-    }
-
-
 class DispatchInPersistentPath(LintRule):
     id = "dispatch-in-persistent-path"
     family = "jax"
@@ -746,12 +523,29 @@ class DispatchInPersistentPath(LintRule):
         "dispatches"
     )
 
+    # The persistent serving plane's ZERO-DISPATCH steady-state contract
+    # (engine/persistent/): once the resident loop is launched, every
+    # per-decision interaction is ring traffic — numpy in, numpy out. A
+    # function is a declared steady-path function when its name ends in
+    # `_steady` (the feeder/harvester naming convention server.py
+    # established) or is one of the ordered-io_callback bodies; anything
+    # the repo graph says is reachable from one (strict dispatch, across
+    # modules now) is on the steady path too.
+
     def check(self, ctx: FileContext) -> Iterable[Finding]:
         if not _loop_scope(ctx.name):
             return
-        graph = _graph(ctx)
-        steady = _steady_roots(graph)
+        repo = ctx.repo
+        steady = repo.steady_roots()
         if not steady:
+            return
+        reach = repo.reachable(steady, dispatch="strict")
+        on_path = [
+            (qual, node)
+            for qual, node, _cls in ctx.graph_funcs()
+            if ctx.gqual(qual) in reach
+        ]
+        if not on_path:
             return
         # `name = jax.jit(...)` assignment targets anywhere in the module
         # (`self._jitted = jax.jit(...)`): calling one re-enters the
@@ -764,19 +558,13 @@ class DispatchInPersistentPath(LintRule):
                     tn = dotted_name(t)
                     if tn:
                         jitted_names.add(tn)
-        reachable: set[str] = set()
-        stack = list(steady)
-        while stack:
-            cur = stack.pop()
-            if cur in reachable:
-                continue
-            reachable.add(cur)
-            stack.extend(graph.edges.get(cur, ()))
-        for qual in sorted(reachable):
-            for node in body_walk(graph.funcs[qual]):
+        jit_roots = repo.jit_roots()
+        for qual, func in on_path:
+            g = ctx.gqual(qual)
+            for node in body_walk(func):
                 if not isinstance(node, ast.Call):
                     continue
-                msg = self._classify(node, graph, jitted_names)
+                msg = self._classify(node, repo, g, jitted_names, jit_roots)
                 if msg:
                     yield ctx.finding(
                         self, node,
@@ -791,7 +579,8 @@ class DispatchInPersistentPath(LintRule):
 
     @staticmethod
     def _classify(
-        call: ast.Call, graph: _ModuleGraph, jitted_names: set[str]
+        call: ast.Call, repo, caller_g: str, jitted_names: set[str],
+        jit_roots: frozenset[str],
     ) -> str | None:
         if isinstance(call.func, ast.Attribute) \
                 and call.func.attr == "block_until_ready":
@@ -804,9 +593,11 @@ class DispatchInPersistentPath(LintRule):
         head = name.split(".", 1)[0]
         if head in ("jax", "jnp"):
             return f"XLA dispatch `{name}(...)`"
-        bare = name.rsplit(".", 1)[-1]
-        for qual in graph.by_bare.get(bare, ()):
-            if qual in graph.roots:
+        # a strictly-resolved callee that is itself a jit root re-enters
+        # the dispatch path by name
+        for callee in repo.resolve_call(caller_g, name, dispatch="strict"):
+            if callee in jit_roots:
+                bare = name.rsplit(".", 1)[-1]
                 return f"call to jit-rooted `{bare}`"
         return None
 
@@ -817,6 +608,5 @@ JAX_RULES: list[LintRule] = [
     NonHashableStatic(),
     DeviceSyncInLoop(),
     DonatedBufferReuse(),
-    UnconstrainedSharding(),
     DispatchInPersistentPath(),
 ]

@@ -1,0 +1,167 @@
+"""Protocol rule family: typestate-style call-graph contracts.
+
+Two of the repo's safety protocols are "if you do A you must also do B"
+shapes that no per-file lint can see:
+
+- **swap-without-epoch-bump**: swapping serving parameters
+  (`swap_params` / `swap_engine_params`) invalidates every cached
+  decision and every pinned prefix-KV snapshot. The coherence story
+  (decision-cache generation, `prefix_epoch`, kvplane generation) only
+  holds if every path that reaches a swap sink ALSO reaches bump
+  evidence — a `bump_generation(...)` call or an augmented assignment
+  to an epoch/generation counter. A swap path with no bump serves
+  stale-model decisions from a warm cache: no crash, wrong answers.
+- **bind-without-fence-check**: the lease-fencing protocol
+  (fleet/lease.py, sched/journal.py) demands that a binder verify
+  ownership (`check_fence`/`owns`) before the bind POST; a bind with
+  no reachable fence check is exactly the zombie-scheduler double-bind
+  the fences exist to prevent.
+
+Both rules run under BARE dispatch deliberately — the generous linking
+polarity is SAFE here, because reaching MORE functions can only find
+more evidence and suppress a finding, never create one. (The jax
+family's reachability runs strict for the same reason in reverse.)
+Evidence search also seeds the lexical parent chain: a nested
+`install()` closure runs inside `swap_to`'s contract, so a bump in the
+enclosing function counts for the closure.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from tools.graftlint.core import (
+    FileContext,
+    Finding,
+    LintRule,
+    body_walk,
+    dotted_name,
+)
+from tools.graftlint.rules.jaxpurity import _loop_scope
+
+_SWAP_SINKS = frozenset({"swap_params", "swap_engine_params"})
+_BUMP_CALLS = frozenset({"bump_generation"})
+_BUMP_ATTRS = frozenset({"prefix_epoch", "generation", "epoch", "_generation"})
+
+_BIND_SINKS = frozenset({"bind_pod_to_node"})
+_FENCE_CALLS = frozenset({
+    "check_fence", "owns", "_owns", "_store_fence", "_verify",
+})
+
+
+def _entry_bumps(entry) -> bool:
+    if any(a in _BUMP_ATTRS for a in entry.aug_attrs):
+        return True
+    return any(
+        c["n"].rsplit(".", 1)[-1] in _BUMP_CALLS for c in entry.calls
+    )
+
+
+def _entry_fences(entry) -> bool:
+    return any(
+        c["n"].rsplit(".", 1)[-1] in _FENCE_CALLS for c in entry.calls
+    )
+
+
+class SwapWithoutEpochBump(LintRule):
+    id = "swap-without-epoch-bump"
+    family = "protocol"
+    description = (
+        "a path reaching a swap_params-class sink with no reachable "
+        "generation/epoch bump — caches keep serving the old model's "
+        "decisions"
+    )
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        if not _loop_scope(ctx.name):
+            return
+        repo = ctx.repo
+        for qual, func, _cls in ctx.graph_funcs():
+            # the sink's own implementation is not a "path to the sink" —
+            # `InferenceEngine.swap_params` bumping prefix_epoch inside
+            # itself is the protocol working, not a caller to audit
+            if qual.rsplit(".", 1)[-1] in _SWAP_SINKS:
+                continue
+            for node in body_walk(func):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = dotted_name(node.func)
+                if not name or name.rsplit(".", 1)[-1] not in _SWAP_SINKS:
+                    continue
+                # bare dispatch + the lexical parent chain: evidence
+                # anywhere the swap path can reach counts — including
+                # the swap sink's own body (engine.swap_params bumps
+                # prefix_epoch internally; callers of THAT are safe)
+                if repo.reaches(
+                    ctx.gqual(qual), _entry_bumps,
+                    dispatch="bare", include_enclosing=True,
+                ):
+                    continue
+                yield ctx.finding(
+                    self, node,
+                    f"`{name}(...)` in `{qual}` swaps serving params but "
+                    f"no generation/epoch bump is reachable from this "
+                    f"path (no bump_generation call, no "
+                    f"prefix_epoch/generation += 1) — decision caches and "
+                    f"pinned prefix KV keep serving the OLD model; bump "
+                    f"every generation the swap invalidates, or justify "
+                    f"via pragma",
+                )
+
+
+class BindWithoutFenceCheck(LintRule):
+    id = "bind-without-fence-check"
+    family = "protocol"
+    description = (
+        "a binder path reaching the bind POST with no reachable lease "
+        "fence check — the zombie-scheduler double-bind the fences "
+        "exist to prevent"
+    )
+
+    # The fencing protocol is a fleet/sched-plane contract; engine code
+    # never binds pods. Fixtures stand in for binder modules.
+    _SCOPES = (
+        "k8s_llm_scheduler_tpu/fleet/",
+        "k8s_llm_scheduler_tpu/sched/",
+    )
+
+    def _in_scope(self, name: str) -> bool:
+        if any(name.startswith(s) for s in self._SCOPES):
+            return True
+        return "fixtures/graftlint" in name
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        if not self._in_scope(ctx.name):
+            return
+        repo = ctx.repo
+        for qual, func, _cls in ctx.graph_funcs():
+            for node in body_walk(func):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = dotted_name(node.func)
+                if not name or name.rsplit(".", 1)[-1] not in _BIND_SINKS:
+                    continue
+                # bare dispatch: `self._binder.bind_pod_to_node(...)`
+                # links to every bind_pod_to_node impl, including the
+                # fenced wrapper whose body holds the check — an
+                # UNfenced call chain finds no evidence anywhere
+                if repo.reaches(
+                    ctx.gqual(qual), _entry_fences,
+                    dispatch="bare", include_enclosing=True,
+                ):
+                    continue
+                yield ctx.finding(
+                    self, node,
+                    f"`{name}(...)` in `{qual}` reaches the bind POST "
+                    f"with no lease fence check reachable (no "
+                    f"check_fence/owns on any path) — a deposed "
+                    f"scheduler can double-bind a pod; route the bind "
+                    f"through the fenced binder, or justify via pragma",
+                )
+
+
+PROTOCOL_RULES: list[LintRule] = [
+    SwapWithoutEpochBump(),
+    BindWithoutFenceCheck(),
+]
